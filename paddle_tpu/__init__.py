@@ -1,0 +1,27 @@
+"""paddle_tpu — a TPU-native deep-learning framework.
+
+A from-scratch rebuild of the capabilities of PaddlePaddle v0 (the 2016
+layer-graph framework) designed for TPU hardware: layers are pure functions
+over jax arrays, the gradient machine is a jit-compiled train step, and
+distribution is SPMD over a `jax.sharding.Mesh` (ICI collectives) instead of
+a socket parameter-server.
+
+Public surface (mirrors the roles of the reference's python/paddle +
+paddle/api, see /root/reference SURVEY):
+
+- ``paddle_tpu.trainer_config_helpers`` — the user-facing config DSL
+  (``fc_layer``, ``lstmemory``, ``recurrent_group``, ``settings`` ...).
+- ``paddle_tpu.config`` — ``parse_config`` turning a user config script into
+  a ``TrainerConfig``.
+- ``paddle_tpu.graph`` — ``GradientMachine``: compiles a ``ModelConfig``
+  into jitted forward/backward functions.
+- ``paddle_tpu.trainer`` — the training driver (pass/batch loops,
+  checkpointing, evaluation).
+- ``paddle_tpu.parallel`` — device mesh, SPMD train-step sharding,
+  collectives, ring attention.
+- ``paddle_tpu.data`` — the ``@provider`` data ingestion contract.
+"""
+
+from paddle_tpu.version import __version__
+
+__all__ = ["__version__"]

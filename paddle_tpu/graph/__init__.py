@@ -1,0 +1,5 @@
+from paddle_tpu.graph.argument import Argument, make_dense, make_ids, make_seq
+from paddle_tpu.graph.network import Network
+from paddle_tpu.graph.machine import GradientMachine
+
+__all__ = ["Argument", "make_dense", "make_ids", "make_seq", "Network", "GradientMachine"]

@@ -1,0 +1,106 @@
+"""Argument — the universal inter-layer value type.
+
+TPU-native redesign of the reference's ``Argument``
+(/root/reference/paddle/parameter/Argument.h:32): there, a batch is a
+ragged concatenation of variable-length sequences with
+``sequenceStartPositions`` / ``subSequenceStartPositions`` index vectors and
+no padding. XLA wants static shapes, so here a batch is a **padded dense
+array plus a lengths vector**; masking (not ragged indexing) removes the
+padding's influence. Nested (sub-)sequences get a second padded axis.
+
+Shapes:
+- non-sequence:   value [B, D]            ids [B]
+- sequence:       value [B, T, D]         ids [B, T]        seq_lengths [B]
+- nested seq:     value [B, S, T, D]      ids [B, S, T]     sub_seq_lengths [B, S]
+                  (seq_lengths [B] = number of valid subsequences per sample)
+
+All fields are optional; a layer populates what it produces. ``Argument``
+is a pytree so whole batches flow through jit/pjit/scan.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+Array = jax.Array
+
+
+@struct.dataclass
+class Argument:
+    value: Optional[Array] = None
+    ids: Optional[Array] = None
+    seq_lengths: Optional[Array] = None       # int32 [B]
+    sub_seq_lengths: Optional[Array] = None   # int32 [B, S]
+    # per-sample weight (reference: Argument::weight used by cost layers)
+    weight: Optional[Array] = None
+
+    # ---- static-shape helpers -------------------------------------------
+
+    @property
+    def is_seq(self) -> bool:
+        return self.seq_lengths is not None
+
+    @property
+    def is_nested_seq(self) -> bool:
+        return self.sub_seq_lengths is not None
+
+    @property
+    def batch_size(self) -> int:
+        ref = self.value if self.value is not None else self.ids
+        assert ref is not None, "empty Argument"
+        return ref.shape[0]
+
+    @property
+    def max_len(self) -> int:
+        ref = self.value if self.value is not None else self.ids
+        assert ref is not None and ref.ndim >= 2
+        return ref.shape[1]
+
+    def seq_mask(self, dtype=jnp.float32) -> Array:
+        """[B, T] mask of valid timesteps (1 inside the sequence)."""
+        assert self.seq_lengths is not None
+        ref = self.value if self.value is not None else self.ids
+        T = ref.shape[1]
+        pos = jnp.arange(T, dtype=jnp.int32)[None, :]
+        return (pos < self.seq_lengths[:, None]).astype(dtype)
+
+    def sub_seq_mask(self, dtype=jnp.float32) -> Array:
+        """[B, S, T] mask for nested sequences."""
+        assert self.sub_seq_lengths is not None
+        ref = self.value if self.value is not None else self.ids
+        T = ref.shape[2]
+        pos = jnp.arange(T, dtype=jnp.int32)[None, None, :]
+        return (pos < self.sub_seq_lengths[:, :, None]).astype(dtype)
+
+def make_dense(value: Array, weight: Optional[Array] = None) -> Argument:
+    return Argument(value=jnp.asarray(value), weight=weight)
+
+
+def make_ids(ids: Array) -> Argument:
+    return Argument(ids=jnp.asarray(ids, dtype=jnp.int32))
+
+
+def make_seq(value: Optional[Array], lengths: Array, ids: Optional[Array] = None) -> Argument:
+    return Argument(
+        value=None if value is None else jnp.asarray(value),
+        ids=None if ids is None else jnp.asarray(ids, dtype=jnp.int32),
+        seq_lengths=jnp.asarray(lengths, dtype=jnp.int32),
+    )
+
+
+def degrade_sequence(arg: Argument) -> Argument:
+    """Nested sequence → plain sequence over subsequences.
+
+    Reference semantics (`Argument::degradeSequence`,
+    /root/reference/paddle/parameter/Argument.cpp:513): treat each
+    subsequence as one unit. Here [B, S, T, D] stays put; the caller uses
+    ``sub_seq_lengths`` directly — this helper just strips nesting metadata
+    for layers that operate per-subsequence after a reduction over T.
+    """
+    return Argument(
+        value=arg.value, ids=arg.ids, seq_lengths=arg.seq_lengths, weight=arg.weight
+    )

@@ -1,0 +1,72 @@
+"""Network — topological execution of a layer graph.
+
+TPU-native replacement for the reference's ``NeuralNetwork``
+(/root/reference/paddle/gserver/gradientmachines/NeuralNetwork.cpp:230,279):
+there, stateful Layer objects run hand-written forward then reverse-order
+backward; here the whole walk happens inside a traced function, jax.grad
+derives the backward, and XLA fuses across layer boundaries.
+
+Sub-models: a ``recurrent_layer_group`` layer in the parent list hands off
+to the recurrent-group executor (paddle_tpu.graph.recurrent_group), the
+analog of RecurrentGradientMachine.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+
+from paddle_tpu.graph.argument import Argument
+from paddle_tpu.layers.base import LayerContext, forward_layer
+from paddle_tpu.proto import LayerConfig, ModelConfig, SubModelConfig
+
+
+class Network:
+    """Executable view of (a sub-model of) a ModelConfig."""
+
+    def __init__(self, model: ModelConfig, submodel: Optional[SubModelConfig] = None):
+        self.model = model
+        self.layer_map: Dict[str, LayerConfig] = {l.name: l for l in model.layers}
+        self.submodel_map: Dict[str, SubModelConfig] = {s.name: s for s in model.sub_models}
+        if submodel is None and model.sub_models:
+            submodel = self.submodel_map.get("root")
+        self.submodel = submodel
+        if submodel is not None:
+            names = list(submodel.layer_names)
+        else:
+            names = [l.name for l in model.layers]
+        self.layers: List[LayerConfig] = [self.layer_map[n] for n in names]
+        if submodel is not None:
+            self.input_layer_names = list(submodel.input_layer_names)
+            self.output_layer_names = list(submodel.output_layer_names)
+        else:
+            self.input_layer_names = list(model.input_layer_names)
+            self.output_layer_names = list(model.output_layer_names)
+
+    def forward(self, ctx: LayerContext, in_args: Dict[str, Argument]) -> Dict[str, Argument]:
+        """Run all layers; returns ctx.outputs (every layer's output)."""
+        for cfg in self.layers:
+            if cfg.name in ctx.outputs:
+                continue
+            if cfg.type == "data":
+                if cfg.name not in in_args:
+                    raise KeyError(f"no data fed for input layer {cfg.name!r}")
+                forward_layer(cfg, [in_args[cfg.name]], ctx)
+            elif cfg.type == "recurrent_layer_group":
+                from paddle_tpu.graph.recurrent_group import forward_recurrent_group
+
+                forward_recurrent_group(self, cfg, ctx)
+            else:
+                ins = [self._lookup_input(ctx, ic.input_layer_name, ic.input_layer_argument)
+                       for ic in cfg.inputs]
+                forward_layer(cfg, ins, ctx)
+        return ctx.outputs
+
+    def _lookup_input(self, ctx: LayerContext, name: str, arg_name: str = "") -> Argument:
+        key = f"{name}@{arg_name}" if arg_name else name
+        if key not in ctx.outputs:
+            raise KeyError(
+                f"layer output {key!r} not available; computed: {sorted(ctx.outputs)}"
+            )
+        return ctx.outputs[key]

@@ -1,0 +1,26 @@
+"""Recurrent-group executor (analog of RecurrentGradientMachine).
+
+Compiles a recurrent sub-model (/root/reference/paddle/gserver/
+gradientmachines/RecurrentGradientMachine.cpp) into a ``lax.scan`` over the
+padded time axis: scatter/gather agents become per-step slices, memory
+links become scan carries, and generation becomes greedy/beam search under
+``lax.while_loop`` (see paddle_tpu.ops.beam_search).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.graph.argument import Argument
+from paddle_tpu.layers.base import LayerContext
+from paddle_tpu.proto import LayerConfig
+
+
+def forward_recurrent_group(network, cfg: LayerConfig, ctx: LayerContext) -> None:
+    raise NotImplementedError(
+        "recurrent_layer_group execution lands with the sequence-machinery "
+        "stage (SURVEY.md §7 step 6)"
+    )

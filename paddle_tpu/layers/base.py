@@ -1,0 +1,121 @@
+"""Layer forward framework.
+
+TPU-native replacement for the reference's ``Layer`` base
+(/root/reference/paddle/gserver/layers/Layer.h:58): instead of stateful
+objects with hand-written forward/backward over Matrix, a layer is a pure
+function ``(LayerConfig, [Argument], LayerContext) -> Argument``. Backward
+comes from jax.grad of the whole graph; bias/activation/dropout
+post-processing is shared here (mirroring Layer::forwardActivation /
+backwardActivation semantics, including dropout after activation).
+"""
+
+from __future__ import annotations
+
+import zlib
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.graph.argument import Argument
+from paddle_tpu.ops.activations import apply_activation
+from paddle_tpu.proto import LayerConfig, ModelConfig
+from paddle_tpu.utils.error import layer_scope
+from paddle_tpu.utils.registry import Registry
+
+Array = jax.Array
+
+LayerFn = Callable[[LayerConfig, List[Argument], "LayerContext"], Argument]
+layer_registry: Registry[LayerFn] = Registry("layer type")
+
+
+def register_layer(*type_names: str):
+    return layer_registry.register(*type_names)
+
+
+@dataclass
+class LayerContext:
+    """Mutable context threaded through one network forward pass.
+
+    Carries everything the reference's Layer pulled from its members:
+    parameter store, pass type, rng, sibling outputs, and (for batch-norm
+    style layers) read/write running state.
+    """
+
+    params: Dict[str, Array]
+    model: ModelConfig
+    pass_type: str = "train"                    # train | test | gen
+    rng: Optional[Array] = None
+    states: Dict[str, Any] = field(default_factory=dict)
+    state_updates: Dict[str, Any] = field(default_factory=dict)
+    outputs: Dict[str, Argument] = field(default_factory=dict)
+    dtype: Any = jnp.float32
+
+    @property
+    def is_training(self) -> bool:
+        return self.pass_type == "train"
+
+    def param(self, name: str) -> Array:
+        try:
+            return self.params[name]
+        except KeyError:
+            known = ", ".join(sorted(self.params))
+            raise KeyError(f"parameter {name!r} not found (have: {known})") from None
+
+    def layer_rng(self, layer_name: str, salt: str = "") -> Array:
+        assert self.rng is not None, "LayerContext.rng not set but layer needs randomness"
+        return jax.random.fold_in(self.rng, zlib.crc32(f"{layer_name}/{salt}".encode()))
+
+
+def input_mask(arg: Argument) -> Optional[Array]:
+    """[B, T] float validity mask if arg is a sequence, else None."""
+    if arg.is_nested_seq:
+        return arg.sub_seq_mask()
+    if arg.is_seq:
+        return arg.seq_mask()
+    return None
+
+
+def finalize_output(
+    cfg: LayerConfig,
+    value: Array,
+    ctx: LayerContext,
+    mask: Optional[Array] = None,
+) -> Array:
+    """Shared bias + activation + dropout tail of a layer forward."""
+    if cfg.bias_parameter_name:
+        value = value + ctx.param(cfg.bias_parameter_name)
+    value = apply_activation(cfg.active_type, value, mask)
+    if cfg.drop_rate > 0.0 and ctx.is_training:
+        keep = 1.0 - cfg.drop_rate
+        rng = ctx.layer_rng(cfg.name, "dropout")
+        m = jax.random.bernoulli(rng, keep, value.shape)
+        # inverted dropout (scale at train time) — reference scales at train
+        # time too (Layer.cpp forwardDropOut divides by (1 - drop_rate)).
+        value = jnp.where(m, value / keep, 0.0)
+    return value
+
+
+def forward_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Argument:
+    fn = layer_registry.get(cfg.type)
+    with layer_scope(f"{cfg.name}({cfg.type})"):
+        out = fn(cfg, inputs, ctx)
+    ctx.outputs[cfg.name] = out
+    return out
+
+
+def first_seq_meta(inputs: List[Argument]) -> Argument:
+    """Propagate sequence metadata from the first sequence input."""
+    for a in inputs:
+        if a.is_seq or a.is_nested_seq:
+            return a
+    return inputs[0] if inputs else Argument()
+
+
+def with_seq_meta(template: Argument, value: Array) -> Argument:
+    return Argument(
+        value=value,
+        seq_lengths=template.seq_lengths,
+        sub_seq_lengths=template.sub_seq_lengths,
+    )

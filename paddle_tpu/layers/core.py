@@ -1,0 +1,228 @@
+"""Core layers: data, fc, mixed (projections/operators), concat, addto...
+
+Reference counterparts live in /root/reference/paddle/gserver/layers/
+(DataLayer.cpp, FullyConnectedLayer.cpp, MixedLayer.cpp, Projection.h
+subtypes, ConcatenateLayer.cpp, AddtoLayer.cpp, MaxIdLayer.cpp,
+TransLayer.cpp, TensorLayer.cpp, ParameterReluLayer.cpp). All matmuls hit
+the MXU via jnp.dot/einsum; sequence inputs are padded [B, T, D] and the
+matmul batches over B*T.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.graph.argument import Argument
+from paddle_tpu.layers.base import (
+    LayerContext,
+    finalize_output,
+    first_seq_meta,
+    input_mask,
+    register_layer,
+    with_seq_meta,
+)
+from paddle_tpu.proto import LayerConfig, LayerInputConfig, ProjectionConfig
+
+Array = jax.Array
+
+
+@register_layer("data")
+def data_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Argument:
+    # DataLayer (ref: DataLayer.cpp): passes through the fed Argument.
+    assert len(inputs) == 1, f"data layer {cfg.name} not fed"
+    return inputs[0]
+
+
+@register_layer("fc")
+def fc_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Argument:
+    # ref: FullyConnectedLayer.cpp — sum_i x_i @ W_i (+ bias, act).
+    acc: Optional[Array] = None
+    for in_cfg, arg in zip(cfg.inputs, inputs):
+        w = ctx.param(in_cfg.input_parameter_name)
+        y = jnp.dot(arg.value, w)
+        acc = y if acc is None else acc + y
+    meta = first_seq_meta(inputs)
+    out = finalize_output(cfg, acc, ctx, input_mask(meta))
+    return with_seq_meta(meta, out)
+
+
+# ----------------------------------------------------------- projections
+
+
+def _context_projection(p: ProjectionConfig, arg: Argument, w: Optional[Array]) -> Array:
+    """Sliding-window concat of neighboring timesteps.
+
+    ref: ContextProjection.cpp + hl_sequence context ops. For offset o in
+    [context_start, context_start + context_length), timestep t contributes
+    input[t + o]; out-of-sequence offsets read zeros or trainable padding
+    rows (w: [|start| + max(0, start+len-1), input_size]).
+    """
+    x = arg.value  # [B, T, D]
+    B, T, D = x.shape
+    cols = []
+    begin_pad = max(0, -p.context_start)
+    for k in range(p.context_length):
+        off = p.context_start + k
+        shifted = jnp.roll(x, -off, axis=1)
+        pos = jnp.arange(T)[None, :] + off
+        if arg.seq_lengths is not None:
+            valid = (pos >= 0) & (pos < arg.seq_lengths[:, None])
+        else:
+            valid = (pos >= 0) & (pos < T)
+        col = jnp.where(valid[:, :, None], shifted, 0.0)
+        if w is not None:
+            # trainable padding: before-sequence offsets use pad rows
+            # [0, begin_pad); after-sequence use rows [begin_pad, ...).
+            if off < 0:
+                pad_row = w[begin_pad + off]  # rows 0..begin_pad-1
+                col = jnp.where((pos < 0)[:, :, None], pad_row[None, None, :], col)
+            elif off > 0:
+                lengths = (
+                    arg.seq_lengths[:, None]
+                    if arg.seq_lengths is not None
+                    else jnp.full((B, 1), T)
+                )
+                over = pos - lengths  # 0-based index past the end
+                over_c = jnp.clip(over, 0, w.shape[0] - begin_pad - 1)
+                pad_rows = w[begin_pad + over_c]  # [B, T, D]
+                col = jnp.where((over >= 0)[:, :, None], pad_rows, col)
+        cols.append(col)
+    return jnp.concatenate(cols, axis=-1)
+
+
+def apply_projection(
+    p: ProjectionConfig, in_cfg: LayerInputConfig, arg: Argument, ctx: LayerContext
+) -> Array:
+    t = p.type
+    pname = in_cfg.input_parameter_name
+    if t == "identity":
+        return arg.value
+    if t == "identity_offset":
+        return jax.lax.slice_in_dim(arg.value, p.offset, p.offset + p.output_size, axis=-1)
+    if t == "dot_mul":
+        return arg.value * ctx.param(pname)
+    if t == "table":
+        table = ctx.param(pname)  # [vocab, dim]
+        return jnp.take(table, arg.ids, axis=0)
+    if t == "fc":  # FullMatrixProjection
+        return jnp.dot(arg.value, ctx.param(pname))
+    if t == "trans_fc":  # TransposedFullMatrixProjection
+        return jnp.dot(arg.value, ctx.param(pname).T)
+    if t == "context":
+        w = ctx.param(pname) if pname else None
+        return _context_projection(p, arg, w)
+    raise NotImplementedError(f"projection type {t!r}")
+
+
+@register_layer("mixed")
+def mixed_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Argument:
+    # ref: MixedLayer.cpp — sum of per-input projections plus operators.
+    acc: Optional[Array] = None
+    for in_cfg, arg in zip(cfg.inputs, inputs):
+        if in_cfg.proj_conf is None:
+            continue  # operator-only input
+        y = apply_projection(in_cfg.proj_conf, in_cfg, arg, ctx)
+        acc = y if acc is None else acc + y
+    for op in cfg.operator_confs:
+        op_ins = [inputs[i] for i in op.input_indices]
+        if op.type == "dot_mul":
+            y = op.dotmul_scale * op_ins[0].value * op_ins[1].value
+        elif op.type == "conv":
+            from paddle_tpu.layers.vision import conv_operator_forward
+
+            y = conv_operator_forward(op, op_ins)
+        else:
+            raise NotImplementedError(f"operator type {op.type!r}")
+        acc = y if acc is None else acc + y
+    meta = first_seq_meta(inputs)
+    out = finalize_output(cfg, acc, ctx, input_mask(meta))
+    return with_seq_meta(meta, out)
+
+
+@register_layer("addto")
+def addto_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Argument:
+    acc = inputs[0].value
+    for a in inputs[1:]:
+        acc = acc + a.value
+    meta = first_seq_meta(inputs)
+    return with_seq_meta(meta, finalize_output(cfg, acc, ctx, input_mask(meta)))
+
+
+@register_layer("concat")
+def concat_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Argument:
+    out = jnp.concatenate([a.value for a in inputs], axis=-1)
+    meta = first_seq_meta(inputs)
+    return with_seq_meta(meta, finalize_output(cfg, out, ctx, input_mask(meta)))
+
+
+@register_layer("tensor")
+def tensor_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Argument:
+    # ref: TensorLayer.cpp — out_k = x @ W_k @ y^T diag; out[:, k] = sum_ij
+    # x_i W^k_ij y_j. Parameter per slice: [in1, in2] stacked as
+    # [in1, size*in2] in the reference; we store [size, in1, in2].
+    x, y = inputs[0].value, inputs[1].value
+    w = ctx.param(cfg.inputs[0].input_parameter_name)
+    if w.ndim == 2:  # stored flat [in1, size*in2]
+        w = w.reshape(x.shape[-1], cfg.size, y.shape[-1]).transpose(1, 0, 2)
+    out = jnp.einsum("...i,kij,...j->...k", x, w, y)
+    meta = first_seq_meta(inputs)
+    return with_seq_meta(meta, finalize_output(cfg, out, ctx, input_mask(meta)))
+
+
+@register_layer("prelu")
+def prelu_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Argument:
+    # ref: ParameterReluLayer.cpp — per-partition leaky slope.
+    x = inputs[0].value
+    w = ctx.param(cfg.inputs[0].input_parameter_name)  # [size / partial_sum]
+    slope = jnp.repeat(w, cfg.partial_sum)
+    out = jnp.where(x > 0, x, x * slope)
+    meta = first_seq_meta(inputs)
+    return with_seq_meta(meta, out)
+
+
+@register_layer("maxid")
+def maxid_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Argument:
+    # ref: MaxIdLayer.cpp — argmax over features → ids.
+    a = inputs[0]
+    ids = jnp.argmax(a.value, axis=-1).astype(jnp.int32)
+    return Argument(
+        ids=ids,
+        value=jnp.max(a.value, axis=-1, keepdims=True),
+        seq_lengths=a.seq_lengths,
+        sub_seq_lengths=a.sub_seq_lengths,
+    )
+
+
+@register_layer("eos_id")
+def eos_id_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Argument:
+    # ref: EosIdCheckLayer.cpp — 1.0 where input id == eos_id.
+    a = inputs[0]
+    out = (a.ids == cfg.eos_id).astype(ctx.dtype)[..., None]
+    return Argument(value=out, seq_lengths=a.seq_lengths, sub_seq_lengths=a.sub_seq_lengths)
+
+
+@register_layer("trans")
+def trans_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Argument:
+    # ref: TransLayer.cpp — transpose the (batch, feature) matrix; only
+    # meaningful for non-sequence 2-D use (weight visualization etc.).
+    return Argument(value=inputs[0].value.T)
+
+
+@register_layer("get_output")
+def get_output_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Argument:
+    # ref: GetOutputLayer.cpp — selects a named output of the input layer;
+    # our layers have a single output so this is identity.
+    return inputs[0]
+
+
+@register_layer("sampling_id")
+def sampling_id_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Argument:
+    # ref: SamplingIdLayer.cpp — sample an id from each row's distribution.
+    a = inputs[0]
+    rng = ctx.layer_rng(cfg.name, "sample")
+    logits = jnp.log(jnp.clip(a.value, 1e-20, None))
+    ids = jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+    return Argument(ids=ids, seq_lengths=a.seq_lengths, sub_seq_lengths=a.sub_seq_lengths)

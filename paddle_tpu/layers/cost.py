@@ -1,0 +1,127 @@
+"""Cost layers.
+
+Reference: /root/reference/paddle/gserver/layers/CostLayer.cpp (square
+error, multi-class CE, binary/soft CE, self-norm CE, rank cost, huber) and
+config_parser's define_cost type strings (config_parser.py:1700-1708).
+
+Each cost layer outputs per-sample cost [B, 1] (sequences: summed over
+valid timesteps — the padded-batch equivalent of the reference's ragged
+per-row costs), already scaled by ``coeff`` and the optional per-sample
+weight. The gradient machine averages over the batch to form the scalar
+loss that jax.grad differentiates.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.graph.argument import Argument
+from paddle_tpu.layers.base import LayerContext, register_layer
+from paddle_tpu.proto import LayerConfig
+
+Array = jax.Array
+_EPS = 1e-10
+
+
+def _finish_cost(cfg: LayerConfig, per_step: Array, arg: Argument, weight_arg: Optional[Argument]) -> Argument:
+    """Reduce per-step cost over time (masked) and apply coeff/weight."""
+    if arg.is_nested_seq:
+        cost = jnp.sum(per_step * arg.sub_seq_mask(), axis=(1, 2))
+    elif arg.is_seq:
+        cost = jnp.sum(per_step * arg.seq_mask(), axis=1)
+    else:
+        cost = per_step
+    if weight_arg is not None and weight_arg.value is not None:
+        cost = cost * weight_arg.value.reshape(cost.shape)
+    return Argument(value=(cfg.coeff * cost)[:, None])
+
+
+def _label_ids(label: Argument) -> Array:
+    if label.ids is not None:
+        return label.ids
+    return jnp.argmax(label.value, axis=-1).astype(jnp.int32)
+
+
+@register_layer("multi-class-cross-entropy")
+def multi_class_cross_entropy(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Argument:
+    # inputs: [probabilities (post-softmax), label(, weight)]
+    out, label = inputs[0], inputs[1]
+    weight = inputs[2] if len(inputs) > 2 else None
+    ids = _label_ids(label)
+    p = jnp.take_along_axis(out.value, ids[..., None], axis=-1)[..., 0]
+    per_step = -jnp.log(jnp.clip(p, _EPS, None))
+    return _finish_cost(cfg, per_step, out, weight)
+
+
+@register_layer("multi_class_cross_entropy_with_selfnorm")
+def selfnorm_cross_entropy(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Argument:
+    # ref: CostLayer.cpp MultiClassCrossEntropyWithSelfNorm — CE on
+    # unnormalized softmax plus alpha * log(Z)^2 keeping Z near 1.
+    out, label = inputs[0], inputs[1]
+    ids = _label_ids(label)
+    z = jnp.sum(out.value, axis=-1)
+    p = jnp.take_along_axis(out.value, ids[..., None], axis=-1)[..., 0]
+    per_step = -jnp.log(jnp.clip(p / jnp.clip(z, _EPS, None), _EPS, None))
+    per_step = per_step + cfg.softmax_selfnorm_alpha * jnp.square(jnp.log(jnp.clip(z, _EPS, None)))
+    return _finish_cost(cfg, per_step, out, None)
+
+
+@register_layer("square_error")
+def square_error(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Argument:
+    out, label = inputs[0], inputs[1]
+    weight = inputs[2] if len(inputs) > 2 else None
+    target = label.value if label.value is not None else label.ids.astype(out.value.dtype)
+    if target.ndim < out.value.ndim:
+        target = target[..., None]
+    per_step = jnp.sum(jnp.square(out.value - target), axis=-1)
+    return _finish_cost(cfg, per_step, out, weight)
+
+
+@register_layer("multi_binary_label_cross_entropy")
+def multi_binary_label_cross_entropy(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Argument:
+    out, label = inputs[0], inputs[1]
+    p = jnp.clip(out.value, _EPS, 1.0 - _EPS)
+    y = label.value
+    per_step = -jnp.sum(y * jnp.log(p) + (1.0 - y) * jnp.log(1.0 - p), axis=-1)
+    return _finish_cost(cfg, per_step, out, None)
+
+
+@register_layer("soft_binary_class_cross_entropy")
+def soft_binary_class_cross_entropy(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Argument:
+    return multi_binary_label_cross_entropy(cfg, inputs, ctx)
+
+
+@register_layer("rank-cost")
+def rank_cost(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Argument:
+    # ref: RankingCost — inputs: left score, right score, label (1 if left
+    # should rank higher, 0.5 for ties), optional weight.
+    left, right, label = inputs[0], inputs[1], inputs[2]
+    weight = inputs[3] if len(inputs) > 3 else None
+    o = (left.value - right.value)[..., 0]
+    t = label.value[..., 0] if label.value is not None else label.ids.astype(o.dtype)
+    per_step = jnp.logaddexp(0.0, o) - t * o
+    return _finish_cost(cfg, per_step, left, weight)
+
+
+@register_layer("huber")
+def huber_two_class(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Argument:
+    # ref: HuberTwoClass — labels {0,1} → y in {-1,+1}; quadratic in
+    # (-1, 1), linear outside, zero when y*f >= 1.
+    out, label = inputs[0], inputs[1]
+    f = out.value[..., 0]
+    y = 2.0 * _label_ids(label).astype(f.dtype) - 1.0
+    a = y * f
+    per_step = jnp.where(a < -1.0, -4.0 * a, jnp.where(a < 1.0, jnp.square(1.0 - a), 0.0))
+    return _finish_cost(cfg, per_step, out, None)
+
+
+@register_layer("classification_error")
+def classification_error_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Argument:
+    # ref: ClassificationErrorLayer — 1.0 where argmax(output) != label.
+    out, label = inputs[0], inputs[1]
+    pred = jnp.argmax(out.value, axis=-1)
+    err = (pred != _label_ids(label)).astype(out.value.dtype)
+    return _finish_cost(cfg, err, out, inputs[2] if len(inputs) > 2 else None)

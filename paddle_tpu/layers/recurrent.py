@@ -1,0 +1,189 @@
+"""Recurrent layers: simple RNN, LSTM, GRU (full-sequence and step forms).
+
+Reference counterparts: RecurrentLayer.cpp, LstmLayer.cpp (+LstmCompute),
+GatedRecurrentLayer.cpp (+GruCompute), LstmStepLayer.cpp, GruStepLayer.cpp
+in /root/reference/paddle/gserver/layers/. The reference fuses per-frame
+cell math in CUDA and schedules variable-length sequences densely via
+SequenceToBatch (SequenceToBatch.h:41); the TPU-native formulation is a
+``lax.scan`` over padded [T, B, D] with a carry mask — XLA fuses the cell,
+and the MXU sees one [B, D]x[D, kD] matmul per step.
+
+Layout contracts (from config_parser.py LstmLayer/GatedRecurrentLayer):
+- lstmemory: input is the 4*size x-projection, recurrent weight
+  [size, 4*size], bias 7*size = 4 gate biases + 3 peephole vectors,
+  gate order [candidate, input, forget, output].
+- gated_recurrent: input is the 3*size x-projection, weight [size, 3*size]
+  split [update, reset | candidate], bias 3*size.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.graph.argument import Argument
+from paddle_tpu.layers.base import LayerContext, register_layer
+from paddle_tpu.ops.activations import apply_activation
+from paddle_tpu.proto import LayerConfig
+
+Array = jax.Array
+
+
+def _scan_time(cell, x_tbd: Array, mask_tb: Array, init_carry, reverse: bool):
+    """Scan ``cell`` over the time-major sequence with carry masking.
+
+    Padded steps pass the carry through unchanged so that (a) forward scans
+    keep the final state at the last valid step and (b) reversed scans stay
+    at the init state until the sequence actually starts.
+    """
+
+    def step(carry, inp):
+        x_t, m_t = inp
+        new_carry, y = cell(carry, x_t)
+        m = m_t[:, None]
+        merged = jax.tree_util.tree_map(lambda n, o: m * n + (1.0 - m) * o, new_carry, carry)
+        return merged, y * m
+
+    carry, ys = jax.lax.scan(step, init_carry, (x_tbd, mask_tb), reverse=reverse)
+    return carry, ys
+
+
+def _prep(a: Argument) -> Tuple[Array, Array]:
+    x = jnp.swapaxes(a.value, 0, 1)  # [T, B, D]
+    mask = jnp.swapaxes(a.seq_mask(dtype=x.dtype), 0, 1)  # [T, B]
+    return x, mask
+
+
+@register_layer("recurrent")
+def recurrent_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Argument:
+    a = inputs[0]
+    x, mask = _prep(a)
+    w = ctx.param(cfg.inputs[0].input_parameter_name).reshape(cfg.size, cfg.size)
+    b = ctx.param(cfg.bias_parameter_name) if cfg.bias_parameter_name else 0.0
+
+    def cell(h, x_t):
+        h_new = apply_activation(cfg.active_type, x_t + jnp.dot(h, w) + b)
+        return h_new, h_new
+
+    B = x.shape[1]
+    h0 = jnp.zeros((B, cfg.size), x.dtype)
+    _, ys = _scan_time(cell, x, mask, h0, cfg.reversed)
+    return Argument(value=jnp.swapaxes(ys, 0, 1), seq_lengths=a.seq_lengths)
+
+
+def lstm_cell_step(
+    cfg: LayerConfig,
+    x4: Array,            # [B, 4*size] x-projection (candidate,i,f,o)
+    h_prev: Array,
+    c_prev: Array,
+    w: Array,             # [size, 4*size]
+    bias: Optional[Array],  # [7*size] or None
+) -> Tuple[Array, Array]:
+    size = h_prev.shape[-1]
+    gates = x4 + jnp.dot(h_prev, w)
+    if bias is not None:
+        gates = gates + bias[: 4 * size]
+        peep_i = bias[4 * size : 5 * size]
+        peep_f = bias[5 * size : 6 * size]
+        peep_o = bias[6 * size : 7 * size]
+    else:
+        peep_i = peep_f = peep_o = None
+    a, gi, gf, go = jnp.split(gates, 4, axis=-1)
+    act_gate = lambda v: apply_activation(cfg.active_gate_type or "sigmoid", v)
+    act_in = lambda v: apply_activation(cfg.active_type or "tanh", v)
+    act_state = lambda v: apply_activation(cfg.active_state_type or "sigmoid", v)
+    i = act_gate(gi + (peep_i * c_prev if peep_i is not None else 0.0))
+    f = act_gate(gf + (peep_f * c_prev if peep_f is not None else 0.0))
+    c = f * c_prev + i * act_in(a)
+    o = act_gate(go + (peep_o * c if peep_o is not None else 0.0))
+    h = o * act_state(c)
+    return h, c
+
+
+@register_layer("lstmemory")
+def lstmemory_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Argument:
+    a = inputs[0]
+    x, mask = _prep(a)  # [T, B, 4*size]
+    size = cfg.size
+    w = ctx.param(cfg.inputs[0].input_parameter_name).reshape(size, 4 * size)
+    bias = ctx.param(cfg.bias_parameter_name) if cfg.bias_parameter_name else None
+
+    def cell(carry, x_t):
+        h, c = carry
+        h2, c2 = lstm_cell_step(cfg, x_t, h, c, w, bias)
+        return (h2, c2), h2
+
+    B = x.shape[1]
+    init = (jnp.zeros((B, size), x.dtype), jnp.zeros((B, size), x.dtype))
+    _, ys = _scan_time(cell, x, mask, init, cfg.reversed)
+    return Argument(value=jnp.swapaxes(ys, 0, 1), seq_lengths=a.seq_lengths)
+
+
+def gru_cell_step(
+    cfg: LayerConfig,
+    x3: Array,        # [B, 3*size] x-projection (update,reset,candidate)
+    h_prev: Array,
+    w: Array,         # [size, 3*size]: [:, :2s]=gates, [:, 2s:]=candidate
+    bias: Optional[Array],
+) -> Array:
+    size = h_prev.shape[-1]
+    xg, xc = x3[..., : 2 * size], x3[..., 2 * size :]
+    wg, wc = w[:, : 2 * size], w[:, 2 * size :]
+    g = xg + jnp.dot(h_prev, wg)
+    if bias is not None:
+        g = g + bias[: 2 * size]
+    act_gate = lambda v: apply_activation(cfg.active_gate_type or "sigmoid", v)
+    u, r = jnp.split(act_gate(g), 2, axis=-1)
+    cand = xc + jnp.dot(r * h_prev, wc)
+    if bias is not None:
+        cand = cand + bias[2 * size :]
+    c = apply_activation(cfg.active_type or "tanh", cand)
+    # ref GruCompute: output = update * prev + (1 - update) * candidate
+    return u * h_prev + (1.0 - u) * c
+
+
+@register_layer("gated_recurrent")
+def gated_recurrent_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Argument:
+    a = inputs[0]
+    x, mask = _prep(a)
+    size = cfg.size
+    w = ctx.param(cfg.inputs[0].input_parameter_name).reshape(size, 3 * size)
+    bias = ctx.param(cfg.bias_parameter_name) if cfg.bias_parameter_name else None
+
+    def cell(h, x_t):
+        h2 = gru_cell_step(cfg, x_t, h, w, bias)
+        return h2, h2
+
+    B = x.shape[1]
+    h0 = jnp.zeros((B, size), x.dtype)
+    _, ys = _scan_time(cell, x, mask, h0, cfg.reversed)
+    return Argument(value=jnp.swapaxes(ys, 0, 1), seq_lengths=a.seq_lengths)
+
+
+@register_layer("lstm_step")
+def lstm_step_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Argument:
+    # ref: LstmStepLayer.cpp — one LSTM step inside a recurrent_group.
+    # inputs: [x-projection 4*size, prev cell state]; primary output is the
+    # hidden state; the new cell state is published as "<name>@state" for
+    # get_output(..., arg_name='state').
+    x4, c_prev = inputs[0].value, inputs[1].value
+    size = cfg.size
+    w = jnp.zeros((size, 4 * size), x4.dtype)  # step layers have no recurrent weight
+    bias = ctx.param(cfg.bias_parameter_name) if cfg.bias_parameter_name else None
+    h_prev = jnp.zeros((x4.shape[0], size), x4.dtype)
+    h, c = lstm_cell_step(cfg, x4, h_prev, c_prev, w, bias)
+    ctx.outputs[f"{cfg.name}@state"] = Argument(value=c, seq_lengths=inputs[0].seq_lengths)
+    return Argument(value=h, seq_lengths=inputs[0].seq_lengths)
+
+
+@register_layer("gru_step")
+def gru_step_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Argument:
+    # ref: GruStepLayer.cpp — inputs: [x-projection 3*size, prev output].
+    x3, h_prev = inputs[0].value, inputs[1].value
+    size = cfg.size
+    w = ctx.param(cfg.inputs[0].input_parameter_name).reshape(size, 3 * size)
+    bias = ctx.param(cfg.bias_parameter_name) if cfg.bias_parameter_name else None
+    h = gru_cell_step(cfg, x3, h_prev, w, bias)
+    return Argument(value=h, seq_lengths=inputs[0].seq_lengths)

@@ -1,0 +1,171 @@
+"""Sequence manipulation layers.
+
+Reference counterparts: MaxLayer/AverageLayer (SequencePool subtypes),
+SequenceLastInstanceLayer, ExpandLayer, SequenceConcatLayer,
+SequenceReshapeLayer, SubSequenceLayer
+(/root/reference/paddle/gserver/layers/). The reference walks ragged rows
+via sequenceStartPositions; here everything is masked reductions/gathers on
+padded [B, T, D] — XLA turns these into fused reduce/gather kernels.
+
+``trans_type`` ("non-seq" | "seq") mirrors the reference's pooling levels:
+with a nested input, "non-seq" pools each subsequence → output is a plain
+sequence over subsequences; with a plain input it pools the whole sequence
+→ dense output.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.graph.argument import Argument
+from paddle_tpu.layers.base import LayerContext, finalize_output, register_layer
+from paddle_tpu.proto import LayerConfig
+
+Array = jax.Array
+
+
+def _pool(cfg: LayerConfig, a: Argument, mode: str) -> Argument:
+    if a.is_nested_seq:
+        mask = a.sub_seq_mask()  # [B, S, T]
+        x = a.value  # [B, S, T, D]
+        axis = 2
+        lengths = a.sub_seq_lengths
+        out_meta = dict(seq_lengths=a.seq_lengths)
+    else:
+        assert a.is_seq, f"{cfg.name}: pooling a non-sequence input"
+        mask = a.seq_mask()  # [B, T]
+        x = a.value  # [B, T, D]
+        axis = 1
+        lengths = a.seq_lengths
+        out_meta = {}
+    m = mask[..., None]
+    if mode == "max":
+        neg = jnp.finfo(x.dtype).min
+        out = jnp.max(jnp.where(m > 0, x, neg), axis=axis)
+        out = jnp.where(lengths[..., None] > 0, out, 0.0)
+    else:
+        s = jnp.sum(x * m, axis=axis)
+        n = jnp.clip(lengths[..., None].astype(x.dtype), 1.0, None)
+        if mode == "sum":
+            out = s
+        elif mode == "squarerootn":
+            out = s / jnp.sqrt(n)
+        else:  # average
+            out = s / n
+    return Argument(value=out, **out_meta)
+
+
+@register_layer("max")
+def max_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Argument:
+    out = _pool(cfg, inputs[0], "max")
+    if cfg.output_max_index:
+        # ref: MaxLayer with output_max_index — emit argmax positions.
+        a = inputs[0]
+        mask = a.sub_seq_mask() if a.is_nested_seq else a.seq_mask()
+        neg = jnp.finfo(a.value.dtype).min
+        axis = 2 if a.is_nested_seq else 1
+        idx = jnp.argmax(jnp.where(mask[..., None] > 0, a.value, neg), axis=axis)
+        return Argument(ids=idx.astype(jnp.int32), seq_lengths=out.seq_lengths)
+    v = finalize_output(cfg, out.value, ctx)
+    return Argument(value=v, seq_lengths=out.seq_lengths)
+
+
+@register_layer("average")
+def average_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Argument:
+    mode = cfg.average_strategy or "average"
+    out = _pool(cfg, inputs[0], mode)
+    v = finalize_output(cfg, out.value, ctx)
+    return Argument(value=v, seq_lengths=out.seq_lengths)
+
+
+def _select_instance(a: Argument, first: bool) -> Argument:
+    if a.is_nested_seq:
+        x, lengths = a.value, a.sub_seq_lengths  # [B,S,T,D], [B,S]
+        idx = jnp.zeros_like(lengths) if first else jnp.clip(lengths - 1, 0, None)
+        out = jnp.take_along_axis(x, idx[..., None, None], axis=2)[:, :, 0]
+        return Argument(value=out, seq_lengths=a.seq_lengths)
+    assert a.is_seq
+    x, lengths = a.value, a.seq_lengths
+    idx = jnp.zeros_like(lengths) if first else jnp.clip(lengths - 1, 0, None)
+    out = jnp.take_along_axis(x, idx[:, None, None], axis=1)[:, 0]
+    return Argument(value=out)
+
+
+@register_layer("seqlastins")
+def seq_last_ins_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Argument:
+    out = _select_instance(inputs[0], first=cfg.select_first)
+    return Argument(value=finalize_output(cfg, out.value, ctx), seq_lengths=out.seq_lengths)
+
+
+@register_layer("seqfirstins")
+def seq_first_ins_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Argument:
+    out = _select_instance(inputs[0], first=True)
+    return Argument(value=finalize_output(cfg, out.value, ctx), seq_lengths=out.seq_lengths)
+
+
+@register_layer("expand")
+def expand_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Argument:
+    # ref: ExpandLayer — broadcast a dense (or seq-level) input along the
+    # sequence layout of the second input.
+    src, layout = inputs[0], inputs[1]
+    if layout.is_nested_seq and src.is_seq:
+        # seq over subseqs → nested: broadcast each subsequence value over T
+        out = jnp.broadcast_to(
+            src.value[:, :, None, :], layout.value.shape[:3] + (src.value.shape[-1],)
+        )
+        return Argument(value=out, seq_lengths=layout.seq_lengths, sub_seq_lengths=layout.sub_seq_lengths)
+    T = layout.max_len
+    out = jnp.broadcast_to(src.value[:, None, :], (src.value.shape[0], T, src.value.shape[-1]))
+    return Argument(value=out, seq_lengths=layout.seq_lengths)
+
+
+@register_layer("seqconcat")
+def seq_concat_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Argument:
+    # ref: SequenceConcatLayer — concatenate two sequences in time per
+    # sample. Padded impl: place b after a's valid region via gather.
+    a, b = inputs[0], inputs[1]
+    Ta, Tb = a.max_len, b.max_len
+    T = Ta + Tb
+    la, lb = a.seq_lengths, b.seq_lengths
+    pos = jnp.arange(T, dtype=jnp.int32)[None, :]  # [1, T]
+    from_a = pos < la[:, None]
+    idx_a = jnp.clip(pos, 0, Ta - 1)
+    idx_b = jnp.clip(pos - la[:, None], 0, Tb - 1)
+    ga = jnp.take_along_axis(a.value, idx_a[..., None], axis=1)
+    gb = jnp.take_along_axis(b.value, idx_b[..., None], axis=1)
+    out = jnp.where(from_a[..., None], ga, gb)
+    lengths = la + lb
+    valid = pos < lengths[:, None]
+    out = jnp.where(valid[..., None], out, 0.0)
+    return Argument(value=finalize_output(cfg, out, ctx), seq_lengths=lengths)
+
+
+@register_layer("seqreshape")
+def seq_reshape_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Argument:
+    # ref: SequenceReshapeLayer — reinterpret [B, T, D] as [B, T*D/size,
+    # size]; only exact multiples are meaningful with padding.
+    a = inputs[0]
+    B, T, D = a.value.shape
+    new_T = T * D // cfg.size
+    out = a.value.reshape(B, new_T, cfg.size)
+    lengths = (a.seq_lengths * D) // cfg.size
+    return Argument(value=finalize_output(cfg, out, ctx), seq_lengths=lengths)
+
+
+@register_layer("subseq")
+def sub_seq_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Argument:
+    # ref: SubSequenceLayer — inputs: (sequence, offsets, sizes); output is
+    # the slice [offset, offset+size) of each sequence.
+    a, offs, sizes = inputs[0], inputs[1], inputs[2]
+    o = (offs.ids if offs.ids is not None else offs.value[..., 0].astype(jnp.int32)).reshape(-1)
+    s = (sizes.ids if sizes.ids is not None else sizes.value[..., 0].astype(jnp.int32)).reshape(-1)
+    T = a.max_len
+    pos = jnp.arange(T, dtype=jnp.int32)[None, :]
+    idx = jnp.clip(pos + o[:, None], 0, T - 1)
+    out = jnp.take_along_axis(a.value, idx[..., None], axis=1)
+    valid = pos < s[:, None]
+    out = jnp.where(valid[..., None], out, 0.0)
+    return Argument(value=finalize_output(cfg, out, ctx), seq_lengths=s)

@@ -1,0 +1,240 @@
+"""Vision layers: conv, pool, batch-norm, response norm, block expand.
+
+Reference counterparts: ExpandConvLayer.cpp (im2col conv), CudnnConvLayer,
+PoolLayer/CudnnPoolLayer, BatchNormalizationLayer/CudnnBatchNormLayer,
+NormProjectionLayer (cross-map LRN), BlockExpandLayer, ResizeLayer,
+FeatureMapExpandLayer in /root/reference/paddle/gserver/layers/.
+
+Data contract matches the reference: images flow between layers as
+flattened NCHW rows [B, C*H*W]. Internally we reshape to NHWC and use
+``lax.conv_general_dilated`` / ``lax.reduce_window`` so XLA tiles the MXU
+directly — no im2col materialization.
+
+Weight layout (set by our config_parser): conv filters are stored flat as
+[num_filters, filter_channels * fh * fw], reshaped here to HWIO.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.graph.argument import Argument
+from paddle_tpu.layers.base import LayerContext, register_layer
+from paddle_tpu.ops.activations import apply_activation
+from paddle_tpu.proto import ConvConfig, LayerConfig, OperatorConfig
+
+Array = jax.Array
+
+
+def conv_output_size(img: int, filter_size: int, padding: int, stride: int, caffe_mode: bool) -> int:
+    # ref: paddle/math/MathUtils.cpp outputSize
+    if caffe_mode:
+        return (img - filter_size + 2 * padding) // stride + 1
+    return (img - filter_size + 2 * padding + stride - 1) // stride + 1
+
+
+def _nchw_to_nhwc(x: Array, channels: int, h: int, w: int) -> Array:
+    return x.reshape(x.shape[0], channels, h, w).transpose(0, 2, 3, 1)
+
+
+def _nhwc_to_flat(x: Array) -> Array:
+    return x.transpose(0, 3, 1, 2).reshape(x.shape[0], -1)
+
+
+def _conv2d(x_nhwc: Array, w_hwio: Array, stride: Tuple[int, int], padding, groups: int) -> Array:
+    return lax.conv_general_dilated(
+        x_nhwc,
+        w_hwio,
+        window_strides=stride,
+        padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=groups,
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _conv_forward(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Argument:
+    acc = None
+    for in_cfg, arg in zip(cfg.inputs, inputs):
+        cc = in_cfg.conv_conf
+        h = w = cc.img_size
+        fy = cc.filter_size_y or cc.filter_size
+        sy = cc.stride_y or cc.stride
+        py = cc.padding_y if cc.filter_size_y else cc.padding
+        x = _nchw_to_nhwc(arg.value, cc.channels, h, w)
+        wf = ctx.param(in_cfg.input_parameter_name)
+        wf = wf.reshape(cfg.num_filters, cc.filter_channels, fy, cc.filter_size)
+        w_hwio = wf.transpose(2, 3, 1, 0)  # OIHW → HWIO
+        y = _conv2d(x, w_hwio, (sy, cc.stride), [(py, py), (cc.padding, cc.padding)], cc.groups)
+        acc = y if acc is None else acc + y
+    if cfg.bias_parameter_name:
+        b = ctx.param(cfg.bias_parameter_name)
+        if cfg.shared_biases:
+            acc = acc + b.reshape(1, 1, 1, cfg.num_filters)
+        else:
+            acc = acc + b.reshape(1, acc.shape[1], acc.shape[2], cfg.num_filters)
+    out = _nhwc_to_flat(acc)
+    out = apply_activation(cfg.active_type, out)
+    if cfg.drop_rate > 0.0 and ctx.is_training:
+        keep = 1.0 - cfg.drop_rate
+        m = jax.random.bernoulli(ctx.layer_rng(cfg.name, "dropout"), keep, out.shape)
+        out = jnp.where(m, out / keep, 0.0)
+    return Argument(value=out)
+
+
+register_layer("conv", "exconv", "cudnn_conv")(_conv_forward)
+
+
+def conv_operator_forward(op: OperatorConfig, inputs: List[Argument]) -> Array:
+    """ConvOperator in a mixed layer: conv(image_input, filter_input).
+
+    ref: ConvOperator.cpp — the second input *is* the filter values
+    (dynamic filters), used e.g. for spatial attention.
+    """
+    cc = op.conv_conf
+    x = _nchw_to_nhwc(inputs[0].value, cc.channels, cc.img_size, cc.img_size)
+    B = x.shape[0]
+    wf = inputs[1].value.reshape(B, op.num_filters, cc.filter_channels, cc.filter_size, cc.filter_size)
+
+    def one(xi, wi):
+        return _conv2d(
+            xi[None],
+            wi.transpose(2, 3, 1, 0),
+            (cc.stride, cc.stride),
+            [(cc.padding, cc.padding), (cc.padding, cc.padding)],
+            cc.groups,
+        )[0]
+
+    y = jax.vmap(one)(x, wf)
+    return _nhwc_to_flat(y)
+
+
+@register_layer("pool")
+def pool_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Argument:
+    pc = cfg.inputs[0].pool_conf
+    h = pc.img_size_y or pc.img_size
+    w = pc.img_size
+    ky = pc.size_y or pc.size_x
+    sy = pc.stride_y or pc.stride
+    py = pc.padding_y or pc.padding
+    x = _nchw_to_nhwc(inputs[0].value, pc.channels, h, w)
+    window = (1, ky, pc.size_x, 1)
+    strides = (1, sy, pc.stride, 1)
+    pads = ((0, 0), (py, py), (pc.padding, pc.padding), (0, 0))
+    kind = pc.pool_type
+    if "max" in kind:
+        init = -jnp.inf
+        y = lax.reduce_window(x, init, lax.max, window, strides, pads)
+    else:  # avg / average pooling — reference divides by the *full* window
+        y = lax.reduce_window(x, 0.0, lax.add, window, strides, pads)
+        y = y / float(ky * pc.size_x)
+    out = _nhwc_to_flat(y)
+    out = apply_activation(cfg.active_type, out)
+    return Argument(value=out)
+
+
+@register_layer("batch_norm", "cudnn_batch_norm")
+def batch_norm_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Argument:
+    """ref: BatchNormalizationLayer.cpp.
+
+    inputs[0] carries the data plus an ImageConfig; per-channel gamma is the
+    input parameter, beta the bias parameter; moving mean/var live in params
+    as the 2nd/3rd input parameters (is_static) and are updated through
+    ``ctx.state_updates`` with moving_average_fraction.
+    """
+    ic = cfg.inputs[0].image_conf
+    a = inputs[0]
+    x = a.value
+    seq_meta = {}
+    if a.is_seq:
+        seq_meta = dict(seq_lengths=a.seq_lengths)
+        B, T, D = x.shape
+        x = x.reshape(B * T, D)
+    if ic is not None and ic.img_size > 0:
+        C, hw = ic.channels, ic.img_size * ic.img_size
+        xr = x.reshape(x.shape[0], C, hw).transpose(0, 2, 1).reshape(-1, C)
+    else:
+        C = cfg.size
+        xr = x
+    gamma = ctx.param(cfg.inputs[0].input_parameter_name).reshape(C)
+    beta = ctx.param(cfg.bias_parameter_name).reshape(C) if cfg.bias_parameter_name else 0.0
+    mean_name = cfg.inputs[1].input_parameter_name
+    var_name = cfg.inputs[2].input_parameter_name
+    eps = 1e-5
+    use_global = cfg.use_global_stats or not ctx.is_training
+    if use_global:
+        mean = ctx.params[mean_name].reshape(C)
+        var = ctx.params[var_name].reshape(C)
+    else:
+        mean = jnp.mean(xr, axis=0)
+        var = jnp.var(xr, axis=0)
+        f = cfg.moving_average_fraction
+        ctx.state_updates[mean_name] = (
+            f * ctx.params[mean_name].reshape(C) + (1.0 - f) * mean
+        ).reshape(ctx.params[mean_name].shape)
+        ctx.state_updates[var_name] = (
+            f * ctx.params[var_name].reshape(C) + (1.0 - f) * var
+        ).reshape(ctx.params[var_name].shape)
+    yn = (xr - mean) * lax.rsqrt(var + eps) * gamma + beta
+    if ic is not None and ic.img_size > 0:
+        y = yn.reshape(x.shape[0], hw, C).transpose(0, 2, 1).reshape(x.shape[0], -1)
+    else:
+        y = yn
+    if seq_meta:
+        y = y.reshape(a.value.shape)
+    y = apply_activation(cfg.active_type, y)
+    return Argument(value=y, **seq_meta)
+
+
+@register_layer("norm", "norm-projection")
+def norm_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Argument:
+    # ref: NormProjectionLayer (cmrnorm-projection): cross-map local
+    # response normalization: y = x / (1 + scale/size * sum_window x^2)^pow
+    nc = cfg.inputs[0].norm_conf
+    x = _nchw_to_nhwc(inputs[0].value, nc.channels, nc.img_size, nc.img_size)
+    half = nc.size // 2
+    sq = jnp.square(x)
+    acc = lax.reduce_window(
+        sq, 0.0, lax.add, (1, 1, 1, nc.size), (1, 1, 1, 1), ((0, 0), (0, 0), (0, 0), (half, nc.size - 1 - half))
+    )
+    denom = jnp.power(1.0 + (nc.scale / nc.size) * acc, nc.pow)
+    y = x / denom
+    return Argument(value=_nhwc_to_flat(y))
+
+
+@register_layer("blockexpand")
+def block_expand_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Argument:
+    # ref: BlockExpandLayer.cpp — extract sliding blocks as a sequence of
+    # flattened patches (OCR-style); output is a sequence of length
+    # output_x * output_y per image.
+    bc = cfg.inputs[0].block_expand_conf
+    x = _nchw_to_nhwc(inputs[0].value, bc.channels, bc.img_size_y, bc.img_size_x)
+    patches = lax.conv_general_dilated_patches(
+        x.transpose(0, 3, 1, 2),  # NCHW
+        filter_shape=(bc.block_y, bc.block_x),
+        window_strides=(bc.stride_y, bc.stride_x),
+        padding=[(bc.padding_y, bc.padding_y), (bc.padding_x, bc.padding_x)],
+    )  # [B, C*by*bx, oy, ox]
+    B, D, oy, ox = patches.shape
+    seq = patches.transpose(0, 2, 3, 1).reshape(B, oy * ox, D)
+    lengths = jnp.full((B,), oy * ox, jnp.int32)
+    return Argument(value=seq, seq_lengths=lengths)
+
+
+@register_layer("featmap_expand")
+def featmap_expand_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Argument:
+    # ref: FeatureMapExpandLayer — tile a sequence input num_filters times.
+    a = inputs[0]
+    out = jnp.tile(a.value, (1,) * (a.value.ndim - 1) + (cfg.num_filters,))
+    return Argument(value=out, seq_lengths=a.seq_lengths)
+
+
+@register_layer("resize")
+def resize_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Argument:
+    # ref: ResizeLayer — reinterpret rows with a new feature width.
+    x = inputs[0].value
+    return Argument(value=x.reshape(-1, cfg.size))

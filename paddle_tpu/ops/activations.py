@@ -1,0 +1,119 @@
+"""Activation functions.
+
+The reference registers 14 activation types by name
+(/root/reference/paddle/gserver/activations/ActivationFunction.cpp:86-308)
+with hand-written forward/backward; here each is a pure jax function (XLA
+fuses it into the producing matmul; jax.grad supplies the backward).
+
+``sequence_softmax`` normalizes over the *time* axis of a padded sequence
+using the validity mask — the replacement for the reference's ragged
+per-sequence softmax.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.utils.registry import Registry
+
+Array = jax.Array
+
+# activation(value, mask) -> value. mask is [B, T] (or None for non-seq).
+activation_registry: Registry[Callable] = Registry("activation")
+
+
+def _simple(name: str):
+    def deco(fn):
+        activation_registry.register_obj(name, lambda x, mask=None: fn(x))
+        return fn
+
+    return deco
+
+
+@_simple("")
+@_simple("linear")
+def identity(x: Array) -> Array:
+    return x
+
+
+@_simple("sigmoid")
+def sigmoid(x: Array) -> Array:
+    return jax.nn.sigmoid(x)
+
+
+@_simple("tanh")
+def tanh(x: Array) -> Array:
+    return jnp.tanh(x)
+
+
+@_simple("stanh")
+def stanh(x: Array) -> Array:
+    # scaled tanh: 1.7159 * tanh(2/3 x) (LeCun) — matches reference STanh.
+    return 1.7159 * jnp.tanh((2.0 / 3.0) * x)
+
+
+@_simple("relu")
+def relu(x: Array) -> Array:
+    return jax.nn.relu(x)
+
+
+@_simple("brelu")
+def brelu(x: Array) -> Array:
+    # bounded relu: clip to [0, 24] (reference BRelu bound).
+    return jnp.clip(x, 0.0, 24.0)
+
+
+@_simple("softrelu")
+def softrelu(x: Array) -> Array:
+    # log(1 + e^x), with the reference's +-40 input clamp for stability.
+    return jnp.log1p(jnp.exp(jnp.clip(x, -40.0, 40.0)))
+
+
+@_simple("abs")
+def abs_act(x: Array) -> Array:
+    return jnp.abs(x)
+
+
+@_simple("square")
+def square(x: Array) -> Array:
+    return x * x
+
+
+@_simple("exponential")
+def exponential(x: Array) -> Array:
+    return jnp.exp(x)
+
+
+def softmax(x: Array, mask: Optional[Array] = None) -> Array:
+    # feature-axis softmax (last dim)
+    return jax.nn.softmax(x, axis=-1)
+
+
+activation_registry.register_obj("softmax", softmax)
+
+
+def sequence_softmax(x: Array, mask: Optional[Array] = None) -> Array:
+    """Softmax across timesteps of each sequence.
+
+    x: [B, T, 1] (or [B, T]) scores; mask: [B, T] validity. Padded steps get
+    probability 0. Replaces the reference's per-sequence ragged softmax
+    (SequenceSoftmaxActivation).
+    """
+    squeeze = x.ndim == 3
+    s = x[..., 0] if squeeze else x
+    if mask is not None:
+        s = jnp.where(mask > 0, s, -jnp.inf)
+    out = jax.nn.softmax(s, axis=-1)
+    if mask is not None:
+        out = jnp.where(mask > 0, out, 0.0)
+    return out[..., None] if squeeze else out
+
+
+activation_registry.register_obj("sequence_softmax", sequence_softmax)
+
+
+def apply_activation(name: str, x: Array, mask: Optional[Array] = None) -> Array:
+    return activation_registry.get(name)(x, mask)

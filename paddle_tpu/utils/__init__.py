@@ -1,0 +1,6 @@
+from paddle_tpu.utils.registry import Registry
+from paddle_tpu.utils.logging import logger
+from paddle_tpu.utils.stats import stat_timer, global_stats
+from paddle_tpu.utils.flags import FLAGS
+
+__all__ = ["Registry", "logger", "stat_timer", "global_stats", "FLAGS"]

@@ -1,0 +1,72 @@
+"""Global process flags.
+
+Analog of the reference's gflags layer (/root/reference/paddle/utils/
+Flags.cpp:19-68 and CommandLineParser.h). One flat namespace consumed by the
+CLI and the trainer; programs may also set them directly
+(``FLAGS.use_tpu = True``). GPU-era flags that have no TPU meaning
+(nics/rdma/ports_num...) are intentionally absent; their roles are served by
+the mesh spec (see paddle_tpu.parallel).
+"""
+
+from __future__ import annotations
+
+import argparse
+from dataclasses import dataclass, field, fields
+from typing import List, Optional
+
+
+@dataclass
+class _Flags:
+    # device / mesh
+    use_tpu: bool = True                 # reference: -use_gpu
+    trainer_count: int = 0               # 0 = all local devices (reference: -trainer_count)
+    mesh_shape: str = ""                 # e.g. "data=8" or "data=4,model=2"
+    # jobs
+    job: str = "train"                   # train | test | checkgrad
+    config: str = ""                     # user config script
+    config_args: str = ""                # k=v,k2=v2 passed to the config
+    # training control
+    num_passes: int = 100
+    start_pass: int = 0
+    test_period: int = 0                 # batches; 0 = test at pass end
+    log_period: int = 100
+    dot_period: int = 1
+    saving_period: int = 1               # passes between checkpoints
+    saving_period_by_batches: int = 0
+    save_dir: str = ""
+    init_model_path: str = ""
+    load_missing_parameter_strategy: str = "fail"   # fail | rand | zero
+    show_parameter_stats_period: int = 0
+    test_pass: int = -1
+    test_wait: bool = False
+    predict_output_dir: str = ""
+    # rng
+    seed: int = 1
+    # distributed (multi-host jax)
+    coordinator_address: str = ""
+    num_processes: int = 1
+    process_id: int = 0
+    # misc
+    use_double: bool = False
+    log_error_clipping: bool = False
+    check_sparse_distribution_ratio: float = 0.6
+
+    def parse(self, argv: Optional[List[str]] = None) -> List[str]:
+        """Parse known flags from argv (``--flag=value`` style); returns leftovers."""
+        p = argparse.ArgumentParser(add_help=False)
+        for f in fields(self):
+            if f.type == "bool" or isinstance(getattr(self, f.name), bool):
+                p.add_argument(f"--{f.name}", type=_parse_bool, default=getattr(self, f.name))
+            else:
+                p.add_argument(f"--{f.name}", type=type(getattr(self, f.name)), default=getattr(self, f.name))
+        ns, rest = p.parse_known_args(argv)
+        for f in fields(self):
+            setattr(self, f.name, getattr(ns, f.name))
+        return rest
+
+
+def _parse_bool(v: str) -> bool:
+    return str(v).lower() in ("1", "true", "yes", "on")
+
+
+FLAGS = _Flags()

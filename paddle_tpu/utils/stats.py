@@ -1,0 +1,89 @@
+"""Scoped timers aggregated into a global stat set.
+
+TPU-native analog of the reference's ``REGISTER_TIMER`` / ``StatSet``
+(/root/reference/paddle/utils/Stat.h:70,127,244): named scopes accumulate
+wall-time and call counts, dumped periodically by the trainer. On TPU the
+device work is async, so timers around jitted calls measure dispatch unless
+you pass ``block=True`` (which block_until_ready's the result); the trainer
+uses blocking timers only at log boundaries. Scopes also emit
+``jax.profiler.TraceAnnotation`` so they show up in xplane traces.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Iterator
+
+import jax
+
+
+@dataclass
+class Stat:
+    name: str
+    total_s: float = 0.0
+    count: int = 0
+    max_s: float = 0.0
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+
+    def add(self, dt: float) -> None:
+        with self._lock:
+            self.total_s += dt
+            self.count += 1
+            if dt > self.max_s:
+                self.max_s = dt
+
+    @property
+    def avg_s(self) -> float:
+        return self.total_s / self.count if self.count else 0.0
+
+
+class StatSet:
+    def __init__(self, name: str = "global"):
+        self.name = name
+        self._stats: Dict[str, Stat] = {}
+        self._lock = threading.Lock()
+
+    def get(self, name: str) -> Stat:
+        with self._lock:
+            st = self._stats.get(name)
+            if st is None:
+                st = self._stats[name] = Stat(name)
+            return st
+
+    def reset(self) -> None:
+        with self._lock:
+            self._stats.clear()
+
+    def summary(self) -> str:
+        with self._lock:
+            stats = sorted(self._stats.values(), key=lambda s: -s.total_s)
+        if not stats:
+            return f"=== StatSet {self.name}: empty ==="
+        lines = [f"=== StatSet {self.name} ==="]
+        for s in stats:
+            lines.append(
+                f"  {s.name:<40s} total={s.total_s * 1e3:10.2f}ms "
+                f"avg={s.avg_s * 1e3:8.3f}ms max={s.max_s * 1e3:8.3f}ms n={s.count}"
+            )
+        return "\n".join(lines)
+
+
+global_stats = StatSet()
+
+
+@contextlib.contextmanager
+def stat_timer(name: str, block_on=None) -> Iterator[None]:
+    """Time a scope into ``global_stats`` and the jax profiler trace.
+
+    ``block_on``: optional pytree whose leaves are block_until_ready'd before
+    stopping the clock, so device time is included.
+    """
+    t0 = time.perf_counter()
+    with jax.profiler.TraceAnnotation(name):
+        yield
+    if block_on is not None:
+        jax.block_until_ready(block_on)
+    global_stats.get(name).add(time.perf_counter() - t0)
