@@ -1,0 +1,73 @@
+"""Benchmark harness — prints ONE JSON line for the driver.
+
+Runs the flagship training step on the available accelerator and reports
+throughput. vs_baseline is measured/target against the north-star proxy
+recorded in benchmarks/targets.json (the reference publishes no numbers —
+BASELINE.md); until a measured CUDA reference exists, targets are the
+driver-defined proxies.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+
+def bench_lstm_classifier(B=256, T=64, steps=20, warmup=3):
+    import jax
+    import jax.numpy as jnp
+
+    from __graft_entry__ import _example_batch, _flagship_config
+    from paddle_tpu.graph import GradientMachine
+    from paddle_tpu.optimizer import Updater
+
+    tc = _flagship_config(dict_dim=10000, emb_dim=256, hidden=512, classes=2)
+    gm = GradientMachine(tc.model_config)
+    updater = Updater(tc.opt_config, tc.model_config)
+    params = gm.init_params(seed=1)
+    opt_state = updater.init_state(params)
+    grad_fn = gm.grad_fn()
+
+    @jax.jit
+    def step(params, opt_state, batch, bs):
+        loss, grads, outputs, _ = grad_fn(params, batch, None)
+        new_params, new_opt = updater(params, grads, opt_state, bs)
+        return new_params, new_opt, loss
+
+    batch = _example_batch(dict_dim=10000, B=B, T=T)
+    bs = jnp.asarray(float(B))
+    for _ in range(warmup):
+        params, opt_state, loss = step(params, opt_state, batch, bs)
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, loss = step(params, opt_state, batch, bs)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    tokens_per_sec = B * T * steps / dt
+    return tokens_per_sec
+
+
+def main():
+    tokens_per_sec = bench_lstm_classifier()
+    targets_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "benchmarks", "targets.json")
+    target = None
+    if os.path.exists(targets_path):
+        with open(targets_path) as f:
+            target = json.load(f).get("lstm_classifier_tokens_per_sec")
+    vs_baseline = tokens_per_sec / target if target else 1.0
+    print(
+        json.dumps(
+            {
+                "metric": "lstm_classifier_train_tokens_per_sec",
+                "value": round(tokens_per_sec, 1),
+                "unit": "tokens/s",
+                "vs_baseline": round(vs_baseline, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

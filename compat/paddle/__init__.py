@@ -1,0 +1,6 @@
+"""`paddle` import-compatibility shim.
+
+Lets reference-era user configs (`from paddle.trainer_config_helpers import
+*`, `from paddle.trainer.PyDataProvider2 import *`) run unmodified against
+paddle_tpu. Added to sys.path by parse_config and the CLI.
+"""

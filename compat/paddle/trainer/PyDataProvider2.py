@@ -1,0 +1,2 @@
+from paddle_tpu.data.provider import *  # noqa: F401,F403
+from paddle_tpu.data.provider import __all__  # noqa: F401

@@ -1,0 +1,5 @@
+from paddle_tpu.config.config_parser import (  # noqa: F401
+    get_config_arg,
+    parse_config,
+    parse_config_and_serialize,
+)

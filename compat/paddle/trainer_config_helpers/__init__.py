@@ -1,0 +1,2 @@
+from paddle_tpu.trainer_config_helpers import *  # noqa: F401,F403
+from paddle_tpu.config.config_parser import get_config_arg  # noqa: F401

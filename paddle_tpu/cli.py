@@ -1,0 +1,105 @@
+"""`paddle` CLI — train / test / checkgrad / dump_config / merge_model /
+version.
+
+Role of the reference's TrainerMain + `paddle` shell dispatcher
+(/root/reference/paddle/trainer/TrainerMain.cpp:35-110,
+paddle/scripts/submit_local.sh.in:46-69). The pserver subcommand has no TPU
+meaning (SPMD replaces it); multi-host launch is `paddle train
+--coordinator_address=... --num_processes=N --process_id=k` per host.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help", "help"):
+        print(__doc__)
+        print("usage: paddle <train|test|checkgrad|dump_config|merge_model|version> [--flags]")
+        return 0
+    cmd, rest = argv[0], argv[1:]
+    if cmd == "version":
+        from paddle_tpu.version import __version__
+        import jax
+
+        print(f"paddle_tpu {__version__} (jax {jax.__version__})")
+        print(f"devices: {jax.devices()}")
+        return 0
+    if cmd in ("train", "test", "checkgrad"):
+        return _run_trainer_job(cmd, rest)
+    if cmd == "dump_config":
+        return _dump_config(rest)
+    if cmd == "merge_model":
+        return _merge_model(rest)
+    print(f"unknown command {cmd!r}", file=sys.stderr)
+    return 2
+
+
+def _setup(rest):
+    from paddle_tpu.utils.flags import FLAGS
+
+    leftover = FLAGS.parse(rest)
+    if leftover:
+        print(f"warning: unrecognized flags {leftover}", file=sys.stderr)
+    if not FLAGS.use_tpu:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if FLAGS.coordinator_address:
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=FLAGS.coordinator_address,
+            num_processes=FLAGS.num_processes,
+            process_id=FLAGS.process_id,
+        )
+    from paddle_tpu.config import parse_config
+
+    if not FLAGS.config:
+        print("error: --config is required", file=sys.stderr)
+        raise SystemExit(2)
+    if not os.path.exists(FLAGS.config):
+        print(f"error: config file {FLAGS.config!r} not found", file=sys.stderr)
+        raise SystemExit(2)
+    config = parse_config(FLAGS.config, FLAGS.config_args)
+    return FLAGS, config
+
+
+def _run_trainer_job(cmd, rest) -> int:
+    flags, config = _setup(rest)
+    from paddle_tpu.trainer import Trainer
+
+    trainer = Trainer(config, flags)
+    if cmd == "train":
+        trainer.train()
+        return 0
+    if cmd == "test":
+        trainer.test()
+        return 0
+    ok = trainer.check_gradient()
+    return 0 if ok else 1
+
+
+def _dump_config(rest) -> int:
+    flags, config = _setup(rest)
+    print(config.to_json(indent=2))
+    return 0
+
+
+def _merge_model(rest) -> int:
+    flags, config = _setup(rest)
+    from paddle_tpu.trainer import checkpoint
+    from paddle_tpu.trainer.checkpoint import latest_pass
+
+    save_dir = flags.save_dir or config.save_dir
+    pass_id = latest_pass(save_dir)
+    assert pass_id is not None, f"no checkpoints under {save_dir}"
+    out = os.path.join(save_dir, "merged_model.npz")
+    checkpoint.merge_model(save_dir, pass_id, config.to_json(), out)
+    print(f"merged model written to {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

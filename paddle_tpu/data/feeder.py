@@ -1,0 +1,279 @@
+"""Batch assembly and the host-side data pipeline.
+
+Replaces the reference's DataProvider/DoubleBuffer machinery
+(/root/reference/paddle/gserver/dataproviders/DataProvider.h:59,245,286 and
+PyDataProvider2.cpp:176 scanners): pulls samples from a @provider
+generator, shuffles in a pool, packs padded numpy batches (the scanner
+role), and prefetches asynchronously on a background thread so the TPU step
+never waits on Python.
+
+Padding uses *bucketed* sequence lengths (next power-of-two-ish) so jit
+recompiles are bounded — the TPU replacement for the reference's ragged
+no-padding layout.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import random
+import threading
+from typing import Any, Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from paddle_tpu.graph.argument import Argument
+from paddle_tpu.data.provider import DataType, SequenceType
+from paddle_tpu.proto import DataConfig
+from paddle_tpu.utils.logging import logger
+
+
+def bucket_length(n: int, multiple: int = 8) -> int:
+    """Round up to limit distinct padded shapes: next multiple of
+    ``multiple`` below 64, else next power of two."""
+    n = max(n, 1)
+    if n <= 64:
+        return ((n + multiple - 1) // multiple) * multiple
+    p = 64
+    while p < n:
+        p *= 2
+    return p
+
+
+class BatchAssembler:
+    """Packs a list of samples (per @provider input_types) into Arguments."""
+
+    def __init__(self, input_types: Sequence, slot_names: Sequence[str]):
+        if isinstance(input_types, dict):
+            self.slot_names = list(input_types.keys())
+            self.input_types = [input_types[k] for k in self.slot_names]
+        else:
+            self.input_types = list(input_types)
+            self.slot_names = list(slot_names)
+        assert len(self.input_types) == len(self.slot_names), (
+            f"provider declares {len(self.input_types)} slots but model has "
+            f"input layers {self.slot_names}"
+        )
+
+    def assemble(self, samples: List[Sequence[Any]]) -> Dict[str, Argument]:
+        out: Dict[str, Argument] = {}
+        for i, (name, tp) in enumerate(zip(self.slot_names, self.input_types)):
+            values = [s[i] for s in samples]
+            out[name] = self._slot(values, tp)
+        return out
+
+    def _slot(self, values: List[Any], tp) -> Argument:
+        if tp.seq_type == SequenceType.NO_SEQUENCE:
+            return self._scalar_slot(values, tp)
+        if tp.seq_type == SequenceType.SEQUENCE:
+            return self._seq_slot(values, tp)
+        return self._subseq_slot(values, tp)
+
+    # ---- scanners (roles of Dense/Index/Sparse*Scanner in the reference)
+
+    def _dense_row(self, v, tp) -> np.ndarray:
+        return np.asarray(v, dtype=np.float32).reshape(tp.dim)
+
+    def _sparse_row(self, v, tp, with_value: bool) -> np.ndarray:
+        row = np.zeros((tp.dim,), dtype=np.float32)
+        if with_value:
+            for idx, val in v:
+                row[int(idx)] = float(val)
+        else:
+            idx = np.asarray(v, dtype=np.int64)
+            row[idx] = 1.0
+        return row
+
+    def _row(self, v, tp) -> np.ndarray:
+        if tp.type == DataType.Dense:
+            return self._dense_row(v, tp)
+        if tp.type == DataType.SparseNonValue:
+            return self._sparse_row(v, tp, with_value=False)
+        if tp.type == DataType.SparseValue:
+            return self._sparse_row(v, tp, with_value=True)
+        raise ValueError(f"unsupported slot type {tp.type}")
+
+    def _scalar_slot(self, values, tp) -> Argument:
+        if tp.type == DataType.Index:
+            return Argument(ids=np.asarray(values, dtype=np.int32))
+        rows = np.stack([self._row(v, tp) for v in values])
+        return Argument(value=rows)
+
+    def _seq_slot(self, values, tp) -> Argument:
+        B = len(values)
+        lengths = np.asarray([len(v) for v in values], dtype=np.int32)
+        T = bucket_length(int(lengths.max()) if B else 1)
+        if tp.type == DataType.Index:
+            ids = np.zeros((B, T), dtype=np.int32)
+            for b, seq in enumerate(values):
+                ids[b, : len(seq)] = np.asarray(seq, dtype=np.int32)
+            return Argument(ids=ids, seq_lengths=lengths)
+        val = np.zeros((B, T, tp.dim), dtype=np.float32)
+        for b, seq in enumerate(values):
+            for t, item in enumerate(seq):
+                val[b, t] = self._row(item, tp)
+        return Argument(value=val, seq_lengths=lengths)
+
+    def _subseq_slot(self, values, tp) -> Argument:
+        B = len(values)
+        num_subs = np.asarray([len(v) for v in values], dtype=np.int32)
+        S = max(int(num_subs.max()) if B else 1, 1)
+        sub_lens = np.zeros((B, S), dtype=np.int32)
+        for b, sample in enumerate(values):
+            for s, sub in enumerate(sample):
+                sub_lens[b, s] = len(sub)
+        T = bucket_length(int(sub_lens.max()))
+        if tp.type == DataType.Index:
+            ids = np.zeros((B, S, T), dtype=np.int32)
+            for b, sample in enumerate(values):
+                for s, sub in enumerate(sample):
+                    ids[b, s, : len(sub)] = np.asarray(sub, dtype=np.int32)
+            return Argument(ids=ids, seq_lengths=num_subs, sub_seq_lengths=sub_lens)
+        val = np.zeros((B, S, T, tp.dim), dtype=np.float32)
+        for b, sample in enumerate(values):
+            for s, sub in enumerate(sample):
+                for t, item in enumerate(sub):
+                    val[b, s, t] = self._row(item, tp)
+        return Argument(value=val, seq_lengths=num_subs, sub_seq_lengths=sub_lens)
+
+
+class DataProvider:
+    """Pass-oriented batch iterator over a @provider object.
+
+    getNextBatch analog (/root/reference/paddle/gserver/dataproviders/
+    DataProvider.h:313) with shuffle pool and async double-buffering.
+    """
+
+    def __init__(
+        self,
+        provider_obj,
+        file_list: List[str],
+        batch_size: int,
+        slot_names: Sequence[str],
+        provider_kwargs: Optional[Dict] = None,
+        async_prefetch: bool = True,
+        seed: int = 1,
+        drop_last: bool = False,
+    ):
+        self.provider = provider_obj
+        self.file_list = file_list
+        self.batch_size = batch_size
+        self.settings = provider_obj.init(**(provider_kwargs or {}))
+        self.assembler = BatchAssembler(self.settings.input_types, slot_names)
+        self.async_prefetch = async_prefetch
+        self.rng = random.Random(seed)
+        self.drop_last = drop_last
+        self._cache: Optional[List] = None
+        self._use_cache = getattr(provider_obj, "cache", 0) == 1
+
+    # -- sample stream
+
+    def _samples(self) -> Iterator[Sequence[Any]]:
+        if self._use_cache and self._cache is not None:
+            yield from self._cache
+            return
+        collect = [] if self._use_cache else None
+        for fname in self.file_list:
+            for sample in self.provider.generator_fn(self.settings, fname):
+                if not isinstance(sample, (list, tuple, dict)):
+                    sample = [sample]
+                if collect is not None:
+                    collect.append(sample)
+                yield sample
+        if collect is not None:
+            self._cache = collect
+
+    def batches(self) -> Iterator[Dict[str, Argument]]:
+        """One pass of batches (shuffled within the pool)."""
+        if self.async_prefetch:
+            yield from self._double_buffered(self._batches_sync())
+        else:
+            yield from self._batches_sync()
+
+    def _batches_sync(self) -> Iterator[Dict[str, Argument]]:
+        pool_size = self.settings.pool_size
+        if pool_size is None or pool_size <= 0:
+            pool_size = 10000 * max(1, self.batch_size // 128 + 1)
+        pool: List = []
+        for sample in self._samples():
+            pool.append(sample)
+            if len(pool) >= pool_size:
+                yield from self._drain(pool, final=False)
+        yield from self._drain(pool, final=True)
+
+    def _drain(self, pool: List, final: bool) -> Iterator[Dict[str, Argument]]:
+        if self.settings.should_shuffle:
+            self.rng.shuffle(pool)
+        # keep a remainder in the pool between drains so shuffling mixes
+        # across pool boundaries
+        while len(pool) >= self.batch_size:
+            batch = pool[: self.batch_size]
+            del pool[: self.batch_size]
+            yield self.assembler.assemble(batch)
+        if final and pool and not self.drop_last:
+            yield self.assembler.assemble(pool)
+            pool.clear()
+
+    def _double_buffered(self, it: Iterator) -> Iterator:
+        """Background-thread prefetch (DoubleBuffer analog)."""
+        q: "queue.Queue" = queue.Queue(maxsize=4)
+        sentinel = object()
+        err: List[BaseException] = []
+
+        def worker():
+            try:
+                for item in it:
+                    q.put(item)
+            except BaseException as e:  # propagate into the consumer
+                err.append(e)
+            finally:
+                q.put(sentinel)
+
+        t = threading.Thread(target=worker, daemon=True, name="pt-data-prefetch")
+        t.start()
+        while True:
+            item = q.get()
+            if item is sentinel:
+                break
+            yield item
+        if err:
+            raise err[0]
+
+
+def create_data_provider(
+    data_config: DataConfig,
+    batch_size: int,
+    slot_names: Sequence[str],
+    async_prefetch: bool = True,
+    seed: int = 1,
+) -> DataProvider:
+    """Instantiate from a DataConfig (define_py_data_sources2 output)."""
+    import importlib
+    import os
+    import sys
+
+    assert data_config.type in ("py2", "py"), f"unsupported data type {data_config.type!r}"
+    # the provider module conventionally sits next to the config / file
+    # list (reference: PyDataProvider2.cpp loads the module by name with
+    # the config dir importable); make cwd + the list dir importable.
+    search = [os.getcwd(), os.path.dirname(os.path.abspath(data_config.files))]
+    added = [p for p in search if p not in sys.path]
+    sys.path[:0] = added
+    try:
+        module = importlib.import_module(data_config.load_data_module)
+    finally:
+        for p in added:
+            sys.path.remove(p)
+    provider_obj = getattr(module, data_config.load_data_object)
+    kwargs = json.loads(data_config.load_data_args) if data_config.load_data_args else {}
+    with open(data_config.files) as f:
+        files = [line.strip() for line in f if line.strip()]
+    return DataProvider(
+        provider_obj,
+        files,
+        batch_size,
+        slot_names,
+        provider_kwargs=kwargs,
+        async_prefetch=async_prefetch,
+        seed=seed,
+    )

@@ -67,18 +67,40 @@ class GradientMachine:
 
     # --------------------------------------------------------------- loss
 
+    # layer types whose output is a differentiable per-sample cost — only
+    # these contribute to the training loss (a prediction output like
+    # maxid can legally sit next to the cost in output_layer_names)
+    COST_TYPES = frozenset(
+        {
+            "multi-class-cross-entropy",
+            "multi_class_cross_entropy_with_selfnorm",
+            "square_error",
+            "multi_binary_label_cross_entropy",
+            "soft_binary_class_cross_entropy",
+            "rank-cost",
+            "huber",
+            "lambda_cost",
+            "ctc",
+            "crf",
+            "nce",
+            "hsigmoid",
+        }
+    )
+
     def total_cost(self, outputs: Dict[str, Argument]) -> Array:
-        """Mean per-sample cost summed across cost outputs.
+        """Mean per-sample cost summed across cost-layer outputs.
 
         The analog of Argument::sumCosts over the out args
         (/root/reference/paddle/parameter/Argument.h:168), normalized by
         batch size so gradients are per-sample means.
         """
+        layer_map = self.network.layer_map
         total = None
         for name in self.network.output_layer_names:
-            arg = outputs[name]
-            if arg.value is None or arg.value.ndim != 2 or arg.value.shape[-1] != 1:
+            cfg = layer_map.get(name)
+            if cfg is None or cfg.type not in self.COST_TYPES:
                 continue
+            arg = outputs[name]
             c = jnp.mean(arg.value[:, 0])
             total = c if total is None else total + c
         if total is None:
@@ -116,7 +138,7 @@ class GradientMachine:
         self,
         params: Params,
         in_args: Dict[str, Argument],
-        epsilon: float = 1e-3,
+        epsilon: float = 1e-4,
         max_entries: int = 20,
         rng: Optional[Array] = None,
         rtol: float = 5e-2,

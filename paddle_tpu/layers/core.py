@@ -77,8 +77,12 @@ def _context_projection(p: ProjectionConfig, arg: Argument, w: Optional[Array]) 
             # trainable padding: before-sequence offsets use pad rows
             # [0, begin_pad); after-sequence use rows [begin_pad, ...).
             if off < 0:
-                pad_row = w[begin_pad + off]  # rows 0..begin_pad-1
-                col = jnp.where((pos < 0)[:, :, None], pad_row[None, None, :], col)
+                # row index = begin_pad + pos for pos in [-begin_pad, 0)
+                # (reference ContextProjection keys the pad row off the
+                # out-of-range position, not the offset)
+                row_idx = jnp.clip(begin_pad + pos[0], 0, begin_pad - 1)  # [T]
+                pad_rows = w[row_idx]  # [T, D]
+                col = jnp.where((pos < 0)[:, :, None], pad_rows[None, :, :], col)
             elif off > 0:
                 lengths = (
                     arg.seq_lengths[:, None]

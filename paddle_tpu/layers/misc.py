@@ -197,8 +197,12 @@ def nce_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Ar
     inputs: feature(s) + label (+ optional per-sample weight). Samples
     num_neg_samples negatives from neg_sampling_dist (or uniform).
     """
-    label = inputs[-1]
-    feats = inputs[:-1]
+    # feature inputs are exactly those with a parameter attached
+    # (reference NCELayer.cpp:80-84: label then optional weight follow)
+    n_feat = sum(1 for ic in cfg.inputs if ic.input_parameter_name)
+    feats = inputs[:n_feat]
+    label = inputs[n_feat]
+    weight = inputs[n_feat + 1] if len(inputs) > n_feat + 1 else None
     num_classes = cfg.num_classes
     k = cfg.num_neg_samples
     pos = (label.ids if label.ids is not None else jnp.argmax(label.value, -1)).astype(jnp.int32)
@@ -225,4 +229,6 @@ def nce_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Ar
     labels01 = jnp.concatenate([jnp.ones((B, 1)), jnp.zeros((B, k))], axis=1)
     per = jnp.logaddexp(0.0, delta) - labels01 * delta
     cost = jnp.sum(per, axis=1)
+    if weight is not None and weight.value is not None:
+        cost = cost * weight.value.reshape(cost.shape)
     return Argument(value=cost[:, None])

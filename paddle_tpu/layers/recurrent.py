@@ -61,7 +61,7 @@ def recurrent_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext)
     a = inputs[0]
     x, mask = _prep(a)
     w = ctx.param(cfg.inputs[0].input_parameter_name).reshape(cfg.size, cfg.size)
-    b = ctx.param(cfg.bias_parameter_name) if cfg.bias_parameter_name else 0.0
+    b = ctx.param(cfg.bias_parameter_name).reshape(-1) if cfg.bias_parameter_name else 0.0
 
     def cell(h, x_t):
         h_new = apply_activation(cfg.active_type, x_t + jnp.dot(h, w) + b)
@@ -108,7 +108,7 @@ def lstmemory_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext)
     x, mask = _prep(a)  # [T, B, 4*size]
     size = cfg.size
     w = ctx.param(cfg.inputs[0].input_parameter_name).reshape(size, 4 * size)
-    bias = ctx.param(cfg.bias_parameter_name) if cfg.bias_parameter_name else None
+    bias = ctx.param(cfg.bias_parameter_name).reshape(-1) if cfg.bias_parameter_name else None
 
     def cell(carry, x_t):
         h, c = carry
@@ -150,7 +150,7 @@ def gated_recurrent_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerCo
     x, mask = _prep(a)
     size = cfg.size
     w = ctx.param(cfg.inputs[0].input_parameter_name).reshape(size, 3 * size)
-    bias = ctx.param(cfg.bias_parameter_name) if cfg.bias_parameter_name else None
+    bias = ctx.param(cfg.bias_parameter_name).reshape(-1) if cfg.bias_parameter_name else None
 
     def cell(h, x_t):
         h2 = gru_cell_step(cfg, x_t, h, w, bias)
@@ -171,7 +171,7 @@ def lstm_step_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext)
     x4, c_prev = inputs[0].value, inputs[1].value
     size = cfg.size
     w = jnp.zeros((size, 4 * size), x4.dtype)  # step layers have no recurrent weight
-    bias = ctx.param(cfg.bias_parameter_name) if cfg.bias_parameter_name else None
+    bias = ctx.param(cfg.bias_parameter_name).reshape(-1) if cfg.bias_parameter_name else None
     h_prev = jnp.zeros((x4.shape[0], size), x4.dtype)
     h, c = lstm_cell_step(cfg, x4, h_prev, c_prev, w, bias)
     ctx.outputs[f"{cfg.name}@state"] = Argument(value=c, seq_lengths=inputs[0].seq_lengths)
@@ -184,6 +184,6 @@ def gru_step_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) 
     x3, h_prev = inputs[0].value, inputs[1].value
     size = cfg.size
     w = ctx.param(cfg.inputs[0].input_parameter_name).reshape(size, 3 * size)
-    bias = ctx.param(cfg.bias_parameter_name) if cfg.bias_parameter_name else None
+    bias = ctx.param(cfg.bias_parameter_name).reshape(-1) if cfg.bias_parameter_name else None
     h = gru_cell_step(cfg, x3, h_prev, w, bias)
     return Argument(value=h, seq_lengths=inputs[0].seq_lengths)

@@ -64,7 +64,7 @@ def _conv_forward(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -
         h = w = cc.img_size
         fy = cc.filter_size_y or cc.filter_size
         sy = cc.stride_y or cc.stride
-        py = cc.padding_y if cc.filter_size_y else cc.padding
+        py = cc.padding_y if cc.padding_y >= 0 else cc.padding
         x = _nchw_to_nhwc(arg.value, cc.channels, h, w)
         wf = ctx.param(in_cfg.input_parameter_name)
         wf = wf.reshape(cfg.num_filters, cc.filter_channels, fy, cc.filter_size)
@@ -76,7 +76,10 @@ def _conv_forward(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -
         if cfg.shared_biases:
             acc = acc + b.reshape(1, 1, 1, cfg.num_filters)
         else:
-            acc = acc + b.reshape(1, acc.shape[1], acc.shape[2], cfg.num_filters)
+            # flat layout is filter-major [F, H, W] (reference
+            # addUnsharedBias over NCHW rows) — transpose into NHWC
+            b_hwf = b.reshape(cfg.num_filters, acc.shape[1], acc.shape[2]).transpose(1, 2, 0)
+            acc = acc + b_hwf[None]
     out = _nhwc_to_flat(acc)
     out = apply_activation(cfg.active_type, out)
     if cfg.drop_rate > 0.0 and ctx.is_training:
@@ -201,7 +204,9 @@ def norm_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> A
     acc = lax.reduce_window(
         sq, 0.0, lax.add, (1, 1, 1, nc.size), (1, 1, 1, 1), ((0, 0), (0, 0), (0, 0), (half, nc.size - 1 - half))
     )
-    denom = jnp.power(1.0 + (nc.scale / nc.size) * acc, nc.pow)
+    # NormConfig.scale already carries scale/size (the reference's
+    # config_parser divides before storing; our DSL does the same)
+    denom = jnp.power(1.0 + nc.scale * acc, nc.pow)
     y = x / denom
     return Argument(value=_nhwc_to_flat(y))
 

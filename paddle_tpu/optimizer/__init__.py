@@ -1,0 +1,4 @@
+from paddle_tpu.optimizer.updater import Updater
+from paddle_tpu.optimizer.schedules import learning_rate_at
+
+__all__ = ["Updater", "learning_rate_at"]

@@ -1,0 +1,43 @@
+"""Learning-rate schedules.
+
+Reference: /root/reference/paddle/parameter/LearningRateScheduler.cpp —
+registered by name: constant, poly, exp, discexp, linear, manual,
+pass_manual. ``t`` is the number of samples processed (the reference's
+numSamplesProcessed), so schedules are batch-size independent.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.proto import OptimizationConfig
+
+
+def learning_rate_at(opt: OptimizationConfig, num_samples_processed) -> jnp.ndarray:
+    lr = opt.learning_rate
+    a, b = opt.learning_rate_decay_a, opt.learning_rate_decay_b
+    t = num_samples_processed
+    sched = opt.learning_rate_schedule
+    if sched in ("constant", ""):
+        return jnp.asarray(lr)
+    if sched == "poly":
+        return lr * jnp.power(1.0 + a * t, -b)
+    if sched == "caffe_poly":
+        return lr * jnp.power(1.0 - t / a, b)
+    if sched == "exp":
+        return lr * jnp.power(a, t / b)
+    if sched == "discexp":
+        return lr * jnp.power(a, jnp.floor(t / b))
+    if sched == "linear":
+        return jnp.maximum(lr - a * t, b)
+    if sched == "manual":
+        # "seg1:lr1,seg2:lr2,..." — segment boundaries in samples
+        segs = []
+        for part in opt.learning_rate_args.split(","):
+            boundary, _, rate = part.partition(":")
+            segs.append((float(boundary), float(rate)))
+        out = jnp.asarray(segs[-1][1])
+        for boundary, rate in reversed(segs[:-1]):
+            out = jnp.where(t < boundary, rate, out)
+        return out
+    raise ValueError(f"unknown learning_rate_schedule {sched!r}")

@@ -1,0 +1,11 @@
+from paddle_tpu.parallel.mesh import make_mesh, MeshSpec
+from paddle_tpu.parallel.spmd import shard_train_step, shard_test_fwd, batch_sharding, param_sharding
+
+__all__ = [
+    "make_mesh",
+    "MeshSpec",
+    "shard_train_step",
+    "shard_test_fwd",
+    "batch_sharding",
+    "param_sharding",
+]

@@ -1,0 +1,83 @@
+"""Device mesh construction.
+
+The replacement for the reference's two distribution mechanisms — the
+intra-process GPU thread ring (MultiGradientMachine,
+/root/reference/paddle/gserver/gradientmachines/MultiGradientMachine.h:
+62-80) and the socket parameter-server (/root/reference/paddle/pserver/) —
+is ONE SPMD story: a `jax.sharding.Mesh` whose axes name the parallelism
+kinds, with XLA inserting the collectives over ICI/DCN.
+
+Axis conventions (used by spmd.py and parameter sharding specs):
+- "data"  — batch-dim data parallelism (the reference's only mode)
+- "model" — tensor parallelism (parameter dim sharding)
+- "seq"   — sequence/context parallelism (ring attention)
+- "pipe"  — pipeline stages
+- "expert"— expert parallelism
+Missing axes are simply absent from the mesh; specs referencing only
+present axes still work.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXIS_ORDER = ["pipe", "data", "expert", "seq", "model"]
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    axes: Tuple[Tuple[str, int], ...]
+
+    @classmethod
+    def parse(cls, spec: str) -> "MeshSpec":
+        """Parse "data=8" / "data=4,model=2" / "8" (implicit data)."""
+        spec = spec.strip()
+        if not spec:
+            return cls((("data", len(jax.devices())),))
+        axes: List[Tuple[str, int]] = []
+        for part in spec.split(","):
+            part = part.strip()
+            if "=" in part:
+                name, _, n = part.partition("=")
+                axes.append((name.strip(), int(n)))
+            else:
+                axes.append(("data", int(part)))
+        axes.sort(key=lambda kv: AXIS_ORDER.index(kv[0]) if kv[0] in AXIS_ORDER else 99)
+        return cls(tuple(axes))
+
+    @property
+    def size(self) -> int:
+        n = 1
+        for _, k in self.axes:
+            n *= k
+        return n
+
+    @property
+    def names(self) -> Tuple[str, ...]:
+        return tuple(n for n, _ in self.axes)
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return tuple(k for _, k in self.axes)
+
+
+def make_mesh(spec: str = "", devices: Optional[list] = None) -> Mesh:
+    ms = MeshSpec.parse(spec) if isinstance(spec, str) else spec
+    devices = devices if devices is not None else jax.devices()
+    if ms.size > len(devices):
+        raise ValueError(
+            f"mesh {ms.axes} needs {ms.size} devices but only {len(devices)} available"
+        )
+    dev = np.asarray(devices[: ms.size]).reshape(ms.shape)
+    return Mesh(dev, ms.names)
+
+
+def data_axis_names(mesh: Mesh) -> Tuple[str, ...]:
+    """Axes that shard the batch dimension (data and expert act as data
+    parallel for the dense path)."""
+    return tuple(n for n in mesh.axis_names if n in ("data",))

@@ -1,0 +1,112 @@
+"""SPMD sharding of the train/test step over a mesh.
+
+This replaces the reference's gradient ring (MultiGradientMachine.h:62-80)
+and pserver sync-SGD (ParameterServer2::addGradient/op_SGD,
+/root/reference/paddle/pserver/ParameterServer2.cpp:352,1035): instead of
+shipping gradients over threads/sockets, the ONE jitted step is compiled
+with sharded inputs — XLA partitions the computation and inserts
+psum/all-gather over ICI where the math requires it. Sync-SGD semantics
+(num_batches_per_send_parameter == 1) fall out exactly: the optimizer
+update sees the full-batch mean gradient every step. The async/stale path
+is deliberately not reproduced (docs/divergences.md).
+
+Sharding rules:
+- batch Arguments: leading axis over the "data" mesh axis
+- parameters: replicated, unless ParameterConfig.sharding names mesh axes
+  (tensor parallelism), e.g. sharding=["model", null] shards dim 0
+- optimizer slots follow their parameter's sharding
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddle_tpu.optimizer.updater import UpdaterState
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    if "data" in mesh.axis_names:
+        return NamedSharding(mesh, P("data"))
+    return NamedSharding(mesh, P())
+
+
+def param_sharding(mesh: Mesh, param_cfg) -> NamedSharding:
+    if param_cfg is not None and param_cfg.sharding:
+        axes = [a if (a and a in mesh.axis_names) else None for a in param_cfg.sharding]
+        return NamedSharding(mesh, P(*axes))
+    return NamedSharding(mesh, P())
+
+
+def _param_shardings(mesh: Mesh, gm) -> Dict[str, NamedSharding]:
+    return {name: param_sharding(mesh, cfg) for name, cfg in gm.param_configs.items()}
+
+
+def _opt_state_sharding(mesh: Mesh, param_shards: Dict[str, NamedSharding], opt_state: UpdaterState):
+    repl = NamedSharding(mesh, P())
+    slots = {
+        name: {slot: param_shards.get(name, repl) for slot in d}
+        for name, d in opt_state.slots.items()
+    }
+    avg = (
+        {name: param_shards.get(name, repl) for name in opt_state.avg_sum}
+        if opt_state.avg_sum is not None
+        else None
+    )
+    return UpdaterState(step=repl, num_samples=repl, slots=slots, avg_sum=avg, avg_count=repl)
+
+
+def _batch_tree_sharding(mesh: Mesh, batch) -> Any:
+    bs = batch_sharding(mesh)
+    return jax.tree_util.tree_map(lambda _: bs, batch)
+
+
+def shard_train_step(step, mesh: Mesh, gm):
+    """Wrap a (params, opt_state, batch, rng, batch_size) step with mesh
+    shardings. Shardings for the batch depend on its treedef, so the jit is
+    built lazily per batch structure and cached."""
+    param_shards = _param_shardings(mesh, gm)
+    repl = NamedSharding(mesh, P())
+    bs = batch_sharding(mesh)
+    cache: Dict[Any, Any] = {}
+
+    def call(params, opt_state, batch, rng, batch_size):
+        treedef = jax.tree_util.tree_structure((opt_state, batch))
+        fn = cache.get(treedef)
+        if fn is None:
+            p_spec = {k: param_shards.get(k, repl) for k in params}
+            o_spec = _opt_state_sharding(mesh, param_shards, opt_state)
+            b_spec = jax.tree_util.tree_map(lambda _: bs, batch)
+            # pin param/opt-state outputs to the same shardings as the
+            # inputs so step N's outputs are valid step N+1 inputs
+            fn = jax.jit(
+                step,
+                in_shardings=(p_spec, o_spec, b_spec, repl, repl),
+                out_shardings=(p_spec, o_spec, None, None),
+                donate_argnums=(0, 1),
+            )
+            cache[treedef] = fn
+        return fn(params, opt_state, batch, rng, batch_size)
+
+    return call
+
+
+def shard_test_fwd(fwd, mesh: Mesh, gm):
+    param_shards = _param_shardings(mesh, gm)
+    repl = NamedSharding(mesh, P())
+    bs = batch_sharding(mesh)
+    cache: Dict[Any, Any] = {}
+
+    def call(params, batch):
+        treedef = jax.tree_util.tree_structure(batch)
+        fn = cache.get(treedef)
+        if fn is None:
+            p_spec = {k: param_shards.get(k, repl) for k in params}
+            b_spec = jax.tree_util.tree_map(lambda _: bs, batch)
+            fn = jax.jit(fwd, in_shardings=(p_spec, b_spec))
+            cache[treedef] = fn
+        return fn(params, batch)
+
+    return call

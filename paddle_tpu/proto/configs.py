@@ -83,8 +83,8 @@ class ConvConfig(Message):
     img_size: int = 0
     caffe_mode: bool = True
     filter_size_y: int = 0
-    padding_y: int = 0
-    stride_y: int = 1
+    padding_y: int = -1   # -1 = unset → fall back to padding
+    stride_y: int = 0     # 0 = unset → fall back to stride
 
 
 @dataclass
@@ -277,6 +277,10 @@ class SubModelConfig(Message):
     in_links: List[LinkConfig] = field(default_factory=list)
     out_links: List[LinkConfig] = field(default_factory=list)
     generator: Optional[GeneratorConfig] = None
+    # TPU extension: whole-value (non-scattered) inputs to the group —
+    # the reference encodes these as ScatterAgent "real layers" at runtime;
+    # making them explicit keeps the config self-describing.
+    static_links: List[LinkConfig] = field(default_factory=list)
 
 
 @dataclass

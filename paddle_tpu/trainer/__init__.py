@@ -1,0 +1,5 @@
+from paddle_tpu.trainer.trainer import Trainer, TrainerStats
+from paddle_tpu.trainer.evaluators import EvaluatorChain, evaluator_registry
+from paddle_tpu.trainer import checkpoint
+
+__all__ = ["Trainer", "TrainerStats", "EvaluatorChain", "evaluator_registry", "checkpoint"]

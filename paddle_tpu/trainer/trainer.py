@@ -1,0 +1,288 @@
+"""Trainer — the pass/batch training driver.
+
+TPU-native replacement for the reference's Trainer/TrainerInternal
+(/root/reference/paddle/trainer/Trainer.cpp:266-477,
+TrainerInternal.cpp:64-170): the per-batch
+startBatch → forwardBackward(updateCallback) → finishBatch pipeline
+becomes ONE jit-compiled train_step (forward + grad + optimizer update
+fused by XLA, buffers donated); the pass loop, periodic test, stats,
+checkpointing and evaluators stay on the host.
+
+When a mesh is configured (opt_config.mesh_shape / FLAGS.mesh_shape) the
+step is sharded over devices — see paddle_tpu.parallel.spmd — which is the
+replacement for MultiGradientMachine's thread ring and the pserver's dense
+sync path.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import time
+from typing import Any, Dict, Iterator, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.data.feeder import DataProvider, create_data_provider
+from paddle_tpu.graph.argument import Argument
+from paddle_tpu.graph.machine import GradientMachine
+from paddle_tpu.optimizer import Updater
+from paddle_tpu.proto import TrainerConfig
+from paddle_tpu.trainer import checkpoint as ckpt
+from paddle_tpu.trainer.evaluators import EvaluatorChain
+from paddle_tpu.utils.flags import FLAGS
+from paddle_tpu.utils.logging import logger
+from paddle_tpu.utils.stats import global_stats, stat_timer
+
+
+class TrainerStats:
+    """Windowed cost averages (ref: TrainerInternal.h TrainerStats)."""
+
+    def __init__(self):
+        self.total_cost = 0.0
+        self.total_samples = 0
+        self.window_cost = 0.0
+        self.window_samples = 0
+
+    def add(self, cost_sum: float, n: int) -> None:
+        self.total_cost += cost_sum
+        self.total_samples += n
+        self.window_cost += cost_sum
+        self.window_samples += n
+
+    def reset_window(self) -> None:
+        self.window_cost = 0.0
+        self.window_samples = 0
+
+    def summary(self) -> str:
+        avg = self.total_cost / max(self.total_samples, 1)
+        cur = self.window_cost / max(self.window_samples, 1)
+        return f"samples={self.total_samples} AvgCost={avg:.6g} CurrentCost={cur:.6g}"
+
+
+class Trainer:
+    def __init__(self, config: TrainerConfig, flags=FLAGS):
+        self.config = config
+        self.flags = flags
+        self.gm = GradientMachine(config.model_config)
+        self.updater = Updater(config.opt_config, config.model_config)
+        self.params = self.gm.init_params(seed=flags.seed)
+        self.opt_state = self.updater.init_state(self.params)
+        self.start_pass = flags.start_pass or config.start_pass
+        self.save_dir = flags.save_dir or config.save_dir
+        self._train_step_fn = None
+        self._test_fwd_fn = None
+        self._mesh = None
+        mesh_shape = flags.mesh_shape or config.opt_config.mesh_shape
+        if mesh_shape:
+            from paddle_tpu.parallel.mesh import make_mesh
+
+            self._mesh = make_mesh(mesh_shape)
+        self._maybe_restore()
+
+    # ------------------------------------------------------------ restore
+
+    def _maybe_restore(self) -> None:
+        init_path = self.flags.init_model_path or self.config.init_model_path
+        if init_path:
+            self.params, opt_state, _ = ckpt.load_checkpoint(
+                init_path,
+                self.opt_state,
+                missing=self.flags.load_missing_parameter_strategy,
+                expected_params=self.params,
+            )
+            if opt_state is not None:
+                self.opt_state = opt_state
+            return
+        if self.start_pass > 0:
+            path = os.path.join(self.save_dir, ckpt.PASS_FMT % (self.start_pass - 1))
+            self.params, opt_state, _ = ckpt.load_checkpoint(
+                path, self.opt_state, expected_params=self.params
+            )
+            if opt_state is not None:
+                self.opt_state = opt_state
+
+    # ------------------------------------------------------------- steps
+
+    def _build_train_step(self):
+        grad_fn = self.gm.grad_fn()
+        updater = self.updater
+        eval_layers = set()
+        for e in self.config.model_config.evaluators:
+            eval_layers.update(e.input_layers)
+        out_layers = set(self.gm.network.output_layer_names) | eval_layers
+
+        def step(params, opt_state, in_args, rng, batch_size):
+            loss, grads, outputs, state_updates = grad_fn(params, in_args, rng)
+            new_params, new_opt = updater(params, grads, opt_state, batch_size)
+            for k, v in state_updates.items():
+                new_params[k] = v
+            keep = {k: v for k, v in outputs.items() if k in out_layers}
+            return new_params, new_opt, loss, keep
+
+        if self._mesh is not None:
+            from paddle_tpu.parallel.spmd import shard_train_step
+
+            return shard_train_step(step, self._mesh, self.gm)
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    def _build_test_fwd(self):
+        gm = self.gm
+
+        def fwd(params, in_args):
+            outputs, _ = gm.forward(params, in_args, pass_type="test", rng=None)
+            return outputs
+
+        if self._mesh is not None:
+            from paddle_tpu.parallel.spmd import shard_test_fwd
+
+            return shard_test_fwd(fwd, self._mesh, self.gm)
+        return jax.jit(fwd)
+
+    @property
+    def train_step(self):
+        if self._train_step_fn is None:
+            self._train_step_fn = self._build_train_step()
+        return self._train_step_fn
+
+    @property
+    def test_fwd(self):
+        if self._test_fwd_fn is None:
+            self._test_fwd_fn = self._build_test_fwd()
+        return self._test_fwd_fn
+
+    # ------------------------------------------------------------- data
+
+    def _provider(self, for_test: bool) -> Optional[DataProvider]:
+        dc = self.config.test_data_config if for_test else self.config.data_config
+        if dc is None:
+            return None
+        slot_names = self.config.model_config.input_layer_names
+        return create_data_provider(
+            dc,
+            self.config.opt_config.batch_size,
+            slot_names,
+            seed=self.flags.seed,
+        )
+
+    # ------------------------------------------------------------- train
+
+    def train(self, num_passes: Optional[int] = None) -> None:
+        num_passes = num_passes or self.flags.num_passes
+        train_provider = self._provider(for_test=False)
+        assert train_provider is not None, "no train data configured"
+        rng = jax.random.PRNGKey(self.flags.seed)
+        saved_pass = -1
+        for pass_id in range(self.start_pass, num_passes):
+            rng, pass_rng = jax.random.split(rng)
+            self.train_one_pass(pass_id, train_provider, pass_rng)
+            with stat_timer("test"):
+                self.test(pass_id=pass_id)
+            if self.save_dir and (pass_id + 1) % max(self.flags.saving_period, 1) == 0:
+                self.save(pass_id)
+                saved_pass = pass_id
+            logger.info(global_stats.summary())
+        if self.save_dir and saved_pass != num_passes - 1:
+            self.save(num_passes - 1, final=True)
+
+    def train_one_pass(self, pass_id: int, provider: DataProvider, rng) -> None:
+        stats = TrainerStats()
+        evaluators = EvaluatorChain(self.config.model_config)
+        evaluators.start()
+        log_period = self.flags.log_period
+        t0 = time.time()
+        batch_id = 0
+        for batch in provider.batches():
+            n = _batch_num_samples(batch)
+            rng, step_rng = jax.random.split(rng)
+            with stat_timer("train_step"):
+                self.params, self.opt_state, loss, outputs = self.train_step(
+                    self.params, self.opt_state, batch, step_rng, jnp.asarray(float(n))
+                )
+            stats.add(float(loss) * n, n)
+            evaluators.eval_batch(outputs)
+            batch_id += 1
+            if log_period and batch_id % log_period == 0:
+                logger.info(
+                    "Pass %d batch %d  %s  %s",
+                    pass_id,
+                    batch_id,
+                    stats.summary(),
+                    evaluators.summary(),
+                )
+                stats.reset_window()
+            if (
+                self.flags.saving_period_by_batches
+                and batch_id % self.flags.saving_period_by_batches == 0
+                and self.save_dir
+            ):
+                self.save(pass_id, batch_id=batch_id)
+        dt = time.time() - t0
+        rate = stats.total_samples / max(dt, 1e-9)
+        logger.info(
+            "Pass %d done: %s  %s  (%.1f samples/s)",
+            pass_id,
+            stats.summary(),
+            evaluators.summary(),
+            rate,
+        )
+
+    # -------------------------------------------------------------- test
+
+    def test(self, pass_id: int = -1) -> Dict[str, float]:
+        provider = self._provider(for_test=True)
+        if provider is None:
+            return {}
+        params = self.updater.averaged_params(self.params, self.opt_state)
+        stats = TrainerStats()
+        evaluators = EvaluatorChain(self.config.model_config)
+        evaluators.start()
+        for batch in provider.batches():
+            n = _batch_num_samples(batch)
+            outputs = self.test_fwd(params, batch)
+            cost = float(self.gm.total_cost(outputs))
+            stats.add(cost * n, n)
+            evaluators.eval_batch(outputs)
+        results = {"cost": stats.total_cost / max(stats.total_samples, 1)}
+        results.update(evaluators.results())
+        logger.info("Test (pass %d): %s  %s", pass_id, stats.summary(), evaluators.summary())
+        return results
+
+    # -------------------------------------------------------------- save
+
+    def save(self, pass_id: int, batch_id: Optional[int] = None, final: bool = False) -> None:
+        extra = {"config_json": self.config.to_json()}
+        if batch_id is not None:
+            extra["batch_id"] = batch_id
+        ckpt.save_checkpoint(
+            self.save_dir,
+            pass_id,
+            self.params,
+            self.opt_state,
+            extra_meta=extra,
+            keep=0 if final else 3,
+        )
+
+    # ---------------------------------------------------------- checkgrad
+
+    def check_gradient(self, epsilon: float = 1e-4, max_entries: int = 10) -> bool:
+        """--job=checkgrad (ref: Trainer.cpp:313-387)."""
+        provider = self._provider(for_test=False) or self._provider(for_test=True)
+        assert provider is not None, "checkgrad needs data"
+        batch = next(iter(provider.batches()))
+        report = self.gm.check_gradient(self.params, batch, epsilon, max_entries)
+        ok = True
+        for name, diff in sorted(report.items()):
+            status = "OK" if diff < 5e-2 else "FAIL"
+            if diff >= 5e-2:
+                ok = False
+            logger.info("checkgrad %-40s max_rel_diff=%.3e %s", name, diff, status)
+        return ok
+
+
+def _batch_num_samples(batch: Dict[str, Argument]) -> int:
+    for arg in batch.values():
+        return arg.batch_size
+    return 0

@@ -1,0 +1,53 @@
+"""Data source declaration (reference: trainer_config_helpers/
+data_sources.py define_py_data_sources2): binds train/test file lists to a
+python @provider module.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, Dict, Optional
+
+from paddle_tpu.config.builder import current_context
+from paddle_tpu.proto import DataConfig
+
+__all__ = ["define_py_data_sources2"]
+
+
+def _encode_args(args: Any) -> str:
+    if args is None:
+        return ""
+    import json
+
+    return json.dumps(args)
+
+
+def define_py_data_sources2(
+    train_list: Optional[str],
+    test_list: Optional[str],
+    module,
+    obj,
+    args: Optional[Dict] = None,
+) -> None:
+    ctx = current_context()
+    train_module = module[0] if isinstance(module, (list, tuple)) else module
+    test_module = module[1] if isinstance(module, (list, tuple)) else module
+    train_obj = obj[0] if isinstance(obj, (list, tuple)) else obj
+    test_obj = obj[1] if isinstance(obj, (list, tuple)) else obj
+    if train_list is not None:
+        ctx.trainer_config.data_config = DataConfig(
+            type="py2",
+            files=train_list,
+            load_data_module=train_module,
+            load_data_object=train_obj,
+            load_data_args=_encode_args(args),
+        )
+    if test_list is not None:
+        ctx.trainer_config.test_data_config = DataConfig(
+            type="py2",
+            files=test_list,
+            load_data_module=test_module,
+            load_data_object=test_obj,
+            load_data_args=_encode_args(args),
+            for_test=True,
+        )

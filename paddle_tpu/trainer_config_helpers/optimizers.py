@@ -1,0 +1,196 @@
+"""settings() and optimizer/regularization DSL objects.
+
+API-compatible with /root/reference/python/paddle/trainer_config_helpers/
+optimizers.py:73-338. Each optimizer maps to a learning_method name
+implemented in paddle_tpu.optimizer; regularization/model-average/clipping
+fold into OptimizationConfig and per-parameter defaults.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from paddle_tpu.config.builder import current_context
+
+__all__ = [
+    "Optimizer",
+    "BaseSGDOptimizer",
+    "MomentumOptimizer",
+    "AdamOptimizer",
+    "AdamaxOptimizer",
+    "AdaGradOptimizer",
+    "RMSPropOptimizer",
+    "DecayedAdaGradOptimizer",
+    "AdaDeltaOptimizer",
+    "BaseRegularization",
+    "L1Regularization",
+    "L2Regularization",
+    "ModelAverage",
+    "GradientClippingThreshold",
+    "settings",
+]
+
+
+class Optimizer:
+    def to_settings(self, s: dict, defaults: dict) -> None:
+        raise NotImplementedError
+
+
+class BaseSGDOptimizer(Optimizer):
+    pass
+
+
+class MomentumOptimizer(BaseSGDOptimizer):
+    def __init__(self, momentum: float = 0.0, sparse: bool = False):
+        self.momentum = momentum
+        self.sparse = sparse
+
+    def to_settings(self, s, defaults):
+        s["learning_method"] = "sparse_momentum" if self.sparse else "momentum"
+        defaults["momentum"] = self.momentum
+
+
+class AdamOptimizer(BaseSGDOptimizer):
+    def __init__(self, beta1: float = 0.9, beta2: float = 0.999, epsilon: float = 1e-8):
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def to_settings(self, s, defaults):
+        s["learning_method"] = "adam"
+        s["adam_beta1"] = self.beta1
+        s["adam_beta2"] = self.beta2
+        s["adam_epsilon"] = self.epsilon
+
+
+class AdamaxOptimizer(BaseSGDOptimizer):
+    def __init__(self, beta1: float = 0.9, beta2: float = 0.999):
+        self.beta1, self.beta2 = beta1, beta2
+
+    def to_settings(self, s, defaults):
+        s["learning_method"] = "adamax"
+        s["adam_beta1"] = self.beta1
+        s["adam_beta2"] = self.beta2
+
+
+class AdaGradOptimizer(BaseSGDOptimizer):
+    def to_settings(self, s, defaults):
+        s["learning_method"] = "adagrad"
+
+
+class RMSPropOptimizer(BaseSGDOptimizer):
+    def __init__(self, rho: float = 0.95, epsilon: float = 1e-6):
+        self.rho, self.epsilon = rho, epsilon
+
+    def to_settings(self, s, defaults):
+        s["learning_method"] = "rmsprop"
+        s["ada_rou"] = self.rho
+        s["ada_epsilon"] = self.epsilon
+
+
+class DecayedAdaGradOptimizer(BaseSGDOptimizer):
+    def __init__(self, rho: float = 0.95, epsilon: float = 1e-6):
+        self.rho, self.epsilon = rho, epsilon
+
+    def to_settings(self, s, defaults):
+        s["learning_method"] = "decayed_adagrad"
+        s["ada_rou"] = self.rho
+        s["ada_epsilon"] = self.epsilon
+
+
+class AdaDeltaOptimizer(BaseSGDOptimizer):
+    def __init__(self, rho: float = 0.95, epsilon: float = 1e-6):
+        self.rho, self.epsilon = rho, epsilon
+
+    def to_settings(self, s, defaults):
+        s["learning_method"] = "adadelta"
+        s["ada_rou"] = self.rho
+        s["ada_epsilon"] = self.epsilon
+
+
+class BaseRegularization(Optimizer):
+    def to_settings(self, s, defaults):
+        pass
+
+
+class L2Regularization(BaseRegularization):
+    def __init__(self, rate: float):
+        self.rate = rate
+
+    def to_settings(self, s, defaults):
+        # sgd path: becomes the per-parameter default decay_rate
+        # (reference: default_decay_rate(rate))
+        defaults["decay_rate"] = self.rate
+
+
+class L1Regularization(BaseRegularization):
+    def __init__(self, rate: float):
+        self.rate = rate
+
+    def to_settings(self, s, defaults):
+        defaults["decay_rate_l1"] = self.rate
+
+
+class ModelAverage(Optimizer):
+    def __init__(self, average_window, max_average_window=None, do_average_in_cpu=False):
+        self.average_window = average_window
+        self.max_average_window = max_average_window
+        self.do_average_in_cpu = do_average_in_cpu
+
+    def to_settings(self, s, defaults):
+        s["average_window"] = self.average_window
+        if self.max_average_window is not None:
+            s["max_average_window"] = self.max_average_window
+        s["do_average_in_cpu"] = self.do_average_in_cpu
+
+
+class GradientClippingThreshold(Optimizer):
+    def __init__(self, threshold: float):
+        self.threshold = threshold
+
+    def to_settings(self, s, defaults):
+        s["gradient_clipping_threshold"] = self.threshold
+        defaults["gradient_clipping_threshold"] = self.threshold
+
+
+def settings(
+    batch_size,
+    learning_rate: float = 1e-3,
+    learning_method: Optional[Optimizer] = None,
+    regularization: Optional[BaseRegularization] = None,
+    is_async: bool = False,
+    model_average: Optional[ModelAverage] = None,
+    gradient_clipping_threshold: Optional[float] = None,
+    learning_rate_decay_a: float = 0.0,
+    learning_rate_decay_b: float = 0.0,
+    learning_rate_schedule: Optional[str] = None,
+    learning_rate_args: str = "",
+    # TPU extensions
+    dtype: Optional[str] = None,
+    mesh_shape: Optional[str] = None,
+):
+    ctx = current_context()
+    s, defaults = ctx.settings, ctx.defaults
+    s["batch_size"] = batch_size
+    s["learning_rate"] = learning_rate
+    if learning_method is None:
+        learning_method = MomentumOptimizer()
+    assert isinstance(learning_method, Optimizer)
+    s["algorithm"] = "async_sgd" if is_async else "sgd"
+    learning_method.to_settings(s, defaults)
+    if regularization is not None:
+        regs = regularization if isinstance(regularization, (list, tuple)) else [regularization]
+        for r in regs:
+            r.to_settings(s, defaults)
+    if model_average is not None:
+        model_average.to_settings(s, defaults)
+    if gradient_clipping_threshold is not None:
+        GradientClippingThreshold(gradient_clipping_threshold).to_settings(s, defaults)
+    s["learning_rate_decay_a"] = learning_rate_decay_a
+    s["learning_rate_decay_b"] = learning_rate_decay_b
+    if learning_rate_schedule is not None:
+        s["learning_rate_schedule"] = learning_rate_schedule
+    if learning_rate_args:
+        s["learning_rate_args"] = learning_rate_args
+    if dtype is not None:
+        s["dtype"] = dtype
+    if mesh_shape is not None:
+        s["mesh_shape"] = mesh_shape

@@ -4,15 +4,35 @@ Tests run on a virtual 8-device CPU mesh (the analog of the reference's
 CPU-only stub build, /root/reference/paddle/cuda/include/stub/, which lets
 the whole suite run without accelerators): sharding/collective tests get 8
 devices; numerics match the TPU path because both are XLA.
+
+The environment may pre-register an accelerator PJRT plugin (e.g. the
+axon TPU tunnel) via sitecustomize and set JAX_PLATFORMS to it; tests must
+never claim the real chip, so we force the CPU platform and drop any
+non-CPU backend factories before any backend initializes.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402
+import jax._src.xla_bridge as _xb  # noqa: E402
+
+# jax may already be imported (sitecustomize registers the accelerator
+# plugin at interpreter start), so the env var was read too early —
+# override the config directly as well.
+jax.config.update("jax_platforms", "cpu")
+
+for _name in list(_xb._backend_factories):
+    if _name != "cpu":
+        del _xb._backend_factories[_name]
 
 jax.config.update("jax_threefry_partitionable", True)
+
+assert len(jax.devices()) == 8, (
+    "test suite expects 8 virtual CPU devices; got "
+    f"{jax.devices()} — check conftest ordering"
+)

@@ -1,0 +1,42 @@
+"""Synthetic bag-of-words text-classification provider (quick_start-shaped:
+compare /root/reference/demo/quick_start/dataprovider_bow.py's contract).
+
+"files" are seeds; samples are linearly separable bags of word ids so a
+logistic regression must reach low error.
+"""
+
+import random
+
+from paddle_tpu.data import (
+    integer_value,
+    integer_value_sequence,
+    provider,
+    sparse_binary_vector,
+)
+
+DICT_DIM = 100
+
+
+@provider(input_types=[sparse_binary_vector(DICT_DIM), integer_value(2)])
+def process(settings, filename):
+    seed = int(filename)
+    rng = random.Random(seed)
+    for _ in range(400):
+        label = rng.randint(0, 1)
+        # class-dependent vocabulary halves with a little noise
+        lo, hi = (0, DICT_DIM // 2) if label == 0 else (DICT_DIM // 2, DICT_DIM)
+        words = {rng.randrange(lo, hi) for _ in range(rng.randint(5, 15))}
+        words |= {rng.randrange(0, DICT_DIM) for _ in range(2)}
+        yield [sorted(words), label]
+
+
+@provider(input_types=[integer_value_sequence(DICT_DIM), integer_value(2)])
+def process_seq(settings, filename):
+    seed = int(filename)
+    rng = random.Random(seed)
+    for _ in range(200):
+        label = rng.randint(0, 1)
+        lo, hi = (0, DICT_DIM // 2) if label == 0 else (DICT_DIM // 2, DICT_DIM)
+        length = rng.randint(3, 20)
+        seq = [rng.randrange(lo, hi) for _ in range(length)]
+        yield [seq, label]

@@ -1,0 +1,130 @@
+"""End-to-end Trainer tests — the Milestone A slice (SURVEY.md §7 stage 3):
+a quick_start-style config trains through provider → Trainer → checkpoint,
+and quality reaches the expected range.
+"""
+
+import os
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from paddle_tpu.config import parse_config
+from paddle_tpu.trainer import Trainer, checkpoint
+from paddle_tpu.utils.flags import FLAGS
+
+PROVIDER_DIR = os.path.join(os.path.dirname(__file__), "providers")
+
+
+@pytest.fixture(autouse=True)
+def _provider_path():
+    sys.path.insert(0, PROVIDER_DIR)
+    yield
+    sys.path.remove(PROVIDER_DIR)
+
+
+def write_lists(tmp_path):
+    train_list = tmp_path / "train.list"
+    train_list.write_text("1\n2\n3\n")
+    test_list = tmp_path / "test.list"
+    test_list.write_text("99\n")
+    return str(train_list), str(test_list)
+
+
+def lr_config(tmp_path):
+    train_list, test_list = write_lists(tmp_path)
+    src = textwrap.dedent(f"""
+    from paddle_tpu.trainer_config_helpers import *
+
+    define_py_data_sources2(train_list={train_list!r}, test_list={test_list!r},
+                            module="synthetic_bow", obj="process")
+    settings(batch_size=64, learning_rate=0.02, learning_method=AdamOptimizer())
+    data = data_layer(name="word", size=100)
+    output = fc_layer(input=data, size=2, act=SoftmaxActivation(), name="output")
+    label = data_layer(name="label", size=2)
+    cls = classification_cost(input=output, label=label)
+    outputs(cls)
+    """)
+    cfg_path = tmp_path / "lr_config.py"
+    cfg_path.write_text(src)
+    return str(cfg_path)
+
+
+def test_lr_trains_end_to_end(tmp_path):
+    cfg = parse_config(lr_config(tmp_path))
+    FLAGS.save_dir = str(tmp_path / "out")
+    FLAGS.num_passes = 3
+    FLAGS.log_period = 0
+    FLAGS.start_pass = 0
+    FLAGS.init_model_path = ""
+    trainer = Trainer(cfg)
+    trainer.train(num_passes=3)
+    results = trainer.test()
+    err = [v for k, v in results.items() if "classification_error" in k][0]
+    assert err < 0.1, f"LR failed to learn: error={err}"
+    # checkpoints exist and load back
+    last = checkpoint.latest_pass(str(tmp_path / "out"))
+    assert last == 2
+    params, opt_state, meta = checkpoint.load_checkpoint(
+        os.path.join(str(tmp_path / "out"), checkpoint.PASS_FMT % last),
+        trainer.opt_state,
+    )
+    assert set(params) == set(trainer.params)
+    assert opt_state is not None and int(opt_state.step) > 0
+
+
+def test_resume_from_checkpoint(tmp_path):
+    cfg = parse_config(lr_config(tmp_path))
+    FLAGS.save_dir = str(tmp_path / "out")
+    FLAGS.log_period = 0
+    FLAGS.start_pass = 0
+    FLAGS.init_model_path = ""
+    t1 = Trainer(cfg)
+    t1.train(num_passes=1)
+    FLAGS.start_pass = 1
+    t2 = Trainer(cfg)
+    np.testing.assert_allclose(
+        np.asarray(t1.params["_output.w0"]), np.asarray(t2.params["_output.w0"])
+    )
+    assert int(t2.opt_state.step) == int(t1.opt_state.step)
+    t2.train(num_passes=2)
+    FLAGS.start_pass = 0
+
+
+def test_checkgrad_job(tmp_path):
+    cfg = parse_config(lr_config(tmp_path))
+    FLAGS.save_dir = ""
+    FLAGS.start_pass = 0
+    FLAGS.init_model_path = ""
+    trainer = Trainer(cfg)
+    assert trainer.check_gradient(max_entries=5)
+
+
+def test_lstm_sequence_trains(tmp_path):
+    train_list, test_list = write_lists(tmp_path)
+    src = textwrap.dedent(f"""
+    from paddle_tpu.trainer_config_helpers import *
+
+    define_py_data_sources2(train_list={train_list!r}, test_list={test_list!r},
+                            module="synthetic_bow", obj="process_seq")
+    settings(batch_size=32, learning_rate=0.01, learning_method=AdamOptimizer())
+    words = data_layer(name="words", size=100)
+    emb = embedding_layer(input=words, size=16)
+    lstm = simple_lstm(input=emb, size=16)
+    pool = pooling_layer(input=lstm, pooling_type=MaxPooling())
+    output = fc_layer(input=pool, size=2, act=SoftmaxActivation(), name="output")
+    label = data_layer(name="label", size=2)
+    outputs(classification_cost(input=output, label=label))
+    """)
+    cfg_path = tmp_path / "lstm_config.py"
+    cfg_path.write_text(src)
+    cfg = parse_config(str(cfg_path))
+    FLAGS.save_dir = ""
+    FLAGS.log_period = 0
+    FLAGS.start_pass = 0
+    trainer = Trainer(cfg)
+    trainer.train(num_passes=3)
+    results = trainer.test()
+    err = [v for k, v in results.items() if "classification_error" in k][0]
+    assert err < 0.15, f"LSTM failed to learn: error={err}"
