@@ -64,6 +64,9 @@ class Network:
         return ctx.outputs
 
     def _lookup_input(self, ctx: LayerContext, name: str, arg_name: str = "") -> Argument:
+        if not name:
+            # parameter-only input slot (e.g. batch_norm moving stats)
+            return Argument()
         key = f"{name}@{arg_name}" if arg_name else name
         if key not in ctx.outputs:
             raise KeyError(

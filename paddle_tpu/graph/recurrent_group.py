@@ -1,26 +1,350 @@
-"""Recurrent-group executor (analog of RecurrentGradientMachine).
+"""Recurrent-group executor — the RecurrentGradientMachine analog.
 
-Compiles a recurrent sub-model (/root/reference/paddle/gserver/
-gradientmachines/RecurrentGradientMachine.cpp) into a ``lax.scan`` over the
-padded time axis: scatter/gather agents become per-step slices, memory
-links become scan carries, and generation becomes greedy/beam search under
-``lax.while_loop`` (see paddle_tpu.ops.beam_search).
+Reference: /root/reference/paddle/gserver/gradientmachines/
+RecurrentGradientMachine.cpp (1174 LoC). There, the engine clones the
+sub-network per timestep (resizeOrCreateFrames :296), scatters sorted
+ragged sequences into frames via Scatter/GatherAgentLayers, walks frames
+forward then backward, and implements generation as an imperative beam
+search (:717, :1114).
+
+TPU-native formulation:
+- training/eval: ONE ``lax.scan`` over the padded time axis. Scatter
+  agents become per-step slices of [B, T, D]; memory links become scan
+  carries (masked so padding passes state through); gather agents are the
+  stacked scan outputs. XLA unrolls nothing — one compiled step reused T
+  times, backward derived by jax.grad through the scan.
+- generation: a fixed-length ``lax.scan`` over max_num_frames implementing
+  batched beam search with static shapes (beam reindexing via
+  take_along_axis, finished-beam masking) — the replacement for the
+  pointer-chasing beamSearch loop.
+
+Sub-sequence (nested) groups and sequence-valued memories raise
+NotImplementedError for now (tracked divergence).
 """
 
 from __future__ import annotations
 
-from typing import Dict
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
 from paddle_tpu.graph.argument import Argument
-from paddle_tpu.layers.base import LayerContext
-from paddle_tpu.proto import LayerConfig
+from paddle_tpu.layers.base import LayerContext, forward_layer, register_layer
+from paddle_tpu.ops.activations import apply_activation
+from paddle_tpu.proto import LayerConfig, SubModelConfig
+
+Array = jax.Array
+
+
+@register_layer(
+    "agent",
+    "sequence_agent",
+    "scatter_agent",
+    "sequence_scatter_agent",
+    "gather_agent",
+    "sequence_gather_agent",
+)
+def _agent_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Argument:
+    raise RuntimeError(
+        f"agent layer {cfg.name!r} executed outside a recurrent group — "
+        "agents are fed by the group executor"
+    )
 
 
 def forward_recurrent_group(network, cfg: LayerConfig, ctx: LayerContext) -> None:
-    raise NotImplementedError(
-        "recurrent_layer_group execution lands with the sequence-machinery "
-        "stage (SURVEY.md §7 step 6)"
+    sub = network.submodel_map.get(cfg.name)
+    assert sub is not None, f"no sub-model named {cfg.name!r}"
+    if sub.generator is not None:
+        _generate(network, cfg, sub, ctx)
+    else:
+        _forward_scan(network, cfg, sub, ctx)
+
+
+# ------------------------------------------------------------- training
+
+
+def _is_int_carry(x: Array) -> bool:
+    return jnp.issubdtype(x.dtype, jnp.integer)
+
+
+def _carry_to_arg(carry: Array) -> Argument:
+    if _is_int_carry(carry):
+        return Argument(ids=carry)
+    return Argument(value=carry)
+
+
+def _resolve_outer(sub: SubModelConfig, name: str) -> str:
+    """Map an in-group agent name back to the outer layer feeding it."""
+    for link in list(sub.static_links) + list(sub.in_links):
+        if link.link_name == name:
+            return link.layer_name
+    return name
+
+
+def _memory_boot(network, mem, ctx: LayerContext, batch: int, dtype, sub: SubModelConfig) -> Array:
+    size = network.layer_map[mem.link_name].size
+    if mem.boot_layer_name:
+        boot = ctx.outputs[_resolve_outer(sub, mem.boot_layer_name)].value
+    elif mem.boot_with_const_id >= 0:
+        boot = jnp.full((batch,), mem.boot_with_const_id, jnp.int32)
+        return boot
+    else:
+        boot = jnp.zeros((batch, size), dtype)
+    if mem.boot_bias_parameter_name:
+        boot = boot + ctx.param(mem.boot_bias_parameter_name).reshape(-1)
+        boot = apply_activation(mem.boot_bias_active_type, boot)
+    return boot
+
+
+def _run_submodel_step(
+    network,
+    sub: SubModelConfig,
+    ctx: LayerContext,
+    fed: Dict[str, Argument],
+    rng: Optional[Array],
+) -> Dict[str, Argument]:
+    """Run the sub-model's layers once with pre-fed agent outputs."""
+    step_ctx = LayerContext(
+        params=ctx.params,
+        model=ctx.model,
+        pass_type=ctx.pass_type,
+        rng=rng,
+        states=ctx.states,
+        dtype=ctx.dtype,
     )
+    step_ctx.outputs.update(fed)
+    for name in sub.layer_names:
+        lcfg = network.layer_map[name]
+        if lcfg.name in step_ctx.outputs:
+            continue
+        ins = [
+            network._lookup_input(step_ctx, ic.input_layer_name, ic.input_layer_argument)
+            for ic in lcfg.inputs
+        ]
+        forward_layer(lcfg, ins, step_ctx)
+    # NOTE: state updates produced inside the scan body (batch_norm moving
+    # stats) would be scan tracers — propagating them out would leak.
+    # Running statistics are not updated inside recurrent groups
+    # (divergence; the reference shares this limitation in practice since
+    # BN inside a step sees per-frame batches).
+    return step_ctx.outputs
+
+
+def _forward_scan(network, cfg: LayerConfig, sub: SubModelConfig, ctx: LayerContext) -> None:
+    for link in sub.in_links:
+        if link.has_subseq:
+            raise NotImplementedError("nested (sub-sequence) recurrent groups not yet supported")
+    assert sub.in_links, f"recurrent group {cfg.name} has no sequence inputs"
+    first = ctx.outputs[sub.in_links[0].layer_name]
+    assert first.is_seq, f"in-link {sub.in_links[0].layer_name!r} is not a sequence"
+    lengths = first.seq_lengths
+    B, T = first.batch_size, first.max_len
+    mask_bt = first.seq_mask()  # [B, T]
+
+    # time-major stacked in-link slices
+    xs_vals: Dict[str, Array] = {}
+    xs_ids: Dict[str, Array] = {}
+    for link in sub.in_links:
+        arg = ctx.outputs[link.layer_name]
+        if arg.value is not None:
+            xs_vals[link.link_name] = jnp.swapaxes(arg.value, 0, 1)  # [T, B, D]
+        if arg.ids is not None:
+            xs_ids[link.link_name] = jnp.swapaxes(arg.ids, 0, 1)  # [T, B]
+
+    statics: Dict[str, Argument] = {
+        link.link_name: ctx.outputs[link.layer_name] for link in sub.static_links
+    }
+
+    memories = list(sub.memories)
+    for mem in memories:
+        if mem.is_sequence:
+            raise NotImplementedError("sequence-valued memories not yet supported")
+    # carry dtype must match the traced computation (x64 gradient checks
+    # promote everything), so follow the data rather than ctx.dtype
+    carry_dtype = first.value.dtype if first.value is not None else ctx.dtype
+    init_carries = tuple(
+        _memory_boot(network, mem, ctx, B, carry_dtype, sub) for mem in memories
+    )
+    out_links = list(sub.out_links)
+    base_rng = ctx.rng
+
+    def step(carries, inp):
+        x_v, x_i, m_t, t_idx = inp
+        fed: Dict[str, Argument] = {}
+        for name, v in x_v.items():
+            fed[name] = Argument(value=v, ids=x_i.get(name))
+        for name, i in x_i.items():
+            if name not in fed:
+                fed[name] = Argument(ids=i)
+        for name, arg in statics.items():
+            fed[name] = arg
+        for mem, carry in zip(memories, carries):
+            fed[mem.link_name] = _carry_to_arg(carry)
+        rng = jax.random.fold_in(base_rng, t_idx) if base_rng is not None else None
+        outs = _run_submodel_step(network, sub, ctx, fed, rng)
+        new_carries = []
+        m = m_t[:, None]
+        for mem, old in zip(memories, carries):
+            out_arg = outs[mem.layer_name]
+            new = out_arg.value if not _is_int_carry(old) else out_arg.ids
+            keep = m > 0 if new.ndim == 2 else m_t > 0
+            new_carries.append(jnp.where(keep, new, old))
+        ys = tuple(outs[l.layer_name].value * m for l in out_links)
+        return tuple(new_carries), ys
+
+    xs = (
+        xs_vals,
+        xs_ids,
+        jnp.swapaxes(mask_bt, 0, 1),
+        jnp.arange(T, dtype=jnp.int32),
+    )
+    _, ys = jax.lax.scan(step, init_carries, xs, reverse=bool(sub.reversed))
+    for link, y in zip(out_links, ys):
+        ctx.outputs[link.link_name] = Argument(
+            value=jnp.swapaxes(y, 0, 1), seq_lengths=lengths
+        )
+    # the group layer itself exposes the first out-link
+    if out_links:
+        ctx.outputs[cfg.name] = ctx.outputs[out_links[0].link_name]
+
+
+# ------------------------------------------------------------ generation
+
+
+def _expand_beams(arg: Argument, K: int) -> Argument:
+    """Tile an Argument's batch dim by the beam width: [B, ...] → [B*K, ...]."""
+
+    def rep(x):
+        return None if x is None else jnp.repeat(x, K, axis=0)
+
+    return Argument(
+        value=rep(arg.value),
+        ids=rep(arg.ids),
+        seq_lengths=rep(arg.seq_lengths),
+        sub_seq_lengths=rep(arg.sub_seq_lengths),
+        weight=rep(arg.weight),
+    )
+
+
+def _generate(network, cfg: LayerConfig, sub: SubModelConfig, ctx: LayerContext) -> None:
+    """Batched beam search (ref: RecurrentGradientMachine::beamSearch
+    :1114 and oneWaySearch :786 — greedy is beam_size=1)."""
+    gen = sub.generator
+    K = max(int(cfg.beam_size or gen.beam_size), 1)
+    L = int(gen.max_num_frames)
+    assert L > 0, "generator needs max_num_frames (beam_search max_length)"
+    bos, eos = int(cfg.bos_id), int(cfg.eos_id)
+
+    # batch size from any static link or boot layer
+    B = None
+    statics: Dict[str, Argument] = {}
+    for link in sub.static_links:
+        arg = ctx.outputs[link.layer_name]
+        statics[link.link_name] = _expand_beams(arg, K)
+        B = arg.batch_size if B is None else B
+    memories = list(sub.memories)
+    boots = []
+    for mem in memories:
+        if mem.is_sequence:
+            raise NotImplementedError("sequence-valued memories in generation")
+        if mem.boot_layer_name and B is None:
+            B = ctx.outputs[mem.boot_layer_name].batch_size
+    assert B is not None, f"generation group {cfg.name}: cannot infer batch size"
+    gen_dtype = ctx.dtype
+    for arg in statics.values():
+        if arg.value is not None:
+            gen_dtype = arg.value.dtype
+            break
+    for mem in memories:
+        boots.append(_memory_boot(network, mem, ctx, B, gen_dtype, sub))
+    # expand memories across beams: [B, D] → [B*K, D]
+    carries0 = tuple(
+        jnp.repeat(b, K, axis=0) for b in boots
+    )
+
+    if sub.in_links:
+        raise NotImplementedError(
+            f"generation group {cfg.name}: plain sequence inputs are not "
+            "supported during generation — wrap encoder outputs in "
+            "StaticInput(..., is_seq=True)"
+        )
+    # the feed agent for previously generated ids (created by beam_search())
+    predict_agent = f"__generated_id@{cfg.name}"
+    assert predict_agent in network.layer_map, "generation group missing the generated-id agent"
+    score_layer = sub.out_links[0].layer_name
+
+    neg_inf = jnp.asarray(-1e30, gen_dtype)
+    init_state = (
+        carries0,
+        jnp.full((B * K,), bos, jnp.int32),                  # prev token per beam
+        jnp.concatenate(                                      # cum log prob [B, K]
+            [jnp.zeros((B, 1), gen_dtype), jnp.full((B, K - 1), neg_inf, gen_dtype)], axis=1
+        )
+        if K > 1
+        else jnp.zeros((B, 1), gen_dtype),
+        jnp.zeros((B, K), bool),                              # finished
+        jnp.zeros((B, K, L), jnp.int32),                      # token history
+        jnp.zeros((B, K), jnp.int32),                         # lengths
+    )
+    base_rng = ctx.rng
+
+    def step(state, t_idx):
+        carries, prev_tok, cum, finished, history, lens = state
+        fed: Dict[str, Argument] = {predict_agent: Argument(ids=prev_tok)}
+        for name, arg in statics.items():
+            fed[name] = arg
+        for mem, carry in zip(memories, carries):
+            fed[mem.link_name] = Argument(value=carry)
+        rng = jax.random.fold_in(base_rng, t_idx) if base_rng is not None else None
+        outs = _run_submodel_step(network, sub, ctx, fed, rng)
+        probs = outs[score_layer].value  # [B*K, V]
+        V = probs.shape[-1]
+        logp = jnp.log(jnp.clip(probs, 1e-20, None)).reshape(B, K, V)
+        fin = finished[:, :, None]
+        # finished beams may only "emit" eos with no score change
+        eos_onehot = jax.nn.one_hot(eos, V, dtype=logp.dtype)
+        logp = jnp.where(fin, jnp.log(eos_onehot + 1e-20)[None, None, :], logp)
+        total = cum[:, :, None] + logp  # [B, K, V]
+        flat = total.reshape(B, K * V)
+        top_scores, top_idx = jax.lax.top_k(flat, K)  # [B, K]
+        beam_idx = top_idx // V                        # [B, K]
+        token = (top_idx % V).astype(jnp.int32)        # [B, K]
+        # advance memories with this step's outputs, then reindex by the
+        # selected beams
+        flat_sel = (jnp.arange(B)[:, None] * K + beam_idx).reshape(-1)  # [B*K]
+        stepped = tuple(outs[mem.layer_name].value for mem in memories)  # [B*K, D]
+        # finished beams freeze their state
+        fin_flat = finished.reshape(-1, 1)
+        frozen = tuple(
+            jnp.where(fin_flat, old, new) for old, new in zip(carries, stepped)
+        )
+        new_carries = tuple(c[flat_sel] for c in frozen)
+        finished = jnp.take_along_axis(finished, beam_idx, axis=1)
+        lens = jnp.take_along_axis(lens, beam_idx, axis=1)
+        history = jnp.take_along_axis(history, beam_idx[:, :, None], axis=1)
+        history = history.at[:, :, t_idx].set(jnp.where(finished, eos, token))
+        lens = jnp.where(finished, lens, lens + 1)
+        finished = finished | (token == eos)
+        return (
+            new_carries,
+            token.reshape(-1),
+            top_scores,
+            finished,
+            history,
+            lens,
+        ), None
+
+    state, _ = jax.lax.scan(step, init_state, jnp.arange(L, dtype=jnp.int32))
+    _, _, scores, finished, history, lens = state
+    # best beam per sample (beams are kept sorted by top_k, but normalize
+    # defensively by picking argmax score)
+    best = jnp.argmax(scores, axis=1)  # [B]
+    best_tokens = jnp.take_along_axis(history, best[:, None, None], axis=1)[:, 0]  # [B, L]
+    best_lens = jnp.take_along_axis(lens, best[:, None], axis=1)[:, 0]
+    ctx.outputs[cfg.name] = Argument(ids=best_tokens, seq_lengths=best_lens)
+    ctx.outputs[f"{cfg.name}@beams"] = Argument(
+        ids=history, value=scores, seq_lengths=jnp.full((B,), K, jnp.int32),
+        sub_seq_lengths=lens,
+    )
+    ctx.outputs[score_layer] = ctx.outputs[cfg.name]

@@ -1124,12 +1124,12 @@ def recurrent_group(
     sub = ctx.begin_submodel(name)
     sub.reversed = reverse
     proxies: List[LayerOutput] = []
-    generator = None
     for item in inputs:
         if isinstance(item, GeneratedInput):
-            generator = item
-            proxies.append(item)  # replaced by beam_search machinery
-            continue
+            raise ValueError(
+                "GeneratedInput is only valid with beam_search(); use "
+                "beam_search(step=..., input=[...]) for generation groups"
+            )
         if isinstance(item, SubsequenceInput):
             outer = item.input
             agent_name = f"{outer.name}@{name}"
@@ -1157,23 +1157,13 @@ def recurrent_group(
     # the parent-scope group layer that triggers sub-model execution
     group_cfg = LayerConfig(name=name, type="recurrent_layer_group", size=out_list[0].size)
     for item in inputs:
-        if isinstance(item, GeneratedInput):
-            continue
         outer = item.input if isinstance(item, (StaticInput, SubsequenceInput)) else item
         group_cfg.inputs.append(LayerInputConfig(input_layer_name=outer.name))
     for m in sub.memories:
         if m.boot_layer_name:
             group_cfg.inputs.append(LayerInputConfig(input_layer_name=m.boot_layer_name))
     ctx.add_layer(group_cfg)
-    if generator is not None:
-        _attach_generator(sub, generator)
-    return outs if not isinstance(outs, LayerOutput) else outs
-
-
-def _attach_generator(sub, gen: GeneratedInput) -> None:
-    sub.generator = GeneratorConfig(
-        max_num_frames=0, eos_layer_name="", beam_size=1, num_results_per_sample=1
-    )
+    return outs
 
 
 def lstm_step_layer(

@@ -1,0 +1,299 @@
+"""Recurrent-group executor tests.
+
+Mirrors the reference's test_RecurrentGradientMachine methodology
+(/root/reference/paddle/gserver/tests/): a recurrent_group built from step
+layers must numerically match the monolithic fused recurrent layer, and
+generation must terminate/shape correctly.
+"""
+
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.config import parse_config
+from paddle_tpu.graph import GradientMachine, make_dense, make_ids, make_seq
+
+
+def parse_str(src: str):
+    import os
+    import tempfile
+
+    with tempfile.NamedTemporaryFile("w", suffix=".py", delete=False) as f:
+        f.write(textwrap.dedent(src))
+        path = f.name
+    try:
+        return parse_config(path)
+    finally:
+        os.unlink(path)
+
+
+GRU_PAIR = """
+from paddle_tpu.trainer_config_helpers import *
+settings(batch_size=4, learning_rate=1e-3)
+x = data_layer(name="x", size=12)
+# monolithic fused GRU
+m1 = mixed_layer(name="proj_a", size=18,
+                 input=[full_matrix_projection(x, param_attr=ParamAttr(name="w_in"))],
+                 bias_attr=False)
+g1 = grumemory(input=m1, name="gru_fused",
+               param_attr=ParamAttr(name="w_rec"),
+               bias_attr=ParamAttr(name="b_rec"))
+# recurrent_group built from gru_step
+m2 = mixed_layer(name="proj_b", size=18,
+                 input=[full_matrix_projection(x, param_attr=ParamAttr(name="w_in"))],
+                 bias_attr=False)
+g2 = gru_group(input=m2, name="gru_grouped", size=6,
+               gru_bias_attr=ParamAttr(name="b_rec2"))
+outputs(g1)
+outputs(g2)
+"""
+
+
+def test_gru_group_matches_fused():
+    tc = parse_str(GRU_PAIR)
+    gm = GradientMachine(tc.model_config)
+    params = gm.init_params(seed=5)
+    # tie the recurrent weights/biases of both implementations
+    grouped_w = [k for k in params if k.startswith("_gru_grouped.w")]
+    assert len(grouped_w) == 1, sorted(params)
+    params[grouped_w[0]] = params["w_rec"].reshape(params[grouped_w[0]].shape)
+    params["b_rec2"] = params["b_rec"].reshape(params["b_rec2"].shape)
+    rng = np.random.RandomState(0)
+    B, T = 3, 7
+    x = rng.randn(B, T, 12).astype(np.float32)
+    lengths = np.array([7, 4, 1], np.int32)
+    batch = {"x": make_seq(jnp.asarray(x), jnp.asarray(lengths))}
+    out, _ = gm.forward(params, batch, "test")
+    fused = np.asarray(out["gru_fused"].value)
+    grouped = np.asarray(out["gru_grouped"].value)
+    np.testing.assert_allclose(fused, grouped, rtol=2e-5, atol=1e-5)
+
+
+LSTM_PAIR = """
+from paddle_tpu.trainer_config_helpers import *
+settings(batch_size=4, learning_rate=1e-3)
+x = data_layer(name="x", size=10)
+m1 = mixed_layer(name="proj_a", size=24,
+                 input=[full_matrix_projection(x, param_attr=ParamAttr(name="w_in"))],
+                 bias_attr=False)
+l1 = lstmemory(input=m1, name="lstm_fused",
+               param_attr=ParamAttr(name="w_rec"),
+               bias_attr=ParamAttr(name="b_rec"))
+m2 = mixed_layer(name="proj_b", size=24,
+                 input=[full_matrix_projection(x, param_attr=ParamAttr(name="w_in"))],
+                 bias_attr=False)
+l2 = lstmemory_group(input=m2, name="lstm_grouped", size=6,
+                     param_attr=ParamAttr(name="w_rec2"),
+                     lstm_bias_attr=ParamAttr(name="b_rec2"))
+outputs(l1)
+outputs(l2)
+"""
+
+
+def test_lstm_group_matches_fused():
+    tc = parse_str(LSTM_PAIR)
+    gm = GradientMachine(tc.model_config)
+    params = gm.init_params(seed=7)
+    params["w_rec2"] = params["w_rec"].reshape(params["w_rec2"].shape)
+    params["b_rec2"] = params["b_rec"].reshape(params["b_rec2"].shape)
+    rng = np.random.RandomState(1)
+    B, T = 2, 5
+    x = rng.randn(B, T, 10).astype(np.float32)
+    lengths = np.array([5, 3], np.int32)
+    batch = {"x": make_seq(jnp.asarray(x), jnp.asarray(lengths))}
+    out, _ = gm.forward(params, batch, "test")
+    fused = np.asarray(out["lstm_fused"].value)
+    grouped = np.asarray(out["lstm_grouped"].value)
+    np.testing.assert_allclose(fused, grouped, rtol=2e-5, atol=1e-5)
+
+
+def test_recurrent_group_gradcheck():
+    tc = parse_str("""
+from paddle_tpu.trainer_config_helpers import *
+settings(batch_size=4, learning_rate=1e-3)
+x = data_layer(name="x", size=9)
+g = simple_gru(input=x, size=3, name="g")
+pool = last_seq(input=g, name="pool")
+label = data_layer(name="label", size=3)
+out = fc_layer(input=pool, size=3, act=SoftmaxActivation(), name="out")
+outputs(classification_cost(input=out, label=label))
+""")
+    gm = GradientMachine(tc.model_config)
+    params = gm.init_params(seed=2)
+    rng = np.random.RandomState(3)
+    x = rng.randn(2, 4, 9).astype(np.float32)
+    batch = {
+        "x": make_seq(jnp.asarray(x), jnp.asarray(np.array([4, 2], np.int32))),
+        "label": make_ids(np.array([0, 2], np.int32)),
+    }
+    report = gm.check_gradient(params, batch, max_entries=4)
+    for name, diff in report.items():
+        assert diff < 5e-2, f"{name}: {diff}"
+
+
+def test_attention_seq2seq_with_static_input():
+    """recurrent_group with StaticInput + simple_attention (the seqToseq
+    decoder shape, ref demo/seqToseq/seqToseq_net.py)."""
+    tc = parse_str("""
+from paddle_tpu.trainer_config_helpers import *
+settings(batch_size=4, learning_rate=1e-3)
+src = data_layer(name="src", size=20)
+src_emb = embedding_layer(input=src, size=8, param_attr=ParamAttr(name="src_emb"))
+enc = simple_gru(input=src_emb, size=8, name="encoder")
+enc_proj = mixed_layer(name="enc_proj", size=8,
+                       input=[full_matrix_projection(enc)])
+trg = data_layer(name="trg", size=20)
+trg_emb = embedding_layer(input=trg, size=8, param_attr=ParamAttr(name="trg_emb"))
+
+def decoder_step(enc_seq, enc_p, cur_emb):
+    decoder_mem = memory(name="dec_state", size=8)
+    context = simple_attention(encoded_sequence=enc_seq, encoded_proj=enc_p,
+                               decoder_state=decoder_mem, name="att")
+    inputs = mixed_layer(size=8*3, input=[full_matrix_projection(context),
+                                          full_matrix_projection(cur_emb)])
+    return gru_step_layer(input=inputs, output_mem=decoder_mem,
+                          size=8, name="dec_state")
+
+dec = recurrent_group(step=decoder_step,
+                      input=[StaticInput(enc, is_seq=True),
+                             StaticInput(enc_proj, is_seq=True),
+                             trg_emb],
+                      name="decoder_group")
+out = fc_layer(input=dec, size=20, act=SoftmaxActivation(), name="out")
+label = data_layer(name="label", size=20)
+outputs(classification_cost(input=out, label=label))
+""")
+    gm = GradientMachine(tc.model_config)
+    params = gm.init_params(seed=4)
+    rng = np.random.RandomState(5)
+    B, S, T = 2, 6, 5
+    src_ids = rng.randint(0, 20, (B, S)).astype(np.int32)
+    trg_ids = rng.randint(0, 20, (B, T)).astype(np.int32)
+    lab_ids = rng.randint(0, 20, (B, T)).astype(np.int32)
+    batch = {
+        "src": make_seq(None, np.array([6, 3], np.int32), ids=src_ids),
+        "trg": make_seq(None, np.array([5, 2], np.int32), ids=trg_ids),
+        "label": make_seq(None, np.array([5, 2], np.int32), ids=lab_ids),
+    }
+    out, _ = gm.forward(params, batch, "test")
+    assert out["out"].value.shape == (B, T, 20)
+    loss = gm.total_cost(out)
+    assert np.isfinite(float(loss))
+    # jit the loss to ensure the whole scan traces
+    f = jax.jit(lambda p: gm.loss_fn(p, batch, None)[0])
+    assert np.isfinite(float(f(params)))
+
+
+def test_beam_search_generation():
+    tc = parse_str("""
+from paddle_tpu.trainer_config_helpers import *
+settings(batch_size=4, learning_rate=1e-3)
+src = data_layer(name="src", size=10)
+src_emb = embedding_layer(input=src, size=6, param_attr=ParamAttr(name="emb"))
+enc = simple_gru(input=src_emb, size=6, name="encoder")
+enc_last = last_seq(input=enc, name="enc_last")
+
+def gen_step(enc_l, cur_emb):
+    mem = memory(name="dec", size=6, boot_layer=enc_l)
+    inp = mixed_layer(size=18, input=[full_matrix_projection(cur_emb)],
+                      name="dec_in")
+    step = gru_step_layer(input=inp, output_mem=mem, size=6, name="dec")
+    return fc_layer(input=step, size=10, act=SoftmaxActivation(), name="scores")
+
+gen = beam_search(step=gen_step,
+                  input=[StaticInput(enc_last),
+                         GeneratedInput(size=10, embedding_name="emb",
+                                        embedding_size=6)],
+                  bos_id=0, eos_id=1, beam_size=3, max_length=7,
+                  name="generator")
+""")
+    gm = GradientMachine(tc.model_config)
+    params = gm.init_params(seed=6)
+    src_ids = np.array([[2, 3, 4, 0], [5, 6, 0, 0]], np.int32)
+    batch = {"src": make_seq(None, np.array([3, 2], np.int32), ids=src_ids)}
+    out, _ = gm.forward(params, batch, "gen")
+    gen_out = out["generator"]
+    assert gen_out.ids.shape == (2, 7)
+    assert gen_out.seq_lengths.shape == (2,)
+    assert np.all(np.asarray(gen_out.seq_lengths) <= 7)
+    beams = out["generator@beams"]
+    assert beams.ids.shape == (2, 3, 7)
+    assert beams.value.shape == (2, 3)
+    # scores sorted descending per sample
+    sc = np.asarray(beams.value)
+    assert np.all(np.diff(sc, axis=1) <= 1e-6)
+
+
+def test_greedy_generation_matches_manual_rollout():
+    """beam_size=1 must equal an argmax rollout computed step by step."""
+    tc = parse_str("""
+from paddle_tpu.trainer_config_helpers import *
+settings(batch_size=4, learning_rate=1e-3)
+boot = data_layer(name="boot", size=5)
+
+def gen_step(b, cur_emb):
+    mem = memory(name="dec", size=5, boot_layer=b)
+    inp = mixed_layer(size=15, input=[full_matrix_projection(cur_emb, param_attr=ParamAttr(name="w_x"))],
+                      name="dec_in", bias_attr=False)
+    step = gru_step_layer(input=inp, output_mem=mem, size=5, name="dec",
+                          param_attr=ParamAttr(name="w_g"), bias_attr=False)
+    return fc_layer(input=step, size=8, act=SoftmaxActivation(), name="scores",
+                    param_attr=ParamAttr(name="w_s"), bias_attr=False)
+
+gen = beam_search(step=gen_step,
+                  input=[StaticInput(boot),
+                         GeneratedInput(size=8, embedding_name="gen_emb",
+                                        embedding_size=6)],
+                  bos_id=0, eos_id=1, beam_size=1, max_length=5,
+                  name="generator")
+""")
+    # the generated-id embedding table parameter
+    gm = GradientMachine(tc.model_config)
+    params = gm.init_params(seed=9)
+    B = 2
+    boot = np.random.RandomState(1).randn(B, 5).astype(np.float32)
+    batch = {"boot": make_dense(jnp.asarray(boot))}
+    out, _ = gm.forward(params, batch, "gen")
+    got = np.asarray(out["generator"].ids)
+
+    # manual rollout in numpy
+    emb_name = "gen_emb"
+    emb = np.asarray(params[emb_name])
+    w_x = np.asarray(params["w_x"]).reshape(6, 15)
+    w_g = np.asarray(params["w_g"]).reshape(5, 15)
+    w_s = np.asarray(params["w_s"]).reshape(5, 8)
+    mixed_w = [k for k in params if "__generated_emb" in k]
+    sigmoid = lambda v: 1 / (1 + np.exp(-v))
+    h = boot.copy()
+    tok = np.zeros((B,), np.int32)
+    done = np.zeros((B,), bool)
+    expect = []
+    for t in range(5):
+        e = emb[tok]
+        x3 = e @ w_x
+        g = x3[:, :10] + h @ w_g[:, :10]
+        u, r = sigmoid(g[:, :5]), sigmoid(g[:, 5:10])
+        cand = np.tanh(x3[:, 10:] + (r * h) @ w_g[:, 10:])
+        h_new = u * h + (1 - u) * cand
+        h = np.where(done[:, None], h, h_new)
+        probs = _np_softmax(h @ w_s)
+        nxt = np.argmax(probs, axis=1).astype(np.int32)
+        nxt = np.where(done, 1, nxt)
+        expect.append(nxt)
+        done = done | (nxt == 1)
+        tok = nxt
+    expect = np.stack(expect, axis=1)
+    # guard against a trivially-passing comparison: the rollout must run
+    # several live steps so decoder-state advancement is actually tested
+    assert int((~np.stack([done])).sum()) >= 0  # shape sanity
+    live_steps = (expect != 1).sum(axis=1)
+    assert live_steps.max() >= 3, f"rollout finished too early to be a real test: {expect}"
+    np.testing.assert_array_equal(got, expect)
+
+
+def _np_softmax(x):
+    e = np.exp(x - x.max(axis=-1, keepdims=True))
+    return e / e.sum(axis=-1, keepdims=True)
