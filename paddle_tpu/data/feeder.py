@@ -56,9 +56,11 @@ class BatchAssembler:
         )
 
     def assemble(self, samples: List[Sequence[Any]]) -> Dict[str, Argument]:
+        # samples are positional lists/tuples or dicts keyed by slot name
+        # (both are legal @provider yields, ref PyDataProvider2.py docs)
         out: Dict[str, Argument] = {}
         for i, (name, tp) in enumerate(zip(self.slot_names, self.input_types)):
-            values = [s[i] for s in samples]
+            values = [s[name] if isinstance(s, dict) else s[i] for s in samples]
             out[name] = self._slot(values, tp)
         return out
 

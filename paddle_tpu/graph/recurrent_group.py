@@ -295,16 +295,18 @@ def _generate(network, cfg: LayerConfig, sub: SubModelConfig, ctx: LayerContext)
         for name, arg in statics.items():
             fed[name] = arg
         for mem, carry in zip(memories, carries):
-            fed[mem.link_name] = Argument(value=carry)
+            fed[mem.link_name] = _carry_to_arg(carry)
         rng = jax.random.fold_in(base_rng, t_idx) if base_rng is not None else None
         outs = _run_submodel_step(network, sub, ctx, fed, rng)
         probs = outs[score_layer].value  # [B*K, V]
         V = probs.shape[-1]
         logp = jnp.log(jnp.clip(probs, 1e-20, None)).reshape(B, K, V)
         fin = finished[:, :, None]
-        # finished beams may only "emit" eos with no score change
+        # finished beams may only "emit" eos with no score change; every
+        # other candidate is dead (-inf, not the clip floor, else a
+        # finished beam's V-1 ghosts can outrank live continuations)
         eos_onehot = jax.nn.one_hot(eos, V, dtype=logp.dtype)
-        logp = jnp.where(fin, jnp.log(eos_onehot + 1e-20)[None, None, :], logp)
+        logp = jnp.where(fin, jnp.where(eos_onehot[None, None, :] > 0, 0.0, neg_inf), logp)
         total = cum[:, :, None] + logp  # [B, K, V]
         flat = total.reshape(B, K * V)
         top_scores, top_idx = jax.lax.top_k(flat, K)  # [B, K]
@@ -313,11 +315,15 @@ def _generate(network, cfg: LayerConfig, sub: SubModelConfig, ctx: LayerContext)
         # advance memories with this step's outputs, then reindex by the
         # selected beams
         flat_sel = (jnp.arange(B)[:, None] * K + beam_idx).reshape(-1)  # [B*K]
-        stepped = tuple(outs[mem.layer_name].value for mem in memories)  # [B*K, D]
+        stepped = tuple(
+            outs[mem.layer_name].ids if _is_int_carry(old) else outs[mem.layer_name].value
+            for mem, old in zip(memories, carries)
+        )
         # finished beams freeze their state
-        fin_flat = finished.reshape(-1, 1)
+        fin_flat = finished.reshape(-1)
         frozen = tuple(
-            jnp.where(fin_flat, old, new) for old, new in zip(carries, stepped)
+            jnp.where(fin_flat[:, None] if new.ndim == 2 else fin_flat, old, new)
+            for old, new in zip(carries, stepped)
         )
         new_carries = tuple(c[flat_sel] for c in frozen)
         finished = jnp.take_along_axis(finished, beam_idx, axis=1)

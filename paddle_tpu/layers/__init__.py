@@ -13,5 +13,6 @@ import paddle_tpu.layers.sequence  # noqa: F401
 import paddle_tpu.layers.recurrent  # noqa: F401
 import paddle_tpu.layers.vision  # noqa: F401
 import paddle_tpu.layers.misc  # noqa: F401
+import paddle_tpu.layers.structured  # noqa: F401
 
 __all__ = ["LayerContext", "layer_registry", "register_layer", "forward_layer"]

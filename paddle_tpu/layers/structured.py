@@ -1,0 +1,291 @@
+"""Structured-prediction layers: linear-chain CRF, CTC, LambdaRank,
+selective FC.
+
+Reference counterparts (/root/reference/paddle/gserver/layers/):
+- CRFLayer.cpp + LinearChainCRF.cpp (param layout [C+2, C]: row 0 = start
+  weights a, row 1 = end weights b, rows 2.. = transitions w;
+  P(s) ∝ exp(a_{s1} + b_{sL} + Σ x_{t,s_t} + Σ w_{s_{t-1}, s_t})).
+- CRFDecodingLayer.cpp (Viterbi decode; with a label input, emits per-token
+  0/1 mismatch).
+- CTCLayer.cpp + LinearChainCTC.cpp (blank = num_classes - 1,
+  ``norm_by_times`` divides the per-sequence cost by its length).
+- CostLayer.cpp LambdaCost (NDCG_num truncation; gradient = LambdaRank
+  lambdas). Here the forward value is -NDCG@K and the gradient comes from
+  the standard LambdaRank pairwise surrogate via a stop-gradient splice.
+- SelectiveFullyConnectedLayer.cpp (fc restricted to selected columns).
+
+All recursions are ``lax.scan`` over the padded time axis with per-batch
+length masks — the XLA-native replacement for the reference's per-sequence
+CPU loops. Gradients (the reference's hand-written backward()s) come from
+jax.grad of these forwards.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.graph.argument import Argument
+from paddle_tpu.layers.base import LayerContext, register_layer
+from paddle_tpu.layers.cost import _finish_cost
+from paddle_tpu.ops.activations import apply_activation
+from paddle_tpu.proto import LayerConfig
+
+Array = jax.Array
+NEG = -1e30
+
+
+def _crf_weights(w: Array) -> Tuple[Array, Array, Array]:
+    """Split the [C+2, C] CRF parameter into (start a, end b, transitions w)."""
+    return w[0], w[1], w[2:]
+
+
+def crf_log_likelihood(x: Array, labels: Array, lengths: Array, param: Array) -> Array:
+    """Per-sequence negative log likelihood. x [B,T,C], labels [B,T] int,
+    lengths [B] int, param [C+2, C]. Returns [B]."""
+    a, b, w = _crf_weights(param)
+    B, T, C = x.shape
+    t_iota = jnp.arange(T, dtype=jnp.int32)
+    mask = (t_iota[None, :] < lengths[:, None])  # [B, T]
+
+    # --- numerator: score of the gold path
+    emit = jnp.take_along_axis(x, labels[..., None], axis=-1)[..., 0]  # [B,T]
+    emit_score = jnp.sum(jnp.where(mask, emit, 0.0), axis=1)
+    trans = w[labels[:, :-1], labels[:, 1:]]  # [B, T-1]
+    trans_score = jnp.sum(jnp.where(mask[:, 1:], trans, 0.0), axis=1)
+    last_idx = jnp.clip(lengths - 1, 0, T - 1)
+    last_lab = jnp.take_along_axis(labels, last_idx[:, None], axis=1)[:, 0]
+    gold = emit_score + trans_score + a[labels[:, 0]] + b[last_lab]
+
+    # --- denominator: log Z by forward recursion (frozen past each length)
+    def step(alpha, inp):
+        x_t, m_t = inp  # [B,C], [B]
+        new = jax.nn.logsumexp(alpha[:, :, None] + w[None], axis=1) + x_t
+        alpha = jnp.where(m_t[:, None], new, alpha)
+        return alpha, None
+
+    alpha0 = a[None] + x[:, 0]
+    xs = (jnp.swapaxes(x[:, 1:], 0, 1), jnp.swapaxes(mask[:, 1:], 0, 1))
+    alpha, _ = lax.scan(step, alpha0, xs)
+    log_z = jax.nn.logsumexp(alpha + b[None], axis=1)
+    return log_z - gold
+
+
+def crf_decode(x: Array, lengths: Array, param: Array) -> Array:
+    """Viterbi decode. x [B,T,C], lengths [B]. Returns int32 [B,T] (padding
+    positions are 0)."""
+    a, b, w = _crf_weights(param)
+    B, T, C = x.shape
+    t_iota = jnp.arange(T, dtype=jnp.int32)
+    mask = (t_iota[None, :] < lengths[:, None])
+
+    def fwd(delta, inp):
+        x_t, m_t = inp
+        scores = delta[:, :, None] + w[None]  # [B, C_prev, C]
+        best_prev = jnp.argmax(scores, axis=1).astype(jnp.int32)  # [B, C]
+        new = jnp.max(scores, axis=1) + x_t
+        delta_next = jnp.where(m_t[:, None], new, delta)
+        return delta_next, (delta_next, best_prev)
+
+    delta0 = a[None] + x[:, 0]
+    xs = (jnp.swapaxes(x[:, 1:], 0, 1), jnp.swapaxes(mask[:, 1:], 0, 1))
+    _, (deltas, tracks) = lax.scan(fwd, delta0, xs)
+    # deltas: [T-1, B, C] (delta at t=1..T-1); tracks[t] maps state at t+1 -> best state at t
+    all_delta = jnp.concatenate([delta0[None], deltas], axis=0)  # [T, B, C]
+    end_choice = jnp.argmax(all_delta + b[None, None], axis=2).astype(jnp.int32)  # [T, B]
+    # pad tracks with a dummy row so tracks_full[t] maps state at t+1 (t = T-1 unused)
+    tracks_full = jnp.concatenate(
+        [tracks, jnp.zeros((1, B, C), dtype=jnp.int32)], axis=0
+    )  # [T, B, C]; tracks_full[t][b, s_{t+1}] = s_t for t in [0, T-2]
+
+    def bwd(carry, inp):
+        nxt = carry  # chosen state at t+1 [B]
+        t, end_t, track_t = inp
+        from_next = jnp.take_along_axis(track_t, nxt[:, None], axis=1)[:, 0]
+        is_end = (t == lengths - 1)
+        in_seq = (t < lengths - 1)
+        cur = jnp.where(is_end, end_t, jnp.where(in_seq, from_next, 0))
+        return cur, cur
+
+    ts = jnp.arange(T - 1, -1, -1, dtype=jnp.int32)
+    init = jnp.zeros((B,), dtype=jnp.int32)
+    _, path_rev = lax.scan(bwd, init, (ts, end_choice[::-1], tracks_full[::-1]))
+    path = jnp.swapaxes(path_rev[::-1], 0, 1)  # [B, T]
+    return jnp.where(mask, path, 0).astype(jnp.int32)
+
+
+@register_layer("crf")
+def crf_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Argument:
+    feats, label = inputs[0], inputs[1]
+    weight = inputs[2] if len(inputs) > 2 else None
+    param = ctx.param(cfg.inputs[0].input_parameter_name)
+    nll = crf_log_likelihood(feats.value, label.ids, feats.seq_lengths, param)
+    # per-sequence cost (already reduced over time) — feed _finish_cost a
+    # non-sequence view so it only applies coeff/weight.
+    return _finish_cost(cfg, nll, Argument(value=nll), weight)
+
+
+@register_layer("crf_decoding")
+def crf_decoding_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Argument:
+    feats = inputs[0]
+    param = ctx.param(cfg.inputs[0].input_parameter_name)
+    path = crf_decode(feats.value, feats.seq_lengths, param)
+    out = Argument(ids=path, seq_lengths=feats.seq_lengths)
+    if len(inputs) > 1:  # label given: per-token 0/1 mismatch (ref: CRFDecodingLayer.cpp:52-62)
+        label = inputs[1]
+        err = (path != label.ids).astype(ctx.dtype) * feats.seq_mask()
+        out = Argument(ids=path, value=err[..., None], seq_lengths=feats.seq_lengths)
+    return out
+
+
+# --------------------------------------------------------------------- CTC
+
+
+def ctc_loss(log_probs: Array, in_lengths: Array, labels: Array, label_lengths: Array,
+             blank: int) -> Array:
+    """Per-sequence CTC negative log likelihood.
+
+    log_probs [B,T,C], in_lengths [B], labels [B,S] (no blanks), label_lengths
+    [B]. Standard alpha recursion (Graves 2006) over the extended sequence
+    blank,l1,blank,l2,...,blank of length 2S+1, log-space, lax.scan over T.
+    """
+    B, T, C = log_probs.shape
+    S = labels.shape[1]
+    U = 2 * S + 1
+    u_iota = jnp.arange(U, dtype=jnp.int32)
+    # extended label sequence: even u -> blank, odd u -> labels[(u-1)/2]
+    lab_idx = jnp.clip((u_iota - 1) // 2, 0, S - 1)
+    ext = jnp.where(u_iota % 2 == 1, labels[:, lab_idx], blank)  # [B, U]
+    u_valid = u_iota[None, :] < (2 * label_lengths[:, None] + 1)
+
+    # skip connection u-2 allowed when ext[u] != blank and ext[u] != ext[u-2]
+    ext_m2 = jnp.concatenate([jnp.full((B, 2), -1, dtype=ext.dtype), ext[:, :-2]], axis=1)
+    can_skip = (u_iota[None, :] % 2 == 1) & (ext != ext_m2)
+
+    def emit(t_slice, ext_):
+        return jnp.take_along_axis(t_slice, ext_, axis=1)  # [B, U]
+
+    alpha0 = jnp.where((u_iota[None, :] <= 1) & u_valid, emit(log_probs[:, 0], ext), NEG)
+
+    def step(alpha, inp):
+        lp_t, m_t = inp  # [B,C], [B]
+        a_m1 = jnp.concatenate([jnp.full((B, 1), NEG), alpha[:, :-1]], axis=1)
+        a_m2 = jnp.concatenate([jnp.full((B, 2), NEG), alpha[:, :-2]], axis=1)
+        a_m2 = jnp.where(can_skip, a_m2, NEG)
+        stacked = jnp.stack([alpha, a_m1, a_m2], axis=0)
+        merged = jax.nn.logsumexp(stacked, axis=0) + emit(lp_t, ext)
+        merged = jnp.where(u_valid, merged, NEG)
+        return jnp.where(m_t[:, None], merged, alpha), None
+
+    t_iota = jnp.arange(T, dtype=jnp.int32)
+    mask = (t_iota[None, :] < in_lengths[:, None])
+    xs = (jnp.swapaxes(log_probs[:, 1:], 0, 1), jnp.swapaxes(mask[:, 1:], 0, 1))
+    alpha, _ = lax.scan(step, alpha0, xs)
+
+    u_last = 2 * label_lengths  # index of final blank
+    a_last = jnp.take_along_axis(alpha, u_last[:, None], axis=1)[:, 0]
+    a_prev = jnp.take_along_axis(alpha, jnp.clip(u_last - 1, 0, U - 1)[:, None], axis=1)[:, 0]
+    a_prev = jnp.where(label_lengths > 0, a_prev, NEG)
+    ll = jnp.logaddexp(a_last, a_prev)
+    return -ll
+
+
+@register_layer("ctc")
+def ctc_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Argument:
+    # input 0: softmax probabilities [B,T,C] (ref CTCLayer feeds softmax
+    # output to LinearChainCTC, which takes log internally); input 1: label
+    # id sequence. blank = size - 1 (LinearChainCTC.cpp:88).
+    probs, label = inputs[0], inputs[1]
+    log_p = jnp.log(jnp.clip(probs.value, 1e-10, None))
+    cost = ctc_loss(log_p, probs.seq_lengths, label.ids, label.seq_lengths,
+                    blank=cfg.size - 1)
+    if cfg.norm_by_times:
+        cost = cost / jnp.maximum(probs.seq_lengths.astype(cost.dtype), 1.0)
+    return _finish_cost(cfg, cost, Argument(value=cost), None)
+
+
+# --------------------------------------------------------------- LambdaRank
+
+
+def _ndcg_at_k(scores: Array, rels: Array, mask: Array, k: int):
+    """NDCG@k per list. scores/rels/mask: [B, T].
+
+    Returns (ndcg [B], rank_discount [B, T], idcg [B]) so lambda_cost can
+    reuse the per-item discounts for the pairwise |ΔNDCG| weights."""
+    neg = jnp.where(mask, scores, NEG)
+    order = jnp.argsort(-neg, axis=1)  # indices of items by model score desc
+    rel_sorted = jnp.take_along_axis(jnp.where(mask, rels, 0.0), order, axis=1)
+    pos = jnp.arange(scores.shape[1], dtype=scores.dtype)
+    disc = jnp.where(pos < k, 1.0 / jnp.log2(pos + 2.0), 0.0)[None, :]
+    dcg = jnp.sum((2.0 ** rel_sorted - 1.0) * disc, axis=1)
+    ideal_sorted = -jnp.sort(-jnp.where(mask, rels, 0.0), axis=1)
+    idcg = jnp.sum((2.0 ** ideal_sorted - 1.0) * disc, axis=1)
+    ndcg = jnp.where(idcg > 0, dcg / jnp.maximum(idcg, 1e-10), 0.0)
+    # discount of each item at its current model-score rank
+    rank = jnp.argsort(jnp.argsort(-neg, axis=1), axis=1).astype(scores.dtype)
+    rank_disc = jnp.where(rank < k, 1.0 / jnp.log2(rank + 2.0), 0.0)
+    return ndcg, rank_disc, idcg
+
+
+@register_layer("lambda_cost")
+def lambda_cost_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Argument:
+    # inputs: [model scores (seq, dim 1), relevance scores (seq, dim 1)]
+    out, score = inputs[0], inputs[1]
+    s = out.value[..., 0]            # [B, T]
+    r = score.value[..., 0]
+    mask = out.seq_mask()
+    k = cfg.NDCG_num or 5
+    ndcg, disc, idcg = _ndcg_at_k(s, lax.stop_gradient(r), mask, k)
+
+    # LambdaRank pairwise surrogate: grad matches the reference's calcGrad
+    # lambdas; spliced in via stop_gradient so forward value stays -NDCG@k.
+    pair_mask = (mask[:, :, None] * mask[:, None, :])
+    rel_diff = r[:, :, None] - r[:, None, :]
+    better = (rel_diff > 0).astype(s.dtype) * pair_mask
+    # |ΔNDCG| from swapping i,j at their current ranks
+    gain = 2.0 ** r - 1.0
+    dg = jnp.abs(
+        (gain[:, :, None] - gain[:, None, :]) * (disc[:, :, None] - disc[:, None, :])
+    ) / jnp.maximum(idcg, 1e-10)[:, None, None]
+    s_diff = s[:, :, None] - s[:, None, :]
+    surrogate = jnp.sum(
+        lax.stop_gradient(better * dg) * jnp.logaddexp(0.0, -s_diff), axis=(1, 2)
+    )
+    cost = -ndcg + (surrogate - lax.stop_gradient(surrogate))
+    return _finish_cost(cfg, cost, Argument(value=cost), None)
+
+
+# ------------------------------------------------------------ selective fc
+
+
+@register_layer("selective_fc")
+def selective_fc_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Argument:
+    # Data inputs carry parameters; a trailing parameter-less input is the
+    # column-selection id set (ref: SelectiveFullyConnectedLayer.cpp — when
+    # no selection, behaves exactly like fc).
+    n_data = sum(1 for ic in cfg.inputs if ic.input_parameter_name)
+    acc: Optional[Array] = None
+    for ic, arg in zip(cfg.inputs[:n_data], inputs[:n_data]):
+        w = ctx.param(ic.input_parameter_name)
+        y = jnp.dot(arg.value, w)
+        acc = y if acc is None else acc + y
+    sel = inputs[n_data] if len(inputs) > n_data else None
+    if cfg.bias_parameter_name:
+        acc = acc + ctx.param(cfg.bias_parameter_name)
+    meta = inputs[0]
+    if sel is not None and sel.ids is not None:
+        # mask of selected columns per row: scatter ones at selected ids
+        onehot = jax.nn.one_hot(sel.ids, cfg.size, dtype=acc.dtype)  # [..., K, size]
+        m = jnp.clip(jnp.sum(onehot, axis=-2), 0.0, 1.0)
+        if cfg.active_type in ("softmax", "sequence_softmax"):
+            logits = jnp.where(m > 0, acc, NEG)
+            value = jax.nn.softmax(logits, axis=-1) * m
+        else:
+            value = apply_activation(cfg.active_type, acc, None) * m
+    else:  # no selection: behaves exactly like fc (bias applied above)
+        value = apply_activation(cfg.active_type, acc, None)
+    return Argument(value=value, seq_lengths=meta.seq_lengths,
+                    sub_seq_lengths=meta.sub_seq_lengths)

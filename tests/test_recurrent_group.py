@@ -265,7 +265,6 @@ gen = beam_search(step=gen_step,
     w_x = np.asarray(params["w_x"]).reshape(6, 15)
     w_g = np.asarray(params["w_g"]).reshape(5, 15)
     w_s = np.asarray(params["w_s"]).reshape(5, 8)
-    mixed_w = [k for k in params if "__generated_emb" in k]
     sigmoid = lambda v: 1 / (1 + np.exp(-v))
     h = boot.copy()
     tok = np.zeros((B,), np.int32)
@@ -288,7 +287,6 @@ gen = beam_search(step=gen_step,
     expect = np.stack(expect, axis=1)
     # guard against a trivially-passing comparison: the rollout must run
     # several live steps so decoder-state advancement is actually tested
-    assert int((~np.stack([done])).sum()) >= 0  # shape sanity
     live_steps = (expect != 1).sum(axis=1)
     assert live_steps.max() >= 3, f"rollout finished too early to be a real test: {expect}"
     np.testing.assert_array_equal(got, expect)
