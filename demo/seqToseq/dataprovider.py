@@ -1,0 +1,49 @@
+"""Synthetic translation data for the seqToseq demo.
+
+The reference demo feeds WMT-14 fr→en corpus files
+(/root/reference/demo/seqToseq/dataprovider.py); to keep this demo
+self-contained it synthesizes a deterministic toy "translation": the target
+sentence is the source sentence reversed, over a small shared vocabulary.
+Swap `process` for a corpus reader (same yield contract) to train on real
+data. Token ids 0/1 are reserved for <s>/<e> like the reference's dicts.
+"""
+
+import random
+
+from paddle.trainer.PyDataProvider2 import *
+
+VOCAB = 20          # ids 0..VOCAB-1; 0 = <s>, 1 = <e>
+MIN_LEN, MAX_LEN = 3, 8
+NUM_SAMPLES = 300
+
+
+def _pairs(seed):
+    rng = random.Random(seed)
+    for _ in range(NUM_SAMPLES):
+        n = rng.randint(MIN_LEN, MAX_LEN)
+        src = [rng.randint(2, VOCAB - 1) for _ in range(n)]
+        trg = list(reversed(src))
+        yield src, trg
+
+
+@provider(
+    input_types={
+        "source_language_word": integer_value_sequence(VOCAB),
+        "target_language_word": integer_value_sequence(VOCAB),
+        "target_language_next_word": integer_value_sequence(VOCAB),
+    }
+)
+def process(settings, file_name):
+    # decoder input = <s> + target; label = target + <e>  (teacher forcing)
+    for src, trg in _pairs(file_name):
+        yield {
+            "source_language_word": src,
+            "target_language_word": [0] + trg,
+            "target_language_next_word": trg + [1],
+        }
+
+
+@provider(input_types={"source_language_word": integer_value_sequence(VOCAB)})
+def gen_process(settings, file_name):
+    for src, _ in _pairs(file_name):
+        yield {"source_language_word": src}

@@ -1,0 +1,11 @@
+#!/bin/bash
+# Beam-search generation from the trained model
+# (ref: demo/seqToseq/translation/gen.sh drives paddle train --job=test).
+set -e
+cd "$(dirname "$0")"
+echo seed2 > test.list
+paddle gen \
+  --config=gen.conf \
+  --init_model_path=./model/pass-00007 \
+  --gen_result=gen_result.txt
+head -20 gen_result.txt

@@ -1,0 +1,99 @@
+"""Attention GRU encoder-decoder network (NMT).
+
+Same model family and config API as the reference demo
+(/root/reference/demo/seqToseq/seqToseq_net.py:65-181): bidirectional GRU
+encoder, Bahdanau attention, GRU decoder driven by a recurrent_group in
+training and beam search in generation. Written against the TPU-native
+DSL — the recurrent group compiles to lax.scan / static-shape beam search.
+"""
+
+from paddle.trainer_config_helpers import *
+
+
+def gru_encoder_decoder(
+    source_dict_dim,
+    target_dict_dim,
+    is_generating,
+    word_vector_dim=512,
+    encoder_size=512,
+    decoder_size=512,
+    beam_size=3,
+    max_length=250,
+    bos_id=0,
+    eos_id=1,
+    gen_result="gen_result.txt",
+    gen_dict=None,
+):
+    src_word_id = data_layer(name="source_language_word", size=source_dict_dim)
+    src_embedding = embedding_layer(
+        input=src_word_id,
+        size=word_vector_dim,
+        param_attr=ParamAttr(name="_source_language_embedding"),
+    )
+    src_forward = simple_gru(input=src_embedding, size=encoder_size)
+    src_backward = simple_gru(input=src_embedding, size=encoder_size, reverse=True)
+    encoded_vector = concat_layer(input=[src_forward, src_backward])
+
+    with mixed_layer(size=decoder_size) as encoded_proj:
+        encoded_proj += full_matrix_projection(encoded_vector)
+
+    backward_first = first_seq(input=src_backward)
+    with mixed_layer(size=decoder_size, act=TanhActivation()) as decoder_boot:
+        decoder_boot += full_matrix_projection(backward_first)
+
+    def gru_decoder_with_attention(enc_vec, enc_proj, current_word):
+        decoder_mem = memory(name="gru_decoder", size=decoder_size, boot_layer=decoder_boot)
+        context = simple_attention(
+            encoded_sequence=enc_vec, encoded_proj=enc_proj, decoder_state=decoder_mem
+        )
+        with mixed_layer(size=decoder_size * 3) as decoder_inputs:
+            decoder_inputs += full_matrix_projection(context)
+            decoder_inputs += full_matrix_projection(current_word)
+        gru_step = gru_step_layer(
+            name="gru_decoder", input=decoder_inputs, output_mem=decoder_mem, size=decoder_size
+        )
+        with mixed_layer(size=target_dict_dim, bias_attr=True, act=SoftmaxActivation()) as out:
+            out += full_matrix_projection(input=gru_step)
+        return out
+
+    decoder_group_name = "decoder_group"
+    if not is_generating:
+        trg_embedding = embedding_layer(
+            input=data_layer(name="target_language_word", size=target_dict_dim),
+            size=word_vector_dim,
+            param_attr=ParamAttr(name="_target_language_embedding"),
+        )
+        decoder = recurrent_group(
+            name=decoder_group_name,
+            step=gru_decoder_with_attention,
+            input=[
+                StaticInput(input=encoded_vector, is_seq=True),
+                StaticInput(input=encoded_proj, is_seq=True),
+                trg_embedding,
+            ],
+        )
+        lbl = data_layer(name="target_language_next_word", size=target_dict_dim)
+        cost = classification_cost(input=decoder, label=lbl)
+        outputs(cost)
+    else:
+        trg_embedding = GeneratedInput(
+            size=target_dict_dim,
+            embedding_name="_target_language_embedding",
+            embedding_size=word_vector_dim,
+        )
+        beam_gen = beam_search(
+            name=decoder_group_name,
+            step=gru_decoder_with_attention,
+            input=[
+                StaticInput(input=encoded_vector, is_seq=True),
+                StaticInput(input=encoded_proj, is_seq=True),
+                trg_embedding,
+            ],
+            bos_id=bos_id,
+            eos_id=eos_id,
+            beam_size=beam_size,
+            max_length=max_length,
+            dict_file=gen_dict,
+            result_file=gen_result,
+        )
+        outputs(beam_gen)

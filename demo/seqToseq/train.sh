@@ -1,0 +1,11 @@
+#!/bin/bash
+# Train the attention NMT model (ref: demo/seqToseq/translation/train.sh).
+set -e
+cd "$(dirname "$0")"
+echo seed1 > train.list
+echo seed2 > test.list
+paddle train \
+  --config=train.conf \
+  --save_dir=./model \
+  --num_passes=8 \
+  --log_period=10

@@ -18,7 +18,7 @@ def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help", "help"):
         print(__doc__)
-        print("usage: paddle <train|test|checkgrad|dump_config|merge_model|version> [--flags]")
+        print("usage: paddle <train|test|gen|checkgrad|dump_config|merge_model|version> [--flags]")
         return 0
     cmd, rest = argv[0], argv[1:]
     if cmd == "version":
@@ -28,7 +28,7 @@ def main(argv=None) -> int:
         print(f"paddle_tpu {__version__} (jax {jax.__version__})")
         print(f"devices: {jax.devices()}")
         return 0
-    if cmd in ("train", "test", "checkgrad"):
+    if cmd in ("train", "test", "checkgrad", "gen"):
         return _run_trainer_job(cmd, rest)
     if cmd == "dump_config":
         return _dump_config(rest)
@@ -76,6 +76,9 @@ def _run_trainer_job(cmd, rest) -> int:
         return 0
     if cmd == "test":
         trainer.test()
+        return 0
+    if cmd == "gen":
+        trainer.generate()
         return 0
     ok = trainer.check_gradient()
     return 0 if ok else 1

@@ -59,13 +59,18 @@ class ConfigContext:
         self.root_submodel: Optional[SubModelConfig] = None
         self.config_args: Dict[str, str] = {}
         # memory links declared in the current recurrent group
-        self._counter = 0
+        self._counters: Dict[str, int] = {}
 
     # ------------------------------------------------------------ layers
 
     def unique_name(self, prefix: str) -> str:
-        self._counter += 1
-        return f"__{prefix}_{self._counter}__"
+        # per-prefix invoke counter (reference wrap_name_default semantics,
+        # default_decorators.py:74): names stay stable between configs that
+        # differ elsewhere — critical for train vs. generation configs
+        # sharing one checkpoint.
+        n = self._counters.get(prefix, 0)
+        self._counters[prefix] = n + 1
+        return f"__{prefix}_{n}__"
 
     def has_layer(self, name: str) -> bool:
         return name in self.layer_map

@@ -156,6 +156,7 @@ class DataProvider:
         async_prefetch: bool = True,
         seed: int = 1,
         drop_last: bool = False,
+        for_test: bool = False,
     ):
         self.provider = provider_obj
         self.file_list = file_list
@@ -165,6 +166,10 @@ class DataProvider:
         self.async_prefetch = async_prefetch
         self.rng = random.Random(seed)
         self.drop_last = drop_last
+        # should_shuffle=None in the provider means: shuffle in training,
+        # keep order for test/gen (matches the reference trainer)
+        shuffle = self.settings.should_shuffle
+        self.shuffle = (not for_test) if shuffle is None else bool(shuffle)
         self._cache: Optional[List] = None
         self._use_cache = getattr(provider_obj, "cache", 0) == 1
 
@@ -204,7 +209,7 @@ class DataProvider:
         yield from self._drain(pool, final=True)
 
     def _drain(self, pool: List, final: bool) -> Iterator[Dict[str, Argument]]:
-        if self.settings.should_shuffle:
+        if self.shuffle:
             self.rng.shuffle(pool)
         # keep a remainder in the pool between drains so shuffling mixes
         # across pool boundaries
@@ -248,6 +253,7 @@ def create_data_provider(
     slot_names: Sequence[str],
     async_prefetch: bool = True,
     seed: int = 1,
+    for_test: bool = False,
 ) -> DataProvider:
     """Instantiate from a DataConfig (define_py_data_sources2 output)."""
     import importlib
@@ -278,4 +284,5 @@ def create_data_provider(
         provider_kwargs=kwargs,
         async_prefetch=async_prefetch,
         seed=seed,
+        for_test=for_test,
     )

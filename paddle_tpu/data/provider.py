@@ -165,7 +165,9 @@ def provider(
         p = PyDataProvider2()
         p.generator_fn = fn
         p.input_types = input_types
-        p.should_shuffle = should_shuffle if should_shuffle is not None else True
+        # None = decided by the consumer: shuffle for training, ordered for
+        # test/gen (reference PyDataProvider2 semantics)
+        p.should_shuffle = should_shuffle
         p.pool_size = pool_size
         p.min_pool_size = min_pool_size
         p.can_over_batch_size = can_over_batch_size

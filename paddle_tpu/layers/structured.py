@@ -277,8 +277,14 @@ def selective_fc_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerConte
         acc = acc + ctx.param(cfg.bias_parameter_name)
     meta = inputs[0]
     if sel is not None and sel.ids is not None:
-        # mask of selected columns per row: scatter ones at selected ids
+        # mask of selected columns per row: scatter ones at selected ids;
+        # variable-size selection sets arrive zero-padded, so drop padded
+        # entries via the selection's lengths (else column 0 leaks in)
         onehot = jax.nn.one_hot(sel.ids, cfg.size, dtype=acc.dtype)  # [..., K, size]
+        if sel.seq_lengths is not None:
+            k_iota = jnp.arange(sel.ids.shape[-1], dtype=jnp.int32)
+            valid = (k_iota[None, :] < sel.seq_lengths[:, None]).astype(acc.dtype)
+            onehot = onehot * valid[..., None]
         m = jnp.clip(jnp.sum(onehot, axis=-2), 0.0, 1.0)
         if cfg.active_type in ("softmax", "sequence_softmax"):
             logits = jnp.where(m > 0, acc, NEG)

@@ -262,6 +262,13 @@ class GeneratorConfig(Message):
     num_results_per_sample: int = 1
     beam_size: int = 1
     log_prob: bool = True
+    # TPU extension: where the gen job writes results and the id→word dict
+    # (the reference demos thread these through shell flags instead).
+    result_file: str = ""
+    dict_file: str = ""
+    # data slot whose ids tag each sample in the result file (beam_search
+    # id_input; empty = sequential indices)
+    id_input_layer: str = ""
 
 
 @dataclass

@@ -155,7 +155,7 @@ class Trainer:
 
     # ------------------------------------------------------------- data
 
-    def _provider(self, for_test: bool) -> Optional[DataProvider]:
+    def _provider(self, for_test: bool, ordered: Optional[bool] = None) -> Optional[DataProvider]:
         dc = self.config.test_data_config if for_test else self.config.data_config
         if dc is None:
             return None
@@ -165,6 +165,7 @@ class Trainer:
             self.config.opt_config.batch_size,
             slot_names,
             seed=self.flags.seed,
+            for_test=for_test if ordered is None else ordered,
         )
 
     # ------------------------------------------------------------- train
@@ -248,6 +249,88 @@ class Trainer:
         results = {"cost": stats.total_cost / max(stats.total_samples, 1)}
         results.update(evaluators.results())
         logger.info("Test (pass %d): %s  %s", pass_id, stats.summary(), evaluators.summary())
+        return results
+
+    # --------------------------------------------------------------- gen
+
+    def generate(self, result_file: Optional[str] = None):
+        """Sequence-generation job (ref: RecurrentGradientMachine
+        generateSequence + demo/seqToseq gen.conf; the reference drives it
+        as `paddle train --job=test` over a generating config).
+
+        Runs the generator sub-model over the test (or train) data and
+        writes, per sample, an index line followed by
+        ``score\\ttok tok ...`` per kept beam. Returns the list of
+        (best_ids, beam_ids, beam_scores, beam_lens) batches."""
+        gen_sub = next(
+            (s for s in self.config.model_config.sub_models if s.generator is not None),
+            None,
+        )
+        assert gen_sub is not None, "config has no generator (use beam_search in the config)"
+        gen = gen_sub.generator
+        group = gen_sub.name
+        result_file = result_file or self.flags.gen_result or gen.result_file
+        words = None
+        if gen.dict_file and os.path.exists(gen.dict_file):
+            with open(gen.dict_file) as f:
+                words = [line.rstrip("\n") for line in f]
+
+        gm = self.gm
+
+        @jax.jit
+        def gen_fwd(params, in_args):
+            outputs, _ = gm.forward(params, in_args, pass_type="gen", rng=None)
+            return outputs
+
+        # generation must consume samples in order (result indices map to
+        # data order), even when falling back to the train data source
+        provider = self._provider(for_test=True) or self._provider(
+            for_test=False, ordered=True
+        )
+        assert provider is not None, "no data configured for generation"
+        params = self.updater.averaged_params(self.params, self.opt_state)
+        n_keep = max(int(gen.num_results_per_sample), 1)
+        results = []
+        sample_idx = 0
+        out_f = open(result_file, "w") if result_file else None
+        try:
+            for batch in provider.batches():
+                id_arg = batch.get(gen.id_input_layer) if gen.id_input_layer else None
+                sample_ids = (
+                    np.asarray(id_arg.ids).reshape(-1) if id_arg is not None else None
+                )
+                outputs = gen_fwd(params, batch)
+                best = outputs[group]
+                beams = outputs.get(f"{group}@beams")
+                ids = np.asarray(best.ids)
+                beam_ids = np.asarray(beams.ids) if beams is not None else ids[:, None]
+                scores = (
+                    np.asarray(beams.value)
+                    if beams is not None
+                    else np.zeros(beam_ids.shape[:2], np.float32)
+                )
+                lens = (
+                    np.asarray(beams.sub_seq_lengths)
+                    if beams is not None
+                    else np.asarray(best.seq_lengths)[:, None]
+                )
+                results.append((ids, beam_ids, scores, lens))
+                if out_f is not None:
+                    for b in range(ids.shape[0]):
+                        tag = sample_ids[b] if sample_ids is not None else sample_idx
+                        out_f.write(f"{tag}\n")
+                        for k in range(min(n_keep, beam_ids.shape[1])):
+                            toks = beam_ids[b, k, : lens[b, k]].tolist()
+                            text = " ".join(
+                                words[t] if words and t < len(words) else str(t)
+                                for t in toks
+                            )
+                            out_f.write(f"{scores[b, k]:.6f}\t{text}\n")
+                        sample_idx += 1
+        finally:
+            if out_f is not None:
+                out_f.close()
+                logger.info("generation results written to %s", result_file)
         return results
 
     # -------------------------------------------------------------- save

@@ -55,13 +55,16 @@ class ParameterAttribute:
             pc.initial_mean = (lo + hi) / 2.0
             pc.initial_std = (hi - lo) / 2.0
             pc.initial_smart = False
-        else:
+        elif self.initial_mean is not None or self.initial_std is not None:
             if self.initial_mean is not None:
                 pc.initial_mean = self.initial_mean
-                pc.initial_smart = False
             if self.initial_std is not None:
                 pc.initial_std = self.initial_std
-                pc.initial_smart = False
+            pc.initial_smart = False
+        elif not self.is_static:
+            # ParamAttr() with no init fields means "smart" init —
+            # std = 1/sqrt(fan_in) (reference attrs.py:67).
+            pc.initial_smart = True
         if self.l1_rate is not None:
             pc.decay_rate_l1 = self.l1_rate
         if self.l2_rate is not None:

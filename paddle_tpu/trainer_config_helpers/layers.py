@@ -224,7 +224,14 @@ def _create_parameter(
         pc.initial_mean = d.get("initial_mean", 0.0)
         pc.initial_std = d.get("initial_std", 0.01)
         pc.initial_strategy = d.get("initial_strategy", 0)
-        pc.initial_smart = d.get("initial_smart", False)
+        # reference semantics: a weight with no explicit init attr gets
+        # "smart" init, std = 1/sqrt(fan_in) (attrs.py:67 ParamAttr() →
+        # {'initial_smart': True}); the 0.01 default only applies when the
+        # user set default_initial_std()/settings overrides.
+        pc.initial_smart = d.get(
+            "initial_smart",
+            not isinstance(attr, ParameterAttribute) and "initial_std" not in d,
+        )
     if isinstance(attr, ParameterAttribute):
         if attr.name:
             # shared parameter: reuse existing config if present
@@ -1262,6 +1269,9 @@ def beam_search(
     max_length: int = 500,
     name: Optional[str] = None,
     num_results_per_sample: Optional[int] = None,
+    id_input=None,
+    dict_file: Optional[str] = None,
+    result_file: Optional[str] = None,
 ) -> LayerOutput:
     """Configure beam-search generation over a recurrent step function
     (reference: layers.py beam_search:2363). The GeneratedInput in
@@ -1323,6 +1333,9 @@ def beam_search(
         eos_layer_name="",
         num_results_per_sample=num_results_per_sample,
         beam_size=beam_size,
+        result_file=result_file or "",
+        dict_file=dict_file or "",
+        id_input_layer=id_input.name if id_input is not None else "",
     )
     # record bos/eos on the scoring layer config for the executor
     score_cfg = ctx.get_layer(out.name)

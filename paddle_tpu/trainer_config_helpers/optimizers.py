@@ -194,3 +194,54 @@ def settings(
         s["dtype"] = dtype
     if mesh_shape is not None:
         s["mesh_shape"] = mesh_shape
+
+
+# ------------------------------------------------- global init defaults
+# (reference config_parser.py:55-60: default_initial_std / default_initial_mean
+#  / default_initial_strategy / default_initial_smart / default_decay_rate /
+#  default_momentum set g_default_* consumed by every later Parameter())
+
+
+def _set_default(key, val):
+    from paddle_tpu.config.builder import current_context
+
+    current_context().defaults[key] = val
+
+
+def default_initial_std(val: float) -> None:
+    _set_default("initial_std", val)
+
+
+def default_initial_mean(val: float) -> None:
+    _set_default("initial_mean", val)
+
+
+def default_initial_strategy(val: int) -> None:
+    _set_default("initial_strategy", val)
+
+
+def default_initial_smart(val: bool) -> None:
+    _set_default("initial_smart", val)
+
+
+def default_decay_rate(val: float) -> None:
+    _set_default("decay_rate", val)
+
+
+def default_momentum(val: float) -> None:
+    _set_default("momentum", val)
+
+
+def default_gradient_clipping_threshold(val: float) -> None:
+    _set_default("gradient_clipping_threshold", val)
+
+
+__all__ += [
+    "default_initial_std",
+    "default_initial_mean",
+    "default_initial_strategy",
+    "default_initial_smart",
+    "default_decay_rate",
+    "default_momentum",
+    "default_gradient_clipping_threshold",
+]

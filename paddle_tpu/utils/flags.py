@@ -40,6 +40,7 @@ class _Flags:
     test_pass: int = -1
     test_wait: bool = False
     predict_output_dir: str = ""
+    gen_result: str = ""                 # gen job output file (overrides config)
     # rng
     seed: int = 1
     # distributed (multi-host jax)

@@ -240,3 +240,22 @@ def test_selective_fc_matches_fc_and_masks():
             else:
                 assert v[i, j] == 0
         np.testing.assert_allclose(v[i].sum(), 1.0, rtol=1e-5)
+
+
+def test_selective_fc_padded_selection_excludes_column0():
+    rng = np.random.RandomState(7)
+    B, D, O = 2, 4, 6
+    x = Argument(value=jnp.asarray(rng.randn(B, D).astype(np.float32)))
+    params = {"sfc.w": jnp.asarray(rng.randn(D, O).astype(np.float32))}
+    cfg = LayerConfig(name="sfc", type="selective_fc", size=O, active_type="softmax",
+                      inputs=[LayerInputConfig(input_layer_name="x", input_parameter_name="sfc.w"),
+                              LayerInputConfig(input_layer_name="sel")])
+    # row 0 selects {2,3} (padded with 0s); row 1 selects {0,1,4,5}
+    sel = Argument(ids=jnp.asarray(np.array([[2, 3, 0, 0], [0, 1, 4, 5]], np.int32)),
+                   seq_lengths=jnp.asarray(np.array([2, 4], np.int32)))
+    out = forward_layer(cfg, [x, sel], _ctx(params))
+    v = np.asarray(out.value)
+    assert v[0, 0] == 0.0 and v[0, 1] == 0.0  # padding must NOT select col 0
+    assert v[0, 2] > 0 and v[0, 3] > 0
+    assert v[1, 0] > 0  # genuine col-0 selection still works
+    np.testing.assert_allclose(v.sum(axis=1), 1.0, rtol=1e-5)
