@@ -1,0 +1,49 @@
+"""Synthetic MovieLens-style data (ref: demo/recommendation/dataprovider.py).
+
+Deterministic generator: each (movie, user) pair gets a rating from a
+planted low-rank structure so the model has signal to learn. Replace
+`process` with a reader of the real ml-1m files (same yield contract) to
+train on MovieLens.
+"""
+
+import random
+
+from paddle.trainer.PyDataProvider2 import *
+
+import trainer_config as C
+
+
+@provider(
+    input_types={
+        "movie_id": integer_value(C.MOVIE_IDS),
+        "movie_title": integer_value_sequence(C.TITLE_WORDS),
+        "movie_genre": sparse_binary_vector(C.GENRES),
+        "user_id": integer_value(C.USER_IDS),
+        "user_gender": integer_value(C.GENDERS),
+        "user_age": integer_value(C.AGES),
+        "user_job": integer_value(C.JOBS),
+        "rating": dense_vector(1),
+    }
+)
+def process(settings, file_name):
+    rng = random.Random(file_name)
+    for _ in range(2000):
+        mid = rng.randrange(C.MOVIE_IDS)
+        uid = rng.randrange(C.USER_IDS)
+        title = [rng.randrange(C.TITLE_WORDS) for _ in range(rng.randint(2, 6))]
+        genres = sorted(rng.sample(range(C.GENRES), rng.randint(1, 3)))
+        gender = uid % C.GENDERS
+        age = uid % C.AGES
+        job = uid % C.JOBS
+        # planted preference: users like movies whose id shares low bits
+        rating = 1.0 if (mid % 8) == (uid % 8) else -1.0
+        yield {
+            "movie_id": mid,
+            "movie_title": title,
+            "movie_genre": genres,
+            "user_id": uid,
+            "user_gender": gender,
+            "user_age": age,
+            "user_job": job,
+            "rating": [rating],
+        }

@@ -1,0 +1,7 @@
+#!/bin/bash
+# Train the CTR model (ref: demo/recommendation/run.sh).
+set -e
+cd "$(dirname "$0")"
+echo seed1 > train.list
+echo seed2 > test.list
+paddle train --config=trainer_config.py --save_dir=./output --num_passes=6 --log_period=10

@@ -1,0 +1,63 @@
+#edit-mode: -*- python -*-
+"""MovieLens-style CTR regression (ref: demo/recommendation/trainer_config.py).
+
+Two-tower model: movie features (id embedding + title word sequence via
+conv-pool + genre one-hot) and user features (id/gender/age/job embeddings)
+each fused by fc layers; rating predicted from the towers' cosine
+similarity. Embedding tables are marked sparse_update — only the rows a
+batch touches advance, the TPU replacement for the reference's sparse
+remote parameter updates.
+"""
+
+from paddle.trainer_config_helpers import *
+
+# synthetic dataset dimensions (see dataprovider.py)
+MOVIE_IDS = 1000
+USER_IDS = 800
+TITLE_WORDS = 500
+GENRES = 18
+GENDERS = 2
+AGES = 7
+JOBS = 21
+
+is_predict = get_config_arg("is_predict", bool, False)
+
+settings(batch_size=64, learning_rate=1e-3, learning_method=RMSPropOptimizer())
+
+sparse = ParamAttr(sparse_update=True)
+
+
+def embed_fc(name, size, emb_dim=64, out=64):
+    emb = embedding_layer(input=data_layer(name, size=size), size=emb_dim,
+                          param_attr=ParamAttr(name=f"_{name}_emb", sparse_update=True))
+    return fc_layer(input=emb, size=out)
+
+
+def construct_movie():
+    fusion = [embed_fc("movie_id", MOVIE_IDS)]
+    title_emb = embedding_layer(input=data_layer("movie_title", size=TITLE_WORDS),
+                                size=64,
+                                param_attr=ParamAttr(name="_title_emb", sparse_update=True))
+    fusion.append(sequence_conv_pool(input=title_emb, context_len=3, hidden_size=64))
+    genre = data_layer("movie_genre", size=GENRES)
+    fusion.append(fc_layer(input=fc_layer(input=genre, size=64), size=64))
+    return fc_layer(name="movie_fusion", input=fusion, size=64)
+
+
+def construct_user():
+    fusion = [
+        embed_fc("user_id", USER_IDS),
+        embed_fc("user_gender", GENDERS, emb_dim=8),
+        embed_fc("user_age", AGES, emb_dim=8),
+        embed_fc("user_job", JOBS, emb_dim=8),
+    ]
+    return fc_layer(name="user_fusion", input=fusion, size=64)
+
+
+similarity = cos_sim(a=construct_movie(), b=construct_user())
+if not is_predict:
+    outputs(regression_cost(input=similarity, label=data_layer("rating", size=1)))
+    define_py_data_sources2("train.list", "test.list",
+                            module="dataprovider", obj="process")
+else:
+    outputs(similarity)

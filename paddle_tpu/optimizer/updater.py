@@ -73,6 +73,10 @@ class Updater:
                 slots[name] = {}
                 continue
             slots[name] = {s: jnp.zeros_like(p) for s in self._slot_names()}
+            if cfg is not None and cfg.sparse_update and p.ndim >= 2:
+                # per-row last-touched step for lazy regularizer catch-up
+                # (OptimizerWithRegularizerSparse.h:124 semantics)
+                slots[name]["t_last"] = jnp.zeros((p.shape[0],), jnp.int32)
         avg_sum = {k: jnp.zeros_like(v) for k, v in params.items()} if self.averaging else None
         return UpdaterState(
             step=jnp.zeros((), jnp.int32),
@@ -103,16 +107,19 @@ class Updater:
             clip = cfg.gradient_clipping_threshold or opt.gradient_clipping_threshold
             if clip and clip > 0:
                 g = jnp.clip(g, -clip, clip)
-            # L2 regularization — reference folds decay into the gradient
-            # (OptimizerWithRegularizer / sgdUpdate)
-            if cfg.decay_rate:
-                g = g + cfg.decay_rate * w
             lr = base_lr * (cfg.learning_rate if cfg.learning_rate else 1.0)
-            w2, slots2 = self._apply_method(cfg, w, g, state.slots[name], lr, t)
-            # L1 regularization: proximal soft-threshold after the step
-            if cfg.decay_rate_l1:
-                thresh = lr * cfg.decay_rate_l1
-                w2 = jnp.sign(w2) * jnp.maximum(jnp.abs(w2) - thresh, 0.0)
+            if cfg.sparse_update and g.ndim >= 2:
+                w2, slots2 = self._apply_sparse_rows(cfg, w, g, state.slots[name], lr, t)
+            else:
+                # L2 regularization — reference folds decay into the gradient
+                # (OptimizerWithRegularizer / sgdUpdate)
+                if cfg.decay_rate:
+                    g = g + cfg.decay_rate * w
+                w2, slots2 = self._apply_method(cfg, w, g, state.slots[name], lr, t)
+                # L1 regularization: proximal soft-threshold after the step
+                if cfg.decay_rate_l1:
+                    thresh = lr * cfg.decay_rate_l1
+                    w2 = jnp.sign(w2) * jnp.maximum(jnp.abs(w2) - thresh, 0.0)
             new_params[name] = w2
             new_slots[name] = slots2
         avg_sum, avg_count = state.avg_sum, state.avg_count
@@ -120,6 +127,42 @@ class Updater:
             avg_sum = {k: avg_sum[k] + new_params[k] for k in new_params}
             avg_count = avg_count + 1.0
         return new_params, UpdaterState(t, num_samples, new_slots, avg_sum, avg_count)
+
+    def _apply_sparse_rows(self, cfg, w, g, slots, lr, t):
+        """Row-sparse update (SparseRowCpuMatrix::sgdUpdate +
+        OptimizerWithRegularizerSparse semantics, /root/reference/paddle/
+        math/SparseRowMatrix.h:31, parameter/OptimizerWithRegularizer.h:124):
+
+        Only rows touched by this batch advance — optimizer state for
+        untouched rows is frozen, and regularization they missed is applied
+        lazily ("catch-up") the next time the row is touched. Touched rows
+        are detected from the exact-zero gradient rows the embedding
+        scatter-add produces; on a sharded table each chip masks its own
+        rows, which is the SPMD replacement for the sparse pserver's
+        per-row remote updates."""
+        row_mask = jnp.any(g != 0, axis=tuple(range(1, g.ndim)))  # [V]
+        rm = row_mask.reshape((-1,) + (1,) * (g.ndim - 1))
+        t_last = slots.get("t_last")
+        inner = {k: v for k, v in slots.items() if k != "t_last"}
+        elapsed = jnp.maximum(t - 1 - t_last, 0).astype(w.dtype)  # missed batches
+        w_base = w
+        if cfg.decay_rate:
+            # compound the missed per-batch L2 decays, then fold the current
+            # step's decay into the gradient as the dense path does
+            decay = jnp.power(1.0 - lr * cfg.decay_rate, elapsed)
+            w_base = w * jnp.where(row_mask, decay, 1.0).reshape(rm.shape)
+            g = g + cfg.decay_rate * w_base * rm
+        if cfg.decay_rate_l1:
+            thresh = (lr * cfg.decay_rate_l1 * elapsed).reshape(rm.shape) * rm
+            w_base = jnp.sign(w_base) * jnp.maximum(jnp.abs(w_base) - thresh, 0.0)
+        w2, inner2 = self._apply_method(cfg, w_base, g, inner, lr, t)
+        if cfg.decay_rate_l1:
+            thresh = lr * cfg.decay_rate_l1
+            w2 = jnp.sign(w2) * jnp.maximum(jnp.abs(w2) - thresh, 0.0)
+        w_new = jnp.where(rm, w2, w)
+        slots_new = {k: jnp.where(rm, inner2[k], inner[k]) for k in inner}
+        slots_new["t_last"] = jnp.where(row_mask, t, t_last)
+        return w_new, slots_new
 
     def _apply_method(self, cfg, w, g, slots, lr, t):
         m = self.method

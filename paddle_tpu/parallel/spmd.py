@@ -46,8 +46,16 @@ def _param_shardings(mesh: Mesh, gm) -> Dict[str, NamedSharding]:
 
 def _opt_state_sharding(mesh: Mesh, param_shards: Dict[str, NamedSharding], opt_state: UpdaterState):
     repl = NamedSharding(mesh, P())
+
+    def slot_shard(name, arr):
+        ps = param_shards.get(name, repl)
+        # row-wise slots (e.g. sparse t_last, [V]) take the leading axes of
+        # the parameter's spec; full-shape slots take it whole
+        spec = tuple(ps.spec)[: arr.ndim] if hasattr(arr, "ndim") else tuple(ps.spec)
+        return NamedSharding(mesh, P(*spec))
+
     slots = {
-        name: {slot: param_shards.get(name, repl) for slot in d}
+        name: {slot: slot_shard(name, arr) for slot, arr in d.items()}
         for name, d in opt_state.slots.items()
     }
     avg = (
