@@ -1,0 +1,10 @@
+"""Shared dataset dimensions for the recommendation demo — DSL-free so the
+dataprovider can import it without executing the trainer config."""
+
+MOVIE_IDS = 1000
+USER_IDS = 800
+TITLE_WORDS = 500
+GENRES = 18
+GENDERS = 2
+AGES = 7
+JOBS = 21

@@ -10,7 +10,7 @@ import random
 
 from paddle.trainer.PyDataProvider2 import *
 
-import trainer_config as C
+import common as C
 
 
 @provider(

@@ -11,20 +11,12 @@ remote parameter updates.
 
 from paddle.trainer_config_helpers import *
 
-# synthetic dataset dimensions (see dataprovider.py)
-MOVIE_IDS = 1000
-USER_IDS = 800
-TITLE_WORDS = 500
-GENRES = 18
-GENDERS = 2
-AGES = 7
-JOBS = 21
+# synthetic dataset dimensions shared with dataprovider.py
+from common import AGES, GENDERS, GENRES, JOBS, MOVIE_IDS, TITLE_WORDS, USER_IDS
 
 is_predict = get_config_arg("is_predict", bool, False)
 
 settings(batch_size=64, learning_rate=1e-3, learning_method=RMSPropOptimizer())
-
-sparse = ParamAttr(sparse_update=True)
 
 
 def embed_fc(name, size, emb_dim=64, out=64):

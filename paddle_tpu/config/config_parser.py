@@ -47,6 +47,30 @@ def _ensure_compat_path() -> None:
         sys.path.insert(0, shim_dir)
 
 
+def evict_shadowed_modules(directory: str) -> None:
+    """Drop cached top-level modules that are shadowed by same-named .py files
+    in ``directory``, so user configs always import their *local* helper
+    modules (two demos both ship a ``dataprovider.py``; the reference runs
+    each config in a fresh embedded interpreter so never hits this)."""
+    try:
+        entries = os.listdir(directory)
+    except OSError:
+        return
+    for fname in entries:
+        if not fname.endswith(".py"):
+            continue
+        stem = fname[:-3]
+        mod = sys.modules.get(stem)
+        if mod is None:
+            continue
+        modfile = getattr(mod, "__file__", None)
+        local = os.path.join(os.path.realpath(directory), fname)
+        if modfile is None or os.path.realpath(modfile) != local:
+            for k in list(sys.modules):
+                if k == stem or k.startswith(stem + "."):
+                    del sys.modules[k]
+
+
 def parse_config(
     config: Union[str, Callable[[], None]],
     config_arg_str: str = "",
@@ -67,6 +91,7 @@ def parse_config(
                     namespace[k] = getattr(tch, k)
             namespace["get_config_arg"] = get_config_arg
             config_dir = os.path.dirname(os.path.abspath(config))
+            evict_shadowed_modules(config_dir)
             added = False
             if config_dir not in sys.path:
                 sys.path.insert(0, config_dir)

@@ -264,7 +264,11 @@ def create_data_provider(
     # the provider module conventionally sits next to the config / file
     # list (reference: PyDataProvider2.cpp loads the module by name with
     # the config dir importable); make cwd + the list dir importable.
-    search = [os.getcwd(), os.path.dirname(os.path.abspath(data_config.files))]
+    search = [os.path.dirname(os.path.abspath(data_config.files)), os.getcwd()]
+    from paddle_tpu.config.config_parser import evict_shadowed_modules
+
+    for p in search:
+        evict_shadowed_modules(p)
     added = [p for p in search if p not in sys.path]
     sys.path[:0] = added
     try:
