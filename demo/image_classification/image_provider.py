@@ -1,0 +1,51 @@
+"""Synthetic CIFAR-style image provider (ref: demo/image_classification/image_provider.py).
+
+Deterministic generator: each class plants a distinct low-frequency color
+template; samples are template + noise, so the net has real signal to
+learn. Swap `process` for a reader of the preprocessed CIFAR batches
+(same yield contract) to train on the real dataset.
+"""
+
+import zlib
+
+import numpy as np
+
+from paddle.trainer.PyDataProvider2 import *
+
+IMG_SIZE = 32
+CHANNELS = 3
+CLASSES = 10
+SAMPLES_PER_FILE = 256
+
+
+def _class_template(label):
+    rng = np.random.RandomState(1000 + label)
+    # low-frequency pattern upsampled to full resolution, per channel
+    coarse = rng.uniform(-1.0, 1.0, (CHANNELS, 4, 4))
+    return np.kron(coarse, np.ones((IMG_SIZE // 4, IMG_SIZE // 4)))
+
+
+_TEMPLATES = None
+
+
+def _templates():
+    global _TEMPLATES
+    if _TEMPLATES is None:
+        _TEMPLATES = [_class_template(c) for c in range(CLASSES)]
+    return _TEMPLATES
+
+
+@provider(
+    input_types={
+        "image": dense_vector(IMG_SIZE * IMG_SIZE * CHANNELS),
+        "label": integer_value(CLASSES),
+    }
+)
+def process(settings, file_name):
+    seed = zlib.crc32(file_name.encode()) % (2**31)
+    rng = np.random.RandomState(seed)
+    tmpl = _templates()
+    for _ in range(SAMPLES_PER_FILE):
+        label = int(rng.randint(CLASSES))
+        img = tmpl[label] + rng.normal(0.0, 0.6, tmpl[label].shape)
+        yield {"image": img.astype(np.float32).ravel().tolist(), "label": label}

@@ -1,0 +1,11 @@
+#!/bin/bash
+# Train the CIFAR VGG (ref: demo/image_classification/train.sh)
+set -e
+cd "$(dirname "$0")"
+paddle train \
+  --config=vgg_16_cifar.py \
+  --save_dir=./cifar_vgg_model \
+  --num_passes=300 \
+  --log_period=100 \
+  --use_tpu=1 \
+  2>&1 | tee train.log

@@ -1,0 +1,51 @@
+#edit-mode: -*- python -*-
+"""CIFAR image classification with a small VGG (ref: demo/image_classification/vgg_16_cifar.py).
+
+`--config_args=is_predict=1` builds the inference graph (no label/cost).
+`--config_args=small=1` shrinks the net for CI smoke runs.
+"""
+
+from paddle.trainer_config_helpers import *
+
+is_predict = get_config_arg("is_predict", bool, False)
+small = get_config_arg("small", bool, False)
+
+if not is_predict:
+    define_py_data_sources2(
+        train_list="train.list",
+        test_list="test.list",
+        module="image_provider",
+        obj="process",
+    )
+
+settings(
+    batch_size=32 if small else 128,
+    learning_rate=0.1 / 128.0,
+    learning_method=MomentumOptimizer(0.9),
+    regularization=L2Regularization(0.0005 * 128),
+)
+
+datadim = 3 * 32 * 32
+img = data_layer(name="image", size=datadim)
+
+if small:
+    # two tiny conv blocks — same topology family, CI-sized
+    tmp = img_conv_group(
+        input=img, num_channels=3, conv_num_filter=[16], conv_filter_size=3,
+        conv_padding=1, conv_act=ReluActivation(), pool_size=2, pool_stride=2,
+        pool_type=MaxPooling(),
+    )
+    tmp = img_conv_group(
+        input=tmp, conv_num_filter=[32], conv_filter_size=3, conv_padding=1,
+        conv_act=ReluActivation(), pool_size=2, pool_stride=2,
+        pool_type=MaxPooling(),
+    )
+    out = fc_layer(input=tmp, size=10, act=SoftmaxActivation(), name="output")
+else:
+    out = small_vgg(input_image=img, num_channels=3, num_classes=10)
+
+if not is_predict:
+    lbl = data_layer(name="label", size=10)
+    outputs(classification_cost(input=out, label=lbl))
+else:
+    outputs(out)

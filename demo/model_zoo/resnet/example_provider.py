@@ -1,0 +1,42 @@
+"""Synthetic ImageNet-shaped provider for the ResNet config — planted
+class templates + noise (same scheme as demo/image_classification).
+Replace with a real ImageNet reader keeping the yield contract."""
+
+import zlib
+
+import numpy as np
+
+from paddle.trainer.PyDataProvider2 import *
+
+_TEMPLATES = {}
+
+
+def _template(label, img_size):
+    key = (label, img_size)
+    if key not in _TEMPLATES:
+        rng = np.random.RandomState(7000 + label)
+        coarse = rng.uniform(-1.0, 1.0, (3, 4, 4))
+        _TEMPLATES[key] = np.kron(coarse, np.ones((img_size // 4, img_size // 4)))
+    return _TEMPLATES[key]
+
+
+def _init(settings, img_size=224, num_classes=1000, **kwargs):
+    settings.img_size = img_size
+    settings.num_classes = num_classes
+    settings.input_types = {
+        "input": dense_vector(3 * img_size * img_size),
+        "label": integer_value(num_classes),
+    }
+
+
+@provider(init_hook=_init)
+def process(settings, file_name):
+    seed = zlib.crc32(file_name.encode()) % (2**31)
+    rng = np.random.RandomState(seed)
+    n_classes = min(settings.num_classes, 16)
+    for _ in range(64):
+        label = int(rng.randint(n_classes))
+        img = _template(label, settings.img_size) + rng.normal(
+            0.0, 0.5, (3, settings.img_size, settings.img_size)
+        )
+        yield {"input": img.astype(np.float32).ravel().tolist(), "label": label}

@@ -1,0 +1,101 @@
+#edit-mode: -*- python -*-
+"""ResNet-50/101/152 ImageNet configs (ref: demo/model_zoo/resnet/resnet.py:160-242).
+
+config_args:
+  layer_num   50 | 101 | 152 (default 50)
+  img_size    input resolution (default 224; use 32 for CIFAR-scale smoke runs)
+  num_classes default 1000
+  is_predict  build inference graph (no label/cost)
+"""
+
+from paddle.trainer_config_helpers import *
+
+layer_num = get_config_arg("layer_num", int, 50)
+img_size = get_config_arg("img_size", int, 224)
+num_classes = get_config_arg("num_classes", int, 1000)
+is_predict = get_config_arg("is_predict", bool, False)
+
+STAGE_BLOCKS = {
+    50: (3, 4, 6, 3),
+    101: (3, 4, 23, 3),
+    152: (3, 8, 36, 3),
+}[layer_num]
+
+if not is_predict:
+    define_py_data_sources2(
+        train_list="train.list",
+        test_list="test.list",
+        module="example_provider",
+        obj="process",
+        args={"img_size": img_size, "num_classes": num_classes},
+    )
+
+settings(
+    batch_size=64,
+    learning_rate=0.01 / 64.0,
+    learning_method=MomentumOptimizer(0.9),
+    regularization=L2Regularization(0.0001 * 64),
+)
+
+
+def conv_bn(name, input, filter_size, num_filters, stride, padding,
+            channels=None, act=None):
+    """conv (no bias) + batch-norm; linear unless act given."""
+    conv = img_conv_layer(
+        name=name,
+        input=input,
+        filter_size=filter_size,
+        num_filters=num_filters,
+        num_channels=channels,
+        stride=stride,
+        padding=padding,
+        act=LinearActivation(),
+        bias_attr=False,
+    )
+    return batch_norm_layer(name=name + "_bn", input=conv,
+                            act=act or ReluActivation())
+
+
+def bottleneck(name, input, mid_filters, out_filters, stride=1, project=False):
+    """1x1 → 3x3 → 1x1 bottleneck with identity or projection shortcut."""
+    if project:
+        shortcut = conv_bn(name + "_proj", input, 1, out_filters, stride, 0,
+                           act=LinearActivation())
+    else:
+        shortcut = input
+    path = conv_bn(name + "_a", input, 1, mid_filters, stride, 0)
+    path = conv_bn(name + "_b", path, 3, mid_filters, 1, 1)
+    path = conv_bn(name + "_c", path, 1, out_filters, 1, 0,
+                   act=LinearActivation())
+    return addto_layer(name=name + "_sum", input=[shortcut, path],
+                       act=ReluActivation())
+
+
+def stage(name, input, blocks, mid_filters, out_filters, first_stride):
+    tmp = bottleneck(name + "_1", input, mid_filters, out_filters,
+                     stride=first_stride, project=True)
+    for i in range(2, blocks + 1):
+        tmp = bottleneck(f"{name}_{i}", tmp, mid_filters, out_filters)
+    return tmp
+
+
+img = data_layer(name="input", size=img_size * img_size * 3)
+tmp = conv_bn("conv1", img, 7, 64, 2, 3, channels=3)
+tmp = img_pool_layer(name="pool1", input=tmp, pool_size=3, stride=2,
+                     padding=1, pool_type=MaxPooling())
+
+widths = [(64, 256), (128, 512), (256, 1024), (512, 2048)]
+for s, ((mid, out_w), blocks) in enumerate(zip(widths, STAGE_BLOCKS), start=2):
+    tmp = stage(f"res{s}", tmp, blocks, mid, out_w,
+                first_stride=1 if s == 2 else 2)
+
+tmp = img_pool_layer(name="global_pool", input=tmp, pool_size=tmp.img_size,
+                     stride=1, pool_type=AvgPooling())
+output = fc_layer(name="output", input=tmp, size=num_classes,
+                  act=SoftmaxActivation())
+
+if not is_predict:
+    lbl = data_layer(name="label", size=num_classes)
+    outputs(classification_cost(input=output, label=lbl))
+else:
+    outputs(output)

@@ -1,0 +1,13 @@
+#!/bin/bash
+# Train ResNet (ref: demo/model_zoo/resnet — reference ships pretrained
+# models + feature extraction; we ship the training entry too)
+set -e
+cd "$(dirname "$0")"
+paddle train \
+  --config=resnet.py \
+  --config_args=layer_num=50 \
+  --save_dir=./resnet_model \
+  --num_passes=90 \
+  --log_period=100 \
+  --use_tpu=1 \
+  2>&1 | tee train.log

@@ -53,7 +53,8 @@ def _conv2d(x_nhwc: Array, w_hwio: Array, stride: Tuple[int, int], padding, grou
         padding=padding,
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
         feature_group_count=groups,
-        preferred_element_type=jnp.float32,
+        # accumulate narrow (bf16) inputs in f32 on the MXU; never narrow f64
+        preferred_element_type=jnp.result_type(x_nhwc.dtype, w_hwio.dtype, jnp.float32),
     )
 
 
@@ -127,14 +128,24 @@ def pool_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> A
     x = _nchw_to_nhwc(inputs[0].value, pc.channels, h, w)
     window = (1, ky, pc.size_x, 1)
     strides = (1, sy, pc.stride, 1)
-    pads = ((0, 0), (py, py), (pc.padding, pc.padding), (0, 0))
+    # the config declares ceil-mode output sizes (reference outputSize with
+    # caffeMode=false); extend the high-edge padding so the last window fits
+    oy = pc.output_y or pc.output_x
+    ox = pc.output_x
+    hi_y = max(0, (oy - 1) * sy + ky - h - py)
+    hi_x = max(0, (ox - 1) * pc.stride + pc.size_x - w - pc.padding)
+    pads = ((0, 0), (py, hi_y), (pc.padding, hi_x), (0, 0))
     kind = pc.pool_type
     if "max" in kind:
         init = -jnp.inf
         y = lax.reduce_window(x, init, lax.max, window, strides, pads)
-    else:  # avg / average pooling — reference divides by the *full* window
+    else:
+        # avg pooling divides each window by its *in-image* area (reference
+        # avgPoolForward clips hstart/hend to the image before dividing);
+        # the ones-counts reduce_window is constant-folded by XLA
         y = lax.reduce_window(x, 0.0, lax.add, window, strides, pads)
-        y = y / float(ky * pc.size_x)
+        counts = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, window, strides, pads)
+        y = y / counts
     out = _nhwc_to_flat(y)
     out = apply_activation(cfg.active_type, out)
     return Argument(value=out)

@@ -1,0 +1,249 @@
+"""Vision stack tests: conv/pool/bn numerics vs torch, gradient checks,
+and the two vision demos end-to-end.
+
+Analog of the reference's gserver/tests/test_LayerGrad.cpp conv/pool/norm
+cases plus the image_classification demo as the integration fixture; the
+CPU↔GPU equivalence harness (test_matrixCompare.cpp) becomes ours-vs-torch
+cross-checks.
+"""
+
+import os
+import shutil
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _build(config_fn, config_args=""):
+    from paddle_tpu.config import parse_config
+    from paddle_tpu.graph import GradientMachine
+
+    cfg = parse_config(config_fn, config_args)
+    return cfg, GradientMachine(cfg.model_config)
+
+
+def conv_config(B=2, C=3, H=8, F=4, fs=3, stride=2, padding=1):
+    def cfg():
+        from paddle_tpu.trainer_config_helpers import (
+            LinearActivation,
+            data_layer,
+            img_conv_layer,
+            outputs,
+            settings,
+        )
+
+        settings(batch_size=B, learning_rate=0.1)
+        img = data_layer(name="image", size=C * H * H)
+        conv = img_conv_layer(
+            input=img, filter_size=fs, num_filters=F, num_channels=C,
+            stride=stride, padding=padding, act=LinearActivation(), name="conv",
+        )
+        outputs(conv)
+
+    return cfg
+
+
+def test_conv_matches_torch():
+    import torch
+    import torch.nn.functional as TF
+
+    from paddle_tpu.graph import make_dense
+
+    B, C, H, F, fs, stride, padding = 2, 3, 8, 4, 3, 2, 1
+    cfg, gm = _build(conv_config(B, C, H, F, fs, stride, padding))
+    params = gm.init_params(seed=3)
+    rng = np.random.RandomState(0)
+    x = rng.randn(B, C * H * H).astype(np.float32)
+    out, _ = gm.forward(params, {"image": make_dense(x)}, pass_type="test")
+    ours = np.asarray(out["conv"].value)
+
+    w = np.asarray(params["_conv.w0"]).reshape(F, C, fs, fs)
+    bias = np.asarray(params["_conv.wbias"]).ravel()
+    t = TF.conv2d(
+        torch.from_numpy(x.reshape(B, C, H, H)),
+        torch.from_numpy(w),
+        bias=torch.from_numpy(bias),
+        stride=stride,
+        padding=padding,
+    )
+    theirs = t.numpy().reshape(B, -1)
+    np.testing.assert_allclose(ours, theirs, rtol=1e-4, atol=1e-4)
+
+
+def test_pool_matches_torch():
+    import torch
+    import torch.nn.functional as TF
+
+    from paddle_tpu.graph import make_dense
+
+    B, C, H = 2, 3, 8
+
+    def cfg():
+        from paddle_tpu.trainer_config_helpers import (
+            AvgPooling,
+            MaxPooling,
+            data_layer,
+            img_pool_layer,
+            outputs,
+            settings,
+        )
+
+        settings(batch_size=B, learning_rate=0.1)
+        img = data_layer(name="image", size=C * H * H)
+        mx = img_pool_layer(input=img, num_channels=C, pool_size=2, stride=2,
+                            pool_type=MaxPooling(), name="maxp")
+        av = img_pool_layer(input=img, num_channels=C, pool_size=2, stride=2,
+                            pool_type=AvgPooling(), name="avgp")
+        outputs(mx, av)
+
+    cfg_obj, gm = _build(cfg)
+    params = gm.init_params(seed=1)
+    rng = np.random.RandomState(1)
+    x = rng.randn(B, C * H * H).astype(np.float32)
+    out, _ = gm.forward(params, {"image": make_dense(x)}, pass_type="test")
+    xt = torch.from_numpy(x.reshape(B, C, H, H))
+    np.testing.assert_allclose(
+        np.asarray(out["maxp"].value),
+        TF.max_pool2d(xt, 2, 2).numpy().reshape(B, -1), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(out["avgp"].value),
+        TF.avg_pool2d(xt, 2, 2).numpy().reshape(B, -1), rtol=1e-5, atol=1e-5)
+
+
+def test_avg_pool_ceil_mode_matches_torch():
+    """Odd input → ceil-mode output; edge windows divide by in-image area."""
+    import torch
+    import torch.nn.functional as TF
+
+    from paddle_tpu.graph import make_dense
+
+    B, C, H = 2, 3, 7
+
+    def cfg():
+        from paddle_tpu.trainer_config_helpers import (
+            AvgPooling,
+            data_layer,
+            img_pool_layer,
+            outputs,
+            settings,
+        )
+
+        settings(batch_size=B, learning_rate=0.1)
+        img = data_layer(name="image", size=C * H * H)
+        outputs(img_pool_layer(input=img, num_channels=C, pool_size=2, stride=2,
+                               pool_type=AvgPooling(), name="avgp"))
+
+    cfg_obj, gm = _build(cfg)
+    params = gm.init_params(seed=1)
+    rng = np.random.RandomState(4)
+    x = rng.randn(B, C * H * H).astype(np.float32)
+    out, _ = gm.forward(params, {"image": make_dense(x)}, pass_type="test")
+    xt = torch.from_numpy(x.reshape(B, C, H, H))
+    want = TF.avg_pool2d(xt, 2, 2, ceil_mode=True, count_include_pad=False)
+    np.testing.assert_allclose(np.asarray(out["avgp"].value),
+                               want.numpy().reshape(B, -1), rtol=1e-5, atol=1e-5)
+
+
+def test_conv_bn_pool_gradient_check():
+    from paddle_tpu.graph import make_dense, make_ids
+
+    B, C, H = 3, 2, 6
+
+    def cfg():
+        from paddle_tpu.trainer_config_helpers import (
+            MaxPooling,
+            ReluActivation,
+            SoftmaxActivation,
+            batch_norm_layer,
+            classification_cost,
+            data_layer,
+            fc_layer,
+            img_conv_layer,
+            img_pool_layer,
+            outputs,
+            settings,
+        )
+
+        settings(batch_size=B, learning_rate=0.1)
+        img = data_layer(name="image", size=C * H * H)
+        conv = img_conv_layer(input=img, filter_size=3, num_filters=4,
+                              num_channels=C, stride=1, padding=1)
+        bn = batch_norm_layer(input=conv, act=ReluActivation())
+        pool = img_pool_layer(input=bn, pool_size=2, stride=2, pool_type=MaxPooling())
+        outp = fc_layer(input=pool, size=3, act=SoftmaxActivation(), name="output")
+        label = data_layer(name="label", size=3)
+        outputs(classification_cost(input=outp, label=label))
+
+    cfg_obj, gm = _build(cfg)
+    params = gm.init_params(seed=2)
+    rng = np.random.RandomState(2)
+    batch = {
+        "image": make_dense(rng.randn(B, C * H * H).astype(np.float32)),
+        "label": make_ids(rng.randint(0, 3, (B,))),
+    }
+    report = gm.check_gradient(params, batch, epsilon=1e-3, max_entries=6)
+    for name, diff in report.items():
+        assert diff < 5e-2, f"gradient mismatch for {name}: {diff}"
+
+
+@pytest.fixture()
+def demo_workspace(tmp_path):
+    def _copy(demo_rel):
+        src = os.path.join(REPO, "demo", demo_rel)
+        ws = tmp_path / os.path.basename(demo_rel)
+        shutil.copytree(src, ws)
+        return ws
+
+    return _copy
+
+
+def _train(ws, config, num_passes, config_args="", **flag_kw):
+    from paddle_tpu.config import parse_config
+    from paddle_tpu.trainer import Trainer
+    from paddle_tpu.utils.flags import _Flags
+
+    cwd = os.getcwd()
+    os.chdir(ws)
+    try:
+        cfg = parse_config(str(ws / config), config_args)
+        flags = _Flags(config=config, save_dir=str(ws / "model"),
+                       num_passes=num_passes, log_period=0, use_tpu=False,
+                       config_args=config_args, **flag_kw)
+        trainer = Trainer(cfg, flags)
+        trainer.train()
+        return trainer.test()
+    finally:
+        os.chdir(cwd)
+
+
+def test_vgg_cifar_demo_trains(demo_workspace):
+    ws = demo_workspace("image_classification")
+    metrics = _train(ws, "vgg_16_cifar.py", num_passes=3, config_args="small=1")
+    assert metrics["cost"] < 1.5, metrics
+    err = metrics.get("classification_error_evaluator", metrics.get("error"))
+    if err is not None:
+        assert err < 0.5, metrics
+
+
+def test_resnet50_trains_smoke(demo_workspace):
+    ws = demo_workspace(os.path.join("model_zoo", "resnet"))
+    metrics = _train(ws, "resnet.py", num_passes=1,
+                     config_args="img_size=32,num_classes=16")
+    assert np.isfinite(metrics["cost"]), metrics
+
+
+def test_resnet_predict_graph_builds():
+    from paddle_tpu.config import parse_config
+
+    cwd = os.getcwd()
+    os.chdir(os.path.join(REPO, "demo", "model_zoo", "resnet"))
+    try:
+        cfg = parse_config("resnet.py", "is_predict=1,layer_num=101")
+    finally:
+        os.chdir(cwd)
+    names = {l.name for l in cfg.model_config.layers}
+    assert "output" in names and "label" not in names
+    assert len([n for n in names if n.endswith("_sum")]) == sum((3, 4, 23, 3))
