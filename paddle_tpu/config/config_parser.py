@@ -107,6 +107,18 @@ def parse_config(
         return ctx.finalize()
 
 
+def parse_config_at(config_path: str, config_arg_str: str = "") -> TrainerConfig:
+    """parse_config with cwd temporarily set to the config's directory, so
+    configs using relative file lists / local imports work from anywhere."""
+    config_path = os.path.abspath(config_path)
+    cwd = os.getcwd()
+    os.chdir(os.path.dirname(config_path))
+    try:
+        return parse_config(os.path.basename(config_path), config_arg_str)
+    finally:
+        os.chdir(cwd)
+
+
 def parse_config_and_serialize(config, config_arg_str: str = "") -> str:
     """JSON form (the reference returned serialized protobuf bytes)."""
     return parse_config(config, config_arg_str).to_json()

@@ -136,16 +136,17 @@ def pool_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> A
     hi_x = max(0, (ox - 1) * pc.stride + pc.size_x - w - pc.padding)
     pads = ((0, 0), (py, hi_y), (pc.padding, hi_x), (0, 0))
     kind = pc.pool_type
+    # in-image element count per window (constant-folded by XLA); a ceil-mode
+    # window can land entirely in padding — guard those outputs to 0
+    counts = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, window, strides, pads)
     if "max" in kind:
-        init = -jnp.inf
-        y = lax.reduce_window(x, init, lax.max, window, strides, pads)
+        y = lax.reduce_window(x, -jnp.inf, lax.max, window, strides, pads)
+        y = jnp.where(counts > 0, y, 0.0)
     else:
         # avg pooling divides each window by its *in-image* area (reference
-        # avgPoolForward clips hstart/hend to the image before dividing);
-        # the ones-counts reduce_window is constant-folded by XLA
+        # avgPoolForward clips hstart/hend to the image before dividing)
         y = lax.reduce_window(x, 0.0, lax.add, window, strides, pads)
-        counts = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, window, strides, pads)
-        y = y / counts
+        y = y / jnp.maximum(counts, 1.0)
     out = _nhwc_to_flat(y)
     out = apply_activation(cfg.active_type, out)
     return Argument(value=out)

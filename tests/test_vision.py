@@ -236,14 +236,12 @@ def test_resnet50_trains_smoke(demo_workspace):
 
 
 def test_resnet_predict_graph_builds():
-    from paddle_tpu.config import parse_config
+    from paddle_tpu.config import parse_config_at
 
-    cwd = os.getcwd()
-    os.chdir(os.path.join(REPO, "demo", "model_zoo", "resnet"))
-    try:
-        cfg = parse_config("resnet.py", "is_predict=1,layer_num=101")
-    finally:
-        os.chdir(cwd)
+    cfg = parse_config_at(
+        os.path.join(REPO, "demo", "model_zoo", "resnet", "resnet.py"),
+        "is_predict=1,layer_num=101",
+    )
     names = {l.name for l in cfg.model_config.layers}
     assert "output" in names and "label" not in names
     assert len([n for n in names if n.endswith("_sum")]) == sum((3, 4, 23, 3))
