@@ -9,6 +9,7 @@ numbers — BASELINE.md); absent a recorded target it reports 1.0.
 
 from __future__ import annotations
 
+import functools
 import json
 import os
 import sys
@@ -29,7 +30,7 @@ def _jit_train_step(tc):
     opt_state = updater.init_state(params)
     grad_fn = gm.grad_fn()
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt_state, batch, bs):
         loss, grads, outputs, state_updates = grad_fn(params, batch, None)
         new_params, new_opt = updater(params, grads, opt_state, bs)
@@ -108,6 +109,9 @@ def main():
         with open(targets_path) as f:
             targets = json.load(f)
 
+    if which not in ("resnet", "lstm"):
+        print(f"unknown benchmark {which!r}: expected 'resnet' or 'lstm'", file=sys.stderr)
+        return 2
     if which == "lstm":
         value = bench_lstm_classifier()
         metric, unit, tkey = ("lstm_classifier_train_tokens_per_sec", "tokens/s",
@@ -137,4 +141,4 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -1,0 +1,48 @@
+"""Shared synthetic sentiment corpus for the quick_start demo.
+
+The reference demo (ref: demo/quick_start/preprocess.sh) downloads Amazon
+product reviews; here a deterministic generator plants the same kind of
+signal — each sentence mixes sentiment-bearing words with neutral filler,
+and the label is decided by which sentiment vocabulary dominates — so every
+config trains out-of-the-box with no downloads. Swap `synth_samples` for a
+reader of real `label\ttext` lines to use a real corpus.
+"""
+
+import random
+
+POSITIVE = ["good", "great", "love", "excellent", "best", "happy", "wonderful",
+            "perfect", "amazing", "recommend"]
+NEGATIVE = ["bad", "poor", "hate", "terrible", "worst", "sad", "awful",
+            "broken", "refund", "disappointing"]
+NEUTRAL = ["the", "a", "it", "this", "product", "item", "was", "is", "i",
+           "we", "they", "box", "time", "day", "use", "one", "very", "really",
+           "quite", "somewhat", "arrived", "ordered", "bought", "tried",
+           "works", "looks", "feels", "seems", "still", "again"]
+
+VOCAB = POSITIVE + NEGATIVE + NEUTRAL
+
+
+def write_dict(path):
+    with open(path, "w") as f:
+        for w in VOCAB:
+            f.write(w + "\n")
+
+
+def synth_samples(seed, n=1000):
+    """Yield (label, words) pairs with planted sentiment signal."""
+    rng = random.Random(seed)
+    for _ in range(n):
+        label = rng.randint(0, 1)
+        strong = POSITIVE if label else NEGATIVE
+        weak = NEGATIVE if label else POSITIVE
+        length = rng.randint(5, 30)
+        words = []
+        for _ in range(length):
+            r = rng.random()
+            if r < 0.25:
+                words.append(rng.choice(strong))
+            elif r < 0.30:
+                words.append(rng.choice(weak))  # noise
+            else:
+                words.append(rng.choice(NEUTRAL))
+        yield label, words

@@ -1,0 +1,38 @@
+"""Bag-of-words provider for quick_start (ref: demo/quick_start/dataprovider_bow.py).
+
+Each sample is the text as a 0/1 sparse vector over the dictionary plus the
+integer label. The dictionary is passed from the trainer config through
+`define_py_data_sources2(args=...)` into `init_hook`.
+"""
+
+from paddle.trainer.PyDataProvider2 import *
+
+import common
+
+UNK_IDX = 0
+
+
+def initializer(settings, dictionary, **kwargs):
+    settings.word_dict = dictionary
+    settings.input_types = [
+        sparse_binary_vector(len(dictionary)),
+        integer_value(2),
+    ]
+
+
+@provider(init_hook=initializer, cache=CacheType.CACHE_PASS_IN_MEM)
+def process(settings, file_name):
+    for label, words in common.synth_samples(file_name):
+        ids = sorted({settings.word_dict.get(w, UNK_IDX) for w in words})
+        yield ids, label
+
+
+def predict_initializer(settings, dictionary, **kwargs):
+    settings.word_dict = dictionary
+    settings.input_types = [sparse_binary_vector(len(dictionary))]
+
+
+@provider(init_hook=predict_initializer, should_shuffle=False)
+def process_predict(settings, file_name):
+    for _, words in common.synth_samples(file_name, n=100):
+        yield sorted({settings.word_dict.get(w, UNK_IDX) for w in words})

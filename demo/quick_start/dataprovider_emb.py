@@ -1,0 +1,36 @@
+"""Word-id sequence provider for quick_start (ref: demo/quick_start/dataprovider_emb.py).
+
+Used by the embedding / CNN / LSTM configs: each sample is the sentence as
+an integer-id sequence plus the label.
+"""
+
+from paddle.trainer.PyDataProvider2 import *
+
+import common
+
+UNK_IDX = 0
+
+
+def initializer(settings, dictionary, **kwargs):
+    settings.word_dict = dictionary
+    settings.input_types = [
+        integer_value_sequence(len(dictionary)),
+        integer_value(2),
+    ]
+
+
+@provider(init_hook=initializer)
+def process(settings, file_name):
+    for label, words in common.synth_samples(file_name):
+        yield [settings.word_dict.get(w, UNK_IDX) for w in words], label
+
+
+def predict_initializer(settings, dictionary, **kwargs):
+    settings.word_dict = dictionary
+    settings.input_types = [integer_value_sequence(len(dictionary))]
+
+
+@provider(init_hook=predict_initializer, should_shuffle=False)
+def process_predict(settings, file_name):
+    for _, words in common.synth_samples(file_name, n=100):
+        yield [settings.word_dict.get(w, UNK_IDX) for w in words]

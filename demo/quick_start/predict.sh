@@ -1,0 +1,11 @@
+#!/bin/bash
+# Run prediction with a trained quick_start model
+# (ref: demo/quick_start/predict.sh).
+set -e
+cd "$(dirname "$0")"
+cfg=${1:-lr}
+echo pred-seed-1 > pred.list
+paddle test \
+  --config=trainer_config.${cfg}.py \
+  --config_args=is_predict=1 \
+  --init_model_path=./output_${cfg}/pass-00004

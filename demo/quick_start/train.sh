@@ -1,0 +1,13 @@
+#!/bin/bash
+# Train any of the quick_start configs (ref: demo/quick_start/train.sh).
+# Usage: ./train.sh [lr|emb|cnn|lstm]
+set -e
+cd "$(dirname "$0")"
+cfg=${1:-lr}
+echo train-seed-1 > train.list
+echo test-seed-1 > test.list
+paddle train \
+  --config=trainer_config.${cfg}.py \
+  --save_dir=./output_${cfg} \
+  --num_passes=5 \
+  --log_period=5

@@ -1,0 +1,34 @@
+#edit-mode: -*- python -*-
+"""quick_start: text CNN (embedding → context conv → max pool)
+(ref: demo/quick_start/trainer_config.cnn.py).
+"""
+
+from paddle.trainer_config_helpers import *
+
+import common
+
+word_dict = {w: i for i, w in enumerate(common.VOCAB)}
+
+is_predict = get_config_arg("is_predict", bool, False)
+define_py_data_sources2(train_list="train.list" if not is_predict else None,
+                        test_list="test.list" if not is_predict else "pred.list",
+                        module="dataprovider_emb",
+                        obj="process" if not is_predict else "process_predict",
+                        args={"dictionary": word_dict})
+
+settings(batch_size=128 if not is_predict else 1,
+         learning_rate=2e-3,
+         learning_method=AdamOptimizer(),
+         regularization=L2Regularization(8e-4),
+         gradient_clipping_threshold=25)
+
+data = data_layer(name="word", size=len(word_dict))
+emb = embedding_layer(input=data, size=32)
+conv = sequence_conv_pool(input=emb, context_len=3, hidden_size=64)
+output = fc_layer(input=conv, size=2, act=SoftmaxActivation())
+
+if not is_predict:
+    label = data_layer(name="label", size=2)
+    outputs(classification_cost(input=output, label=label))
+else:
+    outputs(maxid_layer(output))

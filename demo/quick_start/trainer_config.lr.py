@@ -1,0 +1,38 @@
+#edit-mode: -*- python -*-
+"""quick_start: logistic regression over bag-of-words
+(ref: demo/quick_start/trainer_config.lr.py).
+
+The minimum end-to-end model — one fc+softmax over a sparse binary text
+vector. SURVEY.md Milestone A.
+"""
+
+from paddle.trainer_config_helpers import *
+
+import common
+
+word_dict = {w: i for i, w in enumerate(common.VOCAB)}
+
+is_predict = get_config_arg("is_predict", bool, False)
+trn = "train.list" if not is_predict else None
+tst = "test.list" if not is_predict else "pred.list"
+process = "process" if not is_predict else "process_predict"
+define_py_data_sources2(train_list=trn,
+                        test_list=tst,
+                        module="dataprovider_bow",
+                        obj=process,
+                        args={"dictionary": word_dict})
+
+settings(batch_size=128 if not is_predict else 1,
+         learning_rate=2e-3,
+         learning_method=AdamOptimizer(),
+         regularization=L2Regularization(8e-4),
+         gradient_clipping_threshold=25)
+
+data = data_layer(name="word", size=len(word_dict))
+output = fc_layer(input=data, size=2, act=SoftmaxActivation())
+
+if not is_predict:
+    label = data_layer(name="label", size=2)
+    outputs(classification_cost(input=output, label=label))
+else:
+    outputs(maxid_layer(output))

@@ -87,6 +87,14 @@ class GradientMachine:
         }
     )
 
+    def has_cost(self) -> bool:
+        layer_map = self.network.layer_map
+        return any(
+            layer_map[n].type in self.COST_TYPES
+            for n in self.network.output_layer_names
+            if n in layer_map
+        )
+
     def total_cost(self, outputs: Dict[str, Argument]) -> Array:
         """Mean per-sample cost summed across cost-layer outputs.
 

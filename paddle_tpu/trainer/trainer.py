@@ -237,6 +237,8 @@ class Trainer:
         if provider is None:
             return {}
         params = self.updater.averaged_params(self.params, self.opt_state)
+        if not self.gm.has_cost():
+            return self.predict(provider, params)
         stats = TrainerStats()
         evaluators = EvaluatorChain(self.config.model_config)
         evaluators.start()
@@ -250,6 +252,68 @@ class Trainer:
         results.update(evaluators.results())
         logger.info("Test (pass %d): %s  %s", pass_id, stats.summary(), evaluators.summary())
         return results
+
+    def predict(self, provider: DataProvider, params=None) -> Dict[str, float]:
+        """Cost-less test job: forward the net and dump output-layer values.
+
+        The role of the reference Tester's prediction path
+        (/root/reference/paddle/trainer/Tester.cpp, --predict_output_dir):
+        when the config has no cost layer (is_predict configs ending in
+        maxid/softmax outputs), write one text file per output layer —
+        ids for id outputs, rows of values otherwise.
+        """
+        import numpy as np
+
+        if params is None:
+            params = self.updater.averaged_params(self.params, self.opt_state)
+        out_dir = self.flags.predict_output_dir
+        if out_dir:
+            os.makedirs(out_dir, exist_ok=True)
+        files = {}
+        n_total = 0
+        try:
+            for batch in provider.batches():
+                outputs = self.test_fwd(params, batch)
+                n_total += _batch_num_samples(batch)
+                for name in self.gm.network.output_layer_names:
+                    arg = outputs[name]
+                    if out_dir:
+                        f = files.get(name)
+                        if f is None:
+                            f = files[name] = open(
+                                os.path.join(out_dir, f"predict_{name}.txt"), "w"
+                            )
+                    else:
+                        f = None
+                    lengths = (
+                        np.asarray(arg.seq_lengths) if arg.seq_lengths is not None else None
+                    )
+                    if arg.ids is not None:
+                        data = np.asarray(arg.ids)
+                        if data.ndim == 1:
+                            data = data[:, None]
+                    else:
+                        data = np.asarray(arg.value)
+                    # one line per sample; sequence outputs print only the
+                    # valid (unpadded) timesteps, space-joined
+                    for b in range(data.shape[0]):
+                        row = data[b]
+                        if lengths is not None and row.ndim >= 1 and row.shape[0] >= lengths[b]:
+                            row = row[: lengths[b]]
+                        line = " ".join(f"{v:.6g}" for v in np.ravel(row))
+                        if f is not None:
+                            f.write(line + "\n")
+                        else:
+                            logger.info("predict %s: %s", name, line)
+        finally:
+            for f in files.values():
+                f.close()
+        logger.info(
+            "Predict done: %d samples%s",
+            n_total,
+            f" → {out_dir}" if out_dir else "",
+        )
+        return {"samples": float(n_total)}
 
     # --------------------------------------------------------------- gen
 
