@@ -1,0 +1,37 @@
+"""Synthetic CoNLL-style SRL corpus.
+
+The reference trains on CoNLL-2005 (ref: demo/semantic_role_labeling/
+data/get_data.sh); here sentences are synthesized with a planted tagging
+rule — tokens inside a window around the marked predicate get B-ARG/I-ARG
+style labels, everything else O — so the tagger has deterministic signal.
+"""
+
+import random
+
+WORDS = ["<unk>"] + [f"w{i}" for i in range(199)]
+LABELS = ["O", "B-ARG0", "I-ARG0", "B-V", "B-ARG1", "I-ARG1"]
+
+
+def synth_sentences(seed, n=500):
+    """Yield (words, verb_pos) tagged sentences; labels derive from the
+    predicate position so the mark feature is informative."""
+    rng = random.Random(seed)
+    for _ in range(n):
+        length = rng.randint(5, 25)
+        words = [rng.randrange(1, len(WORDS)) for _ in range(length)]
+        verb = rng.randrange(length)
+        labels = []
+        for i in range(length):
+            if i == verb:
+                labels.append(LABELS.index("B-V"))
+            elif i == verb - 2:
+                labels.append(LABELS.index("B-ARG0"))
+            elif i == verb - 1:
+                labels.append(LABELS.index("I-ARG0"))
+            elif i == verb + 1:
+                labels.append(LABELS.index("B-ARG1"))
+            elif i == verb + 2:
+                labels.append(LABELS.index("I-ARG1"))
+            else:
+                labels.append(LABELS.index("O"))
+        yield words, verb, labels

@@ -1,0 +1,40 @@
+"""SRL data provider (ref: demo/semantic_role_labeling/dataprovider.py).
+
+Seven aligned integer sequences per sample: word ids, the predicate
+broadcast to sentence length, three context-window features, the 0/1
+predicate mark, and the target labels.
+"""
+
+from paddle.trainer.PyDataProvider2 import *
+
+import common
+
+
+def hook(settings, **kwargs):
+    settings.input_types = [
+        integer_value_sequence(len(common.WORDS)),
+        integer_value_sequence(len(common.WORDS)),
+        integer_value_sequence(len(common.WORDS)),
+        integer_value_sequence(len(common.WORDS)),
+        integer_value_sequence(len(common.WORDS)),
+        integer_value_sequence(2),
+        integer_value_sequence(len(common.LABELS)),
+    ]
+
+
+@provider(init_hook=hook)
+def process(settings, file_name):
+    for words, verb, labels in common.synth_sentences(file_name):
+        n = len(words)
+        verb_id = words[verb]
+        ctx_n1 = words[verb - 1] if verb > 0 else 0
+        ctx_p1 = words[verb + 1] if verb < n - 1 else 0
+        yield (
+            words,
+            [verb_id] * n,
+            [ctx_n1] * n,
+            [words[verb]] * n,
+            [ctx_p1] * n,
+            [1 if i == verb else 0 for i in range(n)],
+            labels,
+        )

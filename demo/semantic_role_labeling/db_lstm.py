@@ -1,0 +1,101 @@
+#edit-mode: -*- python -*-
+"""Semantic role labeling: deep bidirectional LSTM tagger
+(ref: demo/semantic_role_labeling/db_lstm.py).
+
+Six parallel id-sequence features (word, predicate, three context windows,
+predicate mark) are embedded — the word-family features share one embedding
+table — fused by a mixed_layer of full-matrix projections, then run through
+a `depth`-deep stack of alternating-direction LSTMs with direct fc edges;
+per-token softmax + classification cost over the padded sequence.
+"""
+
+from paddle.trainer_config_helpers import *
+
+import common
+
+is_test = get_config_arg("is_test", bool, False)
+is_predict = get_config_arg("is_predict", bool, False)
+depth = get_config_arg("depth", int, 8)
+# per-parameter lr multipliers (tutorial values; raise for small synthetic runs)
+lr_mult = get_config_arg("lr_mult", float, 1e-2)
+drop_rate = get_config_arg("drop_rate", float, 0.5)
+hidden_dim = get_config_arg("hidden_dim", int, 128)
+
+word_dict_len = len(common.WORDS)
+label_dict_len = len(common.LABELS)
+mark_dict_len = 2
+word_dim = 32
+mark_dim = 5
+
+if not is_predict:
+    define_py_data_sources2(
+        train_list=None if is_test else "train.list",
+        test_list="test.list",
+        module="dataprovider",
+        obj="process",
+        args={},
+    )
+
+settings(
+    batch_size=150,
+    learning_method=AdamOptimizer(),
+    learning_rate=1e-3,
+    regularization=L2Regularization(8e-4),
+    gradient_clipping_threshold=25,
+)
+
+word = data_layer(name="word_data", size=word_dict_len)
+predicate = data_layer(name="verb_data", size=word_dict_len)
+ctx_n1 = data_layer(name="ctx_n1_data", size=word_dict_len)
+ctx_0 = data_layer(name="ctx_0_data", size=word_dict_len)
+ctx_p1 = data_layer(name="ctx_p1_data", size=word_dict_len)
+mark = data_layer(name="mark_data", size=mark_dict_len)
+
+if not is_predict:
+    target = data_layer(name="target", size=label_dict_len)
+
+src_emb = ParameterAttribute(name="src_emb", learning_rate=lr_mult)
+layer_attr = ExtraLayerAttribute(drop_rate=drop_rate)
+fc_para_attr = ParameterAttribute(learning_rate=lr_mult)
+lstm_para_attr = ParameterAttribute(initial_std=0.0, learning_rate=2 * lr_mult)
+para_attr = [fc_para_attr, lstm_para_attr]
+
+embs = [
+    embedding_layer(size=word_dim, input=word, param_attr=src_emb),
+    embedding_layer(size=word_dim, input=predicate, param_attr=src_emb),
+    embedding_layer(size=word_dim, input=ctx_n1, param_attr=src_emb),
+    embedding_layer(size=word_dim, input=ctx_0, param_attr=src_emb),
+    embedding_layer(size=word_dim, input=ctx_p1, param_attr=src_emb),
+    embedding_layer(size=mark_dim, input=mark),
+]
+
+hidden_0 = mixed_layer(
+    size=hidden_dim,
+    input=[full_matrix_projection(input=e) for e in embs],
+)
+
+lstm_0 = lstmemory(input=hidden_0, layer_attr=layer_attr)
+
+# stack L-LSTM and R-LSTM with direct edges
+input_tmp = [hidden_0, lstm_0]
+for i in range(1, depth):
+    fc = fc_layer(input=input_tmp, size=hidden_dim, param_attr=para_attr)
+    lstm = lstmemory(
+        input=fc,
+        act=ReluActivation(),
+        reverse=(i % 2) == 1,
+        layer_attr=layer_attr,
+    )
+    input_tmp = [fc, lstm]
+
+prob = fc_layer(
+    input=input_tmp,
+    size=label_dict_len,
+    act=SoftmaxActivation(),
+    param_attr=para_attr,
+)
+
+if not is_predict:
+    outputs(classification_cost(input=prob, label=target))
+else:
+    outputs(prob)

@@ -1,0 +1,11 @@
+#!/bin/bash
+# Train the deep bi-LSTM SRL tagger (ref: demo/semantic_role_labeling/train.sh).
+set -e
+cd "$(dirname "$0")"
+echo train-seed-1 > train.list
+echo test-seed-1 > test.list
+paddle train \
+  --config=db_lstm.py \
+  --save_dir=./output \
+  --num_passes=10 \
+  --log_period=5

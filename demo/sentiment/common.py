@@ -1,0 +1,42 @@
+"""Synthetic IMDB-style review corpus for the sentiment demo.
+
+The reference demo preprocesses the aclImdb dataset
+(ref: demo/sentiment/preprocess.py); here reviews are synthesized with a
+planted sentiment signal (longer documents than quick_start, 20-80 words)
+so training runs with no downloads.
+"""
+
+import random
+
+NUM_CLASSES = 2
+
+POSITIVE = ["brilliant", "moving", "masterpiece", "superb", "delight",
+            "captivating", "flawless", "charming", "gripping", "stunning"]
+NEGATIVE = ["dull", "mess", "waste", "boring", "cliched", "shallow",
+            "tedious", "incoherent", "forgettable", "lifeless"]
+NEUTRAL = ["the", "movie", "film", "plot", "actor", "scene", "story", "it",
+           "was", "with", "and", "a", "of", "in", "that", "this", "his",
+           "her", "they", "screen", "director", "script", "music", "ending",
+           "character", "moment", "minute", "hour", "watch", "see", "felt",
+           "seemed", "looked", "went", "came", "thought", "knew", "made"]
+
+VOCAB = POSITIVE + NEGATIVE + NEUTRAL
+
+
+def synth_reviews(seed, n=800):
+    """Yield (label, words) movie reviews with planted sentiment."""
+    rng = random.Random(seed)
+    for _ in range(n):
+        label = rng.randint(0, NUM_CLASSES - 1)
+        strong = POSITIVE if label else NEGATIVE
+        weak = NEGATIVE if label else POSITIVE
+        words = []
+        for _ in range(rng.randint(20, 80)):
+            r = rng.random()
+            if r < 0.15:
+                words.append(rng.choice(strong))
+            elif r < 0.18:
+                words.append(rng.choice(weak))
+            else:
+                words.append(rng.choice(NEUTRAL))
+        yield label, words

@@ -1,0 +1,21 @@
+"""Data provider for the sentiment demo (ref: demo/sentiment/dataprovider.py)."""
+
+from paddle.trainer.PyDataProvider2 import *
+
+import common
+
+UNK_IDX = 0
+
+
+def hook(settings, dictionary, **kwargs):
+    settings.word_dict = dictionary
+    settings.input_types = [
+        integer_value_sequence(len(dictionary)),
+        integer_value(common.NUM_CLASSES),
+    ]
+
+
+@provider(init_hook=hook)
+def process(settings, file_name):
+    for label, words in common.synth_reviews(file_name):
+        yield [settings.word_dict.get(w, UNK_IDX) for w in words], label

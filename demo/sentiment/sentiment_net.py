@@ -1,0 +1,83 @@
+"""Sentiment-analysis networks (ref: demo/sentiment/sentiment_net.py).
+
+Two interchangeable nets over a word-id sequence:
+- stacked_lstm_net: alternating-direction stack of lstmemory layers with
+  direct fc edges (the tutorial's headline model, 3 stacked layers), max
+  pooled over time.
+- bidirectional_lstm_net: single fwd+bwd LSTM pair with dropout.
+"""
+
+from paddle.trainer_config_helpers import *
+
+import common
+
+
+def sentiment_data(is_test=False, is_predict=False,
+                   train_list="train.list", test_list="test.list"):
+    """Declare the synthetic IMDB-style data sources; returns (dict_dim,
+    class_dim). Swap common.synth_samples for a pre-imdb reader to use the
+    real dataset (same provider contract)."""
+    word_dict = {w: i for i, w in enumerate(common.VOCAB)}
+    if is_predict:
+        return len(word_dict), common.NUM_CLASSES
+    define_py_data_sources2(
+        train_list=None if is_test else train_list,
+        test_list=test_list,
+        module="dataprovider",
+        obj="process",
+        args={"dictionary": word_dict},
+    )
+    return len(word_dict), common.NUM_CLASSES
+
+
+def bidirectional_lstm_net(input_dim, class_dim=2, emb_dim=128, lstm_dim=128,
+                           is_predict=False):
+    data = data_layer("word", input_dim)
+    emb = embedding_layer(input=data, size=emb_dim)
+    bi_lstm = bidirectional_lstm(input=emb, size=lstm_dim)
+    dropout = dropout_layer(input=bi_lstm, dropout_rate=0.5)
+    output = fc_layer(input=dropout, size=class_dim, act=SoftmaxActivation())
+    if is_predict:
+        outputs(output)
+    else:
+        outputs(classification_cost(input=output, label=data_layer("label", 1)))
+
+
+def stacked_lstm_net(input_dim, class_dim=2, emb_dim=128, hid_dim=512,
+                     stacked_num=3, is_predict=False):
+    """Alternating-direction stacked LSTM (fewer-layer variant of the
+    architecture in aclweb.org/anthology/P15-1109)."""
+    assert stacked_num % 2 == 1
+
+    layer_attr = ExtraLayerAttribute(drop_rate=0.5)
+    fc_para_attr = ParameterAttribute(learning_rate=1e-3)
+    lstm_para_attr = ParameterAttribute(initial_std=0.0, learning_rate=1.0)
+    para_attr = [fc_para_attr, lstm_para_attr]
+    bias_attr = ParameterAttribute(initial_std=0.0, l2_rate=0.0)
+
+    data = data_layer("word", input_dim)
+    emb = embedding_layer(input=data, size=emb_dim)
+
+    fc1 = fc_layer(input=emb, size=hid_dim, act=LinearActivation(),
+                   bias_attr=bias_attr)
+    lstm1 = lstmemory(input=fc1, act=ReluActivation(), bias_attr=bias_attr,
+                      layer_attr=layer_attr)
+
+    inputs = [fc1, lstm1]
+    for i in range(2, stacked_num + 1):
+        fc = fc_layer(input=inputs, size=hid_dim, act=LinearActivation(),
+                      param_attr=para_attr, bias_attr=bias_attr)
+        lstm = lstmemory(input=fc, reverse=(i % 2) == 0, act=ReluActivation(),
+                         bias_attr=bias_attr, layer_attr=layer_attr)
+        inputs = [fc, lstm]
+
+    fc_last = pooling_layer(input=inputs[0], pooling_type=MaxPooling())
+    lstm_last = pooling_layer(input=inputs[1], pooling_type=MaxPooling())
+    output = fc_layer(input=[fc_last, lstm_last], size=class_dim,
+                      act=SoftmaxActivation(),
+                      bias_attr=bias_attr, param_attr=para_attr)
+
+    if is_predict:
+        outputs(output)
+    else:
+        outputs(classification_cost(input=output, label=data_layer("label", 1)))

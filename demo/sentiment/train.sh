@@ -1,0 +1,11 @@
+#!/bin/bash
+# Train the stacked-LSTM sentiment model (ref: demo/sentiment/train.sh).
+set -e
+cd "$(dirname "$0")"
+echo train-seed-1 > train.list
+echo test-seed-1 > test.list
+paddle train \
+  --config=trainer_config.py \
+  --save_dir=./model_output \
+  --num_passes=10 \
+  --log_period=5

@@ -1,0 +1,26 @@
+#edit-mode: -*- python -*-
+"""Sentiment demo driver config (ref: demo/sentiment/trainer_config.py)."""
+
+from paddle.trainer_config_helpers import *
+
+from sentiment_net import *
+
+is_test = get_config_arg("is_test", bool, False)
+is_predict = get_config_arg("is_predict", bool, False)
+# shrunk sizes for smoke runs: stacked_num=3 hid_dim=512 is the tutorial shape
+hid_dim = get_config_arg("hid_dim", int, 512)
+stacked_num = get_config_arg("stacked_num", int, 3)
+
+dict_dim, class_dim = sentiment_data(is_test, is_predict)
+
+settings(
+    batch_size=128,
+    learning_rate=2e-3,
+    learning_method=AdamOptimizer(),
+    regularization=L2Regularization(8e-4),
+    gradient_clipping_threshold=25,
+)
+
+stacked_lstm_net(dict_dim, class_dim=class_dim, hid_dim=hid_dim,
+                 stacked_num=stacked_num, is_predict=is_predict)
+# bidirectional_lstm_net(dict_dim, class_dim=class_dim, is_predict=is_predict)

@@ -262,8 +262,6 @@ class Trainer:
         maxid/softmax outputs), write one text file per output layer —
         ids for id outputs, rows of values otherwise.
         """
-        import numpy as np
-
         if params is None:
             params = self.updater.averaged_params(self.params, self.opt_state)
         out_dir = self.flags.predict_output_dir

@@ -13,3 +13,4 @@ from paddle_tpu.trainer_config_helpers.optimizers import *  # noqa: F401,F403
 from paddle_tpu.trainer_config_helpers.evaluators import *  # noqa: F401,F403
 from paddle_tpu.trainer_config_helpers.data_sources import *  # noqa: F401,F403
 from paddle_tpu.config.config_parser import get_config_arg  # noqa: F401
+from os.path import join as join_path  # noqa: F401  (reference utils.py export)

@@ -1,0 +1,98 @@
+"""Sentiment (stacked bi-LSTM) and SRL (deep bi-LSTM tagger) demos.
+
+End-to-end over the demo configs — exercises alternating-direction
+lstmemory stacks, shared embedding tables across inputs, mixed_layer
+projection fusion, and per-token sequence classification cost.
+"""
+
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_demo(tmp_path, demo, cfg_name, config_args="", num_passes=2):
+    demo_dir = os.path.join(REPO, "demo", demo)
+    for f in os.listdir(demo_dir):
+        if f.endswith(".py"):
+            shutil.copy(os.path.join(demo_dir, f), tmp_path)
+    (tmp_path / "train.list").write_text("train-seed-1\n")
+    (tmp_path / "test.list").write_text("test-seed-1\n")
+
+    from paddle_tpu.config import parse_config
+    from paddle_tpu.trainer import Trainer
+    from paddle_tpu.utils.flags import _Flags
+
+    cwd = os.getcwd()
+    os.chdir(tmp_path)
+    try:
+        cfg = parse_config(cfg_name, config_args)
+        flags = _Flags(config=cfg_name, num_passes=num_passes,
+                       log_period=100, use_tpu=False)
+        trainer = Trainer(cfg, flags)
+        trainer.train()
+        return cfg, trainer.test()
+    finally:
+        os.chdir(cwd)
+
+
+def test_sentiment_stacked_lstm(tmp_path):
+    # shrunk stack for the smoke run; structure identical to the tutorial
+    cfg, results = _run_demo(
+        tmp_path, "sentiment", "trainer_config.py",
+        config_args="hid_dim=32,stacked_num=3", num_passes=2,
+    )
+    types = [l.type for l in cfg.model_config.layers]
+    assert types.count("lstmemory") == 3
+    assert np.isfinite(results["cost"])
+
+
+def test_srl_db_lstm_learns(tmp_path):
+    cfg, results = _run_demo(
+        tmp_path, "semantic_role_labeling", "db_lstm.py",
+        config_args="depth=2,hidden_dim=32,lr_mult=1,drop_rate=0", num_passes=10,
+    )
+    # one forward + one reverse LSTM at depth=2
+    lstms = [l for l in cfg.model_config.layers if l.type == "lstmemory"]
+    assert len(lstms) == 2 and lstms[1].reversed and not lstms[0].reversed
+    # per-sequence cost must beat the always-predict-marginal baseline
+    # (label entropy ≈ 1.13/token × ~15 tokens ≈ 17); full position
+    # decoding needs more steps than a smoke run, so just require clear
+    # progress past the marginal solution
+    assert results["cost"] < 15.0, f"SRL tagger did not learn: {results}"
+
+
+def test_sentiment_bidirectional_net(tmp_path):
+    demo_dir = os.path.join(REPO, "demo", "sentiment")
+    for f in os.listdir(demo_dir):
+        if f.endswith(".py"):
+            shutil.copy(os.path.join(demo_dir, f), tmp_path)
+    (tmp_path / "train.list").write_text("train-seed-1\n")
+    (tmp_path / "test.list").write_text("test-seed-1\n")
+    (tmp_path / "bi_config.py").write_text(
+        "from paddle.trainer_config_helpers import *\n"
+        "from sentiment_net import *\n"
+        "dict_dim, class_dim = sentiment_data()\n"
+        "settings(batch_size=64, learning_rate=2e-3,\n"
+        "         learning_method=AdamOptimizer())\n"
+        "bidirectional_lstm_net(dict_dim, class_dim, emb_dim=16, lstm_dim=16)\n"
+    )
+
+    from paddle_tpu.config import parse_config
+    from paddle_tpu.trainer import Trainer
+    from paddle_tpu.utils.flags import _Flags
+
+    cwd = os.getcwd()
+    os.chdir(tmp_path)
+    try:
+        cfg = parse_config("bi_config.py")
+        flags = _Flags(config="bi_config.py", num_passes=1,
+                       log_period=100, use_tpu=False)
+        trainer = Trainer(cfg, flags)
+        trainer.train()
+        assert np.isfinite(trainer.test()["cost"])
+    finally:
+        os.chdir(cwd)
