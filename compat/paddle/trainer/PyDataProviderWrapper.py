@@ -1,0 +1,32 @@
+"""Legacy PyDataProviderWrapper slot declarations (compat).
+
+Only the slot classes survive here — used by reference-style predictors
+with DataProviderWrapperConverter (ref: /root/reference/python/paddle/
+trainer/PyDataProviderWrapper.py). The legacy pickled-slot provider
+protocol itself is superseded by PyDataProvider2.
+"""
+
+
+class _Slot:
+    def __init__(self, dim):
+        self.dim = dim
+
+
+class DenseSlot(_Slot):
+    pass
+
+
+class IndexSlot(_Slot):
+    pass
+
+
+class SparseNonValueSlot(_Slot):
+    pass
+
+
+class SparseValueSlot(_Slot):
+    pass
+
+
+class StringSlot(_Slot):
+    pass

@@ -1,0 +1,9 @@
+"""py_paddle compatibility package.
+
+Lets reference-style user programs (`from py_paddle import swig_paddle,
+util, DataProviderWrapperConverter`) run against paddle_tpu.api — the
+SWIG module's roles without SWIG (ref: /root/reference/paddle/py_paddle/).
+"""
+
+from py_paddle import swig_paddle, util  # noqa: F401
+from py_paddle.util import DataProviderWrapperConverter  # noqa: F401

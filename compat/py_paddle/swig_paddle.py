@@ -1,0 +1,12 @@
+"""swig_paddle compatibility module → paddle_tpu.api.
+
+Exposes the names reference predictors import
+(ref: /root/reference/paddle/api/PaddleAPI.h:92-799 via Paddle.swig).
+"""
+
+from paddle_tpu.api import (  # noqa: F401
+    DataProviderConverter,
+    GradientMachine,
+    SequenceGenerator,
+    initPaddle,
+)

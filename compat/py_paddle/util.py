@@ -1,0 +1,45 @@
+"""py_paddle.util compatibility — converter with the wrapper-slot API.
+
+The reference's DataProviderWrapperConverter (py_paddle/util.py:83-213)
+takes legacy PyDataProviderWrapper slot objects (IndexSlot/DenseSlot/...)
+plus an is_sequence flag; translate those into PyDataProvider2 input
+types and reuse the modern converter.
+"""
+
+from typing import Sequence
+
+from paddle_tpu.api import DataProviderConverter
+from paddle_tpu.data.provider import (
+    DataType,
+    SequenceType,
+    InputType,
+)
+
+
+def _as_input_type(slot, is_sequence: bool) -> InputType:
+    seq = SequenceType.SEQUENCE if is_sequence else SequenceType.NO_SEQUENCE
+    if isinstance(slot, InputType):
+        return InputType(slot.dim, seq, slot.type) if is_sequence else slot
+    # legacy wrapper slots expose .dim and a class name ending in "Slot"
+    dim = getattr(slot, "dim")
+    name = type(slot).__name__
+    mapping = {
+        "IndexSlot": DataType.Index,
+        "DenseSlot": DataType.Dense,
+        "SparseNonValueSlot": DataType.SparseNonValue,
+        "SparseValueSlot": DataType.SparseValue,
+    }
+    assert name in mapping, f"unsupported slot type {name}"
+    return InputType(dim, seq, mapping[name])
+
+
+class DataProviderWrapperConverter:
+    def __init__(self, is_sequence: bool, slots: Sequence, slot_names=None):
+        self.input_types = [_as_input_type(s, is_sequence) for s in slots]
+        self.slot_names = list(slot_names) if slot_names else [
+            str(i) for i in range(len(self.input_types))
+        ]
+        self._conv = DataProviderConverter(self.input_types, self.slot_names)
+
+    def __call__(self, samples):
+        return self._conv(list(samples))

@@ -1,0 +1,71 @@
+"""Image classification / feature extraction with a trained ResNet
+(ref: demo/model_zoo/resnet/classify.py, which drives the SWIG binding).
+
+Usage:
+    python classify.py --model_dir=./output/pass-00009 \
+        [--layer_num=50] [--img_size=32] [--num_classes=16] [--n=8]
+Feeds synthetic images (or .npy files listed via --data_file, one
+flattened CHW float row per line) and prints top-1 class + probability.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+from py_paddle import swig_paddle
+from paddle.trainer.config_parser import parse_config
+from paddle.trainer.PyDataProvider2 import dense_vector
+
+
+class ImageClassifier:
+    def __init__(self, conf_file, model_dir, config_args):
+        conf = parse_config(conf_file, config_args)
+        self.network = swig_paddle.GradientMachine.createFromConfigProto(
+            conf.model_config
+        )
+        self.network.loadParameters(model_dir)
+        dim = conf.model_config.layers[0].size
+        self.converter = swig_paddle.DataProviderConverter(
+            [dense_vector(dim)], self.network.input_layer_names()
+        )
+        self.dim = dim
+
+    def classify(self, rows):
+        out = self.network.forwardTest(self.converter([[r] for r in rows]))
+        prob = out[0]["value"]
+        top = np.argmax(prob, axis=-1)
+        return top, prob[np.arange(len(top)), top]
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--conf", default="resnet.py")
+    p.add_argument("--model_dir", required=True)
+    p.add_argument("--layer_num", type=int, default=50)
+    p.add_argument("--img_size", type=int, default=32)
+    p.add_argument("--num_classes", type=int, default=16)
+    p.add_argument("--data_file", default="")
+    p.add_argument("--n", type=int, default=4)
+    args = p.parse_args()
+
+    cfg_args = (
+        f"is_predict=1,layer_num={args.layer_num},"
+        f"img_size={args.img_size},num_classes={args.num_classes}"
+    )
+    clf = ImageClassifier(args.conf, args.model_dir, cfg_args)
+    if args.data_file:
+        rows = [np.load(line.strip()).ravel().tolist() for line in open(args.data_file)]
+    else:
+        rng = np.random.RandomState(0)
+        rows = [rng.rand(clf.dim).astype(np.float32).tolist() for _ in range(args.n)]
+    labels, probs = clf.classify(rows)
+    for i, (l, pr) in enumerate(zip(labels, probs)):
+        print(f"sample {i}: class={int(l)} prob={pr:.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,68 @@
+"""Predict sentiment from a trained model via the embedding API
+(ref: demo/sentiment/predict.py, which drives the SWIG binding).
+
+Usage:
+    python predict.py --model_dir=./model_output [--data_file=f]
+Reads one review per line (whitespace-tokenized) from data_file or the
+synthetic corpus when absent, prints the predicted label per line.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+from py_paddle import swig_paddle
+from paddle.trainer.config_parser import parse_config
+from paddle.trainer.PyDataProvider2 import integer_value_sequence
+
+import common
+
+
+class SentimentPrediction:
+    def __init__(self, train_conf, model_dir, config_args="is_predict=1"):
+        self.word_dict = {w: i for i, w in enumerate(common.VOCAB)}
+        conf = parse_config(train_conf, config_args)
+        self.network = swig_paddle.GradientMachine.createFromConfigProto(
+            conf.model_config
+        )
+        self.network.loadParameters(model_dir)
+        self.converter = swig_paddle.DataProviderConverter(
+            [integer_value_sequence(len(self.word_dict))],
+            self.network.input_layer_names(),
+        )
+
+    def predict_line(self, line):
+        words = [self.word_dict.get(w, 0) for w in line.strip().split()]
+        if not words:
+            return None
+        out = self.network.forwardTest(self.converter([[words]]))
+        prob = out[0]["value"][0]
+        return int(np.argmax(prob)), prob
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--train_conf", default="trainer_config.py")
+    p.add_argument("--config_args", default="is_predict=1,hid_dim=32")
+    p.add_argument("--model_dir", required=True)
+    p.add_argument("--data_file", default="")
+    args = p.parse_args()
+
+    predictor = SentimentPrediction(args.train_conf, args.model_dir, args.config_args)
+    if args.data_file:
+        lines = open(args.data_file)
+    else:  # demo mode: a few synthetic reviews
+        lines = [" ".join(ws) for _, ws in common.synth_reviews("demo", n=5)]
+    for line in lines:
+        res = predictor.predict_line(line)
+        if res is not None:
+            label, prob = res
+            print(f"{label}\t{prob[label]:.4f}\t{line.strip()[:60]}")
+
+
+if __name__ == "__main__":
+    main()

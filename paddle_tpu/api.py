@@ -1,0 +1,261 @@
+"""Embedding API — drive the framework from user Python programs.
+
+The role of the reference's SWIG binding
+(/root/reference/paddle/api/PaddleAPI.h:92-799 and
+paddle/py_paddle/util.py): load a parsed config, build a machine, run
+forward/forwardBackward from numpy data, read/write parameters, and run
+beam-search generation — without the Trainer CLI. No SWIG here: the
+framework is already Python, so this module is a thin numpy-faced wrapper
+over GradientMachine/Updater/checkpoint.
+
+Typical prediction flow (mirrors demo/sentiment/predict.py against the
+reference):
+
+    conf = parse_config("trainer_config.py", "is_predict=1")
+    machine = GradientMachine.createFromConfigProto(conf.model_config)
+    machine.loadParameters("./output/pass-00009")
+    conv = DataProviderConverter([integer_value_sequence(dict_dim)],
+                                 machine.input_layer_names())
+    out = machine.forwardTest(conv([[word_ids], [word_ids2]]))
+    prob = out[0]["value"]
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from paddle_tpu.data.feeder import BatchAssembler
+from paddle_tpu.graph.argument import Argument
+from paddle_tpu.graph.machine import GradientMachine as _CoreMachine
+from paddle_tpu.proto import ModelConfig, OptimizationConfig
+from paddle_tpu.utils.logging import logger
+
+__all__ = [
+    "initPaddle",
+    "GradientMachine",
+    "DataProviderConverter",
+    "SequenceGenerator",
+]
+
+
+def initPaddle(*args: str) -> None:
+    """Process-level init (ref: swig_paddle.initPaddle). Flags in
+    ``--name=value`` form; unknown names are ignored."""
+    from paddle_tpu.utils.flags import FLAGS
+
+    FLAGS.parse(list(args))
+    if not FLAGS.use_tpu:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+class DataProviderConverter:
+    """samples → feed dict of Arguments (ref: py_paddle
+    DataProviderWrapperConverter / dataprovider_converter.py:22-56).
+
+    ``input_types`` are the @provider slot declarations; ``slot_names``
+    the data-layer names in config input order.
+    """
+
+    def __init__(self, input_types: Sequence, slot_names: Sequence[str]):
+        self.assembler = BatchAssembler(input_types, slot_names)
+
+    def __call__(self, samples: List[Sequence[Any]]) -> Dict[str, Argument]:
+        return self.assembler.assemble(samples)
+
+
+class GradientMachine:
+    """Numpy-faced machine wrapper (ref: PaddleAPI.h:626 GradientMachine)."""
+
+    def __init__(self, model_config: ModelConfig, params=None, seed: int = 1):
+        self._core = _CoreMachine(model_config)
+        self.model_config = model_config
+        self.params = params if params is not None else self._core.init_params(seed=seed)
+        self._fwd_test = None
+
+    # -- construction ----------------------------------------------------
+
+    @classmethod
+    def createFromConfigProto(cls, model_config: ModelConfig, seed: int = 1):
+        return cls(model_config, seed=seed)
+
+    @classmethod
+    def createFromConfigFile(cls, config_file: str, config_args: str = ""):
+        from paddle_tpu.config import parse_config
+
+        conf = parse_config(config_file, config_args)
+        return cls(conf.model_config)
+
+    # -- parameters ------------------------------------------------------
+
+    def loadParameters(self, path: str) -> None:
+        """Load parameters from a checkpoint dir (pass-NNNNN), a save_dir
+        containing pass dirs (latest wins), or a merged-model .npz."""
+        import jax.numpy as jnp
+
+        from paddle_tpu.trainer import checkpoint as ckpt
+
+        if os.path.isfile(path):  # merged model (cli merge_model output)
+            with np.load(path, allow_pickle=False) as z:
+                loaded = {
+                    k: jnp.asarray(z[k]) for k in z.files if k != "__config_json__"
+                }
+            for name in self.params:
+                assert name in loaded, f"parameter {name!r} missing from {path}"
+            self.params = {k: loaded[k] for k in self.params}
+        else:
+            if not os.path.exists(os.path.join(path, "params.npz")):
+                latest = ckpt.latest_pass(path)
+                assert latest is not None, f"no checkpoint under {path}"
+                path = os.path.join(path, ckpt.PASS_FMT % latest)
+            self.params, _, _ = ckpt.load_checkpoint(
+                path, None, expected_params=self.params
+            )
+        self._fwd_test = None
+
+    def saveParameters(self, save_dir: str, pass_id: int = 0) -> None:
+        from paddle_tpu.trainer import checkpoint as ckpt
+
+        ckpt.save_checkpoint(save_dir, pass_id, self.params)
+
+    def getParameterNames(self) -> List[str]:
+        return sorted(self.params.keys())
+
+    def getParameter(self, name: str) -> np.ndarray:
+        return np.asarray(self.params[name])
+
+    def setParameter(self, name: str, value) -> None:
+        import jax.numpy as jnp
+
+        cur = self.params[name]
+        arr = jnp.asarray(value, dtype=cur.dtype)
+        assert arr.shape == cur.shape, f"{name}: {arr.shape} != {cur.shape}"
+        self.params[name] = arr
+        self._fwd_test = None
+
+    # -- inference -------------------------------------------------------
+
+    def input_layer_names(self) -> List[str]:
+        return list(self.model_config.input_layer_names)
+
+    def output_layer_names(self) -> List[str]:
+        return list(self._core.network.output_layer_names)
+
+    def _feed(self, in_args) -> Dict[str, Argument]:
+        """Normalize a feed: dict keyed by data-layer names, a positionally
+        keyed dict ("0", "1", ... from DataProviderWrapperConverter), or a
+        list of Arguments in config input order."""
+        names = self.input_layer_names()
+        if isinstance(in_args, dict):
+            if any(n in in_args for n in names):
+                return in_args
+            # positional string keys → input order
+            return {n: in_args[str(i)] for i, n in enumerate(names) if str(i) in in_args}
+        return {n: a for n, a in zip(names, in_args)}
+
+    def forwardTest(self, in_args) -> List[Dict[str, np.ndarray]]:
+        """Forward in test mode; one dict per output layer with numpy
+        ``value`` / ``id`` / ``sequence_lengths`` entries (the shape of the
+        reference's Arguments-out-to-numpy conversion, util.py:136)."""
+        in_args = self._feed(in_args)
+        if self._fwd_test is None:
+            core = self._core
+
+            def fwd(params, args):
+                outputs, _ = core.forward(params, args, pass_type="test", rng=None)
+                return outputs
+
+            self._fwd_test = jax.jit(fwd)
+        outputs = self._fwd_test(self.params, in_args)
+        result = []
+        for name in self.output_layer_names():
+            arg = outputs[name]
+            entry: Dict[str, np.ndarray] = {}
+            if arg.value is not None:
+                entry["value"] = np.asarray(arg.value)
+            if arg.ids is not None:
+                entry["id"] = np.asarray(arg.ids)
+            if arg.seq_lengths is not None:
+                entry["sequence_lengths"] = np.asarray(arg.seq_lengths)
+            result.append(entry)
+        return result
+
+    def forwardBackward(self, in_args: Dict[str, Argument], rng=None):
+        """One loss+gradient evaluation (custom training loops, ref:
+        PaddleAPI.h GradientMachine::forwardBackward). Returns
+        (loss: float, grads: dict name→numpy)."""
+        grad_fn = self._core.grad_fn()
+        loss, grads, _, _ = grad_fn(self.params, in_args, rng)
+        return float(loss), {k: np.asarray(v) for k, v in grads.items()}
+
+    # -- generation ------------------------------------------------------
+
+    def asSequenceGenerator(
+        self,
+        dict_file: str = "",
+        begin_token: int = 0,
+        end_token: int = 1,
+        max_length: int = 100,
+        beam_size: int = -1,
+    ) -> "SequenceGenerator":
+        return SequenceGenerator(self, dict_file, max_length)
+
+
+class SequenceGenerator:
+    """Beam-search generation façade (ref: PaddleAPI.h:775 and
+    ISequenceResults). Works on configs whose sub-model declares a
+    generator (beam_search in the DSL)."""
+
+    def __init__(self, machine: GradientMachine, dict_file: str = "", max_length: int = 100):
+        self.machine = machine
+        self.max_length = max_length
+        self.words: Optional[List[str]] = None
+        if dict_file:
+            with open(dict_file) as f:
+                self.words = [line.rstrip("\n") for line in f]
+        subs = [s for s in machine.model_config.sub_models if s.generator is not None]
+        assert subs, "config declares no generator sub-model (beam_search)"
+        self.sub = subs[0]
+        self._fwd = None
+
+    def generate(self, in_args: Dict[str, Argument]) -> List[List[Dict[str, Any]]]:
+        """Returns, per input sample, a list of beams:
+        ``{"ids": [...], "score": float, "words": [...]}`` sorted best-first."""
+        if self._fwd is None:
+            core = self.machine._core
+
+            def fwd(params, args):
+                outputs, _ = core.forward(params, args, pass_type="gen", rng=None)
+                return outputs
+
+            self._fwd = jax.jit(fwd)
+        outputs = self._fwd(self.machine.params, in_args)
+        group = self.sub.name
+        best = outputs[group]
+        beams = outputs.get(f"{group}@beams")
+        if beams is not None:
+            beam_ids = np.asarray(beams.ids)               # [B, K, T]
+            scores = np.asarray(beams.value)               # [B, K]
+            lens = np.asarray(beams.sub_seq_lengths)       # [B, K]
+        else:
+            beam_ids = np.asarray(best.ids)[:, None]       # [B, 1, T]
+            scores = np.zeros(beam_ids.shape[:2], np.float32)
+            lens = np.asarray(best.seq_lengths)[:, None]
+        results = []
+        for b in range(beam_ids.shape[0]):
+            sample = []
+            for k in range(beam_ids.shape[1]):
+                ids = [int(i) for i in beam_ids[b, k, : lens[b, k]]]
+                entry: Dict[str, Any] = {"ids": ids, "score": float(scores[b, k])}
+                if self.words is not None:
+                    entry["words"] = [
+                        self.words[i] if 0 <= i < len(self.words) else "<unk>"
+                        for i in ids
+                    ]
+                sample.append(entry)
+            sample.sort(key=lambda e: -e["score"])
+            results.append(sample)
+        return results

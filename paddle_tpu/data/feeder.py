@@ -14,6 +14,8 @@ no-padding layout.
 
 from __future__ import annotations
 
+import ctypes
+import itertools
 import json
 import queue
 import random
@@ -24,6 +26,7 @@ import numpy as np
 
 from paddle_tpu.graph.argument import Argument
 from paddle_tpu.data.provider import DataType, SequenceType
+from paddle_tpu.native import ptr
 from paddle_tpu.proto import DataConfig
 from paddle_tpu.utils.logging import logger
 
@@ -40,10 +43,24 @@ def bucket_length(n: int, multiple: int = 8) -> int:
     return p
 
 
+def _flat_i32(seqs, total: int) -> np.ndarray:
+    return np.fromiter(itertools.chain.from_iterable(seqs), dtype=np.int32, count=total)
+
+
 class BatchAssembler:
-    """Packs a list of samples (per @provider input_types) into Arguments."""
+    """Packs a list of samples (per @provider input_types) into Arguments.
+
+    The packing hot loops (the reference's C++ field scanners,
+    PyDataProvider2.cpp:611-865) run in the native datapath library when it
+    is available — ctypes calls release the GIL, so the prefetch thread
+    packs the next batch while the main thread runs Python — and fall back
+    to NumPy loops otherwise.
+    """
 
     def __init__(self, input_types: Sequence, slot_names: Sequence[str]):
+        from paddle_tpu.native import get_lib
+
+        self._native = get_lib()
         if isinstance(input_types, dict):
             self.slot_names = list(input_types.keys())
             self.input_types = [input_types[k] for k in self.slot_names]
@@ -95,9 +112,56 @@ class BatchAssembler:
             return self._sparse_row(v, tp, with_value=True)
         raise ValueError(f"unsupported slot type {tp.type}")
 
+    # -- native marshalling helpers
+
+    def _split_sparse(self, rows, tp):
+        """Flatten sparse rows → (indices[i64], values[f32]|None, counts[i32])."""
+        counts = np.asarray([len(r) for r in rows], dtype=np.int32)
+        total = int(counts.sum())
+        if tp.type == DataType.SparseValue:
+            idx = np.fromiter(
+                (int(p[0]) for r in rows for p in r), dtype=np.int64, count=total
+            )
+            val = np.fromiter(
+                (float(p[1]) for r in rows for p in r), dtype=np.float32, count=total
+            )
+            return idx, val, counts
+        idx = np.fromiter(
+            (int(i) for r in rows for i in r), dtype=np.int64, count=total
+        )
+        return idx, None, counts
+
+    @staticmethod
+    def _check_bounds(idx: np.ndarray, dim: int) -> None:
+        # the C packers don't bounds-check; a bad index must fail here like
+        # the NumPy fallback would, not corrupt the batch buffer
+        if idx.size and (idx.min() < 0 or idx.max() >= dim):
+            bad = idx[(idx < 0) | (idx >= dim)][0]
+            raise IndexError(f"sparse index {int(bad)} out of range [0, {dim})")
+
+    def _native_sparse_rows(self, rows, tp) -> np.ndarray:
+        lib = self._native
+        idx, val, counts = self._split_sparse(rows, tp)
+        self._check_bounds(idx, tp.dim)
+        out = np.empty((len(rows), tp.dim), dtype=np.float32)
+        lib.pt_pack_sparse_rows(
+            ptr(idx, ctypes.c_int64),
+            ptr(val, ctypes.c_float) if val is not None else None,
+            ptr(counts, ctypes.c_int32),
+            len(rows),
+            tp.dim,
+            ptr(out, ctypes.c_float),
+        )
+        return out
+
     def _scalar_slot(self, values, tp) -> Argument:
         if tp.type == DataType.Index:
             return Argument(ids=np.asarray(values, dtype=np.int32))
+        if self._native is not None and tp.type in (
+            DataType.SparseNonValue,
+            DataType.SparseValue,
+        ):
+            return Argument(value=self._native_sparse_rows(values, tp))
         rows = np.stack([self._row(v, tp) for v in values])
         return Argument(value=rows)
 
@@ -105,11 +169,46 @@ class BatchAssembler:
         B = len(values)
         lengths = np.asarray([len(v) for v in values], dtype=np.int32)
         T = bucket_length(int(lengths.max()) if B else 1)
+        lib = self._native
         if tp.type == DataType.Index:
+            if lib is not None:
+                flat = _flat_i32(values, int(lengths.sum()))
+                ids = np.empty((B, T), dtype=np.int32)
+                lib.pt_pack_index_seq(
+                    ptr(flat, ctypes.c_int32), ptr(lengths, ctypes.c_int32),
+                    B, T, ptr(ids, ctypes.c_int32),
+                )
+                return Argument(ids=ids, seq_lengths=lengths)
             ids = np.zeros((B, T), dtype=np.int32)
             for b, seq in enumerate(values):
                 ids[b, : len(seq)] = np.asarray(seq, dtype=np.int32)
             return Argument(ids=ids, seq_lengths=lengths)
+        if lib is not None and tp.type == DataType.Dense:
+            blocks = [
+                np.asarray(seq, dtype=np.float32).reshape(len(seq), tp.dim)
+                for seq in values
+            ]
+            flat = np.concatenate(blocks) if blocks else np.empty((0, tp.dim), np.float32)
+            flat = np.ascontiguousarray(flat)
+            val = np.empty((B, T, tp.dim), dtype=np.float32)
+            lib.pt_pack_dense_seq(
+                ptr(flat, ctypes.c_float), ptr(lengths, ctypes.c_int32),
+                B, T, tp.dim, ptr(val, ctypes.c_float),
+            )
+            return Argument(value=val, seq_lengths=lengths)
+        if lib is not None and tp.type in (DataType.SparseNonValue, DataType.SparseValue):
+            steps = [row for seq in values for row in seq]
+            idx, sval, step_counts = self._split_sparse(steps, tp)
+            self._check_bounds(idx, tp.dim)
+            val = np.empty((B, T, tp.dim), dtype=np.float32)
+            lib.pt_pack_sparse_seq(
+                ptr(idx, ctypes.c_int64),
+                ptr(sval, ctypes.c_float) if sval is not None else None,
+                ptr(step_counts, ctypes.c_int32),
+                ptr(lengths, ctypes.c_int32),
+                B, T, tp.dim, ptr(val, ctypes.c_float),
+            )
+            return Argument(value=val, seq_lengths=lengths)
         val = np.zeros((B, T, tp.dim), dtype=np.float32)
         for b, seq in enumerate(values):
             for t, item in enumerate(seq):
@@ -126,6 +225,17 @@ class BatchAssembler:
                 sub_lens[b, s] = len(sub)
         T = bucket_length(int(sub_lens.max()))
         if tp.type == DataType.Index:
+            if self._native is not None:
+                total = int(sub_lens.sum())
+                flat = _flat_i32(
+                    (sub for sample in values for sub in sample), total
+                )
+                ids = np.empty((B, S, T), dtype=np.int32)
+                self._native.pt_pack_index_subseq(
+                    ptr(flat, ctypes.c_int32), ptr(sub_lens, ctypes.c_int32),
+                    B, S, T, ptr(ids, ctypes.c_int32),
+                )
+                return Argument(ids=ids, seq_lengths=num_subs, sub_seq_lengths=sub_lens)
             ids = np.zeros((B, S, T), dtype=np.int32)
             for b, sample in enumerate(values):
                 for s, sub in enumerate(sample):

@@ -1,0 +1,118 @@
+"""Native datapath: the C++ scanners must byte-match the NumPy fallback.
+
+Analog of the reference's CPU↔GPU kernel-equivalence tests
+(test_matrixCompare.cpp pattern, SURVEY.md §4): same batch packed by the
+native library and by the pure-NumPy path must be identical.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from paddle_tpu.data.feeder import BatchAssembler
+from paddle_tpu.data.provider import (
+    dense_vector_sequence,
+    integer_value,
+    integer_value_sequence,
+    integer_value_sub_sequence,
+    sparse_binary_vector,
+    sparse_binary_vector_sequence,
+    sparse_value_slot,
+    sparse_vector_sequence,
+)
+from paddle_tpu.native import get_lib
+
+
+def _assemblers(input_types, names):
+    a_native = BatchAssembler(input_types, names)
+    a_py = BatchAssembler(input_types, names)
+    a_py._native = None
+    if a_native._native is None:
+        pytest.skip("native datapath unavailable")
+    return a_native, a_py
+
+
+def _check(a_native, a_py, samples, names):
+    out_n = a_native.assemble(samples)
+    out_p = a_py.assemble(samples)
+    for name in names:
+        n, p = out_n[name], out_p[name]
+        for field in ("value", "ids", "seq_lengths", "sub_seq_lengths"):
+            fn, fp = getattr(n, field), getattr(p, field)
+            assert (fn is None) == (fp is None), (name, field)
+            if fn is not None:
+                np.testing.assert_array_equal(np.asarray(fn), np.asarray(fp),
+                                              err_msg=f"{name}.{field}")
+
+
+def test_native_lib_builds():
+    lib = get_lib()
+    if lib is None:
+        pytest.skip("no toolchain")
+    assert lib.pt_datapath_abi_version() == 1
+
+
+def test_index_and_sparse_slots_match():
+    rng = random.Random(7)
+    types = [
+        integer_value_sequence(50),
+        sparse_binary_vector(40),
+        sparse_value_slot(30),
+        integer_value(9),
+    ]
+    names = ["seq", "bow", "sv", "label"]
+    a_n, a_p = _assemblers(types, names)
+    samples = []
+    for _ in range(17):
+        seq = [rng.randrange(50) for _ in range(rng.randint(1, 23))]
+        bow = sorted(rng.sample(range(40), rng.randint(0, 10)))
+        sv = [(i, rng.random()) for i in sorted(rng.sample(range(30), 4))]
+        samples.append([seq, bow, sv, rng.randrange(9)])
+    _check(a_n, a_p, samples, names)
+
+
+def test_dense_and_sparse_sequences_match():
+    rng = random.Random(11)
+    types = [
+        dense_vector_sequence(8),
+        sparse_binary_vector_sequence(25),
+        sparse_vector_sequence(15),
+    ]
+    names = ["dv", "sbs", "svs"]
+    a_n, a_p = _assemblers(types, names)
+    samples = []
+    for _ in range(9):
+        n = rng.randint(1, 12)
+        dv = [[rng.random() for _ in range(8)] for _ in range(n)]
+        sbs = [sorted(rng.sample(range(25), rng.randint(0, 5))) for _ in range(n)]
+        svs = [
+            [(i, rng.random()) for i in sorted(rng.sample(range(15), rng.randint(0, 4)))]
+            for _ in range(n)
+        ]
+        samples.append([dv, sbs, svs])
+    _check(a_n, a_p, samples, names)
+
+
+def test_out_of_range_sparse_index_raises():
+    types = [sparse_binary_vector(10)]
+    a_n, _ = _assemblers(types, ["bow"])
+    with pytest.raises(IndexError):
+        a_n.assemble([[[3, 10]]])
+    with pytest.raises(IndexError):
+        a_n.assemble([[[-1, 2]]])
+
+
+def test_nested_index_sequences_match():
+    rng = random.Random(13)
+    types = [integer_value_sub_sequence(60)]
+    names = ["nested"]
+    a_n, a_p = _assemblers(types, names)
+    samples = []
+    for _ in range(7):
+        subs = [
+            [rng.randrange(60) for _ in range(rng.randint(1, 9))]
+            for _ in range(rng.randint(1, 5))
+        ]
+        samples.append([subs])
+    _check(a_n, a_p, samples, names)
