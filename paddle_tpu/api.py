@@ -196,12 +196,19 @@ class GradientMachine:
     def asSequenceGenerator(
         self,
         dict_file: str = "",
-        begin_token: int = 0,
-        end_token: int = 1,
-        max_length: int = 100,
-        beam_size: int = -1,
+        begin_token: Optional[int] = None,
+        end_token: Optional[int] = None,
+        max_length: Optional[int] = None,
+        beam_size: Optional[int] = None,
     ) -> "SequenceGenerator":
-        return SequenceGenerator(self, dict_file, max_length)
+        """Overrides (when given) are written into the generator sub-model
+        config before the generation graph is traced — same knobs the
+        reference SWIG API exposes (PaddleAPI.h:775)."""
+        return SequenceGenerator(
+            self, dict_file,
+            begin_token=begin_token, end_token=end_token,
+            max_length=max_length, beam_size=beam_size,
+        )
 
 
 class SequenceGenerator:
@@ -209,9 +216,16 @@ class SequenceGenerator:
     ISequenceResults). Works on configs whose sub-model declares a
     generator (beam_search in the DSL)."""
 
-    def __init__(self, machine: GradientMachine, dict_file: str = "", max_length: int = 100):
+    def __init__(
+        self,
+        machine: GradientMachine,
+        dict_file: str = "",
+        begin_token: Optional[int] = None,
+        end_token: Optional[int] = None,
+        max_length: Optional[int] = None,
+        beam_size: Optional[int] = None,
+    ):
         self.machine = machine
-        self.max_length = max_length
         self.words: Optional[List[str]] = None
         if dict_file:
             with open(dict_file) as f:
@@ -219,6 +233,20 @@ class SequenceGenerator:
         subs = [s for s in machine.model_config.sub_models if s.generator is not None]
         assert subs, "config declares no generator sub-model (beam_search)"
         self.sub = subs[0]
+        # the generation graph traces lazily on first generate(), so config
+        # overrides applied here take effect
+        group_cfg = next(
+            (l for l in machine.model_config.layers if l.name == self.sub.name), None
+        )
+        if max_length is not None:
+            self.sub.generator.max_num_frames = int(max_length)
+        if beam_size is not None and group_cfg is not None:
+            group_cfg.beam_size = int(beam_size)
+            self.sub.generator.beam_size = int(beam_size)
+        if begin_token is not None and group_cfg is not None:
+            group_cfg.bos_id = int(begin_token)
+        if end_token is not None and group_cfg is not None:
+            group_cfg.eos_id = int(end_token)
         self._fwd = None
 
     def generate(self, in_args: Dict[str, Argument]) -> List[List[Dict[str, Any]]]:

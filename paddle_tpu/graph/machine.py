@@ -32,6 +32,7 @@ class GradientMachine:
         self.model = model
         self.network = Network(model)
         self.dtype = dtype
+        self.mesh = None  # set by the trainer when running on a mesh
         self.param_configs: Dict[str, ParameterConfig] = {p.name: p for p in model.parameters}
 
     # ------------------------------------------------------------- params
@@ -57,7 +58,8 @@ class GradientMachine:
     ) -> Tuple[Dict[str, Argument], Dict[str, Array]]:
         """Run the graph; returns (all layer outputs, state updates)."""
         ctx = LayerContext(
-            params=params, model=self.model, pass_type=pass_type, rng=rng, dtype=self.dtype
+            params=params, model=self.model, pass_type=pass_type, rng=rng,
+            dtype=self.dtype, mesh=self.mesh,
         )
         self.network.forward(ctx, in_args)
         return ctx.outputs, ctx.state_updates

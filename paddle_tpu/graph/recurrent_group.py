@@ -112,6 +112,7 @@ def _run_submodel_step(
         rng=rng,
         states=ctx.states,
         dtype=ctx.dtype,
+        mesh=ctx.mesh,
     )
     step_ctx.outputs.update(fed)
     for name in sub.layer_names:

@@ -14,5 +14,6 @@ import paddle_tpu.layers.recurrent  # noqa: F401
 import paddle_tpu.layers.vision  # noqa: F401
 import paddle_tpu.layers.misc  # noqa: F401
 import paddle_tpu.layers.structured  # noqa: F401
+import paddle_tpu.layers.attention  # noqa: F401
 
 __all__ = ["LayerContext", "layer_registry", "register_layer", "forward_layer"]

@@ -1,0 +1,67 @@
+"""Multi-head self-attention layer with optional context parallelism.
+
+A TPU extension beyond the 2016 reference (whose only attention is the
+additive simple_attention inside recurrent groups,
+/root/reference/python/paddle/trainer_config_helpers/networks.py:943):
+transformer-style scaled-dot-product attention over a padded sequence
+[B, T, D], with the context dimension shardable across chips — the layer
+dispatches to ring / all-to-all attention (paddle_tpu.parallel.
+sequence_parallel) when the active mesh has a "seq" axis.
+
+Parameters: ``_<name>.wqkv`` [D, 3·H·Dh] fused projection, ``_<name>.wo``
+[H·Dh, D] output projection.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.graph.argument import Argument
+from paddle_tpu.layers.base import LayerContext, register_layer, finalize_output, with_seq_meta
+from paddle_tpu.proto import LayerConfig
+
+Array = jax.Array
+
+
+@register_layer("multi_head_attention")
+def multi_head_attention(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Argument:
+    from paddle_tpu.parallel.sequence_parallel import (
+        alltoall_attention,
+        full_attention,
+        ring_attention,
+    )
+
+    arg = inputs[0]
+    assert arg.is_seq and arg.value is not None, (
+        f"{cfg.name}: multi_head_attention needs a dense sequence input"
+    )
+    x = arg.value                                   # [B, T, D]
+    B, T, D = x.shape
+    H = max(cfg.num_heads, 1)
+    model_dim = cfg.size
+    Dh = model_dim // H
+    assert H * Dh == model_dim, f"{cfg.name}: size {model_dim} not divisible by heads {H}"
+
+    wqkv = ctx.param(f"_{cfg.name}.wqkv")           # [D, 3·H·Dh]
+    wo = ctx.param(f"_{cfg.name}.wo")               # [H·Dh, size_out]
+    qkv = jnp.einsum("btd,de->bte", x, wqkv).reshape(B, T, 3, H, Dh)
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+
+    lengths = arg.seq_lengths
+    causal = cfg.causal_attention
+    mesh = ctx.mesh
+    mode = cfg.seq_parallel_mode
+    if mesh is not None and "seq" in getattr(mesh, "axis_names", ()) and mode != "":
+        attn = ring_attention if mode == "ring" else alltoall_attention
+        out = attn(q, k, v, mesh, lengths=lengths, causal=causal)
+    else:
+        out = full_attention(q, k, v, lengths=lengths, causal=causal)
+    out = out.reshape(B, T, H * Dh)
+    value = jnp.einsum("bte,ed->btd", out, wo)
+    value = finalize_output(cfg, value, ctx, mask=arg.seq_mask())
+    # zero padded positions so downstream pooling/costs see clean zeros
+    value = value * arg.seq_mask()[..., None]
+    return with_seq_meta(arg, value)

@@ -51,6 +51,9 @@ class LayerContext:
     state_updates: Dict[str, Any] = field(default_factory=dict)
     outputs: Dict[str, Argument] = field(default_factory=dict)
     dtype: Any = jnp.float32
+    # device mesh for layers that issue explicit collectives (ring
+    # attention); None outside meshed execution
+    mesh: Any = None
 
     @property
     def is_training(self) -> bool:

@@ -1,0 +1,199 @@
+"""Sequence/context parallelism: ring attention + all-to-all attention.
+
+Long-context support has no 2016 reference counterpart (SURVEY.md §5 "new
+design territory"): the reference's longest-sequence machinery is
+host-side batching (SequenceToBatch). Here a sequence is *sharded across
+chips* on the mesh's "seq" axis and attention runs over the full context
+via ICI collectives:
+
+- ``ring_attention``: blockwise attention with the K/V shards rotating
+  around the ring (`lax.ppermute`), combined with a streaming (online
+  softmax) accumulator — memory per chip stays O(T/n), comms overlap with
+  the next block's compute. The TPU analog of Ring Attention
+  (Liu et al. '23) on ICI neighbors.
+- ``alltoall_attention``: Ulysses-style — `lax.all_to_all` resharding from
+  sequence-sharded to head-sharded, full-context attention locally per
+  head group, reshard back. Cheaper comms for moderate contexts; requires
+  heads % seq_shards == 0.
+
+Both are differentiable (jax autodiff through the collective), masked for
+padded positions, optionally causal, and numerically match the reference
+``full_attention`` below — see tests/test_sequence_parallel.py, which runs
+them on an 8-device CPU mesh exactly like the reference tests distributed
+code on loopback pservers (SURVEY.md §4).
+
+Layout convention: q/k/v are [B, T_local, H, D] under shard_map (T sharded
+over "seq"); lengths is the *global* valid-length vector [B], replicated.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+Array = jax.Array
+
+_NEG = -1e30  # big-negative instead of -inf: keeps fully-masked rows NaN-free
+
+
+def full_attention(
+    q: Array, k: Array, v: Array,
+    lengths: Optional[Array] = None,
+    causal: bool = False,
+    q_offset: int = 0,
+    kv_offset: int = 0,
+) -> Array:
+    """Single-device reference attention over [B, T, H, D] tensors."""
+    D = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(D)
+    Tq, Tk = q.shape[1], k.shape[1]
+    q_pos = q_offset + jnp.arange(Tq)
+    kv_pos = kv_offset + jnp.arange(Tk)
+    mask = jnp.ones((Tq, Tk), bool)
+    if causal:
+        mask &= kv_pos[None, :] <= q_pos[:, None]
+    mask = jnp.broadcast_to(mask, (q.shape[0], 1, Tq, Tk))
+    if lengths is not None:
+        mask &= (kv_pos[None, None, None, :] < lengths[:, None, None, None])
+    s = jnp.where(mask, s, _NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def _ring_attention_local(q, k, v, lengths, causal, axis_name):
+    """Per-shard body: stream the K/V ring through an online-softmax
+    accumulator. q/k/v: [B, T_loc, H, D] (this shard's block)."""
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    B, T_loc, H, D = q.shape
+    scale = 1.0 / math.sqrt(D)
+    q_pos = idx * T_loc + jnp.arange(T_loc)                      # global positions
+
+    o0 = jnp.zeros((B, H, T_loc, D), q.dtype)
+    m0 = jnp.full((B, H, T_loc), _NEG, q.dtype)
+    l0 = jnp.zeros((B, H, T_loc), q.dtype)
+    # under the new shard_map type system fresh constants are unvarying;
+    # the loop carry must already vary over the ring axis like q does
+    if hasattr(jax.lax, "pcast"):
+        o0, m0, l0 = (
+            jax.lax.pcast(x, (axis_name,), to="varying") for x in (o0, m0, l0)
+        )
+    elif hasattr(jax.lax, "pvary"):
+        o0, m0, l0 = (jax.lax.pvary(x, (axis_name,)) for x in (o0, m0, l0))
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def block(r, o, m, l, k_blk, v_blk):
+        src = (idx - r) % n                                      # block owner
+        kv_pos = src * T_loc + jnp.arange(T_loc)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk) * scale
+        mask = jnp.ones((T_loc, T_loc), bool)
+        if causal:
+            mask = kv_pos[None, :] <= q_pos[:, None]
+        mask = jnp.broadcast_to(mask, (B, 1, T_loc, T_loc))
+        if lengths is not None:
+            mask = mask & (kv_pos[None, None, None, :] < lengths[:, None, None, None])
+        s = jnp.where(mask, s, _NEG)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[..., None])
+        p = jnp.where(mask, p, 0.0)                              # kill _NEG rows exactly
+        l = l * alpha + jnp.sum(p, axis=-1)
+        o = o * alpha[..., None] + jnp.einsum("bhqk,bkhd->bhqd", p, v_blk)
+        return o, m_new, l
+
+    # unrolled ring (n is static under shard_map): no permute after the
+    # last block, and XLA can overlap each ppermute with the next matmul
+    o, m, l = o0, m0, l0
+    k_blk, v_blk = k, v
+    for r in range(n):
+        o, m, l = block(r, o, m, l, k_blk, v_blk)
+        if r != n - 1:
+            k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+            v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+    o = o / jnp.maximum(l[..., None], 1e-20)
+    return jnp.transpose(o, (0, 2, 1, 3))                        # [B, T_loc, H, D]
+
+
+def _alltoall_attention_local(q, k, v, lengths, causal, axis_name):
+    """Per-shard body: reshard seq→heads, full local attention, reshard
+    back. Requires H % n == 0."""
+    n = jax.lax.psum(1, axis_name)
+    B, T_loc, H, D = q.shape
+
+    def seq_to_heads(x):  # [B, T_loc, H, D] -> [B, T_glob, H/n, D]
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    def heads_to_seq(x):  # inverse
+        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+    qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    out = full_attention(qg, kg, vg, lengths=lengths, causal=causal)
+    return heads_to_seq(out)
+
+
+def _sharded_attention(q, k, v, lengths, mesh: Mesh, *, causal: bool, axis: str, local_fn):
+    if axis not in mesh.axis_names or mesh.shape[axis] == 1:
+        return full_attention(q, k, v, lengths=lengths, causal=causal)
+    n = mesh.shape[axis]
+    assert q.shape[1] % n == 0, (
+        f"global seq len {q.shape[1]} must divide the {axis}={n} mesh axis "
+        "(pad to a multiple; lengths masking keeps numerics exact)"
+    )
+    seq_spec = P(None, axis, None, None)
+    len_spec = P()
+    shard_fn = functools.partial(local_fn, causal=causal, axis_name=axis)
+
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+
+    mapped = shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(seq_spec, seq_spec, seq_spec, len_spec),
+        out_specs=seq_spec,
+    )
+    return mapped(q, k, v, lengths)
+
+
+def ring_attention(
+    q: Array, k: Array, v: Array,
+    mesh: Mesh,
+    lengths: Optional[Array] = None,
+    causal: bool = False,
+    axis: str = "seq",
+) -> Array:
+    """Attention over sequence-sharded q/k/v [B, T_global, H, D]; T_global
+    is sharded over ``axis`` by the caller's in_shardings (or replicated
+    inputs get partitioned here). Returns the same layout."""
+    if lengths is None:
+        lengths = jnp.full((q.shape[0],), q.shape[1], jnp.int32)
+    return _sharded_attention(
+        q, k, v, lengths, mesh, causal=causal, axis=axis, local_fn=_ring_attention_local
+    )
+
+
+def alltoall_attention(
+    q: Array, k: Array, v: Array,
+    mesh: Mesh,
+    lengths: Optional[Array] = None,
+    causal: bool = False,
+    axis: str = "seq",
+) -> Array:
+    if lengths is None:
+        lengths = jnp.full((q.shape[0],), q.shape[1], jnp.int32)
+    if axis in mesh.axis_names:
+        assert q.shape[2] % mesh.shape[axis] == 0, (
+            f"heads {q.shape[2]} must divide {axis}={mesh.shape[axis]} "
+            "(use ring_attention otherwise)"
+        )
+    return _sharded_attention(
+        q, k, v, lengths, mesh, causal=causal, axis=axis,
+        local_fn=_alltoall_attention_local,
+    )

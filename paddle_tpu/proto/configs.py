@@ -220,6 +220,11 @@ class LayerConfig(Message):
     selective_fc_full_mul_ratio: float = 0.02
     use_global_stats: bool = False
     moving_average_fraction: float = 0.9
+    # TPU extensions (no 2016 counterpart): multi-head attention + context
+    # parallelism knobs (paddle_tpu/layers/attention.py)
+    num_heads: int = 0
+    causal_attention: bool = False
+    seq_parallel_mode: str = ""   # "" | ring | alltoall
 
 
 @dataclass

@@ -80,6 +80,7 @@ class Trainer:
             from paddle_tpu.parallel.mesh import make_mesh
 
             self._mesh = make_mesh(mesh_shape)
+            self.gm.mesh = self._mesh  # layers with explicit collectives
         self._maybe_restore()
 
     # ------------------------------------------------------------ restore

@@ -109,6 +109,7 @@ __all__ = [
     "dropout_layer",
     "out_prod_layer",
     "multiplex_layer",
+    "multi_head_attention_layer",
 ]
 
 
@@ -1660,3 +1661,44 @@ def multiplex_layer(input: Sequence[LayerOutput], name=None) -> LayerOutput:
         cfg.inputs.append(_input(inp))
     _add_layer(cfg)
     return LayerOutput(name, "multiplex", inputs, inputs[1].size)
+
+
+def multi_head_attention_layer(
+    input: LayerOutput,
+    num_heads: int,
+    size: Optional[int] = None,
+    name: Optional[str] = None,
+    causal: bool = False,
+    seq_parallel: str = "",
+    act: Optional[BaseActivation] = None,
+    param_attr: Optional[ParameterAttribute] = None,
+    bias_attr: Union[bool, ParameterAttribute] = False,
+    layer_attr=None,
+) -> LayerOutput:
+    """Transformer-style multi-head self-attention over a sequence (TPU
+    extension; the reference's only attention is simple_attention inside
+    recurrent groups). ``seq_parallel``: "" | "ring" | "alltoall" — shard
+    the context over the mesh "seq" axis (paddle_tpu.parallel.
+    sequence_parallel)."""
+    assert seq_parallel in ("", "ring", "alltoall"), (
+        f"seq_parallel must be '', 'ring' or 'alltoall', got {seq_parallel!r}"
+    )
+    name = _name(name, "mha")
+    size = size or input.size
+    cfg = LayerConfig(
+        name=name,
+        type="multi_head_attention",
+        size=size,
+        active_type=_act_name(act or IdentityActivation()),
+    )
+    cfg.num_heads = num_heads
+    cfg.causal_attention = causal
+    cfg.seq_parallel_mode = seq_parallel
+    wqkv = _create_parameter(
+        f"_{name}.wqkv", input.size * 3 * size, [input.size, 3 * size], param_attr
+    )
+    _create_parameter(f"_{name}.wo", size * size, [size, size], param_attr)
+    cfg.inputs.append(_input(input, wqkv))
+    cfg.bias_parameter_name = _bias_name(name, size, bias_attr)
+    _add_layer(cfg, layer_attr)
+    return LayerOutput(name, "multi_head_attention", [input], size, act)
