@@ -1,0 +1,36 @@
+"""Synthetic corpus for word-embedding training.
+
+The reference model_zoo ships pretrained Chinese vectors
+(ref: demo/model_zoo/embedding/pre_DictAndModel.sh); here embeddings are
+*trained*: words are grouped into topic clusters and sentences draw from
+one cluster, so skip-gram context prediction has real structure and
+within-cluster vectors end up closer than across clusters.
+"""
+
+import random
+
+NUM_CLUSTERS = 8
+WORDS_PER_CLUSTER = 25
+VOCAB_SIZE = NUM_CLUSTERS * WORDS_PER_CLUSTER
+
+
+def word_list():
+    return [f"w{c}_{i}" for c in range(NUM_CLUSTERS) for i in range(WORDS_PER_CLUSTER)]
+
+
+def cluster_of(word_id: int) -> int:
+    return word_id // WORDS_PER_CLUSTER
+
+
+def synth_pairs(seed, n=6000, window=2):
+    """Yield (center, context) skip-gram id pairs."""
+    rng = random.Random(seed)
+    for _ in range(n // 8):
+        c = rng.randrange(NUM_CLUSTERS)
+        sent = [c * WORDS_PER_CLUSTER + rng.randrange(WORDS_PER_CLUSTER)
+                for _ in range(10)]
+        for i, center in enumerate(sent):
+            for off in range(-window, window + 1):
+                j = i + off
+                if off != 0 and 0 <= j < len(sent):
+                    yield center, sent[j]

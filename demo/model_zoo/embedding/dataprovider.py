@@ -1,0 +1,16 @@
+"""Skip-gram pair provider for embedding training."""
+
+from paddle.trainer.PyDataProvider2 import *
+
+import common
+
+
+@provider(
+    input_types={
+        "word": integer_value(common.VOCAB_SIZE),
+        "context": integer_value(common.VOCAB_SIZE),
+    }
+)
+def process(settings, file_name):
+    for center, ctx_word in common.synth_pairs(file_name):
+        yield {"word": center, "context": ctx_word}

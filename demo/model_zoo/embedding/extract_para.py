@@ -1,0 +1,49 @@
+"""Extract embedding rows for a user dictionary from a trained model
+(ref: demo/model_zoo/embedding/extract_para.py — same job against the
+binary parameter format; here checkpoints are npz).
+
+Usage:
+    python extract_para.py --model_dir=./output/pass-00004 \
+        --param=_emb --pre_dict=pre.dict --usr_dict=usr.dict \
+        --out=usr_emb.npz
+Writes an npz with `words` (the user dict) and `vectors` [len(usr), dim].
+"""
+
+import argparse
+import os
+
+import numpy as np
+
+
+def load_dict(path):
+    with open(path) as f:
+        return [line.strip().split("\t")[0] for line in f if line.strip()]
+
+
+def extract(model_dir, param, pre_dict, usr_dict):
+    with np.load(os.path.join(model_dir, "params.npz")) as z:
+        table = z[param]
+    index = {w: i for i, w in enumerate(pre_dict)}
+    missing = [w for w in usr_dict if w not in index]
+    assert not missing, f"words not in pretrained dict: {missing[:5]}..."
+    rows = np.stack([table[index[w]] for w in usr_dict])
+    return rows
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model_dir", required=True)
+    p.add_argument("--param", default="_emb")
+    p.add_argument("--pre_dict", required=True)
+    p.add_argument("--usr_dict", required=True)
+    p.add_argument("--out", required=True)
+    args = p.parse_args()
+    pre = load_dict(args.pre_dict)
+    usr = load_dict(args.usr_dict)
+    rows = extract(args.model_dir, args.param, pre, usr)
+    np.savez(args.out, words=np.asarray(usr), vectors=rows)
+    print(f"wrote {args.out}: {rows.shape[0]} words × {rows.shape[1]} dims")
+
+
+if __name__ == "__main__":
+    main()

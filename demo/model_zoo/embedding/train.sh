@@ -1,0 +1,14 @@
+#!/bin/bash
+# Train skip-gram embeddings, then extract a user-dict subset.
+set -e
+cd "$(dirname "$0")"
+echo corpus-seed-1 > train.list
+echo corpus-seed-2 > test.list
+paddle train --config=trainer_config.py --save_dir=./output --num_passes=5 --log_period=10
+python - <<'PY'
+import common
+open("pre.dict", "w").write("\n".join(common.word_list()) + "\n")
+open("usr.dict", "w").write("\n".join(common.word_list()[:10]) + "\n")
+PY
+python extract_para.py --model_dir=./output/pass-00004 \
+    --pre_dict=pre.dict --usr_dict=usr.dict --out=usr_emb.npz

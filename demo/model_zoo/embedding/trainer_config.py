@@ -1,0 +1,24 @@
+#edit-mode: -*- python -*-
+"""Skip-gram word embeddings with hierarchical-sigmoid output
+(the training counterpart of ref demo/model_zoo/embedding's pretrained
+vectors; hsigmoid keeps the output cost O(log V) like word2vec).
+"""
+
+from paddle.trainer_config_helpers import *
+
+import common
+
+emb_dim = get_config_arg("dim", int, 32)
+
+define_py_data_sources2("train.list", "test.list",
+                        module="dataprovider", obj="process")
+
+settings(batch_size=256, learning_rate=1e-2, learning_method=AdamOptimizer())
+
+word = data_layer(name="word", size=common.VOCAB_SIZE)
+emb = embedding_layer(input=word, size=emb_dim,
+                      param_attr=ParamAttr(name="_emb"))
+hidden = fc_layer(input=emb, size=emb_dim, act=TanhActivation())
+context = data_layer(name="context", size=common.VOCAB_SIZE)
+cost = hsigmoid(input=hidden, label=context, num_classes=common.VOCAB_SIZE)
+outputs(cost)

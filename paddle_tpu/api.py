@@ -230,13 +230,19 @@ class SequenceGenerator:
         if dict_file:
             with open(dict_file) as f:
                 self.words = [line.rstrip("\n") for line in f]
-        subs = [s for s in machine.model_config.sub_models if s.generator is not None]
+        # apply overrides to a private copy of the model config so they
+        # never leak into the machine (or later generators); a dedicated
+        # core machine traces from the copy, sharing the live params
+        import copy
+
+        model_cfg = machine.model_config
+        if any(x is not None for x in (begin_token, end_token, max_length, beam_size)):
+            model_cfg = copy.deepcopy(machine.model_config)
+        subs = [s for s in model_cfg.sub_models if s.generator is not None]
         assert subs, "config declares no generator sub-model (beam_search)"
         self.sub = subs[0]
-        # the generation graph traces lazily on first generate(), so config
-        # overrides applied here take effect
         group_cfg = next(
-            (l for l in machine.model_config.layers if l.name == self.sub.name), None
+            (l for l in model_cfg.layers if l.name == self.sub.name), None
         )
         if max_length is not None:
             self.sub.generator.max_num_frames = int(max_length)
@@ -247,13 +253,16 @@ class SequenceGenerator:
             group_cfg.bos_id = int(begin_token)
         if end_token is not None and group_cfg is not None:
             group_cfg.eos_id = int(end_token)
+        self._core = (
+            machine._core if model_cfg is machine.model_config else _CoreMachine(model_cfg)
+        )
         self._fwd = None
 
     def generate(self, in_args: Dict[str, Argument]) -> List[List[Dict[str, Any]]]:
         """Returns, per input sample, a list of beams:
         ``{"ids": [...], "score": float, "words": [...]}`` sorted best-first."""
         if self._fwd is None:
-            core = self.machine._core
+            core = self._core
 
             def fwd(params, args):
                 outputs, _ = core.forward(params, args, pass_type="gen", rng=None)

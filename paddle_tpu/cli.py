@@ -15,6 +15,11 @@ import sys
 
 
 def main(argv=None) -> int:
+    # die quietly when stdout is a closed pipe (`paddle dump_config | head`)
+    import signal
+
+    if hasattr(signal, "SIGPIPE"):
+        signal.signal(signal.SIGPIPE, signal.SIG_DFL)
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help", "help"):
         print(__doc__)

@@ -144,8 +144,14 @@ def _sharded_attention(q, k, v, lengths, mesh: Mesh, *, causal: bool, axis: str,
         f"global seq len {q.shape[1]} must divide the {axis}={n} mesh axis "
         "(pad to a multiple; lengths masking keeps numerics exact)"
     )
-    seq_spec = P(None, axis, None, None)
-    len_spec = P()
+    # co-shard the batch over any data axes so composing with data
+    # parallelism doesn't all-gather q/k/v across the data dimension
+    data_axes = tuple(
+        n for n in mesh.axis_names if n in ("data", "expert") and mesh.shape[n] > 1
+    )
+    b_spec = data_axes if data_axes else None
+    seq_spec = P(b_spec, axis, None, None)
+    len_spec = P(b_spec)
     shard_fn = functools.partial(local_fn, causal=causal, axis_name=axis)
 
     try:

@@ -8,7 +8,7 @@ with sharded inputs — XLA partitions the computation and inserts
 psum/all-gather over ICI where the math requires it. Sync-SGD semantics
 (num_batches_per_send_parameter == 1) fall out exactly: the optimizer
 update sees the full-batch mean gradient every step. The async/stale path
-is deliberately not reproduced (docs/divergences.md).
+is deliberately not reproduced (doc/divergences.md).
 
 Sharding rules:
 - batch Arguments: leading axis over the "data" mesh axis

@@ -7,7 +7,7 @@ the reference DSL parse to the same logical structure. Fields that only
 made sense for the 2016 CPU/GPU runtime (device pinning, selective-fc
 thread counts, owlqn line-search knobs) are kept where demos/config_parser
 touch them and ignored by the TPU runtime, which documents its divergences
-in docs/divergences.md.
+in doc/divergences.md.
 """
 
 from __future__ import annotations

@@ -1,0 +1,71 @@
+"""Multi-host launcher over ssh
+(ref: paddle/scripts/cluster_train/paddle.py, the fabric/ssh cluster
+driver reading conf.py HOSTS).
+
+Reads a conf module defining HOSTS (list of "user@host" strings) and
+launches the same `paddle train` command on every host with the jax
+distributed-runtime flags filled in (process 0's host becomes the
+coordinator). Assumes a shared or rsynced workdir, as the reference did.
+
+Usage:
+    python -m paddle_tpu.utils.cluster_launch --conf=conf.py \
+        --workdir=/path/on/hosts -- --config=train.conf --mesh_shape=data=16 ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import shlex
+import subprocess
+import sys
+from typing import List
+
+
+def load_hosts(conf_path: str) -> List[str]:
+    spec = importlib.util.spec_from_file_location("cluster_conf", conf_path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    hosts = getattr(mod, "HOSTS", None)
+    assert hosts, f"{conf_path} must define HOSTS = ['user@host', ...]"
+    return list(hosts)
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if "--" in argv:
+        split = argv.index("--")
+        own, train_args = argv[:split], argv[split + 1:]
+    else:
+        own, train_args = argv, []
+    p = argparse.ArgumentParser()
+    p.add_argument("--conf", required=True, help="python file defining HOSTS")
+    p.add_argument("--workdir", required=True, help="job dir present on every host")
+    p.add_argument("--port", type=int, default=8476, help="coordinator port")
+    p.add_argument("--paddle", default="paddle", help="paddle executable on hosts")
+    p.add_argument("--dry_run", action="store_true")
+    args = p.parse_args(own)
+
+    hosts = load_hosts(args.conf)
+    coordinator = f"{hosts[0].split('@')[-1]}:{args.port}"
+    procs = []
+    for rank, host in enumerate(hosts):
+        cmd = [
+            args.paddle, "train", *train_args,
+            f"--coordinator_address={coordinator}",
+            f"--num_processes={len(hosts)}",
+            f"--process_id={rank}",
+        ]
+        remote = f"cd {shlex.quote(args.workdir)} && {' '.join(shlex.quote(c) for c in cmd)}"
+        ssh = ["ssh", "-o", "BatchMode=yes", host, remote]
+        print(f"[{rank}] {host}: {remote}")
+        if not args.dry_run:
+            procs.append(subprocess.Popen(ssh))
+    rc = 0
+    for rank, proc in enumerate(procs):
+        rc |= proc.wait()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,46 @@
+"""Emit a graphviz dot diagram of a model config
+(ref: python/paddle/utils/make_model_diagram.py).
+
+Usage:
+    python -m paddle_tpu.utils.make_model_diagram config.py [config_args] > model.dot
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def make_diagram(model_config) -> str:
+    lines = ["digraph model {", "  rankdir=BT;", '  node [shape=box, fontsize=10];']
+    for layer in model_config.layers:
+        label = f"{layer.name}\\n{layer.type}"
+        if layer.size:
+            label += f" [{layer.size}]"
+        shape = "ellipse" if layer.type == "data" else "box"
+        lines.append(f'  "{layer.name}" [label="{label}", shape={shape}];')
+        for inp in layer.inputs:
+            lines.append(f'  "{inp.input_layer_name}" -> "{layer.name}";')
+    for name in model_config.output_layer_names:
+        lines.append(f'  "{name}" [style=bold];')
+    lines.append("}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv:
+        print(__doc__, file=sys.stderr)
+        return 2
+    from paddle_tpu.config import parse_config
+
+    config = parse_config(argv[0], argv[1] if len(argv) > 1 else "")
+    print(make_diagram(config.model_config))
+    return 0
+
+
+if __name__ == "__main__":
+    import signal
+
+    if hasattr(signal, "SIGPIPE"):
+        signal.signal(signal.SIGPIPE, signal.SIG_DFL)
+    sys.exit(main())

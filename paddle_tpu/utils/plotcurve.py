@@ -1,0 +1,95 @@
+"""Plot cost curves from training logs
+(ref: python/paddle/utils/plotcurve.py — reads trainer log lines and
+plots AvgCost and any named evaluator over passes).
+
+Usage:
+    python -m paddle_tpu.utils.plotcurve [-o out.png] [key ...] < train.log
+Keys default to AvgCost; any `name=value` token in "Pass N done" lines
+can be named (e.g. classification_error). Without matplotlib, prints an
+ASCII curve instead.
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+from typing import Dict, List
+
+PASS_RE = re.compile(r"Pass (\d+) done: (.*)")
+KV_RE = re.compile(r"([A-Za-z_][\w.]*)=([-+0-9.eE]+)")
+
+
+def parse_log(lines) -> Dict[str, List[float]]:
+    """pass-indexed series for every name=value on 'Pass N done' lines."""
+    series: Dict[str, List[float]] = {}
+    for line in lines:
+        m = PASS_RE.search(line)
+        if not m:
+            continue
+        for key, val in KV_RE.findall(m.group(2)):
+            try:
+                series.setdefault(key, []).append(float(val))
+            except ValueError:
+                pass
+    return series
+
+
+def ascii_plot(ys: List[float], width: int = 60, height: int = 12) -> str:
+    if not ys:
+        return "(no data)"
+    lo, hi = min(ys), max(ys)
+    span = (hi - lo) or 1.0
+    rows = [[" "] * width for _ in range(height)]
+    for i, y in enumerate(ys):
+        x = int(i * (width - 1) / max(len(ys) - 1, 1))
+        r = int((hi - y) * (height - 1) / span)
+        rows[r][x] = "*"
+    out = [f"{hi:10.4g} ┐"]
+    out += ["           │" + "".join(r) for r in rows]
+    out += [f"{lo:10.4g} ┘" + f"  (passes 0..{len(ys)-1})"]
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("keys", nargs="*", default=[])
+    p.add_argument("-i", "--input", default="-", help="log file (default stdin)")
+    p.add_argument("-o", "--output", default="", help="png path (matplotlib)")
+    args = p.parse_args(argv)
+
+    lines = sys.stdin if args.input == "-" else open(args.input)
+    series = parse_log(lines)
+    keys = args.keys or (["AvgCost"] if "AvgCost" in series else sorted(series)[:1])
+    missing = [k for k in keys if k not in series]
+    if missing:
+        print(f"keys not found in log: {missing}; have {sorted(series)}", file=sys.stderr)
+        return 1
+    if args.output:
+        try:
+            import matplotlib
+
+            matplotlib.use("Agg")
+            import matplotlib.pyplot as plt
+        except ImportError:
+            print("matplotlib unavailable; use ASCII mode (no -o)", file=sys.stderr)
+            return 1
+        for k in keys:
+            plt.plot(series[k], label=k)
+        plt.xlabel("pass")
+        plt.legend()
+        plt.savefig(args.output)
+        print(f"wrote {args.output}")
+    else:
+        for k in keys:
+            print(f"== {k} ==")
+            print(ascii_plot(series[k]))
+    return 0
+
+
+if __name__ == "__main__":
+    import signal
+
+    if hasattr(signal, "SIGPIPE"):
+        signal.signal(signal.SIGPIPE, signal.SIG_DFL)
+    sys.exit(main())

@@ -1,0 +1,70 @@
+"""model_zoo/embedding demo: skip-gram + hsigmoid learns cluster structure,
+extract_para subsets the table by user dictionary."""
+
+import os
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEMO = os.path.join(REPO, "demo", "model_zoo", "embedding")
+
+
+def test_embedding_trains_and_extracts(tmp_path):
+    for f in os.listdir(DEMO):
+        if f.endswith(".py"):
+            shutil.copy(os.path.join(DEMO, f), tmp_path)
+    (tmp_path / "train.list").write_text("corpus-seed-1\n")
+    (tmp_path / "test.list").write_text("corpus-seed-2\n")
+
+    from paddle_tpu.config import parse_config
+    from paddle_tpu.trainer import Trainer
+    from paddle_tpu.utils.flags import _Flags
+
+    cwd = os.getcwd()
+    os.chdir(tmp_path)
+    try:
+        cfg = parse_config("trainer_config.py", "dim=16")
+        flags = _Flags(config="trainer_config.py", num_passes=3,
+                       log_period=1000, use_tpu=False,
+                       save_dir=str(tmp_path / "output"))
+        trainer = Trainer(cfg, flags)
+        trainer.train()
+
+        import common
+        emb = np.asarray(trainer.params["_emb"])
+        # planted cluster structure: mean within-cluster cosine similarity
+        # must exceed across-cluster similarity
+        norm = emb / (np.linalg.norm(emb, axis=1, keepdims=True) + 1e-8)
+        k = common.WORDS_PER_CLUSTER
+        within, across = [], []
+        rng = np.random.RandomState(0)
+        for _ in range(400):
+            a, b = rng.randint(0, emb.shape[0], 2)
+            sim = float(norm[a] @ norm[b])
+            (within if common.cluster_of(a) == common.cluster_of(b) else across).append(sim)
+        assert np.mean(within) > np.mean(across) + 0.05, (
+            f"within={np.mean(within):.3f} across={np.mean(across):.3f}"
+        )
+
+        # extract_para subsets rows correctly
+        words = common.word_list()
+        (tmp_path / "pre.dict").write_text("\n".join(words) + "\n")
+        usr = [words[3], words[40], words[77]]
+        (tmp_path / "usr.dict").write_text("\n".join(usr) + "\n")
+        out = subprocess.run(
+            [sys.executable, "extract_para.py",
+             "--model_dir=output/pass-00002",
+             "--pre_dict=pre.dict", "--usr_dict=usr.dict", "--out=usr.npz"],
+            capture_output=True, text=True,
+            env={**os.environ, "PYTHONPATH": f"{REPO}:{REPO}/compat"},
+        )
+        assert out.returncode == 0, out.stderr
+        with np.load("usr.npz") as z:
+            assert list(z["words"]) == usr
+            np.testing.assert_allclose(z["vectors"][0], emb[3], rtol=1e-6)
+            np.testing.assert_allclose(z["vectors"][1], emb[40], rtol=1e-6)
+    finally:
+        os.chdir(cwd)

@@ -1,0 +1,56 @@
+"""Ops tooling: plotcurve parsing, model diagram, cluster launch dry run."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_plotcurve_parses_and_plots():
+    from paddle_tpu.utils.plotcurve import ascii_plot, parse_log
+
+    log = [
+        "[x I paddle_tpu] Pass 0 done: samples=100 AvgCost=0.9 CurrentCost=0.9  e.classification_error: classification_error=0.5  (10 samples/s)",
+        "[x I paddle_tpu] Pass 1 done: samples=100 AvgCost=0.7 CurrentCost=0.6  e.classification_error: classification_error=0.3  (10 samples/s)",
+        "noise line",
+        "[x I paddle_tpu] Pass 2 done: samples=100 AvgCost=0.5 CurrentCost=0.4  e.classification_error: classification_error=0.2  (10 samples/s)",
+    ]
+    series = parse_log(log)
+    assert series["AvgCost"] == [0.9, 0.7, 0.5]
+    assert series["classification_error"] == [0.5, 0.3, 0.2]
+    art = ascii_plot(series["AvgCost"])
+    assert "*" in art and "0.9" in art
+
+
+def test_make_model_diagram(tmp_path):
+    from paddle_tpu.config import parse_config
+    from paddle_tpu.utils.make_model_diagram import make_diagram
+
+    cfg_file = tmp_path / "conf.py"
+    cfg_file.write_text(
+        "from paddle.trainer_config_helpers import *\n"
+        "settings(batch_size=4, learning_rate=0.1)\n"
+        "d = data_layer('x', size=4)\n"
+        "o = fc_layer(input=d, size=2, act=SoftmaxActivation(), name='out')\n"
+        "outputs(classification_cost(input=o, label=data_layer('label', size=2)))\n"
+    )
+    cfg = parse_config(str(cfg_file))
+    dot = make_diagram(cfg.model_config)
+    assert dot.startswith("digraph") and '"x" -> "out"' in dot
+
+
+def test_cluster_launch_dry_run(tmp_path):
+    conf = tmp_path / "conf.py"
+    conf.write_text("HOSTS = ['u@h0', 'u@h1']\n")
+    out = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.utils.cluster_launch",
+         "--conf", str(conf), "--workdir", "/job", "--dry_run",
+         "--", "--config=train.conf", "--mesh_shape=data=16"],
+        capture_output=True, text=True, cwd=REPO,
+        env={**os.environ, "PYTHONPATH": f"{REPO}:{REPO}/compat"},
+    )
+    assert out.returncode == 0, out.stderr
+    assert "--process_id=0" in out.stdout and "--process_id=1" in out.stdout
+    assert "--coordinator_address=h0:8476" in out.stdout
+    assert "u@h1" in out.stdout
