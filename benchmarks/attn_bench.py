@@ -1,0 +1,62 @@
+"""Microbench: flash-attention pallas kernel vs XLA attention on TPU.
+
+    python benchmarks/attn_bench.py [T ...]
+
+Prints fwd+bwd step time and achieved context length for both paths.
+"""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from paddle_tpu.ops.pallas_attention import flash_attention
+from paddle_tpu.parallel import sequence_parallel as sp
+
+
+def bench(fn, q, k, v, steps=10):
+    def loss(q, k, v):
+        return jnp.sum(fn(q, k, v) ** 2)
+
+    g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+    out = g(q, k, v)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = g(q, k, v)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / steps
+
+
+def main():
+    Ts = [int(t) for t in sys.argv[1:]] or [1024, 4096, 8192]
+    B, H, D = 4, 8, 64
+    for T in Ts:
+        rng = np.random.RandomState(0)
+        mk = lambda: jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+        q, k, v = mk(), mk(), mk()
+        flash = bench(lambda q, k, v: flash_attention(q, k, v, causal=True), q, k, v)
+        try:
+            xla = bench(_xla_attn, q, k, v)
+        except Exception:  # OOM at long T is the point
+            xla = float("nan")
+        print(f"T={T:6d}  flash={flash*1e3:8.2f} ms  xla={xla*1e3:8.2f} ms  "
+              f"speedup={xla/flash:5.2f}x")
+
+
+def _xla_attn(q, k, v):
+    D = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(jnp.asarray(D, q.dtype))
+    T = q.shape[1]
+    mask = jnp.tril(jnp.ones((T, T), bool))
+    s = jnp.where(mask[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+if __name__ == "__main__":
+    main()

@@ -249,6 +249,57 @@ class BatchAssembler:
         return Argument(value=val, seq_lengths=num_subs, sub_seq_lengths=sub_lens)
 
 
+class MultiDataProvider:
+    """Ratio-mixed composition of sub-providers (ref: MultiDataProvider,
+    /root/reference/paddle/gserver/dataproviders/MultiDataProvider.h:22):
+    each pass draws samples from every sub-provider's stream in proportion
+    to its DataConfig.data_ratio, through one shared shuffle/batch path.
+    All sub-providers must declare the same slot layout."""
+
+    def __init__(self, subs: List["DataProvider"], ratios: List[int],
+                 async_prefetch: bool = True):
+        assert subs and len(subs) == len(ratios)
+        self.subs = subs
+        self.ratios = [max(int(r), 1) for r in ratios]
+        self.async_prefetch = async_prefetch
+        base = subs[0]
+        self.batch_size = base.batch_size
+        self.assembler = base.assembler
+
+        def layout(p):
+            return [(t.type, t.dim, t.seq_type) for t in p.assembler.input_types]
+
+        for i, sub in enumerate(subs[1:], 1):
+            assert layout(sub) == layout(base), (
+                f"multi data provider: sub-provider {i} slot layout "
+                f"{layout(sub)} != {layout(base)}"
+            )
+        self._base = base
+
+    def batches(self) -> Iterator[Dict[str, Argument]]:
+        # interleave ratio-sized runs from each sub-stream into the base
+        # provider's shuffle/batch machinery
+        def mixed_samples():
+            its = [iter(sub._samples()) for sub in self.subs]
+            live = [True] * len(its)
+            while any(live):
+                for i, it in enumerate(its):
+                    if not live[i]:
+                        continue
+                    for _ in range(self.ratios[i]):
+                        try:
+                            yield next(it)
+                        except StopIteration:
+                            live[i] = False
+                            break
+
+        inner = self._base._batches_from(mixed_samples())
+        if self.async_prefetch:
+            yield from self._base._double_buffered(inner)
+        else:
+            yield from inner
+
+
 class DataProvider:
     """Pass-oriented batch iterator over a @provider object.
 
@@ -308,11 +359,14 @@ class DataProvider:
             yield from self._batches_sync()
 
     def _batches_sync(self) -> Iterator[Dict[str, Argument]]:
+        yield from self._batches_from(self._samples())
+
+    def _batches_from(self, samples) -> Iterator[Dict[str, Argument]]:
         pool_size = self.settings.pool_size
         if pool_size is None or pool_size <= 0:
             pool_size = 10000 * max(1, self.batch_size // 128 + 1)
         pool: List = []
-        for sample in self._samples():
+        for sample in samples:
             pool.append(sample)
             if len(pool) >= pool_size:
                 yield from self._drain(pool, final=False)
@@ -370,6 +424,35 @@ def create_data_provider(
     import os
     import sys
 
+    if data_config.type == "multi":
+        subs = [
+            create_data_provider(
+                sub, batch_size, slot_names,
+                async_prefetch=False, seed=seed + i, for_test=for_test,
+            )
+            for i, sub in enumerate(data_config.sub_data_configs)
+        ]
+        return MultiDataProvider(
+            subs,
+            [s.data_ratio for s in data_config.sub_data_configs],
+            async_prefetch=async_prefetch,
+        )
+    with open(data_config.files) as f:
+        file_list = [line.strip() for line in f if line.strip()]
+    if data_config.type == "bin":
+        # binary shards (ProtoDataProvider role, paddle_tpu.data.binary)
+        from paddle_tpu.data.binary import BinaryProvider
+
+        assert file_list, f"{data_config.files}: empty shard list"
+        return DataProvider(
+            BinaryProvider(file_list[0]),
+            file_list,
+            batch_size,
+            slot_names,
+            async_prefetch=async_prefetch,
+            seed=seed,
+            for_test=for_test,
+        )
     assert data_config.type in ("py2", "py"), f"unsupported data type {data_config.type!r}"
     # the provider module conventionally sits next to the config / file
     # list (reference: PyDataProvider2.cpp loads the module by name with
@@ -388,11 +471,9 @@ def create_data_provider(
             sys.path.remove(p)
     provider_obj = getattr(module, data_config.load_data_object)
     kwargs = json.loads(data_config.load_data_args) if data_config.load_data_args else {}
-    with open(data_config.files) as f:
-        files = [line.strip() for line in f if line.strip()]
     return DataProvider(
         provider_obj,
-        files,
+        file_list,
         batch_size,
         slot_names,
         provider_kwargs=kwargs,

@@ -48,7 +48,23 @@ def full_attention(
     q_offset: int = 0,
     kv_offset: int = 0,
 ) -> Array:
-    """Single-device reference attention over [B, T, H, D] tensors."""
+    """Single-device attention over [B, T, H, D] tensors.
+
+    On TPU, self-attention shapes the flash kernel supports dispatch to
+    paddle_tpu.ops.pallas_attention (O(T) activation memory); everything
+    else takes the XLA path below (which materializes [B, H, T, T])."""
+    if (
+        q_offset == 0
+        and kv_offset == 0
+        and q.shape == k.shape
+        and jax.default_backend() == "tpu"
+    ):
+        from paddle_tpu.ops import pallas_attention
+
+        if pallas_attention.supported(q.shape[1], q.shape[3]):
+            return pallas_attention.tpu_flash_attention(
+                q, k, v, lengths=lengths, causal=causal
+            )
     D = q.shape[-1]
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(D)
     Tq, Tk = q.shape[1], k.shape[1]

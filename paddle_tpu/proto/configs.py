@@ -326,6 +326,8 @@ class DataConfig(Message):
     data_ratio: int = 1
     is_main_data: bool = True
     usage_ratio: float = 1.0
+    # ref: DataConfig.proto.m4 sub_data_configs (MultiDataProvider)
+    sub_data_configs: List["DataConfig"] = field(default_factory=list)
 
 
 # ----------------------------------------------------------------- trainer

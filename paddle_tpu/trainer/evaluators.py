@@ -161,6 +161,72 @@ class AucEvaluator(Evaluator):
         return {"auc": auc}
 
 
+@register_evaluator("seq_classification_error")
+class SequenceClassificationErrorEvaluator(Evaluator):
+    """Per-sequence error (ref: SequenceClassificationErrorEvaluator,
+    Evaluator.cpp:111): a sequence counts as wrong if ANY valid frame's
+    argmax disagrees with the label."""
+
+    def start(self):
+        self.wrong = 0.0
+        self.total = 0.0
+
+    def eval_batch(self, args):
+        out, label = args[0], args[1]
+        v = np.asarray(out.value)                       # [B, T, C]
+        pred = np.argmax(v, axis=-1)
+        labels = np.asarray(label.ids)
+        lens = (
+            np.asarray(out.seq_lengths)
+            if out.seq_lengths is not None
+            else np.full((v.shape[0],), v.shape[1], np.int64)
+        )
+        for b in range(v.shape[0]):
+            t = int(lens[b])
+            lb = labels[b] if labels.ndim > 1 else np.full((t,), labels[b])
+            self.wrong += float(np.any(pred[b, :t] != lb[:t]))
+            self.total += 1.0
+
+    def result(self):
+        return {"seq_classification_error": self.wrong / max(self.total, 1.0)}
+
+
+@register_evaluator("rank-auc")
+class RankAucEvaluator(Evaluator):
+    """AUC over rank-model scores (ref: RankAucEvaluator, Evaluator.h:202):
+    inputs = output score, click (label), optional pv (weight). Exact AUC
+    over the accumulated (score, click, pv) triples."""
+
+    def start(self):
+        self.scores = []
+        self.clicks = []
+        self.pvs = []
+
+    def eval_batch(self, args):
+        out = self._rows(args[0])[:, -1]
+        click = self._rows(args[1])[:, -1]
+        pv = self._rows(args[2])[:, -1] if len(args) > 2 else np.ones_like(click)
+        self.scores.append(out)
+        self.clicks.append(click)
+        self.pvs.append(pv)
+
+    def result(self):
+        if not self.scores:
+            return {"rank_auc": 0.0}
+        s = np.concatenate(self.scores)
+        click = np.concatenate(self.clicks)
+        pv = np.concatenate(self.pvs)
+        # group by unique score so tied pos/neg pairs count 0.5 each
+        # (order-independent AUC)
+        uniq, inv = np.unique(s, return_inverse=True)
+        pos_g = np.bincount(inv, weights=click, minlength=len(uniq))
+        neg_g = np.bincount(inv, weights=pv - click, minlength=len(uniq))
+        cum_neg_below = np.cumsum(neg_g) - neg_g   # strictly lower scores
+        pairs_correct = float(np.sum(pos_g * (cum_neg_below + 0.5 * neg_g)))
+        total_pairs = float(pos_g.sum() * neg_g.sum())
+        return {"rank_auc": pairs_correct / total_pairs if total_pairs else 0.0}
+
+
 @register_evaluator("precision_recall")
 class PrecisionRecallEvaluator(Evaluator):
     def start(self):

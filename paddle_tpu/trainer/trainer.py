@@ -194,9 +194,18 @@ class Trainer:
         evaluators = EvaluatorChain(self.config.model_config)
         evaluators.start()
         log_period = self.flags.log_period
+        profiling = False
         t0 = time.time()
         batch_id = 0
         for batch in provider.batches():
+            if (
+                self.flags.profile_dir
+                and pass_id == self.start_pass
+                and batch_id == self.flags.profile_start_batch
+            ):
+                jax.profiler.start_trace(self.flags.profile_dir)
+                profiling = True
+                logger.info("profiler trace started → %s", self.flags.profile_dir)
             n = _batch_num_samples(batch)
             rng, step_rng = jax.random.split(rng)
             with stat_timer("train_step"):
@@ -221,6 +230,17 @@ class Trainer:
                 and self.save_dir
             ):
                 self.save(pass_id, batch_id=batch_id)
+            if profiling and batch_id >= (
+                self.flags.profile_start_batch + self.flags.profile_num_batches
+            ):
+                jax.block_until_ready(self.params)
+                jax.profiler.stop_trace()
+                profiling = False
+                logger.info("profiler trace written to %s", self.flags.profile_dir)
+        if profiling:
+            jax.block_until_ready(self.params)
+            jax.profiler.stop_trace()
+            logger.info("profiler trace written to %s", self.flags.profile_dir)
         dt = time.time() - t0
         rate = stats.total_samples / max(dt, 1e-9)
         logger.info(

@@ -15,6 +15,8 @@ __all__ = [
     "evaluator_base",
     "classification_error_evaluator",
     "auc_evaluator",
+    "rank_auc_evaluator",
+    "seq_classification_error_evaluator",
     "pnpair_evaluator",
     "precision_recall_evaluator",
     "ctc_error_evaluator",
@@ -83,6 +85,16 @@ def classification_error_evaluator(input, label, name=None, weight=None, thresho
 
 def auc_evaluator(input, label, name=None, weight=None):
     return evaluator_base("last-column-auc", input, label, weight, name)
+
+
+def rank_auc_evaluator(input, click, pv=None, name=None):
+    """AUC over rank-model scores (ref: RankAucEvaluator, Evaluator.h:202)."""
+    return evaluator_base("rank-auc", input, click, pv, name)
+
+
+def seq_classification_error_evaluator(input, label, name=None):
+    """Per-sequence classification error (ref: Evaluator.cpp:111)."""
+    return evaluator_base("seq_classification_error", input, label, None, name)
 
 
 def pnpair_evaluator(input, info, name=None, weight=None):

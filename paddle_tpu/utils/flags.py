@@ -41,6 +41,11 @@ class _Flags:
     test_wait: bool = False
     predict_output_dir: str = ""
     gen_result: str = ""                 # gen job output file (overrides config)
+    # profiling (the reference's WITH_TIMER/BarrierStat analogs ride the
+    # jax profiler: xplane traces with the stat_timer scope annotations)
+    profile_dir: str = ""                # write a profiler trace here
+    profile_start_batch: int = 5
+    profile_num_batches: int = 10
     # rng
     seed: int = 1
     # distributed (multi-host jax)

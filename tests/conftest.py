@@ -27,7 +27,10 @@ import jax._src.xla_bridge as _xb  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 for _name in list(_xb._backend_factories):
-    if _name != "cpu":
+    # keep "tpu" registered (never initialized under JAX_PLATFORMS=cpu;
+    # there is no local libtpu — the real chip is behind the axon plugin)
+    # so pallas/checkify can still register their tpu lowering rules
+    if _name not in ("cpu", "tpu"):
         del _xb._backend_factories[_name]
 
 jax.config.update("jax_threefry_partitionable", True)

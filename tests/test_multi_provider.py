@@ -1,0 +1,99 @@
+"""MultiDataProvider: ratio-mixed sub-providers through one batch path."""
+
+import os
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+PROVIDER_SRC = '''
+from paddle.trainer.PyDataProvider2 import *
+
+@provider(input_types=[dense_vector(4), integer_value(2)])
+def pos(settings, file_name):
+    for i in range(int(file_name.split("-")[-1])):
+        yield [1.0, 0.0, 0.0, float(i % 3)], 1
+
+@provider(input_types=[dense_vector(4), integer_value(2)])
+def neg(settings, file_name):
+    for i in range(int(file_name.split("-")[-1])):
+        yield [0.0, 1.0, 0.0, float(i % 3)], 0
+'''
+
+
+def test_multi_ratio_mixing(tmp_path):
+    (tmp_path / "providers_multi.py").write_text(PROVIDER_SRC)
+    (tmp_path / "pos.list").write_text("n-300\n")
+    (tmp_path / "neg.list").write_text("n-300\n")
+    (tmp_path / "conf.py").write_text(
+        "from paddle.trainer_config_helpers import *\n"
+        "define_multi_py_data_sources2(\n"
+        "    train_lists=['pos.list', 'neg.list'],\n"
+        "    module='providers_multi', obj=['pos', 'neg'], ratios=[3, 1])\n"
+        "settings(batch_size=40, learning_rate=0.1)\n"
+        "d = data_layer('x', size=4)\n"
+        "out = fc_layer(input=d, size=2, act=SoftmaxActivation())\n"
+        "outputs(classification_cost(input=out, label=data_layer('label', size=2)))\n"
+    )
+    from paddle_tpu.config import parse_config
+    from paddle_tpu.data.feeder import create_data_provider
+
+    cwd = os.getcwd()
+    os.chdir(tmp_path)
+    try:
+        cfg = parse_config("conf.py")
+        assert cfg.data_config.type == "multi"
+        assert [s.data_ratio for s in cfg.data_config.sub_data_configs] == [3, 1]
+        # ordered (test-mode) stream keeps arrival order: while both
+        # streams are live each mixing round is 3 pos + 1 neg
+        provider = create_data_provider(
+            cfg.data_config, cfg.opt_config.batch_size,
+            cfg.model_config.input_layer_names, for_test=True,
+        )
+        labels = []
+        for batch in provider.batches():
+            labels.extend(np.asarray(batch["label"].ids).tolist())
+        assert len(labels) == 600
+        early = labels[:400]        # pos (300) exhausts at round 100
+        frac_pos = sum(early) / len(early)
+        assert frac_pos == 0.75, frac_pos
+        assert set(labels[400:]) == {0}
+    finally:
+        os.chdir(cwd)
+
+
+def test_multi_trains(tmp_path):
+    (tmp_path / "providers_multi.py").write_text(PROVIDER_SRC)
+    (tmp_path / "pos.list").write_text("n-200\n")
+    (tmp_path / "neg.list").write_text("n-200\n")
+    (tmp_path / "conf.py").write_text(
+        "from paddle.trainer_config_helpers import *\n"
+        "define_multi_py_data_sources2(\n"
+        "    train_lists=['pos.list', 'neg.list'],\n"
+        "    module='providers_multi', obj=['pos', 'neg'])\n"
+        "settings(batch_size=32, learning_rate=0.5)\n"
+        "d = data_layer('x', size=4)\n"
+        "out = fc_layer(input=d, size=2, act=SoftmaxActivation())\n"
+        "outputs(classification_cost(input=out, label=data_layer('label', size=2)))\n"
+    )
+    from paddle_tpu.config import parse_config
+    from paddle_tpu.trainer import Trainer
+    from paddle_tpu.utils.flags import _Flags
+
+    cwd = os.getcwd()
+    os.chdir(tmp_path)
+    try:
+        cfg = parse_config("conf.py")
+        flags = _Flags(config="conf.py", num_passes=4, log_period=100, use_tpu=False)
+        trainer = Trainer(cfg, flags)
+        trainer.train()
+        provider = trainer._provider(for_test=False)
+        errs, total = 0.0, 0
+        for batch in provider.batches():
+            out = trainer.test_fwd(trainer.params, batch)
+            errs += float(trainer.gm.total_cost(out)) * batch["label"].ids.shape[0]
+            total += batch["label"].ids.shape[0]
+        assert errs / total < 0.1, errs / total  # trivially separable
+    finally:
+        os.chdir(cwd)

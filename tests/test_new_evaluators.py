@@ -1,0 +1,70 @@
+"""rank-auc and per-sequence classification-error evaluators, config-wired."""
+
+import numpy as np
+
+from paddle_tpu.graph.argument import Argument
+from paddle_tpu.proto import EvaluatorConfig
+from paddle_tpu.trainer import evaluators as ev
+
+
+def test_rank_auc_exact():
+    cfg = EvaluatorConfig(name="r", type="rank-auc", input_layers=["s", "c"])
+    e = ev.evaluator_registry.get("rank-auc")(cfg)
+    e.start()
+    # pos scores {0.1, 0.8}, neg {0.9, 0.2}: only (0.8, 0.2) of the four
+    # pos/neg pairs is correctly ranked → AUC = 1/4
+    scores = np.asarray([[0.1], [0.9], [0.2], [0.8]], np.float32)
+    clicks = np.asarray([[1.0], [0.0], [0.0], [1.0]], np.float32)
+    e.eval_batch([Argument(value=scores), Argument(value=clicks)])
+    assert abs(e.result()["rank_auc"] - 0.25) < 1e-6
+
+    e.start()
+    order = np.linspace(0, 1, 20)[:, None].astype(np.float32)
+    lab = (order[:, 0] > 0.6).astype(np.float32)[:, None]
+    e.eval_batch([Argument(value=order), Argument(value=lab)])
+    assert e.result()["rank_auc"] == 1.0
+
+
+def test_seq_classification_error_masks_padding():
+    cfg = EvaluatorConfig(name="s", type="seq_classification_error",
+                          input_layers=["o", "l"])
+    e = ev.evaluator_registry.get("seq_classification_error")(cfg)
+    e.start()
+    v = np.zeros((2, 4, 2), np.float32)
+    v[0, :, 1] = 1.0           # predicts 1 everywhere
+    v[1, :, 0] = 1.0           # predicts 0 everywhere
+    lens = np.asarray([2, 4], np.int32)
+    labels = np.asarray([[1, 1, 0, 0],      # wrong only in padding → correct
+                         [0, 0, 0, 1]],     # wrong at a valid frame → wrong
+                        np.int32)
+    e.eval_batch([
+        Argument(value=v, seq_lengths=lens),
+        Argument(ids=labels, seq_lengths=lens),
+    ])
+    assert e.result()["seq_classification_error"] == 0.5
+
+
+def test_dsl_wrappers_emit_configs():
+    from paddle_tpu.config.builder import fresh_context
+    from paddle_tpu.trainer_config_helpers import (
+        classification_cost,
+        data_layer,
+        fc_layer,
+        outputs,
+        rank_auc_evaluator,
+        seq_classification_error_evaluator,
+        settings,
+        SoftmaxActivation,
+    )
+
+    with fresh_context() as ctx:
+        settings(batch_size=8, learning_rate=0.1)
+        d = data_layer("x", size=4)
+        out = fc_layer(input=d, size=2, act=SoftmaxActivation())
+        label = data_layer("label", size=2)
+        rank_auc_evaluator(input=out, click=label)
+        seq_classification_error_evaluator(input=out, label=label)
+        outputs(classification_cost(input=out, label=label))
+        tc = ctx.finalize()
+    types = [e.type for e in tc.model_config.evaluators]
+    assert "rank-auc" in types and "seq_classification_error" in types

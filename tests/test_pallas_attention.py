@@ -1,0 +1,68 @@
+"""Flash-attention pallas kernel vs the XLA reference (interpret mode).
+
+The CPU-stub pattern (SURVEY.md §4): kernels run in pallas interpret mode
+on CPU, asserting numerical equality with the XLA full_attention path —
+forward and gradients, causal and masked variants.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops.pallas_attention import flash_attention, supported
+from paddle_tpu.parallel.sequence_parallel import full_attention
+
+B, T, H, D = 2, 256, 2, 32
+
+
+def _qkv(seed=0):
+    rng = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+    return mk(), mk(), mk()
+
+
+def test_supported_predicate():
+    assert supported(256, 64)
+    assert not supported(100, 64)      # T not divisible by blocks
+    assert not supported(256, 512)     # head dim too large
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_forward_matches_xla(causal):
+    q, k, v = _qkv()
+    lengths = jnp.asarray([T, T - 77], jnp.int32)
+    ref = full_attention(q, k, v, lengths=lengths, causal=causal)
+    out = flash_attention(q, k, v, lengths=lengths, causal=causal, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_gradients_match_xla(causal):
+    q, k, v = _qkv(1)
+    lengths = jnp.asarray([T, T - 130], jnp.int32)
+
+    def loss_ref(q, k, v):
+        o = full_attention(q, k, v, lengths=lengths, causal=causal)
+        # mask padded rows out of the loss: their flash output is 0 but the
+        # XLA path produces garbage values there (both are masked by
+        # downstream layers in real models)
+        m = (jnp.arange(T)[None, :] < lengths[:, None]).astype(o.dtype)
+        return jnp.sum((o * m[..., None, None]) ** 2)
+
+    def loss_flash(q, k, v):
+        o = flash_attention(q, k, v, lengths=lengths, causal=causal, interpret=True)
+        m = (jnp.arange(T)[None, :] < lengths[:, None]).astype(o.dtype)
+        return jnp.sum((o * m[..., None, None]) ** 2)
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_fl = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_fl, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4)
+
+
+def test_full_lengths_default():
+    q, k, v = _qkv(2)
+    ref = full_attention(q, k, v, causal=True)
+    out = flash_attention(q, k, v, causal=True, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
