@@ -80,13 +80,40 @@ def _run_trainer_job(cmd, rest) -> int:
         trainer.train()
         return 0
     if cmd == "test":
-        trainer.test()
+        if flags.test_pass >= 0:
+            _test_saved_passes(trainer, flags)
+        else:
+            trainer.test()
         return 0
     if cmd == "gen":
         trainer.generate()
         return 0
     ok = trainer.check_gradient()
     return 0 if ok else 1
+
+
+def _test_saved_passes(trainer, flags) -> None:
+    """Evaluate saved checkpoints pass by pass (ref: Tester; --test_pass
+    with --test_wait polls for passes still being written by a concurrent
+    trainer)."""
+    import time
+
+    from paddle_tpu.trainer import checkpoint as ckpt
+
+    save_dir = flags.save_dir or trainer.config.save_dir
+    pass_id = flags.test_pass
+    while pass_id < flags.num_passes:
+        path = os.path.join(save_dir, ckpt.PASS_FMT % pass_id)
+        if not os.path.isdir(path):
+            if flags.test_wait:
+                time.sleep(5)
+                continue
+            break
+        trainer.params, _, _ = ckpt.load_checkpoint(
+            path, None, expected_params=trainer.params
+        )
+        trainer.test(pass_id=pass_id)
+        pass_id += 1
 
 
 def _dump_config(rest) -> int:

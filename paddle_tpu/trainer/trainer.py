@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import functools
 import os
+import sys
 import time
 from typing import Any, Dict, Iterator, Optional, Tuple
 
@@ -66,7 +67,12 @@ class Trainer:
     def __init__(self, config: TrainerConfig, flags=FLAGS):
         self.config = config
         self.flags = flags
-        self.gm = GradientMachine(config.model_config)
+        dtype = jnp.float32
+        if flags.use_double:
+            # the reference's WITH_DOUBLE build; mostly for gradient checks
+            jax.config.update("jax_enable_x64", True)
+            dtype = jnp.float64
+        self.gm = GradientMachine(config.model_config, dtype=dtype)
         self.updater = Updater(config.opt_config, config.model_config)
         self.params = self.gm.init_params(seed=flags.seed)
         self.opt_state = self.updater.init_state(self.params)
@@ -76,6 +82,9 @@ class Trainer:
         self._test_fwd_fn = None
         self._mesh = None
         mesh_shape = flags.mesh_shape or config.opt_config.mesh_shape
+        if not mesh_shape and flags.trainer_count > 1:
+            # reference -trainer_count: N-way data parallelism
+            mesh_shape = f"data={flags.trainer_count}"
         if mesh_shape:
             from paddle_tpu.parallel.mesh import make_mesh
 
@@ -215,6 +224,19 @@ class Trainer:
             stats.add(float(loss) * n, n)
             evaluators.eval_batch(outputs)
             batch_id += 1
+            if self.flags.dot_period and batch_id % self.flags.dot_period == 0:
+                print(".", end="", flush=True, file=sys.stderr)
+            if (
+                self.flags.test_period
+                and batch_id % self.flags.test_period == 0
+            ):
+                with stat_timer("test"):
+                    self.test(pass_id=pass_id)
+            if (
+                self.flags.show_parameter_stats_period
+                and batch_id % self.flags.show_parameter_stats_period == 0
+            ):
+                self.show_parameter_stats()
             if log_period and batch_id % log_period == 0:
                 logger.info(
                     "Pass %d batch %d  %s  %s",
@@ -250,6 +272,17 @@ class Trainer:
             evaluators.summary(),
             rate,
         )
+
+    def show_parameter_stats(self) -> None:
+        """Per-parameter value stats (ref: TrainerInternal::showParameterStats,
+        TrainerInternal.cpp:184-213)."""
+        for name in sorted(self.params):
+            v = np.asarray(self.params[name])
+            logger.info(
+                "Param %-40s mean=%.5g absmax=%.5g std=%.5g shape=%s",
+                name, float(v.mean()), float(np.abs(v).max()), float(v.std()),
+                tuple(v.shape),
+            )
 
     # -------------------------------------------------------------- test
 

@@ -53,9 +53,7 @@ class _Flags:
     num_processes: int = 1
     process_id: int = 0
     # misc
-    use_double: bool = False
-    log_error_clipping: bool = False
-    check_sparse_distribution_ratio: float = 0.6
+    use_double: bool = False                 # reference: WITH_DOUBLE build
 
     def parse(self, argv: Optional[List[str]] = None) -> List[str]:
         """Parse known flags from argv (``--flag=value`` style); returns leftovers."""
