@@ -100,18 +100,33 @@ def _test_saved_passes(trainer, flags) -> None:
 
     from paddle_tpu.trainer import checkpoint as ckpt
 
+    from paddle_tpu.utils.logging import logger
+
     save_dir = flags.save_dir or trainer.config.save_dir
     pass_id = flags.test_pass
     while pass_id < flags.num_passes:
         path = os.path.join(save_dir, ckpt.PASS_FMT % pass_id)
-        if not os.path.isdir(path):
+        # a checkpoint is complete once meta.json exists (written last by
+        # save_checkpoint) — guards against racing a concurrent trainer
+        if not os.path.exists(os.path.join(path, "meta.json")):
+            newest = ckpt.latest_pass(save_dir)
+            if newest is not None and newest > pass_id:
+                # rotated away by rolling deletion: skip forward
+                logger.warning(
+                    "pass %d checkpoint rotated away; skipping to %d",
+                    pass_id, newest,
+                )
+                pass_id = newest
+                continue
             if flags.test_wait:
                 time.sleep(5)
                 continue
             break
-        trainer.params, _, _ = ckpt.load_checkpoint(
-            path, None, expected_params=trainer.params
+        trainer.params, opt_state, _ = ckpt.load_checkpoint(
+            path, trainer.opt_state, expected_params=trainer.params
         )
+        if opt_state is not None:
+            trainer.opt_state = opt_state
         trainer.test(pass_id=pass_id)
         pass_id += 1
 

@@ -226,18 +226,22 @@ class Trainer:
             batch_id += 1
             if self.flags.dot_period and batch_id % self.flags.dot_period == 0:
                 print(".", end="", flush=True, file=sys.stderr)
+                self._dots_pending = True
             if (
                 self.flags.test_period
                 and batch_id % self.flags.test_period == 0
             ):
+                self._end_dot_line()
                 with stat_timer("test"):
                     self.test(pass_id=pass_id)
             if (
                 self.flags.show_parameter_stats_period
                 and batch_id % self.flags.show_parameter_stats_period == 0
             ):
+                self._end_dot_line()
                 self.show_parameter_stats()
             if log_period and batch_id % log_period == 0:
+                self._end_dot_line()
                 logger.info(
                     "Pass %d batch %d  %s  %s",
                     pass_id,
@@ -263,6 +267,7 @@ class Trainer:
             jax.block_until_ready(self.params)
             jax.profiler.stop_trace()
             logger.info("profiler trace written to %s", self.flags.profile_dir)
+        self._end_dot_line()
         dt = time.time() - t0
         rate = stats.total_samples / max(dt, 1e-9)
         logger.info(
@@ -272,6 +277,13 @@ class Trainer:
             evaluators.summary(),
             rate,
         )
+
+    def _end_dot_line(self) -> None:
+        """Terminate a run of progress dots before a log line (the
+        reference printed the newline in TrainerInternal too)."""
+        if getattr(self, "_dots_pending", False):
+            print("", flush=True, file=sys.stderr)
+            self._dots_pending = False
 
     def show_parameter_stats(self) -> None:
         """Per-parameter value stats (ref: TrainerInternal::showParameterStats,

@@ -19,7 +19,9 @@ from typing import List, Optional
 class _Flags:
     # device / mesh
     use_tpu: bool = True                 # reference: -use_gpu
-    trainer_count: int = 0               # 0 = all local devices (reference: -trainer_count)
+    trainer_count: int = 0               # >1 = data=N mesh; 0/1 = single program
+                                         # (reference: -trainer_count; use --mesh_shape
+                                         # for multi-axis parallelism)
     mesh_shape: str = ""                 # e.g. "data=8" or "data=4,model=2"
     # jobs
     job: str = "train"                   # train | test | checkgrad
