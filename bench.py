@@ -1,10 +1,20 @@
-"""Benchmark harness — prints ONE JSON line for the driver.
+"""Benchmark harness — prints ONE JSON line for the driver, always.
 
 Headline metric: ResNet-50 training throughput (imgs/sec/chip), the
 north-star workload from BASELINE.md. `python bench.py lstm` runs the
 secondary LSTM-classifier tokens/sec bench. vs_baseline is measured
 against benchmarks/targets.json when present (the reference publishes no
-numbers — BASELINE.md); absent a recorded target it reports 1.0.
+numbers — BASELINE.md; the targets are clearly-labeled estimates, and
+the emitted JSON carries `baseline_kind` so an estimate can never
+masquerade as a measured reference ratio).
+
+Hardening (the round-1 failure mode): the environment pre-registers an
+accelerator plugin whose backend init can raise UNAVAILABLE or hang.
+We therefore (1) probe the backend in a SUBPROCESS with a timeout, and
+only let this process touch the accelerator if the probe proved it
+initializes; (2) otherwise force the CPU platform via
+paddle_tpu.utils.backend_guard; (3) wrap main in a catch-all that emits
+a parseable JSON line with an "error" field rather than a traceback.
 """
 
 from __future__ import annotations
@@ -16,6 +26,11 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+
+# How long the subprocess backend probe may take before we give up on the
+# accelerator and fall back to CPU. First TPU init can take ~40s; leave slack.
+PROBE_TIMEOUT_S = float(os.environ.get("PADDLE_TPU_BENCH_PROBE_TIMEOUT", "180"))
 
 
 def _jit_train_step(tc):
@@ -56,29 +71,10 @@ def _time_steps(step, params, opt_state, batch, bs, steps, warmup):
     return time.perf_counter() - t0
 
 
-def resnet_config(layer_num=50, img_size=224, classes=1000):
-    from paddle_tpu.config import parse_config_at
-
-    return parse_config_at(
-        os.path.join(REPO, "demo", "model_zoo", "resnet", "resnet.py"),
-        f"layer_num={layer_num},img_size={img_size},num_classes={classes}",
-    )
-
-
-def make_image_batch(B, img_size, classes, seed=0):
-    import numpy as np
-
-    from paddle_tpu.graph import make_dense, make_ids
-
-    rng = np.random.RandomState(seed)
-    return {
-        "input": make_dense(rng.randn(B, 3 * img_size * img_size).astype(np.float32)),
-        "label": make_ids(rng.randint(0, classes, (B,)).astype(np.int32)),
-    }
-
-
 def bench_resnet50(B=64, img_size=224, classes=1000, steps=20, warmup=3):
     import jax.numpy as jnp
+
+    from paddle_tpu.flagship import make_image_batch, resnet_config
 
     tc = resnet_config(50, img_size, classes)
     tc.opt_config.batch_size = B
@@ -91,54 +87,140 @@ def bench_resnet50(B=64, img_size=224, classes=1000, steps=20, warmup=3):
 def bench_lstm_classifier(B=256, T=64, steps=20, warmup=3):
     import jax.numpy as jnp
 
-    from __graft_entry__ import _example_batch, _flagship_config
+    from paddle_tpu.flagship import example_batch, flagship_config
 
-    tc = _flagship_config(dict_dim=10000, emb_dim=256, hidden=512, classes=2)
+    tc = flagship_config(dict_dim=10000, emb_dim=256, hidden=512, classes=2)
     tc.opt_config.batch_size = B
     step, params, opt_state = _jit_train_step(tc)
-    batch = _example_batch(dict_dim=10000, B=B, T=T)
+    batch = example_batch(dict_dim=10000, B=B, T=T)
     dt = _time_steps(step, params, opt_state, batch, jnp.asarray(float(B)), steps, warmup)
     return B * T * steps / dt
 
 
+def _emit(metric, value, unit, vs_baseline, **extra):
+    line = {
+        "metric": metric,
+        "value": round(float(value), 1),
+        "unit": unit,
+        "vs_baseline": round(float(vs_baseline), 3),
+    }
+    line.update(extra)
+    print(json.dumps(line))
+
+
 def main():
     which = sys.argv[1] if len(sys.argv) > 1 else "resnet"
+    if which not in ("resnet", "lstm"):
+        print(f"unknown benchmark {which!r}: expected 'resnet' or 'lstm'", file=sys.stderr)
+        return 2
+
     targets_path = os.path.join(REPO, "benchmarks", "targets.json")
     targets = {}
     if os.path.exists(targets_path):
         with open(targets_path) as f:
             targets = json.load(f)
 
-    if which not in ("resnet", "lstm"):
-        print(f"unknown benchmark {which!r}: expected 'resnet' or 'lstm'", file=sys.stderr)
-        return 2
+    # Decide the backend BEFORE this process touches jax: probe in a
+    # subprocess (can't hang us), fall back to forced CPU on any failure.
+    from paddle_tpu.utils.backend_guard import ensure_cpu_mesh, probe_backend
+
+    backend = probe_backend(timeout_s=PROBE_TIMEOUT_S)
+    on_tpu = backend not in ("", "cpu")
+    if not on_tpu:
+        ensure_cpu_mesh(1)
+
     if which == "lstm":
         value = bench_lstm_classifier()
-        metric, unit, tkey = ("lstm_classifier_train_tokens_per_sec", "tokens/s",
-                              "lstm_classifier_tokens_per_sec")
+        metric, unit, tkey = (
+            "lstm_classifier_train_tokens_per_sec",
+            "tokens/s",
+            "lstm_classifier_tokens_per_sec",
+        )
+    elif on_tpu:
+        value = bench_resnet50()
+        metric, unit, tkey = (
+            "resnet50_train_imgs_per_sec_per_chip",
+            "imgs/s",
+            "resnet50_imgs_per_sec",
+        )
     else:
         # CPU smoke runs can't push 224px ResNet: shrink AND rename the
         # metric so a toy run can never masquerade as the flagship number
-        import jax
-
-        on_tpu = jax.default_backend() not in ("cpu",)
-        if on_tpu:
-            value = bench_resnet50()
-            metric, unit, tkey = ("resnet50_train_imgs_per_sec_per_chip", "imgs/s",
-                                  "resnet50_imgs_per_sec")
-        else:
-            value = bench_resnet50(B=16, img_size=32, classes=16, steps=5, warmup=2)
-            metric, unit, tkey = ("resnet50_cpu_smoke_imgs_per_sec", "imgs/s", None)
+        value = bench_resnet50(B=16, img_size=32, classes=16, steps=5, warmup=2)
+        metric, unit, tkey = ("resnet50_cpu_smoke_imgs_per_sec", "imgs/s", None)
 
     target = targets.get(tkey) if tkey else None
     vs_baseline = value / target if target else 1.0
-    print(json.dumps({
-        "metric": metric,
-        "value": round(value, 1),
-        "unit": unit,
-        "vs_baseline": round(vs_baseline, 3),
-    }))
+    _emit(
+        metric,
+        value,
+        unit,
+        vs_baseline,
+        backend=backend,
+        baseline_kind="estimated" if target else "none",
+    )
+    return 0
+
+
+def _good_json_line(text):
+    """The first parseable JSON line, unless it's only a failure report."""
+    for ln in text.strip().splitlines():
+        if ln.startswith("{"):
+            try:
+                parsed = json.loads(ln)
+            except ValueError:
+                continue
+            if parsed.get("metric") != "bench_failed":
+                return ln
+    return None
+
+
+def _supervise():
+    """Run the real bench in a child with a wall-clock budget; if the
+    accelerator leg hangs or crashes (round-1 failure modes), retry on
+    forced CPU. Guarantees exactly one JSON line and rc=0 no matter what."""
+    import subprocess
+
+    budget = float(os.environ.get("PADDLE_TPU_BENCH_BUDGET", "1500"))
+    deadline = time.monotonic() + budget
+    attempts = [
+        dict(os.environ, PADDLE_TPU_BENCH_CHILD="1"),
+        # forced-CPU retry: 1s probe timeout makes the child give up on the
+        # accelerator immediately and run the CPU smoke instead
+        dict(os.environ, PADDLE_TPU_BENCH_CHILD="1", PADDLE_TPU_BENCH_PROBE_TIMEOUT="1"),
+    ]
+    last_err = "no attempt ran"
+    for env in attempts:
+        remaining = deadline - time.monotonic()
+        if remaining <= 10:
+            break
+        try:
+            out = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)] + sys.argv[1:],
+                env=env,
+                capture_output=True,
+                text=True,
+                timeout=remaining,
+            )
+        except subprocess.TimeoutExpired:
+            last_err = f"bench child exceeded {remaining:.0f}s remaining budget"
+            continue
+        sys.stderr.write(out.stderr[-4000:])
+        line = _good_json_line(out.stdout)
+        if line is not None:
+            print(line)
+            return 0
+        last_err = (out.stderr or out.stdout or "no output")[-500:]
+    _emit("bench_failed", 0.0, "none", 0.0, error=last_err)
+    return 0
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    if os.environ.get("PADDLE_TPU_BENCH_CHILD") == "1":
+        try:
+            rc = main()
+        except Exception as e:  # never leave the driver without a JSON line
+            _emit("bench_failed", 0.0, "none", 0.0, error=f"{type(e).__name__}: {e}")
+            rc = 0
+        sys.exit(rc)
+    sys.exit(_supervise())

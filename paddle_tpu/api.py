@@ -189,7 +189,13 @@ class GradientMachine:
         (loss: float, grads: dict name→numpy)."""
         grad_fn = self._core.grad_fn()
         loss, grads, _, _ = grad_fn(self.params, in_args, rng)
-        return float(loss), {k: np.asarray(v) for k, v in grads.items()}
+        # row-sparse embedding grads densify for this numpy API (small
+        # models only; training never materializes them)
+        dense = {
+            k: np.asarray(v.to_dense() if hasattr(v, "to_dense") else v)
+            for k, v in grads.items()
+        }
+        return float(loss), dense
 
     # -- generation ------------------------------------------------------
 

@@ -68,7 +68,10 @@ def write_shard(path: str, samples: List[Sequence[Any]], input_types: Sequence[I
             raise NotImplementedError("binary shards: nested sequences not supported yet")
     meta = {"magic": MAGIC, "n": n, "types": [_type_dict(t) for t in input_types]}
     arrays["__meta__"] = np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8)
-    np.savez(path, **arrays)
+    # np.savez appends .npz to a bare path; write through a file object so
+    # a '.pdz' shard lands at exactly the path the file list names
+    with open(path, "wb") as f:
+        np.savez(f, **arrays)
 
 
 def read_shard(path: str):

@@ -1,0 +1,81 @@
+"""Flagship bench/dryrun model builders, shared by bench.py and
+__graft_entry__.py so neither entry point depends on the other
+(reference role: the benchmark configs under demo/ driven by
+paddle/trainer/Trainer.cpp's train path).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def flagship_config(dict_dim=1000, emb_dim=64, hidden=64, classes=2, mesh_shape=""):
+    """Stacked-LSTM text classifier (the sentiment-demo shape) built via the
+    DSL; the secondary bench flagship next to ResNet."""
+    from paddle_tpu.config.builder import fresh_context
+    from paddle_tpu.trainer_config_helpers import (
+        AdamOptimizer,
+        MaxPooling,
+        ParamAttr,
+        SoftmaxActivation,
+        classification_cost,
+        data_layer,
+        embedding_layer,
+        fc_layer,
+        outputs,
+        pooling_layer,
+        settings,
+        simple_lstm,
+    )
+
+    with fresh_context() as ctx:
+        settings(
+            batch_size=32,
+            learning_rate=1e-3,
+            learning_method=AdamOptimizer(),
+            mesh_shape=mesh_shape or None,
+        )
+        words = data_layer(name="words", size=dict_dim)
+        emb = embedding_layer(input=words, size=emb_dim, param_attr=ParamAttr(name="emb"))
+        lstm = simple_lstm(input=emb, size=hidden)
+        pool = pooling_layer(input=lstm, pooling_type=MaxPooling())
+        output = fc_layer(input=pool, size=classes, act=SoftmaxActivation(), name="output")
+        label = data_layer(name="label", size=classes)
+        outputs(classification_cost(input=output, label=label))
+        return ctx.finalize()
+
+
+def example_batch(dict_dim=1000, B=8, T=32, classes=2, seed=0):
+    from paddle_tpu.graph import make_ids, make_seq
+
+    rng = np.random.RandomState(seed)
+    ids = rng.randint(0, dict_dim, (B, T)).astype(np.int32)
+    lengths = rng.randint(T // 2, T + 1, (B,)).astype(np.int32)
+    labels = rng.randint(0, classes, (B,)).astype(np.int32)
+    return {
+        "words": make_seq(None, lengths, ids=ids),
+        "label": make_ids(labels),
+    }
+
+
+def resnet_config(layer_num=50, img_size=224, classes=1000):
+    from paddle_tpu.config import parse_config_at
+
+    return parse_config_at(
+        os.path.join(REPO, "demo", "model_zoo", "resnet", "resnet.py"),
+        f"layer_num={layer_num},img_size={img_size},num_classes={classes}",
+    )
+
+
+def make_image_batch(B, img_size, classes, seed=0):
+    from paddle_tpu.graph import make_dense, make_ids
+
+    rng = np.random.RandomState(seed)
+    return {
+        "input": make_dense(rng.randn(B, 3 * img_size * img_size).astype(np.float32)),
+        "label": make_ids(rng.randint(0, classes, (B,)).astype(np.int32)),
+    }

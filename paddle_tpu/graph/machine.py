@@ -55,11 +55,12 @@ class GradientMachine:
         in_args: Dict[str, Argument],
         pass_type: str = "test",
         rng: Optional[Array] = None,
+        table_overrides=None,
     ) -> Tuple[Dict[str, Argument], Dict[str, Array]]:
         """Run the graph; returns (all layer outputs, state updates)."""
         ctx = LayerContext(
             params=params, model=self.model, pass_type=pass_type, rng=rng,
-            dtype=self.dtype, mesh=self.mesh,
+            dtype=self.dtype, mesh=self.mesh, table_overrides=table_overrides,
         )
         self.network.forward(ctx, in_args)
         return ctx.outputs, ctx.state_updates
@@ -127,13 +128,64 @@ class GradientMachine:
         outputs, state_updates = self.forward(params, in_args, pass_type, rng)
         return self.total_cost(outputs), (outputs, state_updates)
 
+    # --------------------------------------------------- sparse prefetch
+
+    def sparse_prefetch_plan(self):
+        """Which sparse_update tables can take the row-sparse gradient path.
+
+        The analog of GradientMachine::prefetch (/root/reference/paddle/
+        trainer/TrainerInternal.cpp:91-95): sparse rows are identified from
+        the *input ids*, before forward. A table qualifies when every use
+        of the parameter is a table projection reading ids straight from a
+        data layer (the reference has the same reach — it prefetches from
+        inArgs only). Returns [(param_name, data_layer_name)]; parameters
+        used any other way fall back to the dense-gradient row-scan path.
+        """
+        sparse_names = {
+            n for n, c in self.param_configs.items() if c.sparse_update and not c.is_static
+        }
+        if not sparse_names:
+            return []
+        layer_map = self.network.layer_map
+        uses: Dict[str, list] = {n: [] for n in sparse_names}
+        for layer in self.model.layers:
+            for ic in layer.inputs:
+                pn = ic.input_parameter_name
+                if pn not in sparse_names:
+                    continue
+                src = layer_map.get(ic.input_layer_name)
+                ok = (
+                    ic.proj_conf is not None
+                    and ic.proj_conf.type == "table"
+                    and src is not None
+                    and src.type == "data"
+                )
+                uses[pn].append((ic.input_layer_name, ok))
+            if layer.bias_parameter_name in sparse_names:
+                uses[layer.bias_parameter_name].append(("", False))
+        plan = []
+        for pn, sites in sorted(uses.items()):
+            if sites and all(ok for _, ok in sites):
+                plan.extend((pn, ln) for ln, _ in sites)
+        return plan
+
     def grad_fn(self):
-        """Returns f(params, in_args, rng) → (loss, grads, outputs, state_updates)."""
+        """Returns f(params, in_args, rng) → (loss, grads, outputs, state_updates).
+
+        Gradients for prefetchable sparse_update tables come back as
+        RowSparseGrad (ids + occurrence rows, O(batch·seq) not O(V)) —
+        see paddle_tpu.optimizer.sparse; everything else is dense."""
+        plan = self.sparse_prefetch_plan()
 
         def f(params: Params, in_args: Dict[str, Argument], rng: Optional[Array]):
-            (loss, (outputs, state_updates)), grads = jax.value_and_grad(
-                self.loss_fn, has_aux=True
-            )(params, in_args, rng)
+            if not plan:
+                (loss, (outputs, state_updates)), grads = jax.value_and_grad(
+                    self.loss_fn, has_aux=True
+                )(params, in_args, rng)
+            else:
+                loss, grads, outputs, state_updates = self._sparse_value_and_grad(
+                    plan, params, in_args, rng
+                )
             # static parameters get no gradient
             for n, cfg in self.param_configs.items():
                 if cfg.is_static and n in grads:
@@ -141,6 +193,40 @@ class GradientMachine:
             return loss, grads, outputs, state_updates
 
         return f
+
+    def _sparse_value_and_grad(self, plan, params, in_args, rng):
+        from paddle_tpu.optimizer.sparse import RowSparseGrad
+
+        sparse_pnames = {pn for pn, _ in plan}
+        # prefetch: gather the occurrence rows OUTSIDE autodiff and make
+        # them the differentiable leaves; the table itself is frozen
+        rows_in = {}
+        for pn, dname in plan:
+            ids = in_args[dname].ids
+            rows_in[(pn, dname)] = jnp.take(params[pn], ids, axis=0)
+        dense_params = {k: v for k, v in params.items() if k not in sparse_pnames}
+        frozen = {k: jax.lax.stop_gradient(params[k]) for k in sparse_pnames}
+
+        def loss2(dense_params, rows):
+            full = dict(dense_params, **frozen)
+            outputs, state_updates = self.forward(
+                full, in_args, "train", rng, table_overrides=rows
+            )
+            return self.total_cost(outputs), (outputs, state_updates)
+
+        (loss, (outputs, state_updates)), (dgrads, rgrads) = jax.value_and_grad(
+            loss2, argnums=(0, 1), has_aux=True
+        )(dense_params, rows_in)
+        grads: Dict[str, Any] = dict(dgrads)
+        by_param: Dict[str, list] = {}
+        for (pn, dname), rg in rgrads.items():
+            ids = in_args[dname].ids.reshape(-1)
+            by_param.setdefault(pn, []).append((ids, rg.reshape(ids.shape[0], -1)))
+        for pn, pieces in by_param.items():
+            ids = jnp.concatenate([i for i, _ in pieces])
+            rows = jnp.concatenate([r for _, r in pieces])
+            grads[pn] = RowSparseGrad(ids=ids, rows=rows, nrows=params[pn].shape[0])
+        return loss, grads, outputs, state_updates
 
     # --------------------------------------------------- gradient checking
 

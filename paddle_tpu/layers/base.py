@@ -54,6 +54,11 @@ class LayerContext:
     # device mesh for layers that issue explicit collectives (ring
     # attention); None outside meshed execution
     mesh: Any = None
+    # sparse-embedding prefetch (GradientMachine::prefetch analog): rows
+    # pre-gathered outside autodiff, keyed by (param_name, input_layer);
+    # the table projection returns these instead of gathering, so
+    # jax.grad yields row gradients, never a dense [V, D] scatter
+    table_overrides: Optional[Dict[Any, Array]] = None
 
     @property
     def is_training(self) -> bool:

@@ -109,6 +109,10 @@ def apply_projection(
     if t == "dot_mul":
         return arg.value * ctx.param(pname)
     if t == "table":
+        if ctx.table_overrides is not None:
+            ov = ctx.table_overrides.get((pname, in_cfg.input_layer_name))
+            if ov is not None:  # prefetched rows, already [batch..., dim]
+                return ov
         table = ctx.param(pname)  # [vocab, dim]
         return jnp.take(table, arg.ids, axis=0)
     if t == "fc":  # FullMatrixProjection

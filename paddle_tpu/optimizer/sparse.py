@@ -1,0 +1,93 @@
+"""Row-sparse gradients — the sparse-at-scale embedding path.
+
+The reference's first-class strength is high-dimensional sparse training:
+embedding rows are prefetched before forward from the *input ids*
+(/root/reference/paddle/trainer/TrainerInternal.cpp:91-95 →
+GradientMachine::prefetch), gradients live as sparse rows
+(paddle/math/SparseRowMatrix.h:31), and the pserver applies per-row
+updates (paddle/pserver/ParameterServer2.cpp:352,572).
+
+TPU-native redesign: a gradient for a ``sparse_update`` table is a
+``RowSparseGrad`` — the flat occurrence ids from the batch plus one
+gradient row per occurrence, both STATIC shapes O(batch·seq), never the
+dense [V, D] scatter jax.grad would produce. The machine computes it by
+differentiating w.r.t. the *gathered rows* (the prefetch analog); the
+updater dedupes occurrences with a sort + segment-sum and scatters
+per-row optimizer updates back with out-of-bounds drop — O(N·D) compute
+and memory per step regardless of vocabulary size. On a mesh, the table
+(and its optimizer slots) shard over rows; XLA partitions the
+gather/scatter into ICI collectives (the SPMD replacement for the sparse
+pserver's remote row push/pull).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+@jax.tree_util.register_pytree_node_class
+class RowSparseGrad:
+    """Gradient of a [V, D] table as occurrence rows.
+
+    ids:  [N] int32 — row index per occurrence (duplicates allowed;
+          padded positions contribute zero rows and are harmless)
+    rows: [N, D] — d(loss)/d(table[ids[n]]) per occurrence
+    nrows: static V, for densification and bounds
+    """
+
+    def __init__(self, ids: Array, rows: Array, nrows: int):
+        self.ids = ids
+        self.rows = rows
+        self.nrows = nrows
+
+    def tree_flatten(self):
+        return (self.ids, self.rows), self.nrows
+
+    @classmethod
+    def tree_unflatten(cls, nrows, children):
+        ids, rows = children
+        return cls(ids, rows, nrows)
+
+    @property
+    def shape(self) -> Tuple[int, int]:
+        return (self.nrows, self.rows.shape[-1])
+
+    def to_dense(self) -> Array:
+        """Materialize the dense [V, D] gradient (tests / small-model API
+        introspection only — defeats the point at scale)."""
+        out = jnp.zeros((self.nrows, self.rows.shape[-1]), self.rows.dtype)
+        return out.at[self.ids].add(self.rows)
+
+    def __repr__(self):
+        return (
+            f"RowSparseGrad(ids={self.ids.shape}, rows={self.rows.shape}, "
+            f"nrows={self.nrows})"
+        )
+
+
+def dedupe(ids: Array, rows: Array, nrows: int):
+    """Sum duplicate occurrences: returns (uid, g_rows, valid) all [N]-sized.
+
+    uid[k] is the k-th distinct row index (positions past the distinct
+    count hold the sentinel ``nrows`` — out of bounds, so scatters with
+    mode='drop' ignore them); g_rows[k] is the summed gradient for uid[k].
+    Static shapes throughout: N never shrinks, which is what lets this
+    run under jit on TPU.
+    """
+    N = ids.shape[0]
+    order = jnp.argsort(ids)
+    ids_s = ids[order]
+    rows_s = rows[order]
+    first = jnp.concatenate([jnp.ones((1,), bool), ids_s[1:] != ids_s[:-1]])
+    seg = jnp.cumsum(first) - 1                       # occurrence → distinct slot
+    g_rows = jax.ops.segment_sum(rows_s, seg, num_segments=N)
+    k = jnp.sum(first)
+    valid = jnp.arange(N) < k
+    uid_full = jax.ops.segment_max(ids_s, seg, num_segments=N)
+    uid = jnp.where(valid, uid_full, nrows)
+    return uid, g_rows, valid

@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 
 from paddle_tpu.optimizer.schedules import learning_rate_at
+from paddle_tpu.optimizer.sparse import RowSparseGrad, dedupe
 from paddle_tpu.proto import ModelConfig, OptimizationConfig, ParameterConfig
 
 Array = jax.Array
@@ -33,9 +34,14 @@ class UpdaterState(NamedTuple):
     step: Array                      # int32 batch counter
     num_samples: Array               # float, samples processed (lr schedules)
     slots: Dict[str, Dict[str, Array]]   # per-param optimizer buffers
-    # parameter averaging (AverageOptimizer) — running sum & window count
+    # sliding-window parameter averaging (AverageOptimizer.h:24,99): the
+    # current window's running sum/count plus the previous full window
+    # (the SUM1+SUM2 / SUM3 double-buffer collapsed to two buckets);
+    # average = (sum + old_sum) / (count + old_count)
     avg_sum: Optional[Params]
     avg_count: Array
+    avg_old_sum: Optional[Params] = None
+    avg_old_count: Optional[Array] = None
 
 
 class Updater:
@@ -44,6 +50,12 @@ class Updater:
         self.param_configs: Dict[str, ParameterConfig] = {p.name: p for p in model.parameters}
         self.method = opt.learning_method
         self.averaging = opt.average_window > 0
+        # window limits (AverageOptimizer ctor + isAverageWindowTooLong):
+        # the current window closes once it holds >= min(max_average_window,
+        # numUpdates * average_window) batches (and >= min_window)
+        self.avg_frac = float(opt.average_window)
+        self.max_window = float(opt.max_average_window)
+        self.min_window = float(min(10000, opt.max_average_window))
 
     # ------------------------------------------------------------- state
 
@@ -78,12 +90,15 @@ class Updater:
                 # (OptimizerWithRegularizerSparse.h:124 semantics)
                 slots[name]["t_last"] = jnp.zeros((p.shape[0],), jnp.int32)
         avg_sum = {k: jnp.zeros_like(v) for k, v in params.items()} if self.averaging else None
+        avg_old = {k: jnp.zeros_like(v) for k, v in params.items()} if self.averaging else None
         return UpdaterState(
             step=jnp.zeros((), jnp.int32),
             num_samples=jnp.zeros((), jnp.float32),
             slots=slots,
             avg_sum=avg_sum,
             avg_count=jnp.zeros((), jnp.float32),
+            avg_old_sum=avg_old,
+            avg_old_count=jnp.zeros((), jnp.float32),
         )
 
     # ------------------------------------------------------------- update
@@ -105,9 +120,16 @@ class Updater:
                 continue
             g = grads[name]
             clip = cfg.gradient_clipping_threshold or opt.gradient_clipping_threshold
+            lr = base_lr * (cfg.learning_rate if cfg.learning_rate else 1.0)
+            if isinstance(g, RowSparseGrad):
+                w2, slots2 = self._apply_sparse_indexed(
+                    cfg, w, g, state.slots[name], lr, t, clip
+                )
+                new_params[name] = w2
+                new_slots[name] = slots2
+                continue
             if clip and clip > 0:
                 g = jnp.clip(g, -clip, clip)
-            lr = base_lr * (cfg.learning_rate if cfg.learning_rate else 1.0)
             if cfg.sparse_update and g.ndim >= 2:
                 w2, slots2 = self._apply_sparse_rows(cfg, w, g, state.slots[name], lr, t)
             else:
@@ -123,10 +145,65 @@ class Updater:
             new_params[name] = w2
             new_slots[name] = slots2
         avg_sum, avg_count = state.avg_sum, state.avg_count
+        avg_old_sum, avg_old_count = state.avg_old_sum, state.avg_old_count
         if self.averaging:
-            avg_sum = {k: avg_sum[k] + new_params[k] for k in new_params}
-            avg_count = avg_count + 1.0
-        return new_params, UpdaterState(t, num_samples, new_slots, avg_sum, avg_count)
+            cur = {k: avg_sum[k] + new_params[k] for k in new_params}
+            n_acc = avg_count + 1.0
+            # close the window when it's grown past the configured span
+            # (isAverageWindowTooLong): the full window becomes the "old"
+            # bucket and a fresh one starts accumulating
+            limit = jnp.minimum(self.max_window, t.astype(jnp.float32) * self.avg_frac)
+            shift = (n_acc >= self.min_window) & (n_acc >= limit)
+            avg_old_sum = {k: jnp.where(shift, cur[k], avg_old_sum[k]) for k in cur}
+            avg_old_count = jnp.where(shift, n_acc, avg_old_count)
+            avg_sum = {k: jnp.where(shift, jnp.zeros_like(cur[k]), cur[k]) for k in cur}
+            avg_count = jnp.where(shift, 0.0, n_acc)
+        return new_params, UpdaterState(
+            t, num_samples, new_slots, avg_sum, avg_count, avg_old_sum, avg_old_count
+        )
+
+    def _apply_sparse_indexed(self, cfg, w, sg: RowSparseGrad, slots, lr, t, clip):
+        """Row-sparse update from a RowSparseGrad — O(N·D) in the batch's
+        occurrence count N, independent of vocabulary size V. Same
+        semantics as _apply_sparse_rows (SparseRowCpuMatrix::sgdUpdate +
+        OptimizerWithRegularizerSparse lazy catch-up) but driven by ids
+        instead of a dense-gradient row scan: dedupe occurrences by
+        sort + segment-sum, gather only the touched parameter/slot rows,
+        run the optimizer method on those rows, scatter back (sentinel
+        ids drop out of bounds)."""
+        V = w.shape[0]
+        uid, g_rows, valid = dedupe(sg.ids, sg.rows.reshape(sg.ids.shape[0], -1), V)
+        if clip and clip > 0:  # clip the aggregated gradient, as the dense path does
+            g_rows = jnp.clip(g_rows, -clip, clip)
+        uid_c = jnp.minimum(uid, V - 1)               # safe gather index
+        vmask = valid[:, None]
+        t_last = slots.get("t_last")
+        inner = {k: v for k, v in slots.items() if k != "t_last"}
+        w_rows = w[uid_c]                             # [N, D]
+        inner_rows = {k: v[uid_c] for k, v in inner.items()}
+        tl_rows = t_last[uid_c] if t_last is not None else jnp.zeros_like(uid_c)
+        elapsed = jnp.maximum(t - 1 - tl_rows, 0).astype(w.dtype)[:, None]
+        g = g_rows
+        if cfg.decay_rate:
+            decay = jnp.power(1.0 - lr * cfg.decay_rate, elapsed)
+            w_rows = w_rows * decay
+            g = g + cfg.decay_rate * w_rows
+        if cfg.decay_rate_l1:
+            thresh = lr * cfg.decay_rate_l1 * elapsed
+            w_rows = jnp.sign(w_rows) * jnp.maximum(jnp.abs(w_rows) - thresh, 0.0)
+        w2_rows, inner2_rows = self._apply_method(cfg, w_rows, g, inner_rows, lr, t)
+        if cfg.decay_rate_l1:
+            thresh = lr * cfg.decay_rate_l1
+            w2_rows = jnp.sign(w2_rows) * jnp.maximum(jnp.abs(w2_rows) - thresh, 0.0)
+        # invalid (sentinel) entries scatter out of bounds and are dropped
+        w_new = w.at[uid].set(jnp.where(vmask, w2_rows, 0.0), mode="drop")
+        slots_new = {
+            k: inner[k].at[uid].set(jnp.where(vmask, inner2_rows[k], 0.0), mode="drop")
+            for k in inner
+        }
+        if t_last is not None:
+            slots_new["t_last"] = t_last.at[uid].set(t, mode="drop")
+        return w_new, slots_new
 
     def _apply_sparse_rows(self, cfg, w, g, slots, lr, t):
         """Row-sparse update (SparseRowCpuMatrix::sgdUpdate +
@@ -215,8 +292,18 @@ class Updater:
 
     def averaged_params(self, params: Params, state: UpdaterState) -> Params:
         """Apply-parameter-averaging view for testing (AverageOptimizer
-        apply()/restore() semantics)."""
+        apply()/restore(): average = (SUM1+SUM2+SUM3) / (numAccumulates +
+        oldNumAccumulates) — here (sum + old_sum) / (count + old_count))."""
         if not self.averaging or state.avg_sum is None:
             return params
-        count = jnp.maximum(state.avg_count, 1.0)
-        return {k: state.avg_sum[k] / count for k in params}
+        old_sum = state.avg_old_sum
+        old_count = (
+            state.avg_old_count
+            if state.avg_old_count is not None
+            else jnp.zeros((), jnp.float32)
+        )
+        count = jnp.maximum(state.avg_count + old_count, 1.0)
+        return {
+            k: (state.avg_sum[k] + (old_sum[k] if old_sum is not None else 0.0)) / count
+            for k in params
+        }

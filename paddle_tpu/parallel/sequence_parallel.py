@@ -52,7 +52,11 @@ def full_attention(
 
     On TPU, self-attention shapes the flash kernel supports dispatch to
     paddle_tpu.ops.pallas_attention (O(T) activation memory); everything
-    else takes the XLA path below (which materializes [B, H, T, T])."""
+    else takes the XLA path below (which materializes [B, H, T, T]).
+
+    Outputs at padded query rows (positions >= lengths) are unspecified
+    and differ between the flash and XLA paths — callers must mask them
+    (the mha layer does)."""
     if (
         q_offset == 0
         and kv_offset == 0
@@ -90,9 +94,12 @@ def _ring_attention_local(q, k, v, lengths, causal, axis_name):
     scale = 1.0 / math.sqrt(D)
     q_pos = idx * T_loc + jnp.arange(T_loc)                      # global positions
 
-    o0 = jnp.zeros((B, H, T_loc, D), q.dtype)
-    m0 = jnp.full((B, H, T_loc), _NEG, q.dtype)
-    l0 = jnp.zeros((B, H, T_loc), q.dtype)
+    # accumulate in f32 regardless of q.dtype: bf16 online-softmax state
+    # drifts across ring steps (matches the f32-accumulating flash kernel)
+    acc_t = jnp.float32
+    o0 = jnp.zeros((B, H, T_loc, D), acc_t)
+    m0 = jnp.full((B, H, T_loc), _NEG, acc_t)
+    l0 = jnp.zeros((B, H, T_loc), acc_t)
     # under the new shard_map type system fresh constants are unvarying;
     # the loop carry must already vary over the ring axis like q does
     if hasattr(jax.lax, "pcast"):
@@ -106,7 +113,9 @@ def _ring_attention_local(q, k, v, lengths, causal, axis_name):
     def block(r, o, m, l, k_blk, v_blk):
         src = (idx - r) % n                                      # block owner
         kv_pos = src * T_loc + jnp.arange(T_loc)
-        s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk) * scale
+        s = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, k_blk, preferred_element_type=acc_t
+        ) * scale
         mask = jnp.ones((T_loc, T_loc), bool)
         if causal:
             mask = kv_pos[None, :] <= q_pos[:, None]
@@ -119,19 +128,35 @@ def _ring_attention_local(q, k, v, lengths, causal, axis_name):
         p = jnp.exp(s - m_new[..., None])
         p = jnp.where(mask, p, 0.0)                              # kill _NEG rows exactly
         l = l * alpha + jnp.sum(p, axis=-1)
-        o = o * alpha[..., None] + jnp.einsum("bhqk,bkhd->bhqd", p, v_blk)
+        o = o * alpha[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, v_blk, preferred_element_type=acc_t
+        )
         return o, m_new, l
 
-    # unrolled ring (n is static under shard_map): no permute after the
-    # last block, and XLA can overlap each ppermute with the next matmul
-    o, m, l = o0, m0, l0
-    k_blk, v_blk = k, v
-    for r in range(n):
-        o, m, l = block(r, o, m, l, k_blk, v_blk)
-        if r != n - 1:
+    if n <= 8:
+        # unrolled ring (n is static under shard_map): no permute after the
+        # last block, and XLA can overlap each ppermute with the next matmul
+        o, m, l = o0, m0, l0
+        k_blk, v_blk = k, v
+        for r in range(n):
+            o, m, l = block(r, o, m, l, k_blk, v_blk)
+            if r != n - 1:
+                k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+                v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+    else:
+        # large rings (e.g. 64-chip seq axis): roll the ring with lax.scan
+        # so compile time and program size stay O(1) in n
+        def body(carry, r):
+            o, m, l, k_blk, v_blk = carry
+            o, m, l = block(r, o, m, l, k_blk, v_blk)
             k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
             v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+            return (o, m, l, k_blk, v_blk), None
+        (o, m, l, _, _), _ = jax.lax.scan(
+            body, (o0, m0, l0, k, v), jnp.arange(n)
+        )
     o = o / jnp.maximum(l[..., None], 1e-20)
+    o = o.astype(q.dtype)
     return jnp.transpose(o, (0, 2, 1, 3))                        # [B, T_loc, H, D]
 
 

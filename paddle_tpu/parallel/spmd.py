@@ -63,7 +63,16 @@ def _opt_state_sharding(mesh: Mesh, param_shards: Dict[str, NamedSharding], opt_
         if opt_state.avg_sum is not None
         else None
     )
-    return UpdaterState(step=repl, num_samples=repl, slots=slots, avg_sum=avg, avg_count=repl)
+    avg_old = (
+        {name: param_shards.get(name, repl) for name in opt_state.avg_old_sum}
+        if opt_state.avg_old_sum is not None
+        else None
+    )
+    return UpdaterState(
+        step=repl, num_samples=repl, slots=slots, avg_sum=avg, avg_count=repl,
+        avg_old_sum=avg_old,
+        avg_old_count=repl if opt_state.avg_old_count is not None else None,
+    )
 
 
 def _batch_tree_sharding(mesh: Mesh, batch) -> Any:

@@ -63,10 +63,20 @@ def save_checkpoint(
         np.savez(os.path.join(path, "optimizer_slots.npz"), **_flatten(opt_state.slots))
         if opt_state.avg_sum is not None:
             np.savez(os.path.join(path, "optimizer_avg.npz"), **_flatten(opt_state.avg_sum))
+        if opt_state.avg_old_sum is not None:
+            np.savez(
+                os.path.join(path, "optimizer_avg_old.npz"),
+                **_flatten(opt_state.avg_old_sum),
+            )
         meta["optimizer"] = {
             "step": int(opt_state.step),
             "num_samples": float(opt_state.num_samples),
             "avg_count": float(opt_state.avg_count),
+            "avg_old_count": (
+                float(opt_state.avg_old_count)
+                if opt_state.avg_old_count is not None
+                else 0.0
+            ),
         }
     if extra_meta:
         meta.update(extra_meta)
@@ -133,12 +143,19 @@ def load_checkpoint(
         if avg_sum is not None and os.path.exists(avg_path):
             with np.load(avg_path) as z:
                 avg_sum = {k: jnp.asarray(z[k]) for k in z.files}
+        avg_old_sum = opt_template.avg_old_sum
+        avg_old_path = os.path.join(path, "optimizer_avg_old.npz")
+        if avg_old_sum is not None and os.path.exists(avg_old_path):
+            with np.load(avg_old_path) as z:
+                avg_old_sum = {k: jnp.asarray(z[k]) for k in z.files}
         opt_state = UpdaterState(
             step=jnp.asarray(om.get("step", 0), jnp.int32),
             num_samples=jnp.asarray(om.get("num_samples", 0.0), jnp.float32),
             slots={k: {s: jnp.asarray(v) for s, v in d.items()} for k, d in slots.items()},
             avg_sum=avg_sum,
             avg_count=jnp.asarray(om.get("avg_count", 0.0), jnp.float32),
+            avg_old_sum=avg_old_sum,
+            avg_old_count=jnp.asarray(om.get("avg_old_count", 0.0), jnp.float32),
         )
     logger.info("loaded checkpoint %s", path)
     return params, opt_state, meta

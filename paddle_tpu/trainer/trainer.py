@@ -221,7 +221,18 @@ class Trainer:
                 self.params, self.opt_state, loss, outputs = self.train_step(
                     self.params, self.opt_state, batch, step_rng, jnp.asarray(float(n))
                 )
-            stats.add(float(loss) * n, n)
+            loss_f = float(loss)
+            if not np.isfinite(loss_f):
+                # FP trap role (ref: feenableexcept(FE_INVALID|FE_DIVBYZERO|
+                # FE_OVERFLOW), TrainerMain.cpp:96): a NaN/Inf must abort the
+                # run, not train on silently. loss is already read back to the
+                # host each batch, so this check costs nothing extra.
+                raise FloatingPointError(
+                    f"non-finite loss ({loss_f}) at pass {pass_id} batch "
+                    f"{batch_id} — aborting. Try --job=checkgrad, a lower "
+                    "learning rate, or gradient clipping to locate the cause."
+                )
+            stats.add(loss_f * n, n)
             evaluators.eval_batch(outputs)
             batch_id += 1
             if self.flags.dot_period and batch_id % self.flags.dot_period == 0:

@@ -1,0 +1,100 @@
+"""Backend hardening for driver-facing entry points.
+
+The environment may pre-register an accelerator PJRT plugin (e.g. an
+'axon' TPU tunnel) via sitecustomize at interpreter start. When that
+backend is unavailable, any jax call that initializes backends either
+raises UNAVAILABLE or hangs — which is how the round-1 driver gates
+failed. This module gives every entry point (tests, bench, dryrun) one
+defensive routine: force the CPU platform with N virtual devices and
+drop non-CPU backend factories *before* any backend initializes, even
+if jax was already imported (sitecustomize imports it too early for
+env vars alone to work).
+
+Role analog in the reference: the CPU-only stub build
+(/root/reference/paddle/cuda/include/stub/) that lets everything run
+without accelerators.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def ensure_cpu_mesh(n_devices: int = 8) -> None:
+    """Force jax onto the CPU platform with >= n_devices virtual devices.
+
+    Safe to call multiple times; safe whether or not jax backends have
+    already initialized (re-initializes them if the current platform or
+    device count is wrong). Keeps the 'tpu' factory registered so
+    pallas/checkify lowering rules stay importable — it never
+    initializes under JAX_PLATFORMS=cpu.
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        flags = (flags + f" --xla_force_host_platform_device_count={n_devices}").strip()
+        os.environ["XLA_FLAGS"] = flags
+
+    import jax
+    import jax._src.xla_bridge as _xb
+
+    # jax may have been imported by sitecustomize before our env vars
+    # were set — override the already-read config directly.
+    jax.config.update("jax_platforms", "cpu")
+
+    for _name in list(_xb._backend_factories):
+        if _name not in ("cpu", "tpu"):
+            del _xb._backend_factories[_name]
+
+    try:
+        devices = jax.devices()
+    except Exception:
+        devices = []
+    if len(devices) < n_devices or any(d.platform != "cpu" for d in devices):
+        # Backends initialized before the guard (wrong platform or too few
+        # virtual devices) — drop them and re-initialize under the forced
+        # config. Best-effort: _clear_backends is internal but stable.
+        os.environ["XLA_FLAGS"] = _with_device_count(flags, n_devices)
+        try:
+            _xb._clear_backends()
+        except Exception:
+            pass
+        devices = jax.devices()
+    if len(devices) < n_devices:
+        raise RuntimeError(
+            f"backend guard could not provision {n_devices} CPU devices; "
+            f"got {devices}"
+        )
+
+
+def _with_device_count(flags: str, n: int) -> str:
+    parts = [p for p in flags.split() if "xla_force_host_platform_device_count" not in p]
+    parts.append(f"--xla_force_host_platform_device_count={n}")
+    return " ".join(parts)
+
+
+def probe_backend(timeout_s: float = 180.0) -> str:
+    """Report which jax backend a fresh process can actually initialize.
+
+    Runs the probe in a subprocess so a hanging accelerator plugin (the
+    round-1 failure mode: axon tunnel up but chip unreachable) cannot
+    wedge the caller. Returns the backend platform name ('tpu', 'cpu',
+    ...) on success, or 'cpu' if init fails or exceeds timeout_s.
+    """
+    import subprocess
+    import sys
+
+    code = "import jax; print(jax.default_backend())"
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return "cpu"
+    if out.returncode != 0:
+        return "cpu"
+    backend = out.stdout.strip().splitlines()[-1] if out.stdout.strip() else ""
+    return backend or "cpu"

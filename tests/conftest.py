@@ -5,33 +5,21 @@ CPU-only stub build, /root/reference/paddle/cuda/include/stub/, which lets
 the whole suite run without accelerators): sharding/collective tests get 8
 devices; numerics match the TPU path because both are XLA.
 
-The environment may pre-register an accelerator PJRT plugin (e.g. the
-axon TPU tunnel) via sitecustomize and set JAX_PLATFORMS to it; tests must
-never claim the real chip, so we force the CPU platform and drop any
-non-CPU backend factories before any backend initializes.
+The backend hardening (force CPU platform, drop the pre-registered
+accelerator plugin before any backend initializes) lives in
+paddle_tpu.utils.backend_guard so the driver entry points share it.
 """
 
 import os
+import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from paddle_tpu.utils.backend_guard import ensure_cpu_mesh  # noqa: E402
+
+ensure_cpu_mesh(8)
 
 import jax  # noqa: E402
-import jax._src.xla_bridge as _xb  # noqa: E402
-
-# jax may already be imported (sitecustomize registers the accelerator
-# plugin at interpreter start), so the env var was read too early —
-# override the config directly as well.
-jax.config.update("jax_platforms", "cpu")
-
-for _name in list(_xb._backend_factories):
-    # keep "tpu" registered (never initialized under JAX_PLATFORMS=cpu;
-    # there is no local libtpu — the real chip is behind the axon plugin)
-    # so pallas/checkify can still register their tpu lowering rules
-    if _name not in ("cpu", "tpu"):
-        del _xb._backend_factories[_name]
 
 jax.config.update("jax_threefry_partitionable", True)
 
