@@ -16,10 +16,16 @@ TPU-native formulation:
 - generation: a fixed-length ``lax.scan`` over max_num_frames implementing
   batched beam search with static shapes (beam reindexing via
   take_along_axis, finished-beam masking) — the replacement for the
-  pointer-chasing beamSearch loop.
-
-Sub-sequence (nested) groups and sequence-valued memories raise
-NotImplementedError for now (tracked divergence).
+  pointer-chasing beamSearch loop. Groups with real sequence in-links
+  generate one step per input frame (per-step conditioning).
+- nested (sub-sequence) groups: the outer scan steps over SUBSEQUENCES
+  ([B, S, T, D] in-links feed [B, T, D] sequence frames, ref
+  createInFrameInfo hasSubseq branch :564); an inner recurrent group in
+  the step body scans the tokens — scan-in-scan, still one compiled step.
+- sequence-valued memories (memory(is_seq=True), ref createMemoryFrameInfo
+  :622): the carry is a whole padded sequence (value, lengths), booted
+  from a sequence layer, so step s can read step s-1's full output
+  sequence (hierarchical RNN decoders).
 """
 
 from __future__ import annotations
@@ -114,10 +120,20 @@ def _run_submodel_step(
         dtype=ctx.dtype,
         mesh=ctx.mesh,
     )
+    # outer-scope outputs stay visible so an inner group's static links /
+    # memory boot layers can reference layers outside this group (fed agent
+    # outputs take precedence; group-internal names are globally unique so
+    # nothing in sub.layer_names can be shadowed by a parent output)
+    step_ctx.outputs.update(ctx.outputs)
     step_ctx.outputs.update(fed)
     for name in sub.layer_names:
         lcfg = network.layer_map[name]
         if lcfg.name in step_ctx.outputs:
+            continue
+        if lcfg.type == "recurrent_layer_group":
+            # nested group: the inner executor scans the tokens of this
+            # step's subsequence (scan-in-scan)
+            forward_recurrent_group(network, lcfg, step_ctx)
             continue
         ins = [
             network._lookup_input(step_ctx, ic.input_layer_name, ic.input_layer_argument)
@@ -132,79 +148,171 @@ def _run_submodel_step(
     return step_ctx.outputs
 
 
-def _forward_scan(network, cfg: LayerConfig, sub: SubModelConfig, ctx: LayerContext) -> None:
-    for link in sub.in_links:
-        if link.has_subseq:
-            raise NotImplementedError("nested (sub-sequence) recurrent groups not yet supported")
-    assert sub.in_links, f"recurrent group {cfg.name} has no sequence inputs"
-    first = ctx.outputs[sub.in_links[0].layer_name]
-    assert first.is_seq, f"in-link {sub.in_links[0].layer_name!r} is not a sequence"
-    lengths = first.seq_lengths
-    B, T = first.batch_size, first.max_len
-    mask_bt = first.seq_mask()  # [B, T]
+def _pad_time(x: Array, T: int) -> Array:
+    """Pad or slice axis 1 to exactly T (static shapes for scan carries)."""
+    if x.shape[1] == T:
+        return x
+    if x.shape[1] > T:
+        return jax.lax.slice_in_dim(x, 0, T, axis=1)
+    pad = [(0, 0), (0, T - x.shape[1])] + [(0, 0)] * (x.ndim - 2)
+    return jnp.pad(x, pad)
 
-    # time-major stacked in-link slices
+
+def _memory_boot_seq(network, mem, ctx: LayerContext, sub: SubModelConfig):
+    """Boot a sequence-valued memory (createMemoryFrameInfo seqFlag branch,
+    ref RecurrentGradientMachine.cpp:622): the boot layer MUST be a
+    sequence; the carry is its padded (value-or-ids, lengths) pair."""
+    assert mem.boot_layer_name, (
+        f"sequence memory for {mem.layer_name!r} needs a sequence boot layer "
+        "(reference: 'boot layer must be a sequence when is_sequence = true')"
+    )
+    boot = ctx.outputs[_resolve_outer(sub, mem.boot_layer_name)]
+    assert boot.is_seq, (
+        f"boot layer {mem.boot_layer_name!r} of sequence memory is not a sequence"
+    )
+    v = boot.value if boot.value is not None else boot.ids
+    return (v, boot.seq_lengths)
+
+
+def _forward_scan(network, cfg: LayerConfig, sub: SubModelConfig, ctx: LayerContext) -> None:
+    assert sub.in_links, f"recurrent group {cfg.name} has no sequence inputs"
+    nested = any(link.has_subseq for link in sub.in_links)
+    if nested:
+        # outer scan over SUBSEQUENCES: [B, S, T, ...] in-links feed
+        # [B, T, ...] sequence frames (createInFrameInfo hasSubseq :564)
+        ref_link = next(l for l in sub.in_links if l.has_subseq)
+        first = ctx.outputs[ref_link.layer_name]
+        assert first.is_nested_seq, (
+            f"in-link {ref_link.layer_name!r} marked has_subseq but is not nested"
+        )
+    else:
+        first = ctx.outputs[sub.in_links[0].layer_name]
+        assert first.is_seq, f"in-link {sub.in_links[0].layer_name!r} is not a sequence"
+    lengths = first.seq_lengths          # [B]: valid timesteps / subsequences
+    B, T = first.batch_size, first.max_len
+    mask_bt = first.seq_mask()           # [B, T] (T = S for nested groups)
+
+    # time-major stacked in-link slices; nested links also stack their
+    # per-subsequence lengths so each frame is a real sequence Argument
     xs_vals: Dict[str, Array] = {}
     xs_ids: Dict[str, Array] = {}
+    xs_sublens: Dict[str, Array] = {}
     for link in sub.in_links:
         arg = ctx.outputs[link.layer_name]
         if arg.value is not None:
-            xs_vals[link.link_name] = jnp.swapaxes(arg.value, 0, 1)  # [T, B, D]
+            xs_vals[link.link_name] = jnp.swapaxes(arg.value, 0, 1)
         if arg.ids is not None:
-            xs_ids[link.link_name] = jnp.swapaxes(arg.ids, 0, 1)  # [T, B]
+            xs_ids[link.link_name] = jnp.swapaxes(arg.ids, 0, 1)
+        if link.has_subseq:
+            assert arg.sub_seq_lengths is not None
+            xs_sublens[link.link_name] = jnp.swapaxes(arg.sub_seq_lengths, 0, 1)  # [S, B]
 
     statics: Dict[str, Argument] = {
         link.link_name: ctx.outputs[link.layer_name] for link in sub.static_links
     }
 
     memories = list(sub.memories)
-    for mem in memories:
-        if mem.is_sequence:
-            raise NotImplementedError("sequence-valued memories not yet supported")
     # carry dtype must match the traced computation (x64 gradient checks
     # promote everything), so follow the data rather than ctx.dtype
     carry_dtype = first.value.dtype if first.value is not None else ctx.dtype
-    init_carries = tuple(
-        _memory_boot(network, mem, ctx, B, carry_dtype, sub) for mem in memories
-    )
+    init_carries = []
+    seq_mem_T: Dict[int, int] = {}
+    for i, mem in enumerate(memories):
+        if mem.is_sequence:
+            v, sl = _memory_boot_seq(network, mem, ctx, sub)
+            seq_mem_T[i] = v.shape[1]
+            init_carries.append((v, sl))
+        else:
+            init_carries.append(_memory_boot(network, mem, ctx, B, carry_dtype, sub))
+    init_carries = tuple(init_carries)
     out_links = list(sub.out_links)
     base_rng = ctx.rng
 
     def step(carries, inp):
-        x_v, x_i, m_t, t_idx = inp
+        x_v, x_i, x_sl, m_t, t_idx = inp
         fed: Dict[str, Argument] = {}
-        for name, v in x_v.items():
-            fed[name] = Argument(value=v, ids=x_i.get(name))
-        for name, i in x_i.items():
-            if name not in fed:
-                fed[name] = Argument(ids=i)
+        for link in sub.in_links:
+            name = link.link_name
+            fed[name] = Argument(
+                value=x_v.get(name),
+                ids=x_i.get(name),
+                seq_lengths=x_sl.get(name),
+            )
         for name, arg in statics.items():
             fed[name] = arg
-        for mem, carry in zip(memories, carries):
-            fed[mem.link_name] = _carry_to_arg(carry)
+        for i, (mem, carry) in enumerate(zip(memories, carries)):
+            if mem.is_sequence:
+                v, sl = carry
+                fed[mem.link_name] = (
+                    Argument(ids=v, seq_lengths=sl)
+                    if _is_int_carry(v)
+                    else Argument(value=v, seq_lengths=sl)
+                )
+            else:
+                fed[mem.link_name] = _carry_to_arg(carry)
         rng = jax.random.fold_in(base_rng, t_idx) if base_rng is not None else None
         outs = _run_submodel_step(network, sub, ctx, fed, rng)
         new_carries = []
         m = m_t[:, None]
-        for mem, old in zip(memories, carries):
+        for i, (mem, old) in enumerate(zip(memories, carries)):
             out_arg = outs[mem.layer_name]
-            new = out_arg.value if not _is_int_carry(old) else out_arg.ids
-            keep = m > 0 if new.ndim == 2 else m_t > 0
-            new_carries.append(jnp.where(keep, new, old))
-        ys = tuple(outs[l.layer_name].value * m for l in out_links)
-        return tuple(new_carries), ys
+            if mem.is_sequence:
+                old_v, old_l = old
+                Tm = seq_mem_T[i]
+                new_v = out_arg.ids if _is_int_carry(old_v) else out_arg.value
+                assert new_v.ndim == old_v.ndim, (
+                    f"sequence memory {mem.layer_name!r}: linked layer must "
+                    "produce a sequence frame"
+                )
+                new_v = _pad_time(new_v, Tm)
+                if out_arg.seq_lengths is not None:
+                    new_l = jnp.minimum(out_arg.seq_lengths, Tm)
+                else:
+                    new_l = jnp.full((B,), Tm, jnp.int32)
+                keep = m_t > 0
+                keep_v = keep.reshape((B,) + (1,) * (new_v.ndim - 1))
+                new_carries.append(
+                    (jnp.where(keep_v, new_v, old_v), jnp.where(keep, new_l, old_l))
+                )
+            else:
+                new = out_arg.value if not _is_int_carry(old) else out_arg.ids
+                keep = m > 0 if new.ndim == 2 else m_t > 0
+                new_carries.append(jnp.where(keep, new, old))
+        ys = []
+        for l in out_links:
+            out_arg = outs[l.layer_name]
+            if out_arg.value.ndim >= 3 and out_arg.seq_lengths is not None:
+                # sequence frame (inner-group output): nested result
+                ys.append(
+                    (
+                        out_arg.value * m_t[:, None, None],
+                        (out_arg.seq_lengths * m_t.astype(jnp.int32)),
+                    )
+                )
+            else:
+                ys.append((out_arg.value * m, None))
+        return tuple(new_carries), tuple(ys)
 
     xs = (
         xs_vals,
         xs_ids,
+        xs_sublens,
         jnp.swapaxes(mask_bt, 0, 1),
         jnp.arange(T, dtype=jnp.int32),
     )
     _, ys = jax.lax.scan(step, init_carries, xs, reverse=bool(sub.reversed))
-    for link, y in zip(out_links, ys):
-        ctx.outputs[link.link_name] = Argument(
-            value=jnp.swapaxes(y, 0, 1), seq_lengths=lengths
-        )
+    for link, (y, y_lens) in zip(out_links, ys):
+        if y_lens is not None:
+            # [S, B, T, D] → nested [B, S, T, D] with per-subseq lengths
+            ctx.outputs[link.link_name] = Argument(
+                value=jnp.swapaxes(y, 0, 1),
+                seq_lengths=lengths,
+                sub_seq_lengths=jnp.swapaxes(y_lens, 0, 1),
+            )
+        else:
+            ctx.outputs[link.link_name] = Argument(
+                value=jnp.swapaxes(y, 0, 1), seq_lengths=lengths
+            )
     # the group layer itself exposes the first out-link
     if out_links:
         ctx.outputs[cfg.name] = ctx.outputs[out_links[0].link_name]
@@ -244,6 +352,40 @@ def _generate(network, cfg: LayerConfig, sub: SubModelConfig, ctx: LayerContext)
         arg = ctx.outputs[link.layer_name]
         statics[link.link_name] = _expand_beams(arg, K)
         B = arg.batch_size if B is None else B
+    # real sequence in-links: generation consumes one input frame per step
+    # (per-step conditioning — each generated token sees x_t next to the
+    # fed-back embedding; sequence length follows the input)
+    in_xs_v: Dict[str, Array] = {}
+    in_xs_i: Dict[str, Array] = {}
+    in_lengths = None
+    L_in = None
+    for link in sub.in_links:
+        if link.has_subseq:
+            raise NotImplementedError(
+                f"generation group {cfg.name}: nested in-links unsupported"
+            )
+        arg = ctx.outputs[link.layer_name]
+        assert arg.is_seq, (
+            f"generation in-link {link.layer_name!r} must be a sequence "
+            "(wrap whole-sequence conditions in StaticInput(..., is_seq=True))"
+        )
+        B = arg.batch_size if B is None else B
+        L_in = arg.max_len if L_in is None else min(L_in, arg.max_len)
+        # generation ends at the SHORTEST in-link per sample — a longer
+        # link's frames past that point would be padding, not conditioning
+        in_lengths = (
+            arg.seq_lengths
+            if in_lengths is None
+            else jnp.minimum(in_lengths, arg.seq_lengths)
+        )
+        ex = _expand_beams(arg, K)  # [B*K, T, ...]
+        if ex.value is not None:
+            in_xs_v[link.link_name] = jnp.swapaxes(ex.value, 0, 1)  # [T, B*K, D]
+        if ex.ids is not None:
+            in_xs_i[link.link_name] = jnp.swapaxes(ex.ids, 0, 1)
+    if L_in is not None:
+        L = min(L, L_in)
+
     memories = list(sub.memories)
     boots = []
     for mem in memories:
@@ -257,6 +399,10 @@ def _generate(network, cfg: LayerConfig, sub: SubModelConfig, ctx: LayerContext)
         if arg.value is not None:
             gen_dtype = arg.value.dtype
             break
+    if gen_dtype == ctx.dtype:
+        for v in in_xs_v.values():
+            gen_dtype = v.dtype
+            break
     for mem in memories:
         boots.append(_memory_boot(network, mem, ctx, B, gen_dtype, sub))
     # expand memories across beams: [B, D] → [B*K, D]
@@ -264,12 +410,6 @@ def _generate(network, cfg: LayerConfig, sub: SubModelConfig, ctx: LayerContext)
         jnp.repeat(b, K, axis=0) for b in boots
     )
 
-    if sub.in_links:
-        raise NotImplementedError(
-            f"generation group {cfg.name}: plain sequence inputs are not "
-            "supported during generation — wrap encoder outputs in "
-            "StaticInput(..., is_seq=True)"
-        )
     # the feed agent for previously generated ids (created by beam_search())
     predict_agent = f"__generated_id@{cfg.name}"
     assert predict_agent in network.layer_map, "generation group missing the generated-id agent"
@@ -290,9 +430,14 @@ def _generate(network, cfg: LayerConfig, sub: SubModelConfig, ctx: LayerContext)
     )
     base_rng = ctx.rng
 
-    def step(state, t_idx):
+    def step(state, inp):
+        t_idx, x_v, x_i = inp
         carries, prev_tok, cum, finished, history, lens = state
         fed: Dict[str, Argument] = {predict_agent: Argument(ids=prev_tok)}
+        for link in sub.in_links:
+            fed[link.link_name] = Argument(
+                value=x_v.get(link.link_name), ids=x_i.get(link.link_name)
+            )
         for name, arg in statics.items():
             fed[name] = arg
         for mem, carry in zip(memories, carries):
@@ -333,6 +478,10 @@ def _generate(network, cfg: LayerConfig, sub: SubModelConfig, ctx: LayerContext)
         history = history.at[:, :, t_idx].set(jnp.where(finished, eos, token))
         lens = jnp.where(finished, lens, lens + 1)
         finished = finished | (token == eos)
+        if in_lengths is not None:
+            # real in-links bound the generation: a sequence ends with its
+            # last input frame even without eos
+            finished = finished | ((t_idx + 1) >= in_lengths[:, None])
         return (
             new_carries,
             token.reshape(-1),
@@ -342,7 +491,12 @@ def _generate(network, cfg: LayerConfig, sub: SubModelConfig, ctx: LayerContext)
             lens,
         ), None
 
-    state, _ = jax.lax.scan(step, init_state, jnp.arange(L, dtype=jnp.int32))
+    xs = (
+        jnp.arange(L, dtype=jnp.int32),
+        {k: v[:L] for k, v in in_xs_v.items()},
+        {k: v[:L] for k, v in in_xs_i.items()},
+    )
+    state, _ = jax.lax.scan(step, init_state, xs)
     _, _, scores, finished, history, lens = state
     # best beam per sample (beams are kept sorted by top_k, but normalize
     # defensively by picking argmax score)

@@ -173,6 +173,11 @@ class Updater:
         ids drop out of bounds)."""
         V = w.shape[0]
         uid, g_rows, valid = dedupe(sg.ids, sg.rows.reshape(sg.ids.shape[0], -1), V)
+        # rows whose aggregate gradient is exactly zero (e.g. ids at padded
+        # positions) stay frozen, matching the dense path's any(g != 0)
+        # touched-row detection and the reference's sparse semantics
+        valid = valid & jnp.any(g_rows != 0, axis=1)
+        uid = jnp.where(valid, uid, V)
         if clip and clip > 0:  # clip the aggregated gradient, as the dense path does
             g_rows = jnp.clip(g_rows, -clip, clip)
         uid_c = jnp.minimum(uid, V - 1)               # safe gather index

@@ -145,16 +145,18 @@ def _ring_attention_local(q, k, v, lengths, causal, axis_name):
                 v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
     else:
         # large rings (e.g. 64-chip seq axis): roll the ring with lax.scan
-        # so compile time and program size stay O(1) in n
+        # so compile time and program size stay O(1) in n; the last block
+        # runs outside the loop so no wasted trailing ppermute
         def body(carry, r):
             o, m, l, k_blk, v_blk = carry
             o, m, l = block(r, o, m, l, k_blk, v_blk)
             k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
             v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
             return (o, m, l, k_blk, v_blk), None
-        (o, m, l, _, _), _ = jax.lax.scan(
-            body, (o0, m0, l0, k, v), jnp.arange(n)
+        (o, m, l, k_blk, v_blk), _ = jax.lax.scan(
+            body, (o0, m0, l0, k, v), jnp.arange(n - 1)
         )
+        o, m, l = block(n - 1, o, m, l, k_blk, v_blk)
     o = o / jnp.maximum(l[..., None], 1e-20)
     o = o.astype(q.dtype)
     return jnp.transpose(o, (0, 2, 1, 3))                        # [B, T_loc, H, D]

@@ -1,0 +1,325 @@
+"""Nested recurrent groups, sequence-valued memories, generation in-links.
+
+Mirrors the reference's hierarchical-RNN equivalence tests
+(/root/reference/paddle/gserver/tests/test_RecurrentGradientMachine.cpp,
+sequence_nest_rnn.conf vs sequence_rnn.conf): an outer group stepping over
+subsequences with an inner RNN group must match the flat RNN run over each
+subsequence as an independent sequence; sequence memories
+(createMemoryFrameInfo seqFlag, RecurrentGradientMachine.cpp:622) carry a
+whole sequence between outer steps; generation with real sequence
+in-links consumes one input frame per step.
+"""
+
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.config import parse_config
+from paddle_tpu.graph import GradientMachine, make_seq
+from paddle_tpu.graph.argument import Argument
+
+
+def parse_str(src: str):
+    import os
+    import tempfile
+
+    with tempfile.NamedTemporaryFile("w", suffix=".py", delete=False) as f:
+        f.write(textwrap.dedent(src))
+        path = f.name
+    try:
+        return parse_config(path)
+    finally:
+        os.unlink(path)
+
+
+D, H = 5, 6
+
+FLAT_RNN = """
+from paddle_tpu.trainer_config_helpers import *
+settings(batch_size=4, learning_rate=1e-3)
+x = data_layer(name="x", size=5)
+def rnn_step(y):
+    mem = memory(name="rnn_out", size=6)
+    return mixed_layer(name="rnn_out", size=6, act=TanhActivation(), bias_attr=False,
+        input=[full_matrix_projection(y, param_attr=ParamAttr(name="w_x")),
+               full_matrix_projection(mem, param_attr=ParamAttr(name="w_h"))])
+out = recurrent_group(step=rnn_step, input=x, name="flat_rnn")
+outputs(out)
+"""
+
+NEST_RNN = """
+from paddle_tpu.trainer_config_helpers import *
+settings(batch_size=4, learning_rate=1e-3)
+x = data_layer(name="x", size=5)
+def rnn_step(y):
+    mem = memory(name="rnn_out", size=6)
+    return mixed_layer(name="rnn_out", size=6, act=TanhActivation(), bias_attr=False,
+        input=[full_matrix_projection(y, param_attr=ParamAttr(name="w_x")),
+               full_matrix_projection(mem, param_attr=ParamAttr(name="w_h"))])
+def outer_step(sub):
+    return recurrent_group(step=rnn_step, input=sub, name="inner_rnn")
+out = recurrent_group(step=outer_step, input=SubsequenceInput(x), name="outer")
+outputs(out)
+"""
+
+
+def test_nested_rnn_matches_flat():
+    """Outer-group-over-subsequences + inner RNN == flat RNN on each
+    subsequence as its own sequence (the reference equivalence test)."""
+    B, S, T = 2, 3, 4
+    rng = np.random.RandomState(0)
+    x_nest = rng.randn(B, S, T, D).astype(np.float32)
+    n_subs = np.array([3, 2], np.int32)            # sample 1 has 2 subseqs
+    sub_lens = np.array([[4, 2, 3], [1, 4, 0]], np.int32)
+
+    tc_n = parse_str(NEST_RNN)
+    gm_n = GradientMachine(tc_n.model_config)
+    params = gm_n.init_params(seed=3)
+    batch_n = {
+        "x": Argument(
+            value=jnp.asarray(x_nest),
+            seq_lengths=jnp.asarray(n_subs),
+            sub_seq_lengths=jnp.asarray(sub_lens),
+        )
+    }
+    out_n, _ = gm_n.forward(params, batch_n, "test")
+    nested = np.asarray(out_n["outer"].value)      # [B, S, T, H]
+    assert out_n["outer"].sub_seq_lengths is not None
+
+    # flat run: every VALID subsequence as an independent sequence
+    pairs = [(b, s) for b in range(B) for s in range(n_subs[b])]
+    x_flat = np.stack([x_nest[b, s] for b, s in pairs])          # [N, T, D]
+    l_flat = np.array([sub_lens[b, s] for b, s in pairs], np.int32)
+    tc_f = parse_str(FLAT_RNN)
+    gm_f = GradientMachine(tc_f.model_config)
+    params_f = gm_f.init_params(seed=4)
+    for k in ("w_x", "w_h"):
+        params_f[k] = params[k]
+    out_f, _ = gm_f.forward(params_f, {"x": make_seq(jnp.asarray(x_flat), jnp.asarray(l_flat))}, "test")
+    flat = np.asarray(out_f["flat_rnn"].value)     # [N, T, H]
+
+    for i, (b, s) in enumerate(pairs):
+        l = int(sub_lens[b, s])
+        np.testing.assert_allclose(
+            nested[b, s, :l], flat[i, :l], rtol=2e-5, atol=1e-6,
+            err_msg=f"subseq {(b, s)}",
+        )
+    # invalid outer steps are masked to zero
+    np.testing.assert_array_equal(nested[1, 2], 0.0)
+
+
+FLAT_RNN_STATIC = """
+from paddle_tpu.trainer_config_helpers import *
+settings(batch_size=4, learning_rate=1e-3)
+x = data_layer(name="x", size=5)
+z = data_layer(name="z", size=4)
+enc = fc_layer(input=z, size=6, act=TanhActivation(), name="enc",
+               param_attr=ParamAttr(name="w_z"), bias_attr=False)
+def rnn_step(y, c):
+    mem = memory(name="rnn_out", size=6)
+    return mixed_layer(name="rnn_out", size=6, act=TanhActivation(), bias_attr=False,
+        input=[full_matrix_projection(y, param_attr=ParamAttr(name="w_x")),
+               full_matrix_projection(mem, param_attr=ParamAttr(name="w_h")),
+               full_matrix_projection(c, param_attr=ParamAttr(name="w_c"))])
+out = recurrent_group(step=rnn_step, input=[x, StaticInput(enc)], name="flat_rnn")
+outputs(out)
+"""
+
+NEST_RNN_STATIC = """
+from paddle_tpu.trainer_config_helpers import *
+settings(batch_size=4, learning_rate=1e-3)
+x = data_layer(name="x", size=5)
+z = data_layer(name="z", size=4)
+enc = fc_layer(input=z, size=6, act=TanhActivation(), name="enc",
+               param_attr=ParamAttr(name="w_z"), bias_attr=False)
+def rnn_step(y, c):
+    mem = memory(name="rnn_out", size=6)
+    return mixed_layer(name="rnn_out", size=6, act=TanhActivation(), bias_attr=False,
+        input=[full_matrix_projection(y, param_attr=ParamAttr(name="w_x")),
+               full_matrix_projection(mem, param_attr=ParamAttr(name="w_h")),
+               full_matrix_projection(c, param_attr=ParamAttr(name="w_c"))])
+def outer_step(sub):
+    return recurrent_group(step=rnn_step, input=[sub, StaticInput(enc)],
+                           name="inner_rnn")
+out = recurrent_group(step=outer_step, input=SubsequenceInput(x), name="outer")
+outputs(out)
+"""
+
+
+def test_inner_group_reads_outer_scope_static():
+    """An inner group's StaticInput can reference a layer OUTSIDE the outer
+    group (an encoder in root scope) — the hierarchical-decoder shape."""
+    B, S, T = 2, 2, 3
+    rng = np.random.RandomState(4)
+    x_nest = rng.randn(B, S, T, D).astype(np.float32)
+    z = rng.randn(B, 4).astype(np.float32)
+    sub_lens = np.array([[3, 2], [1, 3]], np.int32)
+    n_subs = np.full((B,), S, np.int32)
+
+    tc_n = parse_str(NEST_RNN_STATIC)
+    gm_n = GradientMachine(tc_n.model_config)
+    params = gm_n.init_params(seed=11)
+    out_n, _ = gm_n.forward(
+        params,
+        {
+            "x": Argument(
+                value=jnp.asarray(x_nest),
+                seq_lengths=jnp.asarray(n_subs),
+                sub_seq_lengths=jnp.asarray(sub_lens),
+            ),
+            "z": Argument(value=jnp.asarray(z)),
+        },
+        "test",
+    )
+    nested = np.asarray(out_n["outer"].value)
+
+    pairs = [(b, s) for b in range(B) for s in range(S)]
+    x_flat = np.stack([x_nest[b, s] for b, s in pairs])
+    z_flat = np.stack([z[b] for b, _ in pairs])
+    l_flat = np.array([sub_lens[b, s] for b, s in pairs], np.int32)
+    tc_f = parse_str(FLAT_RNN_STATIC)
+    gm_f = GradientMachine(tc_f.model_config)
+    params_f = gm_f.init_params(seed=12)
+    for k in ("w_x", "w_h", "w_c", "w_z"):
+        params_f[k] = params[k]
+    out_f, _ = gm_f.forward(
+        params_f,
+        {
+            "x": make_seq(jnp.asarray(x_flat), jnp.asarray(l_flat)),
+            "z": Argument(value=jnp.asarray(z_flat)),
+        },
+        "test",
+    )
+    flat = np.asarray(out_f["flat_rnn"].value)
+    for i, (b, s) in enumerate(pairs):
+        l = int(sub_lens[b, s])
+        np.testing.assert_allclose(
+            nested[b, s, :l], flat[i, :l], rtol=2e-5, atol=1e-6,
+            err_msg=f"subseq {(b, s)}",
+        )
+
+
+SEQ_MEM = """
+from paddle_tpu.trainer_config_helpers import *
+settings(batch_size=4, learning_rate=1e-3)
+x = data_layer(name="x", size=5)
+boot = data_layer(name="boot", size=5)
+def outer_step(sub):
+    mem = memory(name="acc", size=5, is_seq=True, boot_layer=boot)
+    return addto_layer(input=[sub, mem], name="acc", act=LinearActivation(),
+                       bias_attr=False)
+out = recurrent_group(step=outer_step, input=SubsequenceInput(x), name="nacc")
+outputs(out)
+"""
+
+
+def test_sequence_memory_carries_whole_sequence():
+    """A memory(is_seq=True) hands step s the FULL output sequence of step
+    s-1: with out = sub + mem the result is a cumulative sum over
+    subsequences."""
+    B, S, T = 2, 3, 4
+    rng = np.random.RandomState(1)
+    x = rng.randn(B, S, T, D).astype(np.float32)
+    n_subs = np.array([3, 2], np.int32)
+    sub_lens = np.full((B, S), T, np.int32)
+    sub_lens[1, 2] = 0
+    tc = parse_str(SEQ_MEM)
+    gm = GradientMachine(tc.model_config)
+    params = gm.init_params(seed=5)
+    batch = {
+        "x": Argument(
+            value=jnp.asarray(x),
+            seq_lengths=jnp.asarray(n_subs),
+            sub_seq_lengths=jnp.asarray(sub_lens),
+        ),
+        "boot": make_seq(jnp.zeros((B, T, D), jnp.float32),
+                         jnp.full((B,), T, jnp.int32)),
+    }
+    out, _ = gm.forward(params, batch, "test")
+    got = np.asarray(out["nacc"].value)            # [B, S, T, D]
+    want = np.cumsum(x, axis=1)
+    for b in range(B):
+        for s in range(n_subs[b]):
+            np.testing.assert_allclose(got[b, s], want[b, s], rtol=1e-5,
+                                       err_msg=f"step {(b, s)}")
+
+
+GEN_INLINK = """
+from paddle_tpu.trainer_config_helpers import *
+settings(batch_size=4, learning_rate=1e-3)
+src = data_layer(name="src", size=11)
+def gen_step(x_t, prev):
+    e = embedding_layer(input=x_t, size=7, name="src_emb",
+                        param_attr=ParamAttr(name="Tsrc"))
+    h = concat_layer(input=[e, prev], name="h")
+    return fc_layer(input=h, size=9, act=SoftmaxActivation(), name="scorer")
+out = beam_search(step=gen_step,
+                  input=[src, GeneratedInput(size=9, embedding_name="Tgen",
+                                             embedding_size=7)],
+                  bos_id=0, eos_id=8, beam_size=1, max_length=8, name="gen")
+"""
+
+
+def test_generation_consumes_input_frames():
+    """Generation with a real sequence in-link: one token per input frame
+    (greedy rollout reproduced in numpy)."""
+    V_in, V, E = 11, 9, 7
+    B, T = 3, 5
+    bos, eos = 0, 8
+    rng = np.random.RandomState(2)
+    src = rng.randint(0, V_in, (B, T)).astype(np.int32)
+    lens = np.array([5, 3, 4], np.int32)
+    tc = parse_str(GEN_INLINK)
+    gm = GradientMachine(tc.model_config)
+    params = gm.init_params(seed=7)
+    batch = {"src": make_seq(None, jnp.asarray(lens), ids=jnp.asarray(src))}
+    out, _ = gm.forward(params, batch, "gen")
+    got_ids = np.asarray(out["gen"].ids)
+    got_lens = np.asarray(out["gen"].seq_lengths)
+
+    Tsrc = np.asarray(params["Tsrc"])
+    Tgen = np.asarray(params["Tgen"])
+    W = np.asarray(params["_scorer.w0"])
+    b_w = np.asarray(params["_scorer.wbias"]).reshape(-1)
+    for i in range(B):
+        prev = bos
+        toks = []
+        for t in range(int(lens[i])):
+            h = np.concatenate([Tsrc[src[i, t]], Tgen[prev]])
+            logits = h @ W + b_w
+            tok = int(np.argmax(logits))
+            toks.append(tok)
+            if tok == eos:
+                break
+            prev = tok
+        assert got_lens[i] == len(toks), (i, got_lens[i], toks)
+        np.testing.assert_array_equal(got_ids[i, : len(toks)], toks)
+
+
+def test_nested_group_gradients_flow():
+    """Training through a nested group: grads exist and are finite for the
+    shared RNN weights."""
+    B, S, T = 2, 2, 3
+    rng = np.random.RandomState(3)
+    x = rng.randn(B, S, T, D).astype(np.float32)
+    tc = parse_str(NEST_RNN)
+    gm = GradientMachine(tc.model_config)
+    params = gm.init_params(seed=9)
+    batch = {
+        "x": Argument(
+            value=jnp.asarray(x),
+            seq_lengths=jnp.full((B,), S, jnp.int32),
+            sub_seq_lengths=jnp.full((B, S), T, jnp.int32),
+        )
+    }
+
+    def loss(p):
+        outs, _ = gm.forward(p, batch, "train", rng=jax.random.PRNGKey(0))
+        return jnp.sum(outs["outer"].value ** 2)
+
+    grads = jax.grad(loss)(params)
+    for k in ("w_x", "w_h"):
+        g = np.asarray(grads[k])
+        assert np.isfinite(g).all() and np.abs(g).sum() > 0, k

@@ -87,6 +87,33 @@ def test_indexed_matches_dense_row_scan():
             )
 
 
+def test_zero_aggregate_rows_stay_frozen():
+    """Ids whose summed gradient is exactly zero (padded positions) must
+    not advance the row or its optimizer state — matching the dense
+    path's any(g != 0) touched-row detection."""
+    V, D = 8, 3
+    upd = _updater("adam", 0.0, V, D)
+    w0 = jnp.asarray(np.random.RandomState(3).randn(V, D).astype(np.float32))
+    params = {"emb": w0}
+    state = upd.init_state(params)
+    # row 2: two occurrences that cancel exactly; row 5: zero rows only
+    ids = jnp.asarray([2, 5, 2, 1], jnp.int32)
+    rows = jnp.asarray(
+        [[1.0, 2.0, 3.0], [0.0, 0.0, 0.0], [-1.0, -2.0, -3.0], [0.5, 0.5, 0.5]],
+        jnp.float32,
+    )
+    sg = RowSparseGrad(ids=ids, rows=rows, nrows=V)
+    params, state = jax.jit(upd)(params, {"emb": sg}, state, 2.0)
+    w = np.asarray(params["emb"])
+    np.testing.assert_array_equal(w[2], np.asarray(w0)[2])
+    np.testing.assert_array_equal(w[5], np.asarray(w0)[5])
+    assert not np.allclose(w[1], np.asarray(w0)[1])
+    t_last = np.asarray(state.slots["emb"]["t_last"])
+    np.testing.assert_array_equal(t_last, [0, 1, 0, 0, 0, 0, 0, 0])
+    m = np.asarray(state.slots["emb"]["m"])
+    assert (m[[2, 5]] == 0).all() and (m[1] != 0).any()
+
+
 def _emb_model(V, D, classes=3, sparse=True):
     with fresh_context() as ctx:
         settings(batch_size=4, learning_rate=0.05)
