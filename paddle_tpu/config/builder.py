@@ -114,9 +114,9 @@ class ConfigContext:
             self.root_submodel = root
         return self.root_submodel
 
-    def begin_submodel(self, name: str) -> SubModelConfig:
+    def begin_submodel(self, name: str, recurrent: bool = True) -> SubModelConfig:
         self.ensure_root_submodel()
-        sub = SubModelConfig(name=name, is_recurrent_layer_group=True)
+        sub = SubModelConfig(name=name, is_recurrent_layer_group=recurrent)
         self.model.sub_models.append(sub)
         self.submodel_stack.append(sub)
         return sub
@@ -134,19 +134,26 @@ class ConfigContext:
     # ------------------------------------------------------------ inputs
 
     def mark_input(self, name: str) -> None:
-        names = (
-            self.submodel_stack[-1].input_layer_names
-            if self.submodel_stack
-            else self.model.input_layer_names
-        )
-        if name not in names:
-            names.append(name)
+        if self.submodel_stack:
+            sub = self.submodel_stack[-1]
+            if name not in sub.input_layer_names:
+                sub.input_layer_names.append(name)
+            if sub.is_recurrent_layer_group:
+                return
+            # plain (multi_nn) sub-network inputs are fed from the data
+            # provider like root inputs — fall through
+        if name not in self.model.input_layer_names:
+            self.model.input_layer_names.append(name)
 
     def mark_output(self, name: str) -> None:
         if self.submodel_stack:
             sub = self.submodel_stack[-1]
             if name not in sub.output_layer_names:
                 sub.output_layer_names.append(name)
+            if sub.is_recurrent_layer_group:
+                return
+            if name not in self.model.output_layer_names:
+                self.model.output_layer_names.append(name)
         else:
             if name not in self.model.output_layer_names:
                 self.model.output_layer_names.append(name)
@@ -162,8 +169,14 @@ class ConfigContext:
             _apply_settings(opt, s)
         if self.root_submodel is not None:
             self.root_submodel.input_layer_names = list(self.model.input_layer_names)
-            if not self.root_submodel.output_layer_names:
-                self.root_submodel.output_layer_names = list(self.model.output_layer_names)
+            # model-level outputs include plain (multi_nn) sub-network
+            # outputs; the root network serves them all
+            self.root_submodel.output_layer_names = list(
+                dict.fromkeys(
+                    list(self.root_submodel.output_layer_names)
+                    + list(self.model.output_layer_names)
+                )
+            )
         return self.trainer_config
 
 

@@ -34,6 +34,14 @@ class Network:
         self.submodel = submodel
         if submodel is not None:
             names = list(submodel.layer_names)
+            if submodel.name == "root":
+                # multi_nn (ref MultiNetwork, gradientmachines/MultiNetwork.h:
+                # 25): plain non-recurrent sub-models are independent
+                # sub-networks trained jointly — execute their layers after
+                # the root's (each depends only on its own data layers)
+                for s in model.sub_models:
+                    if s.name != "root" and not s.is_recurrent_layer_group:
+                        names.extend(n for n in s.layer_names if n not in names)
         else:
             names = [l.name for l in model.layers]
         self.layers: List[LayerConfig] = [self.layer_map[n] for n in names]

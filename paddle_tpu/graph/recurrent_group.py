@@ -88,10 +88,25 @@ def _resolve_outer(sub: SubModelConfig, name: str) -> str:
     return name
 
 
+def _scope_lookup(ctx: LayerContext, name: str) -> Argument:
+    """Group-entry name resolution: this scope, then enclosing scopes.
+
+    Used ONLY for in-links, static links, and memory boot layers — the
+    references a nested group may legitimately make to layers outside its
+    enclosing group (reference: agent layers connect across frames).
+    """
+    c = ctx
+    while c is not None:
+        if name in c.outputs:
+            return c.outputs[name]
+        c = c.parent
+    raise KeyError(f"layer output {name!r} not found in any enclosing scope")
+
+
 def _memory_boot(network, mem, ctx: LayerContext, batch: int, dtype, sub: SubModelConfig) -> Array:
     size = network.layer_map[mem.link_name].size
     if mem.boot_layer_name:
-        boot = ctx.outputs[_resolve_outer(sub, mem.boot_layer_name)].value
+        boot = _scope_lookup(ctx, _resolve_outer(sub, mem.boot_layer_name)).value
     elif mem.boot_with_const_id >= 0:
         boot = jnp.full((batch,), mem.boot_with_const_id, jnp.int32)
         return boot
@@ -120,11 +135,11 @@ def _run_submodel_step(
         dtype=ctx.dtype,
         mesh=ctx.mesh,
     )
-    # outer-scope outputs stay visible so an inner group's static links /
-    # memory boot layers can reference layers outside this group (fed agent
-    # outputs take precedence; group-internal names are globally unique so
-    # nothing in sub.layer_names can be shadowed by a parent output)
-    step_ctx.outputs.update(ctx.outputs)
+    # the parent link lets an inner group's ENTRY resolution (static
+    # links, boot layers, nested in-links) see outer-scope layers without
+    # making them resolvable as ordinary layer inputs — a step referencing
+    # an outer sequence without StaticInput still fails loudly
+    step_ctx.parent = ctx
     step_ctx.outputs.update(fed)
     for name in sub.layer_names:
         lcfg = network.layer_map[name]
@@ -166,7 +181,7 @@ def _memory_boot_seq(network, mem, ctx: LayerContext, sub: SubModelConfig):
         f"sequence memory for {mem.layer_name!r} needs a sequence boot layer "
         "(reference: 'boot layer must be a sequence when is_sequence = true')"
     )
-    boot = ctx.outputs[_resolve_outer(sub, mem.boot_layer_name)]
+    boot = _scope_lookup(ctx, _resolve_outer(sub, mem.boot_layer_name))
     assert boot.is_seq, (
         f"boot layer {mem.boot_layer_name!r} of sequence memory is not a sequence"
     )
@@ -181,12 +196,12 @@ def _forward_scan(network, cfg: LayerConfig, sub: SubModelConfig, ctx: LayerCont
         # outer scan over SUBSEQUENCES: [B, S, T, ...] in-links feed
         # [B, T, ...] sequence frames (createInFrameInfo hasSubseq :564)
         ref_link = next(l for l in sub.in_links if l.has_subseq)
-        first = ctx.outputs[ref_link.layer_name]
+        first = _scope_lookup(ctx, ref_link.layer_name)
         assert first.is_nested_seq, (
             f"in-link {ref_link.layer_name!r} marked has_subseq but is not nested"
         )
     else:
-        first = ctx.outputs[sub.in_links[0].layer_name]
+        first = _scope_lookup(ctx, sub.in_links[0].layer_name)
         assert first.is_seq, f"in-link {sub.in_links[0].layer_name!r} is not a sequence"
     lengths = first.seq_lengths          # [B]: valid timesteps / subsequences
     B, T = first.batch_size, first.max_len
@@ -198,7 +213,7 @@ def _forward_scan(network, cfg: LayerConfig, sub: SubModelConfig, ctx: LayerCont
     xs_ids: Dict[str, Array] = {}
     xs_sublens: Dict[str, Array] = {}
     for link in sub.in_links:
-        arg = ctx.outputs[link.layer_name]
+        arg = _scope_lookup(ctx, link.layer_name)
         if arg.value is not None:
             xs_vals[link.link_name] = jnp.swapaxes(arg.value, 0, 1)
         if arg.ids is not None:
@@ -208,7 +223,7 @@ def _forward_scan(network, cfg: LayerConfig, sub: SubModelConfig, ctx: LayerCont
             xs_sublens[link.link_name] = jnp.swapaxes(arg.sub_seq_lengths, 0, 1)  # [S, B]
 
     statics: Dict[str, Argument] = {
-        link.link_name: ctx.outputs[link.layer_name] for link in sub.static_links
+        link.link_name: _scope_lookup(ctx, link.layer_name) for link in sub.static_links
     }
 
     memories = list(sub.memories)
@@ -349,7 +364,7 @@ def _generate(network, cfg: LayerConfig, sub: SubModelConfig, ctx: LayerContext)
     B = None
     statics: Dict[str, Argument] = {}
     for link in sub.static_links:
-        arg = ctx.outputs[link.layer_name]
+        arg = _scope_lookup(ctx, link.layer_name)
         statics[link.link_name] = _expand_beams(arg, K)
         B = arg.batch_size if B is None else B
     # real sequence in-links: generation consumes one input frame per step
@@ -364,7 +379,7 @@ def _generate(network, cfg: LayerConfig, sub: SubModelConfig, ctx: LayerContext)
             raise NotImplementedError(
                 f"generation group {cfg.name}: nested in-links unsupported"
             )
-        arg = ctx.outputs[link.layer_name]
+        arg = _scope_lookup(ctx, link.layer_name)
         assert arg.is_seq, (
             f"generation in-link {link.layer_name!r} must be a sequence "
             "(wrap whole-sequence conditions in StaticInput(..., is_seq=True))"
@@ -392,7 +407,7 @@ def _generate(network, cfg: LayerConfig, sub: SubModelConfig, ctx: LayerContext)
         if mem.is_sequence:
             raise NotImplementedError("sequence-valued memories in generation")
         if mem.boot_layer_name and B is None:
-            B = ctx.outputs[mem.boot_layer_name].batch_size
+            B = _scope_lookup(ctx, mem.boot_layer_name).batch_size
     assert B is not None, f"generation group {cfg.name}: cannot infer batch size"
     gen_dtype = ctx.dtype
     for arg in statics.values():
@@ -424,7 +439,13 @@ def _generate(network, cfg: LayerConfig, sub: SubModelConfig, ctx: LayerContext)
         )
         if K > 1
         else jnp.zeros((B, 1), gen_dtype),
-        jnp.zeros((B, K), bool),                              # finished
+        # an empty in-link sequence is finished before step 0 (no frame to
+        # condition on) — generates length 0, not one garbage token
+        (
+            jnp.zeros((B, K), bool)
+            if in_lengths is None
+            else jnp.broadcast_to((in_lengths <= 0)[:, None], (B, K))
+        ),
         jnp.zeros((B, K, L), jnp.int32),                      # token history
         jnp.zeros((B, K), jnp.int32),                         # lengths
     )

@@ -59,6 +59,11 @@ class LayerContext:
     # the table projection returns these instead of gathering, so
     # jax.grad yields row gradients, never a dense [V, D] scatter
     table_overrides: Optional[Dict[Any, Array]] = None
+    # enclosing scope for recurrent-group steps: group-ENTRY resolution
+    # (static links, memory boot layers, nested-group in-links) may walk
+    # up this chain; ordinary layer-input lookup deliberately cannot, so
+    # referencing an outer sequence without StaticInput stays an error
+    parent: Optional["LayerContext"] = None
 
     @property
     def is_training(self) -> bool:

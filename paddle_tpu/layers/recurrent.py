@@ -187,3 +187,100 @@ def gru_step_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) 
     bias = ctx.param(cfg.bias_parameter_name).reshape(-1) if cfg.bias_parameter_name else None
     h = gru_cell_step(cfg, x3, h_prev, w, bias)
     return Argument(value=h, seq_lengths=inputs[0].seq_lengths)
+
+
+@register_layer("mdlstmemory")
+def mdlstm_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Argument:
+    """Multi-dimensional LSTM over a 2-D grid (ref: MDLstmLayer.cpp:81-473,
+    Graves-style MDLSTM). Input is a NESTED argument [B, H, W, (3+D)*size]
+    holding the precomputed x-projections for blocks
+    [inputNode, inputGate, forgetGate×D, outputGate]; the recurrent weight
+    [size, (3+D)*size] is SHARED across the D predecessor directions and
+    the bias packs (3+D) gate biases + checkIg + checkFg×D + checkOg
+    (config_parser.py:2608 MDLstmLayer). directions[d]=False scans dim d
+    backwards. Per position: each predecessor (top/left) contributes its
+    output through W, its state through the peepholes and through an
+    independent forget gate — out-of-grid predecessors contribute zeros,
+    which reproduces the reference's skip semantics exactly.
+
+    TPU formulation: lax.scan over rows carrying the previous row's
+    (out, state) [W, B, size], with an inner lax.scan over columns — the
+    cell math vectorizes over the batch. Ragged grids (per-sample
+    sub_seq_lengths) are handled by zeroing out-of-grid cells' out/state,
+    which makes them behave exactly like the reference's out-of-grid
+    skip.
+    """
+    a = inputs[0]
+    x = a.value
+    assert x is not None and x.ndim == 4, (
+        "mdlstmemory expects a nested [B, H, W, (3+D)*size] input "
+        "(dense_vector_sub_sequence grid)"
+    )
+    dirs = list(cfg.directions) or [True, True]
+    D = len(dirs)
+    assert D == 2, "mdlstmemory: 2-D grids supported (directions must have 2 entries)"
+    nb = cfg.size
+    w = ctx.param(cfg.inputs[0].input_parameter_name).reshape(nb, (3 + D) * nb)
+    bias = ctx.param(cfg.bias_parameter_name).reshape(-1)
+    gate_bias = bias[: (3 + D) * nb]
+    check_ig = bias[(3 + D) * nb : (4 + D) * nb]
+    check_fg = bias[(4 + D) * nb : (4 + 2 * D) * nb].reshape(D, nb)
+    check_og = bias[(4 + 2 * D) * nb : (5 + 2 * D) * nb]
+
+    if a.sub_seq_lengths is not None:
+        grid_mask = a.sub_seq_mask(dtype=x.dtype)[..., None]  # [B, H, W, 1]
+    else:
+        grid_mask = jnp.ones(x.shape[:3] + (1,), x.dtype)
+    if not dirs[0]:
+        x = jnp.flip(x, 1)
+        grid_mask = jnp.flip(grid_mask, 1)
+    if not dirs[1]:
+        x = jnp.flip(x, 2)
+        grid_mask = jnp.flip(grid_mask, 2)
+    B, H, W, _ = x.shape
+    g_all = jnp.transpose(x + gate_bias, (1, 2, 0, 3))  # [H, W, B, (3+D)nb]
+    m_all = jnp.transpose(grid_mask, (1, 2, 0, 3))      # [H, W, B, 1]
+
+    act_gate = lambda v: apply_activation(cfg.active_gate_type or "sigmoid", v)
+    act_in = lambda v: apply_activation(cfg.active_type or "tanh", v)
+    act_state = lambda v: apply_activation(cfg.active_state_type or "sigmoid", v)
+
+    def col_cell(carry, inp):
+        out_l, st_l = carry                        # left neighbor [B, nb]
+        g, out_t, st_t, m = inp                    # this col + top neighbor
+        g = g + jnp.dot(out_t + out_l, w)          # shared recurrent weight
+        in_pre = g[:, :nb]
+        ig_pre = g[:, nb : 2 * nb]
+        fg_pre = g[:, 2 * nb : (2 + D) * nb]
+        og_pre = g[:, (2 + D) * nb : (3 + D) * nb]
+        ig = act_gate(ig_pre + (st_t + st_l) * check_ig)
+        fg = act_gate(
+            fg_pre + jnp.concatenate([st_t * check_fg[0], st_l * check_fg[1]], -1)
+        )
+        state = fg[:, :nb] * st_t + fg[:, nb:] * st_l + act_in(in_pre) * ig
+        og = act_gate(og_pre + state * check_og)
+        out = og * act_state(state)
+        # out-of-grid cells emit zeros so neighbors treat them as absent
+        out = out * m
+        state = state * m
+        return (out, state), (out, state)
+
+    def row_step(carry, inp):
+        g_row, m_row = inp
+        out_top, st_top = carry                    # previous row [W, B, nb]
+        z = jnp.zeros((B, nb), x.dtype)
+        (_, _), (outs, sts) = jax.lax.scan(
+            col_cell, (z, z), (g_row, out_top, st_top, m_row)
+        )
+        return (outs, sts), outs
+
+    zrow = jnp.zeros((W, B, nb), x.dtype)
+    _, ys = jax.lax.scan(row_step, (zrow, zrow), (g_all, m_all))  # [H, W, B, nb]
+    out = jnp.transpose(ys, (2, 0, 1, 3))                # [B, H, W, nb]
+    if not dirs[1]:
+        out = jnp.flip(out, 2)
+    if not dirs[0]:
+        out = jnp.flip(out, 1)
+    return Argument(
+        value=out, seq_lengths=a.seq_lengths, sub_seq_lengths=a.sub_seq_lengths
+    )

@@ -80,6 +80,45 @@ def _batch_tree_sharding(mesh: Mesh, batch) -> Any:
     return jax.tree_util.tree_map(lambda _: bs, batch)
 
 
+def globalize_batch(batch, mesh: Mesh):
+    """Assemble per-process batch slices into global sharded arrays.
+
+    Multi-host analog of the reference's per-trainer data partitions
+    (each pserver trainer reads its own split): every process builds the
+    same host-level batch (providers are seeded identically), takes its
+    contiguous row block, and jax.make_array_from_process_local_data
+    glues the blocks into one global array sharded over the 'data' axis.
+    No-op in single-process mode. Returns None for a remainder batch
+    whose size is not divisible by the process count (the end-of-pass
+    partial batch) — the caller skips it; sync-SGD needs every host to
+    contribute an identical batch structure.
+    """
+    import numpy as np
+
+    pc = jax.process_count()
+    if pc == 1:
+        return batch
+    bs = batch_sharding(mesh)
+    pid = jax.process_index()
+    first = next(
+        v
+        for v in jax.tree_util.tree_leaves(batch)
+        if hasattr(v, "shape") and v.shape
+    )
+    if first.shape[0] % pc != 0:
+        return None
+
+    def put(x):
+        if x is None:
+            return None
+        x = np.asarray(x)
+        n = x.shape[0] // pc
+        local = x[pid * n : (pid + 1) * n]
+        return jax.make_array_from_process_local_data(bs, local, x.shape)
+
+    return jax.tree_util.tree_map(put, batch)
+
+
 def shard_train_step(step, mesh: Mesh, gm):
     """Wrap a (params, opt_state, batch, rng, batch_size) step with mesh
     shardings. Shardings for the batch depend on its treedef, so the jit is

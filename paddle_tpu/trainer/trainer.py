@@ -90,6 +90,12 @@ class Trainer:
 
             self._mesh = make_mesh(mesh_shape)
             self.gm.mesh = self._mesh  # layers with explicit collectives
+        self._multiproc = jax.process_count() > 1
+        if self._multiproc and self._mesh is None:
+            raise ValueError(
+                "multi-process training needs a mesh "
+                "(--mesh_shape or --trainer_count)"
+            )
         self._maybe_restore()
 
     # ------------------------------------------------------------ restore
@@ -206,7 +212,14 @@ class Trainer:
         profiling = False
         t0 = time.time()
         batch_id = 0
+        step_times: list = []
         for batch in provider.batches():
+            if self._multiproc:
+                from paddle_tpu.parallel.spmd import globalize_batch
+
+                batch = globalize_batch(batch, self._mesh)
+                if batch is None:  # remainder batch not divisible by hosts
+                    continue
             if (
                 self.flags.profile_dir
                 and pass_id == self.start_pass
@@ -217,11 +230,13 @@ class Trainer:
                 logger.info("profiler trace started → %s", self.flags.profile_dir)
             n = _batch_num_samples(batch)
             rng, step_rng = jax.random.split(rng)
+            t_step = time.perf_counter()
             with stat_timer("train_step"):
                 self.params, self.opt_state, loss, outputs = self.train_step(
                     self.params, self.opt_state, batch, step_rng, jnp.asarray(float(n))
                 )
             loss_f = float(loss)
+            step_times.append(time.perf_counter() - t_step)
             if not np.isfinite(loss_f):
                 # FP trap role (ref: feenableexcept(FE_INVALID|FE_DIVBYZERO|
                 # FE_OVERFLOW), TrainerMain.cpp:96): a NaN/Inf must abort the
@@ -233,7 +248,11 @@ class Trainer:
                     "learning rate, or gradient clipping to locate the cause."
                 )
             stats.add(loss_f * n, n)
-            evaluators.eval_batch(outputs)
+            if not self._multiproc:
+                # evaluators read outputs to host numpy; under multi-process
+                # SPMD the output shards live on other hosts (divergence
+                # note: per-host evaluators are not merged — use test())
+                evaluators.eval_batch(outputs)
             batch_id += 1
             if self.flags.dot_period and batch_id % self.flags.dot_period == 0:
                 print(".", end="", flush=True, file=sys.stderr)
@@ -288,6 +307,9 @@ class Trainer:
             evaluators.summary(),
             rate,
         )
+        from paddle_tpu.utils.barrier import step_time_skew_summary
+
+        step_time_skew_summary(step_times)
 
     def _end_dot_line(self) -> None:
         """Terminate a run of progress dots before a log line (the
@@ -321,13 +343,28 @@ class Trainer:
         evaluators.start()
         for batch in provider.batches():
             n = _batch_num_samples(batch)
+            if self._multiproc:
+                from paddle_tpu.parallel.spmd import globalize_batch
+
+                batch = globalize_batch(batch, self._mesh)
+                if batch is None:
+                    continue
             outputs = self.test_fwd(params, batch)
             cost = float(self.gm.total_cost(outputs))
             stats.add(cost * n, n)
-            evaluators.eval_batch(outputs)
+            if not self._multiproc:
+                evaluators.eval_batch(outputs)
         results = {"cost": stats.total_cost / max(stats.total_samples, 1)}
-        results.update(evaluators.results())
-        logger.info("Test (pass %d): %s  %s", pass_id, stats.summary(), evaluators.summary())
+        if self._multiproc:
+            # evaluator metrics are NOT computed multi-process (outputs are
+            # sharded across hosts) — report only the cost rather than
+            # zero-sample evaluator numbers
+            logger.info("Test (pass %d): %s  (evaluators skipped: multi-process)",
+                        pass_id, stats.summary())
+        else:
+            results.update(evaluators.results())
+            logger.info("Test (pass %d): %s  %s", pass_id, stats.summary(),
+                        evaluators.summary())
         return results
 
     def predict(self, provider: DataProvider, params=None) -> Dict[str, float]:
@@ -475,6 +512,8 @@ class Trainer:
     # -------------------------------------------------------------- save
 
     def save(self, pass_id: int, batch_id: Optional[int] = None, final: bool = False) -> None:
+        if self._multiproc and jax.process_index() != 0:
+            return  # one writer per cluster (sharded orbax save is separate)
         extra = {"config_json": self.config.to_json()}
         if batch_id is not None:
             extra["batch_id"] = batch_id

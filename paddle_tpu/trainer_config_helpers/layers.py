@@ -110,6 +110,8 @@ __all__ = [
     "out_prod_layer",
     "multiplex_layer",
     "multi_head_attention_layer",
+    "mdlstm_layer",
+    "sub_network",
 ]
 
 
@@ -1702,3 +1704,69 @@ def multi_head_attention_layer(
     cfg.bias_parameter_name = _bias_name(name, size, bias_attr)
     _add_layer(cfg, layer_attr)
     return LayerOutput(name, "multi_head_attention", [input], size, act)
+
+
+def mdlstm_layer(
+    input: LayerOutput,
+    size: Optional[int] = None,
+    directions: Sequence[bool] = (True, True),
+    name: Optional[str] = None,
+    act: Optional[BaseActivation] = None,
+    gate_act: Optional[BaseActivation] = None,
+    state_act: Optional[BaseActivation] = None,
+    param_attr: Optional[ParameterAttribute] = None,
+    bias_attr: Union[bool, ParameterAttribute] = True,
+    layer_attr=None,
+) -> LayerOutput:
+    """Multi-dimensional LSTM over a 2-D grid (ref: config_parser.py:2608
+    MDLstmLayer / MDLstmLayer.cpp). ``input`` holds the precomputed
+    x-projections, size (3+len(directions))*size, over a nested
+    [B, H, W, ...] grid; directions[d]=False scans dim d backwards."""
+    D = len(directions)
+    name = _name(name, "mdlstm")
+    size = size or input.size // (3 + D)
+    assert input.size == (3 + D) * size, (
+        f"mdlstm input size {input.size} must be (3+{D})*size (= {(3 + D) * size})"
+    )
+    cfg = LayerConfig(
+        name=name,
+        type="mdlstmemory",
+        size=size,
+        active_type=_act_name(act or TanhActivation()),
+        active_gate_type=_act_name(gate_act or SigmoidActivation()),
+        active_state_type=_act_name(state_act or SigmoidActivation()),
+    )
+    cfg.directions = [bool(d) for d in directions]
+    pname = _create_parameter(
+        f"_{name}.w0", size * size * (3 + D), [size, (3 + D) * size], param_attr
+    )
+    cfg.inputs.append(_input(input, pname))
+    cfg.bias_parameter_name = _bias_name(name, (5 + 2 * D) * size, bias_attr)
+    _add_layer(cfg, layer_attr)
+    return LayerOutput(name, "mdlstmemory", [input], size, act)
+
+
+class sub_network:
+    """Plain (non-recurrent) sub-network — multi-task / multi_nn configs.
+
+    TPU analog of the reference's MultiNetwork machine
+    (/root/reference/paddle/gserver/gradientmachines/MultiNetwork.h:25,
+    selected by ModelConfig.type == 'multi_nn'): each ``with
+    sub_network("task"):`` block is an independent sub-graph with its own
+    data layers and cost; all of them train jointly in ONE fused step
+    (their costs sum into the total loss), replacing the reference's
+    split-by-dataId argument multiplexing.
+    """
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def __enter__(self):
+        ctx = _ctx()
+        ctx.model.type = "multi_nn"
+        self.sub = ctx.begin_submodel(self.name, recurrent=False)
+        return self.sub
+
+    def __exit__(self, exc_type, exc, tb):
+        _ctx().end_submodel()
+        return False

@@ -1,0 +1,45 @@
+"""Per-host step-time skew diagnostics — the BarrierStat role.
+
+The reference's BarrierStat (/root/reference/paddle/utils/BarrierStat.h:
+36-60) records per-trainer wait times at pserver barriers and reports
+which hosts straggle. The SPMD analog: every step is an implicit barrier
+(collectives synchronize the mesh), so the observable is each host's
+wall-clock step time; skew between hosts is exactly the time fast hosts
+spend waiting inside collectives for stragglers.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from paddle_tpu.utils.logging import logger
+
+
+def step_time_skew_summary(step_times_s: List[float]) -> Optional[str]:
+    """All-gather this host's mean/p99 step time and summarize cross-host
+    skew. Returns the log line (also logged here), or None when not
+    running multi-process."""
+    import jax
+
+    if not step_times_s:
+        return None
+    local = np.asarray(
+        [np.mean(step_times_s), np.percentile(step_times_s, 99)], np.float32
+    )
+    if jax.process_count() == 1:
+        return None
+    from jax.experimental import multihost_utils
+
+    all_stats = np.asarray(multihost_utils.process_allgather(local))  # [P, 2]
+    means = all_stats[:, 0]
+    slowest = int(np.argmax(means))
+    skew = float(means.max() - means.min())
+    line = (
+        f"BarrierStat: step mean/host={['%.1fms' % (m * 1e3) for m in means]} "
+        f"skew={skew * 1e3:.1f}ms slowest=host{slowest} "
+        f"p99[slowest]={all_stats[slowest, 1] * 1e3:.1f}ms"
+    )
+    logger.info(line)
+    return line

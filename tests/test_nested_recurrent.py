@@ -323,3 +323,18 @@ def test_nested_group_gradients_flow():
     for k in ("w_x", "w_h"):
         g = np.asarray(grads[k])
         assert np.isfinite(g).all() and np.abs(g).sum() > 0, k
+
+
+def test_generation_empty_input_generates_nothing():
+    """A sample with an empty in-link sequence generates length 0."""
+    rng = np.random.RandomState(6)
+    src = rng.randint(0, 11, (2, 4)).astype(np.int32)
+    lens = np.array([4, 0], np.int32)
+    tc = parse_str(GEN_INLINK)
+    gm = GradientMachine(tc.model_config)
+    params = gm.init_params(seed=8)
+    batch = {"src": make_seq(None, jnp.asarray(lens), ids=jnp.asarray(src))}
+    out, _ = gm.forward(params, batch, "gen")
+    got_lens = np.asarray(out["gen"].seq_lengths)
+    assert got_lens[1] == 0, got_lens
+    assert got_lens[0] >= 1
