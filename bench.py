@@ -1,12 +1,22 @@
 """Benchmark harness — prints ONE JSON line for the driver, always.
 
-Headline metric: ResNet-50 training throughput (imgs/sec/chip), the
-north-star workload from BASELINE.md. `python bench.py lstm` runs the
-secondary LSTM-classifier tokens/sec bench. vs_baseline is measured
-against benchmarks/targets.json when present (the reference publishes no
-numbers — BASELINE.md; the targets are clearly-labeled estimates, and
-the emitted JSON carries `baseline_kind` so an estimate can never
-masquerade as a measured reference ratio).
+Headline metric: ResNet-50 bf16 training throughput (imgs/sec/chip), the
+north-star workload from BASELINE.md. The default run ("all") also times
+the two sequence flagships — the stacked-LSTM classifier and the seqToseq
+NMT attention encoder-decoder (demo/seqToseq, reference
+demo/seqToseq/seqToseq_net.py:65-181) — and reports them in the same JSON
+line under "legs", plus an MFU figure (see benchmarks/mfu.py: XLA
+cost-analysis FLOPs of the compiled step / wall-clock / chip peak).
+`python bench.py resnet|lstm|nmt` runs a single leg. vs_baseline is
+measured against benchmarks/targets.json when present (the reference
+publishes no numbers — BASELINE.md; targets are clearly-labeled estimates,
+and the JSON carries `baseline_kind` so an estimate can never masquerade
+as a measured reference ratio).
+
+On TPU all legs train in bf16 mixed precision (f32 master weights) —
+the production configuration; `PADDLE_TPU_BENCH_DTYPE=float32` forces
+full precision for A/B runs. Set PADDLE_TPU_BENCH_TRACE_DIR to capture an
+xplane trace of the headline timed window.
 
 Hardening (the round-1 failure mode): the environment pre-registers an
 accelerator plugin whose backend init can raise UNAVAILABLE or hang.
@@ -31,15 +41,18 @@ sys.path.insert(0, REPO)
 # How long the subprocess backend probe may take before we give up on the
 # accelerator and fall back to CPU. First TPU init can take ~40s; leave slack.
 PROBE_TIMEOUT_S = float(os.environ.get("PADDLE_TPU_BENCH_PROBE_TIMEOUT", "180"))
+BENCH_DTYPE = os.environ.get("PADDLE_TPU_BENCH_DTYPE", "bfloat16")
+TRACE_DIR = os.environ.get("PADDLE_TPU_BENCH_TRACE_DIR", "")
 
 
 def _jit_train_step(tc):
     import jax
 
     from paddle_tpu.graph import GradientMachine
+    from paddle_tpu.graph.machine import compute_dtype_of
     from paddle_tpu.optimizer import Updater
 
-    gm = GradientMachine(tc.model_config)
+    gm = GradientMachine(tc.model_config, compute_dtype=compute_dtype_of(tc.opt_config))
     updater = Updater(tc.opt_config, tc.model_config)
     params = gm.init_params(seed=1)
     opt_state = updater.init_state(params)
@@ -56,45 +69,98 @@ def _jit_train_step(tc):
     return step, params, opt_state
 
 
-def _time_steps(step, params, opt_state, batch, bs, steps, warmup):
+def _time_steps(step, params, opt_state, batch, bs, steps, warmup, trace=False):
+    """Returns (elapsed seconds, flops-per-step or None)."""
+    import jax
+
+    from benchmarks.mfu import flops_of_compiled
+
+    # AOT-compile ONCE and drive the loop with the same executable the
+    # cost analysis describes (jit dispatch would compile a second time)
+    try:
+        compiled = step.lower(params, opt_state, batch, bs).compile()
+        flops = flops_of_compiled(compiled)
+        step = compiled
+    except Exception:
+        flops = None  # fall back to the jit dispatch path
     # sync via host readback: on the axon TPU platform block_until_ready
     # returns before execution finishes, but a device→host transfer of the
     # loss (which transitively depends on every step) cannot
+    import contextlib
+
     loss = None
     for _ in range(warmup):
         params, opt_state, loss = step(params, opt_state, batch, bs)
     float(loss)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        params, opt_state, loss = step(params, opt_state, batch, bs)
-    float(loss)
-    return time.perf_counter() - t0
+    tracer = (
+        jax.profiler.trace(TRACE_DIR) if trace and TRACE_DIR else contextlib.nullcontext()
+    )
+    with tracer:  # exception-safe: a failing step still finalizes the trace
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, opt_state, loss = step(params, opt_state, batch, bs)
+        float(loss)
+        dt = time.perf_counter() - t0
+    return dt, flops
 
 
-def bench_resnet50(B=64, img_size=224, classes=1000, steps=20, warmup=3):
+def _mfu_of(flops, dt, steps):
+    import jax
+
+    from benchmarks.mfu import mfu
+
+    kind = jax.devices()[0].device_kind
+    m = mfu(flops, dt / steps, kind)
+    return (round(m, 4) if m is not None else None), kind
+
+
+def bench_resnet50(B=64, img_size=224, classes=1000, steps=20, warmup=3, trace=True,
+                   dtype=None):
     import jax.numpy as jnp
 
     from paddle_tpu.flagship import make_image_batch, resnet_config
 
     tc = resnet_config(50, img_size, classes)
     tc.opt_config.batch_size = B
+    tc.opt_config.dtype = dtype or BENCH_DTYPE
     step, params, opt_state = _jit_train_step(tc)
     batch = make_image_batch(B, img_size, classes)
-    dt = _time_steps(step, params, opt_state, batch, jnp.asarray(float(B)), steps, warmup)
-    return B * steps / dt
+    dt, flops = _time_steps(
+        step, params, opt_state, batch, jnp.asarray(float(B)), steps, warmup, trace=trace
+    )
+    m, kind = _mfu_of(flops, dt, steps)
+    return B * steps / dt, {"mfu": m, "device_kind": kind, "dtype": tc.opt_config.dtype}
 
 
-def bench_lstm_classifier(B=256, T=64, steps=20, warmup=3):
+def bench_lstm_classifier(B=256, T=64, steps=20, warmup=3, dtype=None):
     import jax.numpy as jnp
 
     from paddle_tpu.flagship import example_batch, flagship_config
 
     tc = flagship_config(dict_dim=10000, emb_dim=256, hidden=512, classes=2)
     tc.opt_config.batch_size = B
+    tc.opt_config.dtype = dtype or BENCH_DTYPE
     step, params, opt_state = _jit_train_step(tc)
     batch = example_batch(dict_dim=10000, B=B, T=T)
-    dt = _time_steps(step, params, opt_state, batch, jnp.asarray(float(B)), steps, warmup)
-    return B * T * steps / dt
+    dt, flops = _time_steps(step, params, opt_state, batch, jnp.asarray(float(B)), steps, warmup)
+    m, _ = _mfu_of(flops, dt, steps)
+    return B * T * steps / dt, {"mfu": m, "dtype": tc.opt_config.dtype}
+
+
+def bench_nmt(B=64, T=32, vocab=30000, dim=512, steps=10, warmup=2, dtype=None):
+    """seqToseq NMT attention encoder-decoder train step; tokens/sec counts
+    target (decoder) tokens — BASELINE.md north-star workload #2."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.flagship import nmt_batch, nmt_config
+
+    tc = nmt_config(vocab=vocab, dim=dim, dtype=dtype or BENCH_DTYPE)
+    tc.opt_config.batch_size = B
+    step, params, opt_state = _jit_train_step(tc)
+    batch = nmt_batch(vocab=vocab, B=B, T=T)
+    dt, flops = _time_steps(step, params, opt_state, batch, jnp.asarray(float(B)), steps, warmup)
+    m, _ = _mfu_of(flops, dt, steps)
+    return B * T * steps / dt, {"mfu": m, "dtype": tc.opt_config.dtype, "tokens": "target"}
 
 
 def _emit(metric, value, unit, vs_baseline, **extra):
@@ -104,14 +170,17 @@ def _emit(metric, value, unit, vs_baseline, **extra):
         "unit": unit,
         "vs_baseline": round(float(vs_baseline), 3),
     }
-    line.update(extra)
+    line.update({k: v for k, v in extra.items() if v is not None})
     print(json.dumps(line))
 
 
 def main():
-    which = sys.argv[1] if len(sys.argv) > 1 else "resnet"
-    if which not in ("resnet", "lstm"):
-        print(f"unknown benchmark {which!r}: expected 'resnet' or 'lstm'", file=sys.stderr)
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    if which not in ("all", "resnet", "lstm", "nmt"):
+        print(
+            f"unknown benchmark {which!r}: expected 'all', 'resnet', 'lstm' or 'nmt'",
+            file=sys.stderr,
+        )
         return 2
 
     targets_path = os.path.join(REPO, "benchmarks", "targets.json")
@@ -129,15 +198,23 @@ def main():
     if not on_tpu:
         ensure_cpu_mesh(1)
 
+    # bf16 on XLA CPU is emulated and slow — CPU fallbacks run f32 so
+    # their numbers stay comparable run-to-run
+    leg_dtype = None if on_tpu else "float32"
     if which == "lstm":
-        value = bench_lstm_classifier()
+        value, extras = bench_lstm_classifier(dtype=leg_dtype)
         metric, unit, tkey = (
             "lstm_classifier_train_tokens_per_sec",
             "tokens/s",
             "lstm_classifier_tokens_per_sec",
         )
+    elif which == "nmt":
+        value, extras = bench_nmt(dtype=leg_dtype)
+        metric, unit, tkey = ("nmt_train_tokens_per_sec", "tokens/s", "nmt_tokens_per_sec")
     elif on_tpu:
-        value = bench_resnet50()
+        # headline: bf16 ResNet-50; "all" additionally runs the two
+        # sequence flagships (emitted incrementally below)
+        value, extras = bench_resnet50()
         metric, unit, tkey = (
             "resnet50_train_imgs_per_sec_per_chip",
             "imgs/s",
@@ -146,24 +223,42 @@ def main():
     else:
         # CPU smoke runs can't push 224px ResNet: shrink AND rename the
         # metric so a toy run can never masquerade as the flagship number
-        value = bench_resnet50(B=16, img_size=32, classes=16, steps=5, warmup=2)
+        value, extras = bench_resnet50(B=16, img_size=32, classes=16, steps=5, warmup=2,
+                                       trace=False, dtype="float32")
         metric, unit, tkey = ("resnet50_cpu_smoke_imgs_per_sec", "imgs/s", None)
 
     target = targets.get(tkey) if tkey else None
     vs_baseline = value / target if target else 1.0
-    _emit(
-        metric,
-        value,
-        unit,
-        vs_baseline,
-        backend=backend,
-        baseline_kind="estimated" if target else "none",
-    )
+    common = dict(backend=backend, baseline_kind="estimated" if target else "none")
+    # emit the headline IMMEDIATELY — if a later leg hangs past the
+    # supervisor budget, the measured number is already on stdout (the
+    # supervisor keeps the LAST parseable line and salvages timed-out
+    # child output)
+    _emit(metric, value, unit, vs_baseline, **common, **extras)
+    sys.stdout.flush()
+    if which == "all" and on_tpu:
+        legs = {}
+        for key, fn in (
+            ("lstm_classifier_train_tokens_per_sec", bench_lstm_classifier),
+            ("nmt_train_tokens_per_sec", bench_nmt),
+        ):
+            try:
+                v, e = fn()
+                legs[key] = {"value": round(v, 1), "unit": "tokens/s",
+                             **{k: x for k, x in e.items() if x is not None}}
+            except Exception as ex:
+                legs[key] = {"error": f"{type(ex).__name__}: {ex}"}
+            # cumulative re-emit after each leg: always a complete line
+            _emit(metric, value, unit, vs_baseline, **common, legs=legs, **extras)
+            sys.stdout.flush()
     return 0
 
 
 def _good_json_line(text):
-    """The first parseable JSON line, unless it's only a failure report."""
+    """The LAST parseable JSON line that isn't a failure report — the
+    child emits the headline first, then cumulative lines as extra legs
+    finish, so the last line is the most complete."""
+    best = None
     for ln in text.strip().splitlines():
         if ln.startswith("{"):
             try:
@@ -171,8 +266,8 @@ def _good_json_line(text):
             except ValueError:
                 continue
             if parsed.get("metric") != "bench_failed":
-                return ln
-    return None
+                best = ln
+    return best
 
 
 def _supervise():
@@ -202,7 +297,16 @@ def _supervise():
                 text=True,
                 timeout=remaining,
             )
-        except subprocess.TimeoutExpired:
+        except subprocess.TimeoutExpired as te:
+            # salvage: the child may have emitted the headline before a
+            # later leg hung
+            txt = te.stdout or ""
+            if isinstance(txt, bytes):
+                txt = txt.decode(errors="replace")
+            line = _good_json_line(txt)
+            if line is not None:
+                print(line)
+                return 0
             last_err = f"bench child exceeded {remaining:.0f}s remaining budget"
             continue
         sys.stderr.write(out.stderr[-4000:])

@@ -1,0 +1,67 @@
+"""MFU (model FLOPs utilization) accounting for bench.py.
+
+MFU = executed FLOPs per second / peak bf16 FLOPs of the chip. The FLOP
+count comes from XLA's own cost analysis of the *compiled* train step
+(`jitted.lower(...).compile().cost_analysis()['flops']`) — the same
+computation the timed loop executes, so together with the wall-clock
+step time this is the standard MFU formula. `bench.py` can additionally
+capture an xplane trace of the timed window (PADDLE_TPU_BENCH_TRACE_DIR)
+for profile-level verification of the step time; the trace is for
+inspection, the MFU number printed in the bench JSON comes from the
+formula above.
+
+Peak numbers are per jax device (= one chip on v4+), bf16, from Google's
+published TPU specs. Unknown device kinds yield None (MFU omitted, never
+guessed).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+# substring (lowercased device_kind) -> peak bf16 TFLOP/s per jax device
+_PEAK_BF16_TFLOPS = [
+    ("v6e", 918.0),
+    ("v6 lite", 918.0),
+    ("v5p", 459.0),
+    ("v5e", 197.0),
+    ("v5 lite", 197.0),
+    ("v5litepod", 197.0),
+    ("v4", 275.0),
+    ("v3", 61.5),   # per core (a v3 jax device is one core)
+    ("v2", 23.0),
+]
+
+
+def peak_tflops(device_kind: str) -> Optional[float]:
+    dk = device_kind.lower()
+    for key, peak in _PEAK_BF16_TFLOPS:
+        if key in dk:
+            return peak
+    return None
+
+
+def flops_of_compiled(compiled) -> Optional[float]:
+    """FLOPs of one execution of an AOT-compiled jit (XLA cost analysis).
+
+    The caller compiles once (``jitted.lower(*args).compile()``) and uses
+    the SAME executable for the timed loop, so the analysis describes
+    exactly what ran."""
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):  # older jax returns [dict]
+            cost = cost[0] if cost else {}
+        flops = cost.get("flops")
+        return float(flops) if flops else None
+    except Exception:
+        return None
+
+
+def mfu(flops_per_step: Optional[float], step_time_s: float,
+        device_kind: str) -> Optional[float]:
+    """Fraction of peak bf16 FLOP/s sustained; None if either the FLOP
+    count or the chip's peak is unknown."""
+    peak = peak_tflops(device_kind)
+    if flops_per_step is None or peak is None or step_time_s <= 0:
+        return None
+    return (flops_per_step / step_time_s) / (peak * 1e12)

@@ -62,6 +62,61 @@ def example_batch(dict_dim=1000, B=8, T=32, classes=2, seed=0):
     }
 
 
+def nmt_config(vocab=30000, dim=512, dtype="float32", batch_size=64):
+    """seqToseq NMT attention encoder-decoder (training graph), the
+    BASELINE.md north-star workload #2 — the same model the demo config
+    builds (reference demo/seqToseq/seqToseq_net.py:65-181)."""
+    import importlib.util
+
+    from paddle_tpu.config.builder import fresh_context
+    from paddle_tpu.trainer_config_helpers import AdamOptimizer, settings
+
+    from paddle_tpu.config.config_parser import _ensure_compat_path
+
+    _ensure_compat_path()  # the demo imports `paddle.trainer_config_helpers`
+    spec = importlib.util.spec_from_file_location(
+        "seqToseq_net_bench", os.path.join(REPO, "demo", "seqToseq", "seqToseq_net.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    with fresh_context() as ctx:
+        settings(
+            batch_size=batch_size,
+            learning_rate=1e-3,
+            learning_method=AdamOptimizer(),
+            dtype=dtype,
+        )
+        mod.gru_encoder_decoder(
+            source_dict_dim=vocab,
+            target_dict_dim=vocab,
+            is_generating=False,
+            word_vector_dim=dim,
+            encoder_size=dim,
+            decoder_size=dim,
+        )
+        return ctx.finalize()
+
+
+def nmt_batch(vocab=30000, B=8, T=32, seed=0):
+    from paddle_tpu.graph import make_seq
+
+    rng = np.random.RandomState(seed)
+
+    def seq():
+        ids = rng.randint(2, vocab, (B, T)).astype(np.int32)
+        lengths = rng.randint(max(T // 2, 1), T + 1, (B,)).astype(np.int32)
+        return ids, lengths
+
+    src, src_len = seq()
+    trg, trg_len = seq()
+    nxt = np.roll(trg, -1, axis=1)
+    return {
+        "source_language_word": make_seq(None, src_len, ids=src),
+        "target_language_word": make_seq(None, trg_len, ids=trg),
+        "target_language_next_word": make_seq(None, trg_len, ids=nxt),
+    }
+
+
 def resnet_config(layer_num=50, img_size=224, classes=1000):
     from paddle_tpu.config import parse_config_at
 

@@ -27,13 +27,41 @@ Array = jax.Array
 Params = Dict[str, Array]
 
 
+def compute_dtype_of(opt_config) -> Optional[Any]:
+    """Resolve OptimizationConfig.dtype ('float32'|'bfloat16') to the
+    narrow compute dtype, or None for plain f32 training."""
+    name = getattr(opt_config, "dtype", "float32") or "float32"
+    if name in ("bfloat16", "bf16"):
+        return jnp.bfloat16
+    if name in ("float32", "fp32", ""):
+        return None
+    raise ValueError(f"unsupported OptimizationConfig.dtype {name!r}")
+
+
 class GradientMachine:
-    def __init__(self, model: ModelConfig, dtype=jnp.float32):
+    def __init__(self, model: ModelConfig, dtype=jnp.float32, compute_dtype=None):
         self.model = model
         self.network = Network(model)
         self.dtype = dtype
+        # mixed precision: master params stay `dtype`; activations/matmuls
+        # run in `compute_dtype` (bf16 on the MXU). None = everything in
+        # `dtype` (see LayerContext.compute_dtype for the cast rules).
+        self.compute_dtype = None if compute_dtype == jnp.float32 else compute_dtype
         self.mesh = None  # set by the trainer when running on a mesh
         self.param_configs: Dict[str, ParameterConfig] = {p.name: p for p in model.parameters}
+        # data layers whose every consumer is a cost layer carry targets/
+        # labels/weights, not features — exempt them from the bf16 input
+        # cast so loss math sees un-rounded values (code-review finding)
+        data_names = {l.name for l in model.layers if l.type == "data"}
+        consumers: Dict[str, set] = {}
+        for layer in model.layers:
+            for ic in layer.inputs:
+                if ic.input_layer_name in data_names:
+                    consumers.setdefault(ic.input_layer_name, set()).add(layer.type)
+        costish = self.COST_TYPES | {"classification_error", "lambda_cost"}
+        self.no_cast_inputs = frozenset(
+            n for n, types in consumers.items() if types and types <= costish
+        )
 
     # ------------------------------------------------------------- params
 
@@ -61,6 +89,7 @@ class GradientMachine:
         ctx = LayerContext(
             params=params, model=self.model, pass_type=pass_type, rng=rng,
             dtype=self.dtype, mesh=self.mesh, table_overrides=table_overrides,
+            compute_dtype=self.compute_dtype, no_cast_inputs=self.no_cast_inputs,
         )
         self.network.forward(ctx, in_args)
         return ctx.outputs, ctx.state_updates
@@ -246,8 +275,13 @@ class GradientMachine:
         Runs in float64 (the reference's WITH_DOUBLE gradient-check mode) —
         fp32 finite differences are too noisy for small gradients.
         """
-        with jax.enable_x64():
-            return self._check_gradient_x64(params, in_args, epsilon, max_entries, rng, rtol)
+        saved = self.compute_dtype
+        self.compute_dtype = None  # bf16 forward would swamp the FD signal
+        try:
+            with jax.enable_x64():
+                return self._check_gradient_x64(params, in_args, epsilon, max_entries, rng, rtol)
+        finally:
+            self.compute_dtype = saved
 
     def _check_gradient_x64(self, params, in_args, epsilon, max_entries, rng, rtol):
         import numpy as np

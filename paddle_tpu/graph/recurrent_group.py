@@ -134,6 +134,8 @@ def _run_submodel_step(
         states=ctx.states,
         dtype=ctx.dtype,
         mesh=ctx.mesh,
+        compute_dtype=ctx.compute_dtype,
+        no_cast_inputs=ctx.no_cast_inputs,
     )
     # the parent link lets an inner group's ENTRY resolution (static
     # links, boot layers, nested in-links) see outer-scope layers without
@@ -298,14 +300,15 @@ def _forward_scan(network, cfg: LayerConfig, sub: SubModelConfig, ctx: LayerCont
             out_arg = outs[l.layer_name]
             if out_arg.value.ndim >= 3 and out_arg.seq_lengths is not None:
                 # sequence frame (inner-group output): nested result
+                # (mask cast keeps bf16 outputs bf16)
                 ys.append(
                     (
-                        out_arg.value * m_t[:, None, None],
+                        out_arg.value * m_t[:, None, None].astype(out_arg.value.dtype),
                         (out_arg.seq_lengths * m_t.astype(jnp.int32)),
                     )
                 )
             else:
-                ys.append((out_arg.value * m, None))
+                ys.append((out_arg.value * m.astype(out_arg.value.dtype), None))
         return tuple(new_carries), tuple(ys)
 
     xs = (

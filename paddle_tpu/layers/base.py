@@ -51,6 +51,18 @@ class LayerContext:
     state_updates: Dict[str, Any] = field(default_factory=dict)
     outputs: Dict[str, Argument] = field(default_factory=dict)
     dtype: Any = jnp.float32
+    # mixed precision (OptimizationConfig.dtype="bfloat16"): master params
+    # and optimizer state stay `dtype` (f32); activations and matmul
+    # operands are cast to `compute_dtype` so the MXU runs bf16. Softmax,
+    # losses, batch-norm statistics and CRF/CTC recursions stay f32
+    # (upcast at their entry points). jax.grad of the cast yields f32
+    # parameter gradients automatically (convert_element_type transpose).
+    compute_dtype: Any = None
+    # data layers feeding ONLY cost layers (regression targets, soft
+    # labels, per-sample weights) — their dense values must NOT be
+    # narrowed, or the f32 loss island would see pre-rounded targets
+    # (GradientMachine computes this set from the graph)
+    no_cast_inputs: frozenset = frozenset()
     # device mesh for layers that issue explicit collectives (ring
     # attention); None outside meshed execution
     mesh: Any = None
@@ -69,12 +81,26 @@ class LayerContext:
     def is_training(self) -> bool:
         return self.pass_type == "train"
 
-    def param(self, name: str) -> Array:
+    def param(self, name: str, cast: bool = True) -> Array:
         try:
-            return self.params[name]
+            v = self.params[name]
         except KeyError:
             known = ", ".join(sorted(self.params))
             raise KeyError(f"parameter {name!r} not found (have: {known})") from None
+        if cast and self.compute_dtype is not None and jnp.issubdtype(v.dtype, jnp.floating):
+            v = v.astype(self.compute_dtype)
+        return v
+
+    def cast_compute(self, x: Optional[Array]) -> Optional[Array]:
+        """Cast a float activation to the compute dtype (no-op otherwise)."""
+        if (
+            x is not None
+            and self.compute_dtype is not None
+            and jnp.issubdtype(x.dtype, jnp.floating)
+            and x.dtype != self.compute_dtype
+        ):
+            return x.astype(self.compute_dtype)
+        return x
 
     def layer_rng(self, layer_name: str, salt: str = "") -> Array:
         assert self.rng is not None, "LayerContext.rng not set but layer needs randomness"

@@ -32,8 +32,16 @@ Array = jax.Array
 @register_layer("data")
 def data_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Argument:
     # DataLayer (ref: DataLayer.cpp): passes through the fed Argument.
+    # Under mixed precision, float FEATURES enter the graph in the compute
+    # dtype so every downstream matmul is narrow from the first layer;
+    # pure cost inputs (targets/labels/weights) stay full precision.
     assert len(inputs) == 1, f"data layer {cfg.name} not fed"
-    return inputs[0]
+    a = inputs[0]
+    if a.value is not None and cfg.name not in ctx.no_cast_inputs:
+        cast = ctx.cast_compute(a.value)
+        if cast is not a.value:
+            a = a.replace(value=cast)
+    return a
 
 
 @register_layer("fc")
@@ -112,9 +120,12 @@ def apply_projection(
         if ctx.table_overrides is not None:
             ov = ctx.table_overrides.get((pname, in_cfg.input_layer_name))
             if ov is not None:  # prefetched rows, already [batch..., dim]
-                return ov
-        table = ctx.param(pname)  # [vocab, dim]
-        return jnp.take(table, arg.ids, axis=0)
+                return ctx.cast_compute(ov)
+        # gather from the master-dtype table, THEN cast: converting the
+        # whole [V, D] table to bf16 each step would be an HBM-bound pass
+        # over the full vocabulary
+        table = ctx.param(pname, cast=False)  # [vocab, dim]
+        return ctx.cast_compute(jnp.take(table, arg.ids, axis=0))
     if t == "fc":  # FullMatrixProjection
         return jnp.dot(arg.value, ctx.param(pname))
     if t == "trans_fc":  # TransposedFullMatrixProjection

@@ -22,6 +22,8 @@ from paddle_tpu.graph.argument import Argument
 from paddle_tpu.layers.base import LayerContext, register_layer
 from paddle_tpu.proto import LayerConfig
 
+from paddle_tpu.ops.precision import hp as _hp
+
 Array = jax.Array
 _EPS = 1e-10
 
@@ -51,7 +53,7 @@ def multi_class_cross_entropy(cfg: LayerConfig, inputs: List[Argument], ctx: Lay
     out, label = inputs[0], inputs[1]
     weight = inputs[2] if len(inputs) > 2 else None
     ids = _label_ids(label)
-    p = jnp.take_along_axis(out.value, ids[..., None], axis=-1)[..., 0]
+    p = jnp.take_along_axis(_hp(out.value), ids[..., None], axis=-1)[..., 0]
     per_step = -jnp.log(jnp.clip(p, _EPS, None))
     return _finish_cost(cfg, per_step, out, weight)
 
@@ -62,8 +64,9 @@ def selfnorm_cross_entropy(cfg: LayerConfig, inputs: List[Argument], ctx: LayerC
     # unnormalized softmax plus alpha * log(Z)^2 keeping Z near 1.
     out, label = inputs[0], inputs[1]
     ids = _label_ids(label)
-    z = jnp.sum(out.value, axis=-1)
-    p = jnp.take_along_axis(out.value, ids[..., None], axis=-1)[..., 0]
+    v = _hp(out.value)
+    z = jnp.sum(v, axis=-1)
+    p = jnp.take_along_axis(v, ids[..., None], axis=-1)[..., 0]
     per_step = -jnp.log(jnp.clip(p / jnp.clip(z, _EPS, None), _EPS, None))
     per_step = per_step + cfg.softmax_selfnorm_alpha * jnp.square(jnp.log(jnp.clip(z, _EPS, None)))
     return _finish_cost(cfg, per_step, out, None)
@@ -73,18 +76,19 @@ def selfnorm_cross_entropy(cfg: LayerConfig, inputs: List[Argument], ctx: LayerC
 def square_error(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Argument:
     out, label = inputs[0], inputs[1]
     weight = inputs[2] if len(inputs) > 2 else None
-    target = label.value if label.value is not None else label.ids.astype(out.value.dtype)
+    v = _hp(out.value)
+    target = _hp(label.value) if label.value is not None else label.ids.astype(v.dtype)
     if target.ndim < out.value.ndim:
         target = target[..., None]
-    per_step = jnp.sum(jnp.square(out.value - target), axis=-1)
+    per_step = jnp.sum(jnp.square(v - target), axis=-1)
     return _finish_cost(cfg, per_step, out, weight)
 
 
 @register_layer("multi_binary_label_cross_entropy")
 def multi_binary_label_cross_entropy(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Argument:
     out, label = inputs[0], inputs[1]
-    p = jnp.clip(out.value, _EPS, 1.0 - _EPS)
-    y = label.value
+    p = jnp.clip(_hp(out.value), _EPS, 1.0 - _EPS)
+    y = _hp(label.value)
     per_step = -jnp.sum(y * jnp.log(p) + (1.0 - y) * jnp.log(1.0 - p), axis=-1)
     return _finish_cost(cfg, per_step, out, None)
 
@@ -100,7 +104,7 @@ def rank_cost(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Ar
     # should rank higher, 0.5 for ties), optional weight.
     left, right, label = inputs[0], inputs[1], inputs[2]
     weight = inputs[3] if len(inputs) > 3 else None
-    o = (left.value - right.value)[..., 0]
+    o = (_hp(left.value) - _hp(right.value))[..., 0]
     t = label.value[..., 0] if label.value is not None else label.ids.astype(o.dtype)
     per_step = jnp.logaddexp(0.0, o) - t * o
     return _finish_cost(cfg, per_step, left, weight)
@@ -111,7 +115,7 @@ def huber_two_class(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext)
     # ref: HuberTwoClass — labels {0,1} → y in {-1,+1}; quadratic in
     # (-1, 1), linear outside, zero when y*f >= 1.
     out, label = inputs[0], inputs[1]
-    f = out.value[..., 0]
+    f = _hp(out.value)[..., 0]
     y = 2.0 * _label_ids(label).astype(f.dtype) - 1.0
     a = y * f
     per_step = jnp.where(a < -1.0, -4.0 * a, jnp.where(a < 1.0, jnp.square(1.0 - a), 0.0))
@@ -123,5 +127,5 @@ def classification_error_layer(cfg: LayerConfig, inputs: List[Argument], ctx: La
     # ref: ClassificationErrorLayer — 1.0 where argmax(output) != label.
     out, label = inputs[0], inputs[1]
     pred = jnp.argmax(out.value, axis=-1)
-    err = (pred != _label_ids(label)).astype(out.value.dtype)
+    err = (pred != _label_ids(label)).astype(jnp.promote_types(out.value.dtype, jnp.float32))
     return _finish_cost(cfg, err, out, inputs[2] if len(inputs) > 2 else None)

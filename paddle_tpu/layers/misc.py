@@ -178,10 +178,13 @@ def hsigmoid_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) 
     node_c = jnp.clip(node, 0, num_classes - 2)
     acc = jnp.zeros(bit.shape, jnp.float32)
     for in_cfg, f in zip(cfg.inputs[:-1], feats):
-        w = ctx.param(in_cfg.input_parameter_name)  # [num_classes-1, D]
+        # gather the path rows from the master-dtype table (casting the
+        # whole [num_classes-1, D] table per step would be an HBM-bound
+        # full pass); the cost is an f32 island anyway
+        w = ctx.param(in_cfg.input_parameter_name, cast=False)
         acc = acc + jnp.einsum("bd,bld->bl", f.value, w[node_c])
     if cfg.bias_parameter_name:
-        b = ctx.param(cfg.bias_parameter_name).reshape(-1)  # [num_classes-1]
+        b = ctx.param(cfg.bias_parameter_name, cast=False).reshape(-1)
         acc = acc + b[node_c]
     # per-node binary CE: bit=1 ⇒ -log sigmoid(acc) ... reference sums
     # -log(sigmoid) over the path with sign from the bit.
@@ -219,10 +222,12 @@ def nce_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Ar
     samples = jnp.concatenate([pos[:, None], neg], axis=1)  # [B, 1+k]
     acc = jnp.zeros((B, 1 + k), jnp.float32)
     for in_cfg, f in zip(cfg.inputs[: len(feats)], feats):
-        w = ctx.param(in_cfg.input_parameter_name)  # [num_classes, D]
+        # gather sampled rows from the master-dtype table — NCE's whole
+        # point is avoiding O(vocab) work, so never cast the full table
+        w = ctx.param(in_cfg.input_parameter_name, cast=False)
         acc = acc + jnp.einsum("bd,bkd->bk", f.value, w[samples])
     if cfg.bias_parameter_name:
-        b = ctx.param(cfg.bias_parameter_name).reshape(-1)
+        b = ctx.param(cfg.bias_parameter_name, cast=False).reshape(-1)
         acc = acc + b[samples]
     log_kp = jnp.log(k * jnp.clip(p_noise[samples], _EPS, None))
     delta = acc - log_kp  # logit of P(data | sample)

@@ -41,7 +41,7 @@ def _pool(cfg: LayerConfig, a: Argument, mode: str) -> Argument:
         axis = 1
         lengths = a.seq_lengths
         out_meta = {}
-    m = mask[..., None]
+    m = mask[..., None].astype(x.dtype)  # keep bf16 activations bf16
     if mode == "max":
         neg = jnp.finfo(x.dtype).min
         out = jnp.max(jnp.where(m > 0, x, neg), axis=axis)

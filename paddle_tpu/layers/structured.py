@@ -30,7 +30,7 @@ from jax import lax
 
 from paddle_tpu.graph.argument import Argument
 from paddle_tpu.layers.base import LayerContext, register_layer
-from paddle_tpu.layers.cost import _finish_cost
+from paddle_tpu.layers.cost import _finish_cost, _hp
 from paddle_tpu.ops.activations import apply_activation
 from paddle_tpu.proto import LayerConfig
 
@@ -121,8 +121,10 @@ def crf_decode(x: Array, lengths: Array, param: Array) -> Array:
 def crf_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Argument:
     feats, label = inputs[0], inputs[1]
     weight = inputs[2] if len(inputs) > 2 else None
-    param = ctx.param(cfg.inputs[0].input_parameter_name)
-    nll = crf_log_likelihood(feats.value, label.ids, feats.seq_lengths, param)
+    # CRF recursions are logsumexp chains — run them f32 even when the
+    # features arrive bf16 (param stays master dtype)
+    param = ctx.param(cfg.inputs[0].input_parameter_name, cast=False)
+    nll = crf_log_likelihood(_hp(feats.value), label.ids, feats.seq_lengths, param)
     # per-sequence cost (already reduced over time) — feed _finish_cost a
     # non-sequence view so it only applies coeff/weight.
     return _finish_cost(cfg, nll, Argument(value=nll), weight)
@@ -131,8 +133,8 @@ def crf_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Ar
 @register_layer("crf_decoding")
 def crf_decoding_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Argument:
     feats = inputs[0]
-    param = ctx.param(cfg.inputs[0].input_parameter_name)
-    path = crf_decode(feats.value, feats.seq_lengths, param)
+    param = ctx.param(cfg.inputs[0].input_parameter_name, cast=False)
+    path = crf_decode(_hp(feats.value), feats.seq_lengths, param)
     out = Argument(ids=path, seq_lengths=feats.seq_lengths)
     if len(inputs) > 1:  # label given: per-token 0/1 mismatch (ref: CRFDecodingLayer.cpp:52-62)
         label = inputs[1]
@@ -199,7 +201,7 @@ def ctc_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Ar
     # output to LinearChainCTC, which takes log internally); input 1: label
     # id sequence. blank = size - 1 (LinearChainCTC.cpp:88).
     probs, label = inputs[0], inputs[1]
-    log_p = jnp.log(jnp.clip(probs.value, 1e-10, None))
+    log_p = jnp.log(jnp.clip(_hp(probs.value), 1e-10, None))
     cost = ctc_loss(log_p, probs.seq_lengths, label.ids, label.seq_lengths,
                     blank=cfg.size - 1)
     if cfg.norm_by_times:
@@ -234,8 +236,8 @@ def _ndcg_at_k(scores: Array, rels: Array, mask: Array, k: int):
 def lambda_cost_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Argument:
     # inputs: [model scores (seq, dim 1), relevance scores (seq, dim 1)]
     out, score = inputs[0], inputs[1]
-    s = out.value[..., 0]            # [B, T]
-    r = score.value[..., 0]
+    s = _hp(out.value)[..., 0]       # [B, T]
+    r = _hp(score.value)[..., 0]
     mask = out.seq_mask()
     k = cfg.NDCG_num or 5
     ndcg, disc, idcg = _ndcg_at_k(s, lax.stop_gradient(r), mask, k)

@@ -25,6 +25,7 @@ from jax import lax
 from paddle_tpu.graph.argument import Argument
 from paddle_tpu.layers.base import LayerContext, register_layer
 from paddle_tpu.ops.activations import apply_activation
+from paddle_tpu.ops.precision import hp
 from paddle_tpu.proto import ConvConfig, LayerConfig, OperatorConfig
 
 Array = jax.Array
@@ -46,6 +47,10 @@ def _nhwc_to_flat(x: Array) -> Array:
 
 
 def _conv2d(x_nhwc: Array, w_hwio: Array, stride: Tuple[int, int], padding, groups: int) -> Array:
+    # bf16 in/out is safe on TPU: the MXU accumulates partial products in
+    # f32 internally regardless of the result dtype, so no explicit
+    # preferred_element_type (which this JAX's conv transpose rejects for
+    # mixed bf16-operand/f32-cotangent pairs).
     return lax.conv_general_dilated(
         x_nhwc,
         w_hwio,
@@ -53,8 +58,6 @@ def _conv2d(x_nhwc: Array, w_hwio: Array, stride: Tuple[int, int], padding, grou
         padding=padding,
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
         feature_group_count=groups,
-        # accumulate narrow (bf16) inputs in f32 on the MXU; never narrow f64
-        preferred_element_type=jnp.result_type(x_nhwc.dtype, w_hwio.dtype, jnp.float32),
     )
 
 
@@ -175,18 +178,22 @@ def batch_norm_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext
     else:
         C = cfg.size
         xr = x
-    gamma = ctx.param(cfg.inputs[0].input_parameter_name).reshape(C)
-    beta = ctx.param(cfg.bias_parameter_name).reshape(C) if cfg.bias_parameter_name else 0.0
+    # statistics and normalization run in (at least) f32 even when the
+    # activations are bf16 — bf16 mean/var over big batches is too lossy;
+    # gamma/beta/running stats are master-dtype params (cast=False)
+    gamma = ctx.param(cfg.inputs[0].input_parameter_name, cast=False).reshape(C)
+    beta = ctx.param(cfg.bias_parameter_name, cast=False).reshape(C) if cfg.bias_parameter_name else 0.0
     mean_name = cfg.inputs[1].input_parameter_name
     var_name = cfg.inputs[2].input_parameter_name
     eps = 1e-5
+    xr_hp = hp(xr)
     use_global = cfg.use_global_stats or not ctx.is_training
     if use_global:
         mean = ctx.params[mean_name].reshape(C)
         var = ctx.params[var_name].reshape(C)
     else:
-        mean = jnp.mean(xr, axis=0)
-        var = jnp.var(xr, axis=0)
+        mean = jnp.mean(xr_hp, axis=0)
+        var = jnp.var(xr_hp, axis=0)
         f = cfg.moving_average_fraction
         ctx.state_updates[mean_name] = (
             f * ctx.params[mean_name].reshape(C) + (1.0 - f) * mean
@@ -194,7 +201,7 @@ def batch_norm_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext
         ctx.state_updates[var_name] = (
             f * ctx.params[var_name].reshape(C) + (1.0 - f) * var
         ).reshape(ctx.params[var_name].shape)
-    yn = (xr - mean) * lax.rsqrt(var + eps) * gamma + beta
+    yn = ((xr_hp - mean) * lax.rsqrt(var + eps) * gamma + beta).astype(xr.dtype)
     if ic is not None and ic.img_size > 0:
         y = yn.reshape(x.shape[0], hw, C).transpose(0, 2, 1).reshape(x.shape[0], -1)
     else:

@@ -17,6 +17,7 @@ from typing import Callable, Optional
 import jax
 import jax.numpy as jnp
 
+from paddle_tpu.ops.precision import hp
 from paddle_tpu.utils.registry import Registry
 
 Array = jax.Array
@@ -88,8 +89,9 @@ def exponential(x: Array) -> Array:
 
 
 def softmax(x: Array, mask: Optional[Array] = None) -> Array:
-    # feature-axis softmax (last dim)
-    return jax.nn.softmax(x, axis=-1)
+    # feature-axis softmax (last dim); the exp/sum runs in f32 even for
+    # bf16 activations (mixed-precision islands), result returns narrow
+    return jax.nn.softmax(hp(x), axis=-1).astype(x.dtype)
 
 
 activation_registry.register_obj("softmax", softmax)
@@ -104,11 +106,13 @@ def sequence_softmax(x: Array, mask: Optional[Array] = None) -> Array:
     """
     squeeze = x.ndim == 3
     s = x[..., 0] if squeeze else x
+    s = hp(s)  # f32 island
     if mask is not None:
         s = jnp.where(mask > 0, s, -jnp.inf)
     out = jax.nn.softmax(s, axis=-1)
     if mask is not None:
         out = jnp.where(mask > 0, out, 0.0)
+    out = out.astype(x.dtype)
     return out[..., None] if squeeze else out
 
 

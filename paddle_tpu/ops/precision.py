@@ -1,0 +1,18 @@
+"""Mixed-precision helpers shared across layers/activations.
+
+The f32-island rule: loss math, softmax internals, batch-norm statistics
+and CRF/CTC recursions run in at least f32 even when activations are bf16
+(LayerContext.compute_dtype). `hp` is the single upcast point so the
+promotion policy lives in one place.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def hp(x: jax.Array) -> jax.Array:
+    """Upcast half-precision values to f32; no-op for f32/f64 (x64)."""
+    hi = jnp.promote_types(x.dtype, jnp.float32)
+    return x.astype(hi) if hi != x.dtype else x

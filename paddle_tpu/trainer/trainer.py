@@ -72,7 +72,14 @@ class Trainer:
             # the reference's WITH_DOUBLE build; mostly for gradient checks
             jax.config.update("jax_enable_x64", True)
             dtype = jnp.float64
-        self.gm = GradientMachine(config.model_config, dtype=dtype)
+        from paddle_tpu.graph.machine import compute_dtype_of
+
+        # OptimizationConfig.dtype="bfloat16" → bf16 activations/matmuls
+        # with f32 master weights + optimizer state (x64 builds stay full)
+        compute_dtype = None if flags.use_double else compute_dtype_of(config.opt_config)
+        self.gm = GradientMachine(
+            config.model_config, dtype=dtype, compute_dtype=compute_dtype
+        )
         self.updater = Updater(config.opt_config, config.model_config)
         self.params = self.gm.init_params(seed=flags.seed)
         self.opt_state = self.updater.init_state(self.params)
