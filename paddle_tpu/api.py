@@ -107,7 +107,7 @@ class GradientMachine:
                 assert name in loaded, f"parameter {name!r} missing from {path}"
             self.params = {k: loaded[k] for k in self.params}
         else:
-            if not os.path.exists(os.path.join(path, "params.npz")):
+            if not ckpt.has_params_tree(path):
                 latest = ckpt.latest_pass(path)
                 assert latest is not None, f"no checkpoint under {path}"
                 path = os.path.join(path, ckpt.PASS_FMT % latest)

@@ -123,7 +123,8 @@ def _test_saved_passes(trainer, flags) -> None:
                 continue
             break
         trainer.params, opt_state, _ = ckpt.load_checkpoint(
-            path, trainer.opt_state, expected_params=trainer.params
+            path, trainer.opt_state, expected_params=trainer.params,
+            sharding_for=trainer.ckpt_sharding_for(),
         )
         if opt_state is not None:
             trainer.opt_state = opt_state

@@ -19,7 +19,7 @@ Sharding rules:
 
 from __future__ import annotations
 
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -44,15 +44,23 @@ def _param_shardings(mesh: Mesh, gm) -> Dict[str, NamedSharding]:
     return {name: param_sharding(mesh, cfg) for name, cfg in gm.param_configs.items()}
 
 
+def _slot_sharding(mesh: Mesh, param_sh: NamedSharding, ndim: Optional[int]) -> NamedSharding:
+    """Optimizer-slot sharding policy (single source of truth, used by the
+    train-step in_shardings AND checkpoint restore): row-wise slots (e.g.
+    sparse t_last, [V]) take the leading axes of the parameter's spec;
+    full-shape slots take it whole."""
+    spec = tuple(param_sh.spec)
+    if ndim is not None:
+        spec = spec[:ndim]
+    return NamedSharding(mesh, P(*spec))
+
+
 def _opt_state_sharding(mesh: Mesh, param_shards: Dict[str, NamedSharding], opt_state: UpdaterState):
     repl = NamedSharding(mesh, P())
 
     def slot_shard(name, arr):
         ps = param_shards.get(name, repl)
-        # row-wise slots (e.g. sparse t_last, [V]) take the leading axes of
-        # the parameter's spec; full-shape slots take it whole
-        spec = tuple(ps.spec)[: arr.ndim] if hasattr(arr, "ndim") else tuple(ps.spec)
-        return NamedSharding(mesh, P(*spec))
+        return _slot_sharding(mesh, ps, arr.ndim if hasattr(arr, "ndim") else None)
 
     slots = {
         name: {slot: slot_shard(name, arr) for slot, arr in d.items()}
@@ -73,6 +81,25 @@ def _opt_state_sharding(mesh: Mesh, param_shards: Dict[str, NamedSharding], opt_
         avg_old_sum=avg_old,
         avg_old_count=repl if opt_state.avg_old_count is not None else None,
     )
+
+
+def checkpoint_sharding_fn(mesh: Mesh, gm):
+    """(tree_base, flat_key, shape) → NamedSharding for checkpoint restore:
+    params and averaging sums take the parameter's sharding; optimizer
+    slots take the leading axes of their parameter's spec (row-wise slots
+    like sparse t_last are [V]-shaped); everything else replicates."""
+    param_shards = _param_shardings(mesh, gm)
+    repl = NamedSharding(mesh, P())
+
+    def fn(base: str, key: str, shape) -> NamedSharding:
+        if base in ("params", "optimizer_avg", "optimizer_avg_old"):
+            return param_shards.get(key, repl)
+        if base == "optimizer_slots":
+            pname = key.split("/", 1)[0]
+            return _slot_sharding(mesh, param_shards.get(pname, repl), len(shape))
+        return repl
+
+    return fn
 
 
 def _batch_tree_sharding(mesh: Mesh, batch) -> Any:

@@ -3,9 +3,22 @@
 Reference: ParameterUtil (/root/reference/paddle/trainer/ParamUtil.cpp:
 53-103) wrote one binary file per parameter with a versioned header and
 rolled old pass dirs; the reference did NOT checkpoint optimizer state — we
-do (SURVEY.md §5 flags this as a required upgrade). Format: one .npz for
-params, one for optimizer slots, meta.json for step counters + config
-snapshot. Multi-host sharded checkpointing rides orbax (parallel stage).
+do (SURVEY.md §5 flags this as a required upgrade).
+
+Single-host format: one .npz for params, one per optimizer tree,
+meta.json for step counters + config snapshot.
+
+Multi-host SHARDED format (the pserver-side save/load analog,
+ParameterServer2::loadValueVector/saveValueVector,
+/root/reference/paddle/pserver/ParameterServer2.cpp:1150-1213): every
+process writes the addressable shards it uniquely owns (replica_id == 0)
+to ``<tree>.shard<pid>.npz`` plus a partial index; after a cross-process
+barrier, process 0 merges the partials into ``<tree>.index.json``. The
+save_dir must be a shared filesystem (the standard TPU-pod setup; same
+assumption orbax/GCS makes). Restore assembles each parameter from its
+shard records and re-shards onto the CURRENT mesh via
+``jax.make_array_from_callback`` — a checkpoint written on one mesh
+layout loads onto any other, including single-host ↔ multi-host moves.
 """
 
 from __future__ import annotations
@@ -13,7 +26,7 @@ from __future__ import annotations
 import json
 import os
 import shutil
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -47,6 +60,66 @@ def _unflatten(flat: Dict[str, np.ndarray]) -> Dict:
     return out
 
 
+def _save_tree_sharded(path: str, base: str, flat: Dict[str, jax.Array]) -> None:
+    """Write this process's uniquely-owned shards of one tree + a partial
+    index. Called by EVERY process."""
+    pid = jax.process_index()
+    shard_file = f"{base}.shard{pid:05d}.npz"
+    pieces: Dict[str, np.ndarray] = {}
+    partial: Dict[str, Any] = {}
+    for name, arr in flat.items():
+        arr = jnp.asarray(arr) if not isinstance(arr, jax.Array) else arr
+        entry = {"shape": list(arr.shape), "dtype": str(arr.dtype), "shards": []}
+        for i, sh in enumerate(arr.addressable_shards):
+            if sh.replica_id != 0:
+                continue  # exactly one process owns each distinct slice
+            key = f"{name}::{i}"
+            pieces[key] = np.asarray(sh.data)
+            entry["shards"].append(
+                {
+                    "file": shard_file,
+                    "key": key,
+                    "start": [int(sl.start or 0) for sl in sh.index],
+                }
+            )
+        if entry["shards"]:
+            partial[name] = entry
+    np.savez(os.path.join(path, shard_file), **pieces)
+    with open(os.path.join(path, f"{base}.index.{pid:05d}.json"), "w") as f:
+        json.dump(partial, f)
+
+
+def _merge_tree_indexes(path: str, base: str) -> None:
+    """Process 0, after the barrier: merge partial indexes into
+    ``<base>.index.json`` and drop the partials."""
+    merged: Dict[str, Any] = {}
+    for fn in sorted(os.listdir(path)):
+        if not (fn.startswith(f"{base}.index.") and fn.endswith(".json")):
+            continue
+        if fn == f"{base}.index.json":
+            continue
+        with open(os.path.join(path, fn)) as f:
+            partial = json.load(f)
+        for name, entry in partial.items():
+            if name in merged:
+                assert merged[name]["shape"] == entry["shape"], name
+                merged[name]["shards"].extend(entry["shards"])
+            else:
+                merged[name] = entry
+        os.remove(os.path.join(path, fn))
+    with open(os.path.join(path, f"{base}.index.json"), "w") as f:
+        json.dump(merged, f)
+
+
+def _optimizer_trees(opt_state: UpdaterState) -> Dict[str, Dict]:
+    trees = {"optimizer_slots": _flatten(opt_state.slots)}
+    if opt_state.avg_sum is not None:
+        trees["optimizer_avg"] = _flatten(opt_state.avg_sum)
+    if opt_state.avg_old_sum is not None:
+        trees["optimizer_avg_old"] = _flatten(opt_state.avg_old_sum)
+    return trees
+
+
 def save_checkpoint(
     save_dir: str,
     pass_id: int,
@@ -55,19 +128,30 @@ def save_checkpoint(
     extra_meta: Optional[Dict[str, Any]] = None,
     keep: int = 3,
 ) -> str:
+    """Save one pass directory. In multi-process runs every process must
+    call this (collective); shards are written where they live instead of
+    materializing cross-host arrays on process 0."""
     path = os.path.join(save_dir, PASS_FMT % pass_id)
-    os.makedirs(path, exist_ok=True)
-    np.savez(os.path.join(path, "params.npz"), **_flatten(params))
-    meta: Dict[str, Any] = {"pass_id": pass_id, "format_version": 1}
+    multihost = jax.process_count() > 1
+    if jax.process_index() == 0:
+        # clear any previous contents: a re-save in the OTHER format would
+        # otherwise leave a stale <tree>.index.json that the loader prefers
+        # over the fresh .npz
+        shutil.rmtree(path, ignore_errors=True)
+        os.makedirs(path, exist_ok=True)
+    trees: Dict[str, Dict] = {"params": _flatten(params) if not multihost else dict(params)}
+    meta: Dict[str, Any] = {"pass_id": pass_id, "format_version": 2 if multihost else 1}
     if opt_state is not None:
-        np.savez(os.path.join(path, "optimizer_slots.npz"), **_flatten(opt_state.slots))
-        if opt_state.avg_sum is not None:
-            np.savez(os.path.join(path, "optimizer_avg.npz"), **_flatten(opt_state.avg_sum))
-        if opt_state.avg_old_sum is not None:
-            np.savez(
-                os.path.join(path, "optimizer_avg_old.npz"),
-                **_flatten(opt_state.avg_old_sum),
-            )
+        if multihost:
+            trees["optimizer_slots"] = {
+                f"{n}/{s}": a for n, d in opt_state.slots.items() for s, a in d.items()
+            }
+            if opt_state.avg_sum is not None:
+                trees["optimizer_avg"] = dict(opt_state.avg_sum)
+            if opt_state.avg_old_sum is not None:
+                trees["optimizer_avg_old"] = dict(opt_state.avg_old_sum)
+        else:
+            trees.update(_optimizer_trees(opt_state))
         meta["optimizer"] = {
             "step": int(opt_state.step),
             "num_samples": float(opt_state.num_samples),
@@ -80,9 +164,28 @@ def save_checkpoint(
         }
     if extra_meta:
         meta.update(extra_meta)
-    with open(os.path.join(path, "meta.json"), "w") as f:
-        json.dump(meta, f, indent=2)
-    _rotate(save_dir, keep)
+    if multihost:
+        from jax.experimental import multihost_utils
+
+        # everyone waits for mkdir, writes its shards, then process 0
+        # merges the partial indexes and finalizes meta
+        multihost_utils.sync_global_devices("ckpt_dir:" + path)
+        for base, flat in trees.items():
+            _save_tree_sharded(path, base, flat)
+        multihost_utils.sync_global_devices("ckpt_shards:" + path)
+        if jax.process_index() == 0:
+            for base in trees:
+                _merge_tree_indexes(path, base)
+            with open(os.path.join(path, "meta.json"), "w") as f:
+                json.dump(meta, f, indent=2)
+            _rotate(save_dir, keep)
+        multihost_utils.sync_global_devices("ckpt_done:" + path)
+    else:
+        for base, flat in trees.items():
+            np.savez(os.path.join(path, f"{base}.npz"), **flat)
+        with open(os.path.join(path, "meta.json"), "w") as f:
+            json.dump(meta, f, indent=2)
+        _rotate(save_dir, keep)
     logger.info("saved checkpoint %s", path)
     return path
 
@@ -98,6 +201,13 @@ def _rotate(save_dir: str, keep: int) -> None:
         shutil.rmtree(os.path.join(save_dir, d), ignore_errors=True)
 
 
+def has_params_tree(path: str) -> bool:
+    """True if a pass dir contains a params tree in either format."""
+    return os.path.exists(os.path.join(path, "params.npz")) or os.path.exists(
+        os.path.join(path, "params.index.json")
+    )
+
+
 def latest_pass(save_dir: str) -> Optional[int]:
     if not os.path.isdir(save_dir):
         return None
@@ -107,20 +217,71 @@ def latest_pass(save_dir: str) -> Optional[int]:
     return max(passes) if passes else None
 
 
+def _load_tree_numpy(path: str, base: str) -> Optional[Dict[str, np.ndarray]]:
+    """Read one tree as full host numpy arrays from either format, or
+    None if the tree is absent. Sharded trees are assembled from their
+    shard records (no cross-host transfers — files carry the data)."""
+    idx_path = os.path.join(path, f"{base}.index.json")
+    if os.path.exists(idx_path):
+        with open(idx_path) as f:
+            index = json.load(f)
+        files: Dict[str, Any] = {}
+        try:
+            out = {}
+            for name, entry in index.items():
+                full = np.zeros(tuple(entry["shape"]), np.dtype(entry["dtype"]))
+                for rec in entry["shards"]:
+                    z = files.get(rec["file"])
+                    if z is None:
+                        z = files[rec["file"]] = np.load(os.path.join(path, rec["file"]))
+                    data = z[rec["key"]]
+                    sl = tuple(
+                        slice(st, st + d) for st, d in zip(rec["start"], data.shape)
+                    )
+                    full[sl] = data
+                out[name] = full
+            return out
+        finally:
+            for z in files.values():
+                z.close()
+    npz_path = os.path.join(path, f"{base}.npz")
+    if os.path.exists(npz_path):
+        with np.load(npz_path) as z:
+            return {k: z[k] for k in z.files}
+    return None
+
+
 def load_checkpoint(
     path: str,
     opt_template: Optional[UpdaterState] = None,
     missing: str = "fail",
     expected_params: Optional[Dict[str, jax.Array]] = None,
+    sharding_for: Optional[Callable[[str, str, Any], Any]] = None,
 ) -> Tuple[Dict[str, jax.Array], Optional[UpdaterState], Dict[str, Any]]:
     """Load params (+ optimizer state rebuilt onto ``opt_template``).
 
     ``missing``: fail | rand | zero — the reference's
     --load_missing_parameter_strategy; ``expected_params`` supplies shapes
     (and values, for 'rand') for parameters absent from the file.
+
+    ``sharding_for(tree_base, flat_key, shape)`` (multi-process restore):
+    returns the NamedSharding each value must live on; values are built with
+    ``jax.make_array_from_callback`` so the restore re-shards onto the
+    CURRENT mesh regardless of the layout the checkpoint was written
+    with. Without it values load as host-local arrays (single process).
     """
-    with np.load(os.path.join(path, "params.npz")) as z:
-        params = {k: jnp.asarray(z[k]) for k in z.files}
+
+    def put(base: str, key: str, full):
+        if sharding_for is None:
+            return jnp.asarray(full)
+        full = np.asarray(full)
+        sh = sharding_for(base, key, full.shape)
+        return jax.make_array_from_callback(full.shape, sh, lambda idx, _f=full: _f[idx])
+
+    raw = _load_tree_numpy(path, "params")
+    if raw is None:
+        raise FileNotFoundError(f"no params tree in checkpoint {path}")
+    params = {k: put("params", k, v) for k, v in raw.items()}
     if expected_params is not None:
         for name, val in expected_params.items():
             if name not in params:
@@ -133,29 +294,37 @@ def load_checkpoint(
         with open(meta_path) as f:
             meta = json.load(f)
     opt_state = None
-    slots_path = os.path.join(path, "optimizer_slots.npz")
-    if opt_template is not None and os.path.exists(slots_path):
-        with np.load(slots_path) as z:
-            slots = _unflatten({k: z[k] for k in z.files})
+    raw_slots = _load_tree_numpy(path, "optimizer_slots")
+    if opt_template is not None and raw_slots is not None:
+        slots = _unflatten(
+            {k: put("optimizer_slots", k, v) for k, v in raw_slots.items()}
+        )
         om = meta.get("optimizer", {})
         avg_sum = opt_template.avg_sum
-        avg_path = os.path.join(path, "optimizer_avg.npz")
-        if avg_sum is not None and os.path.exists(avg_path):
-            with np.load(avg_path) as z:
-                avg_sum = {k: jnp.asarray(z[k]) for k in z.files}
+        raw_avg = _load_tree_numpy(path, "optimizer_avg")
+        if avg_sum is not None and raw_avg is not None:
+            avg_sum = {k: put("optimizer_avg", k, v) for k, v in raw_avg.items()}
         avg_old_sum = opt_template.avg_old_sum
-        avg_old_path = os.path.join(path, "optimizer_avg_old.npz")
-        if avg_old_sum is not None and os.path.exists(avg_old_path):
-            with np.load(avg_old_path) as z:
-                avg_old_sum = {k: jnp.asarray(z[k]) for k in z.files}
+        raw_avg_old = _load_tree_numpy(path, "optimizer_avg_old")
+        if avg_old_sum is not None and raw_avg_old is not None:
+            avg_old_sum = {
+                k: put("optimizer_avg_old", k, v) for k, v in raw_avg_old.items()
+            }
+
+        def scalar(v, dtype):
+            # multi-process: keep host numpy — jit treats it as replicated
+            # input; a committed single-device jnp array would fail to
+            # reshard across processes
+            return np.asarray(v, dtype) if sharding_for is not None else jnp.asarray(v, dtype)
+
         opt_state = UpdaterState(
-            step=jnp.asarray(om.get("step", 0), jnp.int32),
-            num_samples=jnp.asarray(om.get("num_samples", 0.0), jnp.float32),
-            slots={k: {s: jnp.asarray(v) for s, v in d.items()} for k, d in slots.items()},
+            step=scalar(om.get("step", 0), jnp.int32),
+            num_samples=scalar(om.get("num_samples", 0.0), jnp.float32),
+            slots=slots,
             avg_sum=avg_sum,
-            avg_count=jnp.asarray(om.get("avg_count", 0.0), jnp.float32),
+            avg_count=scalar(om.get("avg_count", 0.0), jnp.float32),
             avg_old_sum=avg_old_sum,
-            avg_old_count=jnp.asarray(om.get("avg_old_count", 0.0), jnp.float32),
+            avg_old_count=scalar(om.get("avg_old_count", 0.0), jnp.float32),
         )
     logger.info("loaded checkpoint %s", path)
     return params, opt_state, meta

@@ -107,14 +107,26 @@ class Trainer:
 
     # ------------------------------------------------------------ restore
 
+    def ckpt_sharding_for(self):
+        """Multi-process restore must rebuild every value as a global
+        array sharded onto the CURRENT mesh (a host-local jnp array could
+        not be resharded across processes by jit). None single-process."""
+        if self._mesh is None or not self._multiproc:
+            return None
+        from paddle_tpu.parallel.spmd import checkpoint_sharding_fn
+
+        return checkpoint_sharding_fn(self._mesh, self.gm)
+
     def _maybe_restore(self) -> None:
         init_path = self.flags.init_model_path or self.config.init_model_path
+        sharding_for = self.ckpt_sharding_for()
         if init_path:
             self.params, opt_state, _ = ckpt.load_checkpoint(
                 init_path,
                 self.opt_state,
                 missing=self.flags.load_missing_parameter_strategy,
                 expected_params=self.params,
+                sharding_for=sharding_for,
             )
             if opt_state is not None:
                 self.opt_state = opt_state
@@ -122,7 +134,8 @@ class Trainer:
         if self.start_pass > 0:
             path = os.path.join(self.save_dir, ckpt.PASS_FMT % (self.start_pass - 1))
             self.params, opt_state, _ = ckpt.load_checkpoint(
-                path, self.opt_state, expected_params=self.params
+                path, self.opt_state, expected_params=self.params,
+                sharding_for=sharding_for,
             )
             if opt_state is not None:
                 self.opt_state = opt_state
@@ -519,8 +532,10 @@ class Trainer:
     # -------------------------------------------------------------- save
 
     def save(self, pass_id: int, batch_id: Optional[int] = None, final: bool = False) -> None:
-        if self._multiproc and jax.process_index() != 0:
-            return  # one writer per cluster (sharded orbax save is separate)
+        # collective in multi-process runs: each host writes the shards it
+        # owns (ckpt.save_checkpoint handles the barrier + index merge) —
+        # a cross-host model-sharded parameter is never materialized on
+        # one process
         extra = {"config_json": self.config.to_json()}
         if batch_id is not None:
             extra["batch_id"] = batch_id
