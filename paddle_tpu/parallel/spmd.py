@@ -19,6 +19,7 @@ Sharding rules:
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Dict, Optional
 
 import jax
@@ -80,6 +81,36 @@ def _opt_state_sharding(mesh: Mesh, param_shards: Dict[str, NamedSharding], opt_
         step=repl, num_samples=repl, slots=slots, avg_sum=avg, avg_count=repl,
         avg_old_sum=avg_old,
         avg_old_count=repl if opt_state.avg_old_count is not None else None,
+    )
+
+
+@functools.lru_cache(maxsize=8)
+def _replicate_fn(mesh: Mesh):
+    # one cached PjitFunction per mesh so per-batch gathers hit the jit
+    # cache instead of retracing every call
+    return jax.jit(lambda a: a, out_shardings=NamedSharding(mesh, P()))
+
+
+def replicate_to_host(x, mesh: Mesh):
+    """All-gather a (possibly cross-host sharded) array and return the
+    FULL value as host numpy on every process. The jit identity with a
+    replicated out_sharding compiles to one all-gather over ICI."""
+    import numpy as np
+
+    return np.asarray(_replicate_fn(mesh)(x).addressable_data(0))
+
+
+def gather_outputs(outputs, mesh: Mesh, names=None):
+    """Materialize (selected) layer outputs as full host values on every
+    process — the distributeEval analog (reference Evaluator::
+    distributeEval merges per-trainer evaluator state over the pserver,
+    /root/reference/paddle/gserver/evaluators/Evaluator.h:81-82; here
+    each host instead sees the full small output batch and computes
+    identical merged metrics). ``names`` limits the gather to the layers
+    the evaluator chain actually reads."""
+    picked = outputs if names is None else {k: outputs[k] for k in names if k in outputs}
+    return jax.tree_util.tree_map(
+        lambda x: None if x is None else replicate_to_host(x, mesh), picked
     )
 
 

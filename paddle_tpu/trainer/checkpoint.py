@@ -334,7 +334,8 @@ def merge_model(save_dir: str, pass_id: int, config_json: str, out_path: str) ->
     """MergeModel analog (/root/reference/paddle/trainer/MergeModel.cpp):
     bundle config + parameters into one deployable .npz."""
     path = os.path.join(save_dir, PASS_FMT % pass_id)
-    with np.load(os.path.join(path, "params.npz")) as z:
-        arrays = {k: z[k] for k in z.files}
+    arrays = _load_tree_numpy(path, "params")
+    if arrays is None:
+        raise FileNotFoundError(f"no params tree in checkpoint {path}")
     arrays["__config_json__"] = np.frombuffer(config_json.encode(), dtype=np.uint8)
     np.savez(out_path, **arrays)

@@ -502,6 +502,20 @@ class EvaluatorChain:
             if cfg.type in evaluator_registry:
                 self.evaluators.append(evaluator_registry.get(cfg.type)(cfg))
 
+    def __bool__(self) -> bool:
+        return bool(self.evaluators)
+
+    @property
+    def needed_layers(self) -> List[str]:
+        """Layer outputs the chain reads — multi-process runs gather only
+        these to the host (distributeEval analog, Evaluator.h:81-82)."""
+        seen: List[str] = []
+        for e in self.evaluators:
+            for n in e.cfg.input_layers:
+                if n not in seen:
+                    seen.append(n)
+        return seen
+
     def start(self):
         for e in self.evaluators:
             e.start()

@@ -97,6 +97,15 @@ class Trainer:
 
             self._mesh = make_mesh(mesh_shape)
             self.gm.mesh = self._mesh  # layers with explicit collectives
+        # sync-SGD over a data-parallel mesh needs every device to get an
+        # identical batch slice: batches whose size is not divisible by
+        # the data axis (the end-of-pass remainder) are skipped, matching
+        # globalize_batch's multi-host policy (doc/divergences.md)
+        self._batch_divisor = 1
+        if self._mesh is not None:
+            self._batch_divisor = dict(
+                zip(self._mesh.axis_names, self._mesh.devices.shape)
+            ).get("data", 1)
         self._multiproc = jax.process_count() > 1
         if self._multiproc and self._mesh is None:
             raise ValueError(
@@ -234,6 +243,9 @@ class Trainer:
         batch_id = 0
         step_times: list = []
         for batch in provider.batches():
+            if self._batch_divisor > 1 and _batch_num_samples(batch) % self._batch_divisor:
+                self._warn_remainder(_batch_num_samples(batch))
+                continue
             if self._multiproc:
                 from paddle_tpu.parallel.spmd import globalize_batch
 
@@ -268,11 +280,7 @@ class Trainer:
                     "learning rate, or gradient clipping to locate the cause."
                 )
             stats.add(loss_f * n, n)
-            if not self._multiproc:
-                # evaluators read outputs to host numpy; under multi-process
-                # SPMD the output shards live on other hosts (divergence
-                # note: per-host evaluators are not merged — use test())
-                evaluators.eval_batch(outputs)
+            self._eval_outputs(evaluators, outputs)
             batch_id += 1
             if self.flags.dot_period and batch_id % self.flags.dot_period == 0:
                 print(".", end="", flush=True, file=sys.stderr)
@@ -331,6 +339,28 @@ class Trainer:
 
         step_time_skew_summary(step_times)
 
+    def _eval_outputs(self, evaluators: EvaluatorChain, outputs) -> None:
+        """Feed one batch's outputs to the evaluator chain. Multi-process:
+        gather the (small) evaluator inputs to every host first, so each
+        computes identical merged metrics (distributeEval analog)."""
+        if not evaluators:
+            return
+        if self._multiproc:
+            from paddle_tpu.parallel.spmd import gather_outputs
+
+            outputs = gather_outputs(outputs, self._mesh, evaluators.needed_layers)
+        evaluators.eval_batch(outputs)
+
+    def _warn_remainder(self, n: int) -> None:
+        if not getattr(self, "_remainder_warned", False):
+            self._remainder_warned = True
+            logger.warning(
+                "skipping remainder batch of %d samples (not divisible by "
+                "the %d-way data axis); pad the dataset or pick a batch "
+                "size multiple of the mesh to use every sample", n,
+                self._batch_divisor,
+            )
+
     def _end_dot_line(self) -> None:
         """Terminate a run of progress dots before a log line (the
         reference printed the newline in TrainerInternal too)."""
@@ -363,6 +393,9 @@ class Trainer:
         evaluators.start()
         for batch in provider.batches():
             n = _batch_num_samples(batch)
+            if self._batch_divisor > 1 and n % self._batch_divisor:
+                self._warn_remainder(n)
+                continue
             if self._multiproc:
                 from paddle_tpu.parallel.spmd import globalize_batch
 
@@ -372,19 +405,11 @@ class Trainer:
             outputs = self.test_fwd(params, batch)
             cost = float(self.gm.total_cost(outputs))
             stats.add(cost * n, n)
-            if not self._multiproc:
-                evaluators.eval_batch(outputs)
+            self._eval_outputs(evaluators, outputs)
         results = {"cost": stats.total_cost / max(stats.total_samples, 1)}
-        if self._multiproc:
-            # evaluator metrics are NOT computed multi-process (outputs are
-            # sharded across hosts) — report only the cost rather than
-            # zero-sample evaluator numbers
-            logger.info("Test (pass %d): %s  (evaluators skipped: multi-process)",
-                        pass_id, stats.summary())
-        else:
-            results.update(evaluators.results())
-            logger.info("Test (pass %d): %s  %s", pass_id, stats.summary(),
-                        evaluators.summary())
+        results.update(evaluators.results())
+        logger.info("Test (pass %d): %s  %s", pass_id, stats.summary(),
+                    evaluators.summary())
         return results
 
     def predict(self, provider: DataProvider, params=None) -> Dict[str, float]:

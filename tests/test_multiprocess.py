@@ -55,6 +55,26 @@ FLAGS.seed = 7
 ws = sys.argv[3]
 trainer = Trainer(parse_config(os.path.join(ws, "cfg.py")))
 trainer.train(num_passes=1)
+
+# distributeEval analog: every process computes the MERGED evaluator
+# metrics over the full globalized batches; results must be identical
+# across processes (asserted host-side) and match the single-process run
+import json
+from paddle_tpu.parallel.spmd import gather_outputs, globalize_batch
+from paddle_tpu.trainer.evaluators import EvaluatorChain
+
+chain = EvaluatorChain(trainer.config.model_config)
+chain.start()
+provider = trainer._provider(for_test=False)
+for batch in provider.batches():
+    b = globalize_batch(batch, trainer._mesh)
+    if b is None:
+        continue
+    outputs = trainer.test_fwd(trainer.params, b)
+    chain.eval_batch(gather_outputs(outputs, trainer._mesh, chain.needed_layers))
+with open(os.path.join(ws, "eval_p%d.json" % pid), "w") as f:
+    json.dump(chain.results(), f)
+
 if jax.process_index() == 0:
     import numpy as np
     np.savez(os.path.join(ws, "mp_params.npz"),
@@ -148,3 +168,29 @@ def test_two_process_training_matches_single(tmp_path):
             np.asarray(ref_v), mp_params[name], rtol=2e-4, atol=1e-5,
             err_msg=name,
         )
+
+    # merged evaluator metrics: identical on every process, and the
+    # classification error matches the single-process run over the same
+    # data with the (numerically near-identical) final parameters
+    import json
+    from paddle_tpu.trainer.evaluators import EvaluatorChain
+
+    with open(os.path.join(ws, "eval_p0.json")) as f:
+        eval_p0 = json.load(f)
+    with open(os.path.join(ws, "eval_p1.json")) as f:
+        eval_p1 = json.load(f)
+    assert eval_p0 == eval_p1, (eval_p0, eval_p1)
+    assert eval_p0, "no evaluator results produced"
+
+    sys.path.insert(0, PROVIDERS)
+    try:
+        chain = EvaluatorChain(ref.config.model_config)
+        chain.start()
+        provider = ref._provider(for_test=False)
+        for batch in provider.batches():
+            chain.eval_batch(ref.test_fwd(ref.params, batch))
+        ref_results = chain.results()
+    finally:
+        sys.path.remove(PROVIDERS)
+    for k, v in ref_results.items():
+        assert abs(eval_p0[k] - v) <= 5e-3, (k, eval_p0[k], v)
