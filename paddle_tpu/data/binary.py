@@ -65,7 +65,22 @@ def write_shard(path: str, samples: List[Sequence[Any]], input_types: Sequence[I
             arrays[f"s{i}_data"] = np.asarray(flat, dtype=dtype)
             arrays[f"s{i}_offs"] = offs
         else:
-            raise NotImplementedError("binary shards: nested sequences not supported yet")
+            # nested sequences (ref ProtoDataProvider subseq handling,
+            # ProtoDataProvider.h:49): two offset levels — sub_offs maps
+            # each SUBSEQUENCE to its flat token range, offs maps each
+            # sample to its subsequence range
+            offs = np.zeros(n + 1, np.int64)
+            sub_offs: List[int] = [0]
+            flat = []
+            for j, subseqs in enumerate(col):
+                for seq in subseqs:
+                    flat.extend(seq)
+                    sub_offs.append(len(flat))
+                offs[j + 1] = len(sub_offs) - 1
+            dtype = np.int32 if tp.type == DataType.Index else np.float32
+            arrays[f"s{i}_data"] = np.asarray(flat, dtype=dtype)
+            arrays[f"s{i}_offs"] = offs
+            arrays[f"s{i}_sub_offs"] = np.asarray(sub_offs, dtype=np.int64)
     meta = {"magic": MAGIC, "n": n, "types": [_type_dict(t) for t in input_types]}
     arrays["__meta__"] = np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8)
     # np.savez appends .npz to a bare path; write through a file object so
@@ -96,9 +111,18 @@ def read_shard(path: str):
                         sample.append(list(zip(ids.tolist(), vals.tolist())))
                     else:
                         sample.append(ids.tolist())
-            else:
+            elif tp.seq_type == SequenceType.SEQUENCE:
                 lo, hi = arrays[f"s{i}_offs"][j], arrays[f"s{i}_offs"][j + 1]
                 sample.append(arrays[f"s{i}_data"][lo:hi].tolist())
+            else:  # nested: sample -> subseq range -> token ranges
+                lo, hi = arrays[f"s{i}_offs"][j], arrays[f"s{i}_offs"][j + 1]
+                so = arrays[f"s{i}_sub_offs"]
+                sample.append(
+                    [
+                        arrays[f"s{i}_data"][so[s] : so[s + 1]].tolist()
+                        for s in range(int(lo), int(hi))
+                    ]
+                )
         yield sample
 
 

@@ -127,6 +127,14 @@ class GradientMachine:
             if n in layer_map
         )
 
+    def cost_layer_names(self):
+        layer_map = self.network.layer_map
+        return [
+            n
+            for n in self.network.output_layer_names
+            if n in layer_map and layer_map[n].type in self.COST_TYPES
+        ]
+
     def total_cost(self, outputs: Dict[str, Argument]) -> Array:
         """Mean per-sample cost summed across cost-layer outputs.
 

@@ -165,6 +165,41 @@ def _run_submodel_step(
     return step_ctx.outputs
 
 
+def _memory_feed_arg(mem, carry) -> Argument:
+    """Turn a scan/beam carry back into the Argument fed to the step
+    (shared by training and generation)."""
+    if mem.is_sequence:
+        v, sl = carry
+        return (
+            Argument(ids=v, seq_lengths=sl)
+            if _is_int_carry(v)
+            else Argument(value=v, seq_lengths=sl)
+        )
+    return _carry_to_arg(carry)
+
+
+def _advance_seq_memory(mem, old, out_arg: Argument, Tm: int, n_rows: int):
+    """New (value, lengths) for a sequence memory from the linked layer's
+    step output, padded/clamped to the FIXED capacity Tm (the boot
+    sequence's padded length — XLA carries need static shapes, so a
+    carried sequence cannot grow past the boot's capacity; pad the boot
+    to the maximum length the step may produce; see doc/divergences.md).
+    Callers apply their own keep-mask (per-sample in training, per-beam
+    in generation)."""
+    old_v, _ = old
+    new_v = out_arg.ids if _is_int_carry(old_v) else out_arg.value
+    assert new_v.ndim == old_v.ndim, (
+        f"sequence memory {mem.layer_name!r}: linked layer must "
+        "produce a sequence frame"
+    )
+    new_v = _pad_time(new_v, Tm)
+    if out_arg.seq_lengths is not None:
+        new_l = jnp.minimum(out_arg.seq_lengths, Tm)
+    else:
+        new_l = jnp.full((n_rows,), Tm, jnp.int32)
+    return new_v, new_l
+
+
 def _pad_time(x: Array, T: int) -> Array:
     """Pad or slice axis 1 to exactly T (static shapes for scan carries)."""
     if x.shape[1] == T:
@@ -258,15 +293,7 @@ def _forward_scan(network, cfg: LayerConfig, sub: SubModelConfig, ctx: LayerCont
         for name, arg in statics.items():
             fed[name] = arg
         for i, (mem, carry) in enumerate(zip(memories, carries)):
-            if mem.is_sequence:
-                v, sl = carry
-                fed[mem.link_name] = (
-                    Argument(ids=v, seq_lengths=sl)
-                    if _is_int_carry(v)
-                    else Argument(value=v, seq_lengths=sl)
-                )
-            else:
-                fed[mem.link_name] = _carry_to_arg(carry)
+            fed[mem.link_name] = _memory_feed_arg(mem, carry)
         rng = jax.random.fold_in(base_rng, t_idx) if base_rng is not None else None
         outs = _run_submodel_step(network, sub, ctx, fed, rng)
         new_carries = []
@@ -275,17 +302,7 @@ def _forward_scan(network, cfg: LayerConfig, sub: SubModelConfig, ctx: LayerCont
             out_arg = outs[mem.layer_name]
             if mem.is_sequence:
                 old_v, old_l = old
-                Tm = seq_mem_T[i]
-                new_v = out_arg.ids if _is_int_carry(old_v) else out_arg.value
-                assert new_v.ndim == old_v.ndim, (
-                    f"sequence memory {mem.layer_name!r}: linked layer must "
-                    "produce a sequence frame"
-                )
-                new_v = _pad_time(new_v, Tm)
-                if out_arg.seq_lengths is not None:
-                    new_l = jnp.minimum(out_arg.seq_lengths, Tm)
-                else:
-                    new_l = jnp.full((B,), Tm, jnp.int32)
+                new_v, new_l = _advance_seq_memory(mem, old, out_arg, seq_mem_T[i], B)
                 keep = m_t > 0
                 keep_v = keep.reshape((B,) + (1,) * (new_v.ndim - 1))
                 new_carries.append(
@@ -405,10 +422,7 @@ def _generate(network, cfg: LayerConfig, sub: SubModelConfig, ctx: LayerContext)
         L = min(L, L_in)
 
     memories = list(sub.memories)
-    boots = []
     for mem in memories:
-        if mem.is_sequence:
-            raise NotImplementedError("sequence-valued memories in generation")
         if mem.boot_layer_name and B is None:
             B = _scope_lookup(ctx, mem.boot_layer_name).batch_size
     assert B is not None, f"generation group {cfg.name}: cannot infer batch size"
@@ -421,12 +435,23 @@ def _generate(network, cfg: LayerConfig, sub: SubModelConfig, ctx: LayerContext)
         for v in in_xs_v.values():
             gen_dtype = v.dtype
             break
-    for mem in memories:
-        boots.append(_memory_boot(network, mem, ctx, B, gen_dtype, sub))
-    # expand memories across beams: [B, D] → [B*K, D]
-    carries0 = tuple(
-        jnp.repeat(b, K, axis=0) for b in boots
-    )
+    # boot memories and expand them across beams: [B, ...] → [B*K, ...].
+    # Sequence-valued memories (seqFlag branch of createMemoryFrameInfo,
+    # ref RecurrentGradientMachine.cpp:740-744) carry a (padded sequence,
+    # lengths) pair so step s reads step s-1's FULL output sequence —
+    # hierarchical decoders at generation time.
+    carries0 = []
+    seq_mem_T: Dict[int, int] = {}
+    for i, mem in enumerate(memories):
+        if mem.is_sequence:
+            v, sl = _memory_boot_seq(network, mem, ctx, sub)
+            seq_mem_T[i] = v.shape[1]
+            carries0.append((jnp.repeat(v, K, axis=0), jnp.repeat(sl, K, axis=0)))
+        else:
+            carries0.append(
+                jnp.repeat(_memory_boot(network, mem, ctx, B, gen_dtype, sub), K, axis=0)
+            )
+    carries0 = tuple(carries0)
 
     # the feed agent for previously generated ids (created by beam_search())
     predict_agent = f"__generated_id@{cfg.name}"
@@ -465,7 +490,7 @@ def _generate(network, cfg: LayerConfig, sub: SubModelConfig, ctx: LayerContext)
         for name, arg in statics.items():
             fed[name] = arg
         for mem, carry in zip(memories, carries):
-            fed[mem.link_name] = _carry_to_arg(carry)
+            fed[mem.link_name] = _memory_feed_arg(mem, carry)
         rng = jax.random.fold_in(base_rng, t_idx) if base_rng is not None else None
         outs = _run_submodel_step(network, sub, ctx, fed, rng)
         probs = outs[score_layer].value  # [B*K, V]
@@ -482,20 +507,28 @@ def _generate(network, cfg: LayerConfig, sub: SubModelConfig, ctx: LayerContext)
         top_scores, top_idx = jax.lax.top_k(flat, K)  # [B, K]
         beam_idx = top_idx // V                        # [B, K]
         token = (top_idx % V).astype(jnp.int32)        # [B, K]
-        # advance memories with this step's outputs, then reindex by the
-        # selected beams
+        # advance memories with this step's outputs (finished beams freeze
+        # their state), then reindex by the selected beams
         flat_sel = (jnp.arange(B)[:, None] * K + beam_idx).reshape(-1)  # [B*K]
-        stepped = tuple(
-            outs[mem.layer_name].ids if _is_int_carry(old) else outs[mem.layer_name].value
-            for mem, old in zip(memories, carries)
-        )
-        # finished beams freeze their state
         fin_flat = finished.reshape(-1)
-        frozen = tuple(
-            jnp.where(fin_flat[:, None] if new.ndim == 2 else fin_flat, old, new)
-            for old, new in zip(carries, stepped)
-        )
-        new_carries = tuple(c[flat_sel] for c in frozen)
+
+        def freeze(old, new):
+            keep = fin_flat.reshape((-1,) + (1,) * (new.ndim - 1))
+            return jnp.where(keep if new.ndim > 1 else fin_flat, old, new)
+
+        new_carries = []
+        for i, (mem, old) in enumerate(zip(memories, carries)):
+            out_arg = outs[mem.layer_name]
+            if mem.is_sequence:
+                old_v, old_l = old
+                new_v, new_l = _advance_seq_memory(mem, old, out_arg, seq_mem_T[i], B * K)
+                new_carries.append(
+                    (freeze(old_v, new_v)[flat_sel], freeze(old_l, new_l)[flat_sel])
+                )
+            else:
+                new = out_arg.ids if _is_int_carry(old) else out_arg.value
+                new_carries.append(freeze(old, new)[flat_sel])
+        new_carries = tuple(new_carries)
         finished = jnp.take_along_axis(finished, beam_idx, axis=1)
         lens = jnp.take_along_axis(lens, beam_idx, axis=1)
         history = jnp.take_along_axis(history, beam_idx[:, :, None], axis=1)

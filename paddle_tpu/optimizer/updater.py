@@ -45,8 +45,12 @@ class UpdaterState(NamedTuple):
 
 
 class Updater:
-    def __init__(self, opt: OptimizationConfig, model: ModelConfig):
+    def __init__(self, opt: OptimizationConfig, model: ModelConfig,
+                 init_model_path: str = ""):
         self.opt = opt
+        # pruning-mask search root (reference ctor falls back to
+        # --init_model_path); the trainer passes its resolved path
+        self.init_model_path = init_model_path
         self.param_configs: Dict[str, ParameterConfig] = {p.name: p for p in model.parameters}
         self.method = opt.learning_method
         self.averaging = opt.average_window > 0
@@ -56,6 +60,16 @@ class Updater:
         self.avg_frac = float(opt.average_window)
         self.max_window = float(opt.max_average_window)
         self.min_window = float(min(10000, opt.max_average_window))
+        # StaticPruningHook (ParameterUpdaterHook.cpp:37): masks loaded
+        # lazily (need shapes) in init_state; gradients are masked every
+        # step, values once via apply_init_hooks
+        self._prune_files = {
+            name: h.purning_mask_filename
+            for name, cfg in self.param_configs.items()
+            for h in cfg.update_hooks
+            if h.type in ("pruning", "static_pruning")
+        }
+        self._masks: Dict[str, Array] = {}
 
     # ------------------------------------------------------------- state
 
@@ -77,7 +91,37 @@ class Updater:
             return ["m", "u"]
         raise ValueError(f"unknown learning_method {m!r}")
 
+    def _load_masks(self, params: Params) -> None:
+        if not self._prune_files or self._masks:
+            return
+        from paddle_tpu.optimizer.hooks import resolve_mask
+        from paddle_tpu.utils.flags import FLAGS
+
+        root = self.init_model_path or FLAGS.init_model_path
+        for name, fn in self._prune_files.items():
+            cfg = self.param_configs[name]
+            assert not cfg.sparse_update, (
+                f"{name}: pruning hook is not supported together with "
+                "sparse_update (the row-sparse gradient path)"
+            )
+            self._masks[name] = jnp.asarray(
+                resolve_mask(fn, params[name].shape, root),
+                params[name].dtype,
+            )
+
+    def apply_init_hooks(self, params: Params) -> Params:
+        """StaticPruningHook::init — mask parameter values once at
+        startup (call after init/restore)."""
+        self._load_masks(params)
+        if not self._masks:
+            return params
+        return {
+            k: (v * self._masks[k] if k in self._masks else v)
+            for k, v in params.items()
+        }
+
     def init_state(self, params: Params) -> UpdaterState:
+        self._load_masks(params)
         slots = {}
         for name, p in params.items():
             cfg = self.param_configs.get(name)
@@ -119,6 +163,9 @@ class Updater:
                 new_slots[name] = state.slots.get(name, {})
                 continue
             g = grads[name]
+            if name in self._masks and not isinstance(g, RowSparseGrad):
+                # StaticPruningHook::update — pruned weights get no gradient
+                g = g * self._masks[name]
             clip = cfg.gradient_clipping_threshold or opt.gradient_clipping_threshold
             lr = base_lr * (cfg.learning_rate if cfg.learning_rate else 1.0)
             if isinstance(g, RowSparseGrad):

@@ -40,6 +40,12 @@ Array = jax.Array
 
 _NEG = -1e30  # big-negative instead of -inf: keeps fully-masked rows NaN-free
 
+# rings up to this size unroll (XLA overlaps each ppermute with the next
+# block's matmuls); larger rings roll with lax.scan so program size stays
+# O(1) in n. Module-level so tests can force the scan path on small meshes
+# (the 64-chip branch must not be dead untested code).
+RING_UNROLL_MAX = 8
+
 
 def full_attention(
     q: Array, k: Array, v: Array,
@@ -133,7 +139,7 @@ def _ring_attention_local(q, k, v, lengths, causal, axis_name):
         )
         return o, m_new, l
 
-    if n <= 8:
+    if n <= RING_UNROLL_MAX:
         # unrolled ring (n is static under shard_map): no permute after the
         # last block, and XLA can overlap each ppermute with the next matmul
         o, m, l = o0, m0, l0

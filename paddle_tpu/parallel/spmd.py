@@ -107,11 +107,13 @@ def gather_outputs(outputs, mesh: Mesh, names=None):
     /root/reference/paddle/gserver/evaluators/Evaluator.h:81-82; here
     each host instead sees the full small output batch and computes
     identical merged metrics). ``names`` limits the gather to the layers
-    the evaluator chain actually reads."""
+    the evaluator chain actually reads. The whole picked tree goes through
+    ONE jitted all-gather (one collective, one host sync per batch)."""
+    import numpy as np
+
     picked = outputs if names is None else {k: outputs[k] for k in names if k in outputs}
-    return jax.tree_util.tree_map(
-        lambda x: None if x is None else replicate_to_host(x, mesh), picked
-    )
+    rep = _replicate_fn(mesh)(picked)
+    return jax.tree_util.tree_map(lambda x: np.asarray(x.addressable_data(0)), rep)
 
 
 def checkpoint_sharding_fn(mesh: Mesh, gm):

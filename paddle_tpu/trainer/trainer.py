@@ -80,7 +80,10 @@ class Trainer:
         self.gm = GradientMachine(
             config.model_config, dtype=dtype, compute_dtype=compute_dtype
         )
-        self.updater = Updater(config.opt_config, config.model_config)
+        self.updater = Updater(
+            config.opt_config, config.model_config,
+            init_model_path=flags.init_model_path or config.init_model_path,
+        )
         self.params = self.gm.init_params(seed=flags.seed)
         self.opt_state = self.updater.init_state(self.params)
         self.start_pass = flags.start_pass or config.start_pass
@@ -113,6 +116,8 @@ class Trainer:
                 "(--mesh_shape or --trainer_count)"
             )
         self._maybe_restore()
+        # StaticPruningHook init semantics: mask values once at startup
+        self.params = self.updater.apply_init_hooks(self.params)
 
     # ------------------------------------------------------------ restore
 
@@ -242,16 +247,7 @@ class Trainer:
         t0 = time.time()
         batch_id = 0
         step_times: list = []
-        for batch in provider.batches():
-            if self._batch_divisor > 1 and _batch_num_samples(batch) % self._batch_divisor:
-                self._warn_remainder(_batch_num_samples(batch))
-                continue
-            if self._multiproc:
-                from paddle_tpu.parallel.spmd import globalize_batch
-
-                batch = globalize_batch(batch, self._mesh)
-                if batch is None:  # remainder batch not divisible by hosts
-                    continue
+        for n, _host_batch, batch in self._global_batches(provider):
             if (
                 self.flags.profile_dir
                 and pass_id == self.start_pass
@@ -260,7 +256,6 @@ class Trainer:
                 jax.profiler.start_trace(self.flags.profile_dir)
                 profiling = True
                 logger.info("profiler trace started → %s", self.flags.profile_dir)
-            n = _batch_num_samples(batch)
             rng, step_rng = jax.random.split(rng)
             t_step = time.perf_counter()
             with stat_timer("train_step"):
@@ -339,13 +334,47 @@ class Trainer:
 
         step_time_skew_summary(step_times)
 
-    def _eval_outputs(self, evaluators: EvaluatorChain, outputs) -> None:
+    @property
+    def _is_writer(self) -> bool:
+        """Exactly one process writes result/prediction files."""
+        return not self._multiproc or jax.process_index() == 0
+
+    def _global_batches(self, provider: DataProvider, pad: bool = False):
+        """Yield (n_samples, host batch, mesh-ready batch).
+
+        Batches that cannot be evenly sharded (data-axis divisor ×
+        multi-host process count): training SKIPS them with a one-time
+        warning (sync-SGD needs identical per-device slices;
+        doc/divergences.md), inference jobs (``pad=True``) PAD them by
+        repeating the last sample and the caller trims outputs back to n
+        — every sample is processed exactly once."""
+        div = self._batch_divisor
+        if self._multiproc:
+            div = div * jax.process_count() // _gcd(div, jax.process_count())
+        for batch in provider.batches():
+            n = _batch_num_samples(batch)
+            if div > 1 and n % div:
+                if not pad:
+                    self._warn_remainder(n)
+                    continue
+                batch = _pad_batch(batch, n + (div - n % div))
+            if self._multiproc:
+                from paddle_tpu.parallel.spmd import globalize_batch
+
+                g = globalize_batch(batch, self._mesh)
+                assert g is not None  # padded/skipped to divisibility above
+                yield n, batch, g
+            else:
+                yield n, batch, batch
+
+    def _eval_outputs(self, evaluators: EvaluatorChain, outputs, gathered=False) -> None:
         """Feed one batch's outputs to the evaluator chain. Multi-process:
         gather the (small) evaluator inputs to every host first, so each
-        computes identical merged metrics (distributeEval analog)."""
+        computes identical merged metrics (distributeEval analog).
+        ``gathered``: outputs are already full host values."""
         if not evaluators:
             return
-        if self._multiproc:
+        if self._multiproc and not gathered:
             from paddle_tpu.parallel.spmd import gather_outputs
 
             outputs = gather_outputs(outputs, self._mesh, evaluators.needed_layers)
@@ -391,21 +420,23 @@ class Trainer:
         stats = TrainerStats()
         evaluators = EvaluatorChain(self.config.model_config)
         evaluators.start()
-        for batch in provider.batches():
-            n = _batch_num_samples(batch)
-            if self._batch_divisor > 1 and n % self._batch_divisor:
-                self._warn_remainder(n)
-                continue
-            if self._multiproc:
-                from paddle_tpu.parallel.spmd import globalize_batch
-
-                batch = globalize_batch(batch, self._mesh)
-                if batch is None:
-                    continue
+        for n, _host_batch, batch in self._global_batches(provider, pad=True):
             outputs = self.test_fwd(params, batch)
+            if self._multiproc:
+                # gather only what cost + evaluators read, then slice the
+                # padding off host-side
+                from paddle_tpu.parallel.spmd import gather_outputs
+
+                keep = list(
+                    dict.fromkeys(
+                        self.gm.cost_layer_names() + evaluators.needed_layers
+                    )
+                )
+                outputs = gather_outputs(outputs, self._mesh, keep)
+            outputs = self._trim_outputs(outputs, n)
             cost = float(self.gm.total_cost(outputs))
             stats.add(cost * n, n)
-            self._eval_outputs(evaluators, outputs)
+            self._eval_outputs(evaluators, outputs, gathered=True)
         results = {"cost": stats.total_cost / max(stats.total_samples, 1)}
         results.update(evaluators.results())
         logger.info("Test (pass %d): %s  %s", pass_id, stats.summary(),
@@ -424,17 +455,26 @@ class Trainer:
         if params is None:
             params = self.updater.averaged_params(self.params, self.opt_state)
         out_dir = self.flags.predict_output_dir
-        if out_dir:
+        write = self._is_writer
+        if out_dir and write:
             os.makedirs(out_dir, exist_ok=True)
         files = {}
         n_total = 0
         try:
-            for batch in provider.batches():
+            for n, _host_batch, batch in self._global_batches(provider, pad=True):
                 outputs = self.test_fwd(params, batch)
-                n_total += _batch_num_samples(batch)
+                if self._multiproc:
+                    # collective: every host gathers, only process 0 writes
+                    from paddle_tpu.parallel.spmd import gather_outputs
+
+                    outputs = gather_outputs(
+                        outputs, self._mesh, self.gm.network.output_layer_names
+                    )
+                outputs = self._trim_outputs(outputs, n)
+                n_total += n
                 for name in self.gm.network.output_layer_names:
                     arg = outputs[name]
-                    if out_dir:
+                    if out_dir and write:
                         f = files.get(name)
                         if f is None:
                             f = files[name] = open(
@@ -453,6 +493,8 @@ class Trainer:
                         data = np.asarray(arg.value)
                     # one line per sample; sequence outputs print only the
                     # valid (unpadded) timesteps, space-joined
+                    if not write:
+                        continue
                     for b in range(data.shape[0]):
                         row = data[b]
                         if lengths is not None and row.ndim >= 1 and row.shape[0] >= lengths[b]:
@@ -498,10 +540,16 @@ class Trainer:
 
         gm = self.gm
 
-        @jax.jit
-        def gen_fwd(params, in_args):
+        def gen_fwd_fn(params, in_args):
             outputs, _ = gm.forward(params, in_args, pass_type="gen", rng=None)
             return outputs
+
+        if self._mesh is not None:
+            from paddle_tpu.parallel.spmd import shard_test_fwd
+
+            gen_fwd = shard_test_fwd(gen_fwd_fn, self._mesh, self.gm)
+        else:
+            gen_fwd = jax.jit(gen_fwd_fn)
 
         # generation must consume samples in order (result indices map to
         # data order), even when falling back to the train data source
@@ -513,14 +561,25 @@ class Trainer:
         n_keep = max(int(gen.num_results_per_sample), 1)
         results = []
         sample_idx = 0
-        out_f = open(result_file, "w") if result_file else None
+        out_f = open(result_file, "w") if result_file and self._is_writer else None
         try:
-            for batch in provider.batches():
-                id_arg = batch.get(gen.id_input_layer) if gen.id_input_layer else None
+            for n, host_batch, batch in self._global_batches(provider, pad=True):
+                # sample ids come from the HOST batch (pre-globalize), so
+                # every process sees the full index column
+                id_arg = (
+                    host_batch.get(gen.id_input_layer) if gen.id_input_layer else None
+                )
                 sample_ids = (
                     np.asarray(id_arg.ids).reshape(-1) if id_arg is not None else None
                 )
                 outputs = gen_fwd(params, batch)
+                if self._multiproc:
+                    from paddle_tpu.parallel.spmd import gather_outputs
+
+                    outputs = gather_outputs(
+                        outputs, self._mesh, [group, f"{group}@beams"]
+                    )
+                outputs = self._trim_outputs(outputs, n)
                 best = outputs[group]
                 beams = outputs.get(f"{group}@beams")
                 ids = np.asarray(best.ids)
@@ -588,6 +647,43 @@ class Trainer:
                 ok = False
             logger.info("checkgrad %-40s max_rel_diff=%.3e %s", name, diff, status)
         return ok
+
+
+    def _trim_outputs(self, outputs, n: int):
+        """Slice every output's batch dim back to the true sample count
+        (inverse of _global_batches' inference padding). Multi-process
+        callers must gather to host first (host values slice freely)."""
+        first = next(
+            (
+                v
+                for v in jax.tree_util.tree_leaves(outputs)
+                if hasattr(v, "shape") and v.shape
+            ),
+            None,
+        )
+        if first is None or first.shape[0] == n:
+            return outputs
+        return jax.tree_util.tree_map(lambda x: x[:n], outputs)
+
+
+def _gcd(a: int, b: int) -> int:
+    while b:
+        a, b = b, a % b
+    return a
+
+
+def _pad_batch(batch: Dict[str, Argument], m: int) -> Dict[str, Argument]:
+    """Pad every leaf's batch dim to m rows by repeating the last sample
+    (host-side; all processes see the same padded batch)."""
+
+    def pad(x):
+        x = np.asarray(x)
+        if x.shape[0] >= m:
+            return x
+        reps = np.repeat(x[-1:], m - x.shape[0], axis=0)
+        return np.concatenate([x, reps], axis=0)
+
+    return jax.tree_util.tree_map(pad, batch)
 
 
 def _batch_num_samples(batch: Dict[str, Argument]) -> int:

@@ -10,7 +10,27 @@ from __future__ import annotations
 
 from typing import Optional
 
-__all__ = ["ParamAttr", "ExtraAttr", "ParameterAttribute", "ExtraLayerAttribute"]
+__all__ = [
+    "HookAttr",
+    "HookAttribute",
+    "ParamAttr",
+    "ExtraAttr",
+    "ParameterAttribute",
+    "ExtraLayerAttribute",
+]
+
+
+class HookAttribute:
+    """Parameter updater hook declaration (StaticPruningHook,
+    /root/reference/paddle/parameter/ParameterUpdaterHook.cpp:37):
+    ``HookAttr(type="pruning", mask_filename="layer.mask")`` keeps the
+    weights disabled by the bitmask file at zero through training."""
+
+    def __init__(self, type: str = "pruning", mask_filename: str = ""):
+        assert type in ("pruning", "static_pruning"), type
+        assert mask_filename, "pruning hook needs a mask_filename"
+        self.type = type
+        self.mask_filename = mask_filename
 
 
 class ParameterAttribute:
@@ -30,6 +50,7 @@ class ParameterAttribute:
         # TPU extension: logical mesh-axis sharding for this parameter,
         # e.g. sharding=("model", None)
         sharding=None,
+        update_hooks=None,
     ):
         self.name = name
         self.is_static = is_static
@@ -43,6 +64,9 @@ class ParameterAttribute:
         self.momentum = momentum
         self.sparse_update = sparse_update
         self.sharding = sharding
+        if update_hooks is not None and not isinstance(update_hooks, (list, tuple)):
+            update_hooks = [update_hooks]
+        self.update_hooks = update_hooks
 
     def apply_to(self, pc) -> None:
         """Fill a ParameterConfig with the attribute's overrides."""
@@ -77,6 +101,15 @@ class ParameterAttribute:
             pc.sparse_update = True
         if self.sharding is not None:
             pc.sharding = list(self.sharding)
+        if self.update_hooks:
+            from paddle_tpu.proto import ParameterUpdaterHookConfig
+
+            pc.update_hooks = [
+                ParameterUpdaterHookConfig(
+                    type=h.type, purning_mask_filename=h.mask_filename
+                )
+                for h in self.update_hooks
+            ]
 
 
 class ExtraLayerAttribute:
@@ -96,4 +129,5 @@ class ExtraLayerAttribute:
 
 
 ParamAttr = ParameterAttribute
+HookAttr = HookAttribute
 ExtraAttr = ExtraLayerAttribute

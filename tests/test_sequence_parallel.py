@@ -84,3 +84,34 @@ def test_degenerate_mesh_falls_back():
     ref = full_attention(q, k, v)
     out = ring_attention(q, k, v, mesh)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_scan_path_matches_unrolled(causal, monkeypatch):
+    """The large-ring lax.scan branch (RING_UNROLL_MAX exceeded — the
+    64-chip configuration) must match both the unrolled ring and the
+    single-device reference on the same mesh, forward and backward."""
+    import paddle_tpu.parallel.sequence_parallel as sp
+
+    mesh = make_mesh("seq=8")
+    q, k, v = _qkv(4)
+    lengths = _lengths()
+    ref = full_attention(q, k, v, lengths=lengths, causal=causal)
+    unrolled = ring_attention(q, k, v, mesh, lengths=lengths, causal=causal)
+    monkeypatch.setattr(sp, "RING_UNROLL_MAX", 1)  # force the scan ring
+    scanned = ring_attention(q, k, v, mesh, lengths=lengths, causal=causal)
+    np.testing.assert_allclose(np.asarray(scanned), np.asarray(ref), atol=2e-5)
+    np.testing.assert_allclose(np.asarray(scanned), np.asarray(unrolled), atol=2e-5)
+
+    def loss(fn):
+        def f(q, k, v):
+            out = fn(q, k, v, mesh, lengths=lengths, causal=causal)
+            return jnp.sum(out**2)
+
+        return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+
+    g_scan = loss(ring_attention)
+    monkeypatch.setattr(sp, "RING_UNROLL_MAX", 8)
+    g_unroll = loss(ring_attention)
+    for a, b in zip(g_scan, g_unroll):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-4)
