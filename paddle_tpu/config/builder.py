@@ -204,6 +204,7 @@ def _apply_settings(opt: OptimizationConfig, s: Dict[str, Any]) -> None:
         "gradient_clipping_threshold",
         "dtype",
         "mesh_shape",
+        "remat",
     ]
     for k in direct:
         if k in s and s[k] is not None:

@@ -206,22 +206,31 @@ class GradientMachine:
                 plan.extend((pn, ln) for ln, _ in sites)
         return plan
 
-    def grad_fn(self):
+    def grad_fn(self, remat: str = "none"):
         """Returns f(params, in_args, rng) → (loss, grads, outputs, state_updates).
 
         Gradients for prefetchable sparse_update tables come back as
         RowSparseGrad (ids + occurrence rows, O(batch·seq) not O(V)) —
-        see paddle_tpu.optimizer.sparse; everything else is dense."""
+        see paddle_tpu.optimizer.sparse; everything else is dense.
+
+        ``remat="full"`` (OptimizationConfig.remat) wraps the loss in
+        jax.checkpoint: backward recomputes the forward instead of
+        storing activations — the HBM-for-FLOPs trade."""
         plan = self.sparse_prefetch_plan()
+        loss_fn = self.loss_fn
+        if remat == "full":
+            loss_fn = jax.checkpoint(loss_fn)
+        elif remat not in ("", "none"):
+            raise ValueError(f"unsupported remat mode {remat!r}")
 
         def f(params: Params, in_args: Dict[str, Argument], rng: Optional[Array]):
             if not plan:
                 (loss, (outputs, state_updates)), grads = jax.value_and_grad(
-                    self.loss_fn, has_aux=True
+                    loss_fn, has_aux=True
                 )(params, in_args, rng)
             else:
                 loss, grads, outputs, state_updates = self._sparse_value_and_grad(
-                    plan, params, in_args, rng
+                    plan, params, in_args, rng, remat=remat
                 )
             # static parameters get no gradient
             for n, cfg in self.param_configs.items():
@@ -231,7 +240,7 @@ class GradientMachine:
 
         return f
 
-    def _sparse_value_and_grad(self, plan, params, in_args, rng):
+    def _sparse_value_and_grad(self, plan, params, in_args, rng, remat="none"):
         from paddle_tpu.optimizer.sparse import RowSparseGrad
 
         sparse_pnames = {pn for pn, _ in plan}
@@ -251,6 +260,8 @@ class GradientMachine:
             )
             return self.total_cost(outputs), (outputs, state_updates)
 
+        if remat == "full":
+            loss2 = jax.checkpoint(loss2)
         (loss, (outputs, state_updates)), (dgrads, rgrads) = jax.value_and_grad(
             loss2, argnums=(0, 1), has_aux=True
         )(dense_params, rows_in)

@@ -161,6 +161,20 @@ def mixed_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> 
     return with_seq_meta(meta, out)
 
 
+@register_layer("concat2")
+def concat2_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Argument:
+    # ref: ConcatenateLayer2 (ConcatenateLayer.cpp:95-115) — like concat,
+    # but each input first passes through its OWN projection; the
+    # projection outputs are concatenated (mixed sums them instead).
+    parts = []
+    for in_cfg, arg in zip(cfg.inputs, inputs):
+        assert in_cfg.proj_conf is not None, f"concat2 {cfg.name}: input needs a projection"
+        parts.append(apply_projection(in_cfg.proj_conf, in_cfg, arg, ctx))
+    out = jnp.concatenate(parts, axis=-1)
+    meta = first_seq_meta(inputs)
+    return with_seq_meta(meta, finalize_output(cfg, out, ctx, input_mask(meta)))
+
+
 @register_layer("addto")
 def addto_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Argument:
     acc = inputs[0].value

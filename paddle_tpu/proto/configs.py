@@ -365,6 +365,11 @@ class OptimizationConfig(Message):
     gradient_clipping_threshold: float = 0.0
     dtype: str = "float32"       # compute dtype for activations: float32|bfloat16
     mesh_shape: str = ""         # e.g. "data=8" / "data=4,model=2"
+    # rematerialization: "none" stores all activations for backward;
+    # "full" wraps the loss in jax.checkpoint so backward recomputes the
+    # forward — trades ~33% more FLOPs for O(1) activation memory, the
+    # HBM lever for big models/long sequences (SURVEY.md: jax.checkpoint)
+    remat: str = "none"          # none|full
 
 
 @dataclass

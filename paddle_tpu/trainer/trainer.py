@@ -157,7 +157,7 @@ class Trainer:
     # ------------------------------------------------------------- steps
 
     def _build_train_step(self):
-        grad_fn = self.gm.grad_fn()
+        grad_fn = self.gm.grad_fn(remat=self.config.opt_config.remat)
         updater = self.updater
         eval_layers = set()
         for e in self.config.model_config.evaluators:

@@ -1062,6 +1062,23 @@ def concat_layer(
 ) -> LayerOutput:
     name = _name(name, "concat")
     inputs = _to_list(input)
+    if any(isinstance(i, _Projection) for i in inputs):
+        # projections in the list -> concat2 (reference ConcatenateLayer2:
+        # project each input, concatenate the projection outputs)
+        assert all(isinstance(i, _Projection) for i in inputs), (
+            "concat_layer: mix of projections and layers is not supported — "
+            "wrap plain layers in identity_projection()"
+        )
+        sizes = [p.size or p.input.size for p in inputs]
+        size = sum(sizes)
+        cfg = LayerConfig(
+            name=name, type="concat2", size=size,
+            active_type=_act_name(act or IdentityActivation()),
+        )
+        for idx, (p, out_size) in enumerate(zip(inputs, sizes)):
+            cfg.inputs.append(p.materialize(name, out_size, idx))
+        _add_layer(cfg, layer_attr)
+        return LayerOutput(name, "concat2", [p.input for p in inputs], size, act)
     size = sum(i.size for i in inputs)
     cfg = LayerConfig(
         name=name, type="concat", size=size, active_type=_act_name(act or IdentityActivation())

@@ -204,3 +204,47 @@ def test_lstm_padding_invariance():
     np.testing.assert_allclose(
         np.asarray(out1["lstm"].value), np.asarray(out2["lstm"].value)[:, :4], rtol=1e-5, atol=1e-6
     )
+
+
+def test_concat2_projects_then_concatenates():
+    """ConcatenateLayer2 (ref ConcatenateLayer.cpp:95): concat of per-input
+    projection outputs (mixed sums them; concat2 concatenates)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.config.builder import fresh_context
+    from paddle_tpu.graph import GradientMachine, make_dense
+    from paddle_tpu.trainer_config_helpers import (
+        LinearActivation,
+        concat_layer,
+        data_layer,
+        full_matrix_projection,
+        identity_projection,
+        outputs,
+        settings,
+    )
+
+    with fresh_context() as ctx:
+        settings(batch_size=4, learning_rate=0.1)
+        a = data_layer(name="a", size=5)
+        b = data_layer(name="b", size=3)
+        out = concat_layer(
+            input=[full_matrix_projection(a, size=6), identity_projection(b)],
+            act=LinearActivation(), name="cc2",
+        )
+        outputs(out)
+        tc = ctx.finalize()
+
+    lm = {l.name: l for l in tc.model_config.layers}
+    assert lm["cc2"].type == "concat2"
+    assert lm["cc2"].size == 9
+    gm = GradientMachine(tc.model_config)
+    params = gm.init_params(seed=2)
+    rng = np.random.RandomState(0)
+    xa = rng.randn(4, 5).astype(np.float32)
+    xb = rng.randn(4, 3).astype(np.float32)
+    outs, _ = gm.forward(params, {"a": make_dense(xa), "b": make_dense(xb)}, "test")
+    got = np.asarray(outs["cc2"].value)
+    w = np.asarray(params["_cc2.w0"])
+    np.testing.assert_allclose(got[:, :6], xa @ w, rtol=1e-5)
+    np.testing.assert_allclose(got[:, 6:], xb, rtol=1e-6)
+

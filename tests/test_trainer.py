@@ -128,3 +128,32 @@ def test_lstm_sequence_trains(tmp_path):
     results = trainer.test()
     err = [v for k, v in results.items() if "classification_error" in k][0]
     assert err < 0.15, f"LSTM failed to learn: error={err}"
+
+
+def test_remat_full_matches_plain_gradients():
+    """settings(remat="full") wraps the loss in jax.checkpoint — backward
+    recomputes the forward; gradients must match the stored-activation
+    path exactly (same math, different schedule)."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.flagship import example_batch, flagship_config
+    from paddle_tpu.graph import GradientMachine
+
+    tc = flagship_config()
+    gm = GradientMachine(tc.model_config)
+    params = gm.init_params(seed=1)
+    batch = example_batch(B=4, T=8)
+    rng = jax.random.PRNGKey(0)
+    loss_a, grads_a, _, _ = jax.jit(gm.grad_fn(remat="none"))(params, batch, rng)
+    loss_b, grads_b, _, _ = jax.jit(gm.grad_fn(remat="full"))(params, batch, rng)
+    assert float(loss_a) == float(loss_b)
+    for k in grads_a:
+        np.testing.assert_allclose(
+            np.asarray(grads_a[k]), np.asarray(grads_b[k]), rtol=1e-6, atol=1e-7,
+            err_msg=k,
+        )
+    import pytest
+
+    with pytest.raises(ValueError):
+        gm.grad_fn(remat="bogus")
