@@ -1069,7 +1069,15 @@ def concat_layer(
             "concat_layer: mix of projections and layers is not supported — "
             "wrap plain layers in identity_projection()"
         )
-        sizes = [p.size or p.input.size for p in inputs]
+        def _c2_size(p):
+            # per-projection output width; identity falls back to the
+            # input width, identity_offset to the remaining slice, and
+            # context to in_size * context_length (output_size helper)
+            if p.type == "identity_offset":
+                return p.size or (p.input.size - p.extra.get("offset", 0))
+            return p.output_size(p.input.size)
+
+        sizes = [_c2_size(p) for p in inputs]
         size = sum(sizes)
         cfg = LayerConfig(
             name=name, type="concat2", size=size,

@@ -166,6 +166,7 @@ def settings(
     # TPU extensions
     dtype: Optional[str] = None,
     mesh_shape: Optional[str] = None,
+    remat: Optional[str] = None,
 ):
     ctx = current_context()
     s, defaults = ctx.settings, ctx.defaults
@@ -192,6 +193,8 @@ def settings(
         s["learning_rate_args"] = learning_rate_args
     if dtype is not None:
         s["dtype"] = dtype
+    if remat is not None:
+        s["remat"] = remat
     if mesh_shape is not None:
         s["mesh_shape"] = mesh_shape
 

@@ -248,3 +248,49 @@ def test_concat2_projects_then_concatenates():
     np.testing.assert_allclose(got[:, :6], xa @ w, rtol=1e-5)
     np.testing.assert_allclose(got[:, 6:], xb, rtol=1e-6)
 
+
+
+def test_concat2_context_and_offset_sizes():
+    """concat2 size inference covers context and offset-identity
+    projections (review finding: p.size fallback mis-sized them)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.config.builder import fresh_context
+    from paddle_tpu.graph import GradientMachine, make_seq
+    from paddle_tpu.trainer_config_helpers import (
+        LinearActivation,
+        concat_layer,
+        context_projection,
+        data_layer,
+        identity_projection,
+        outputs,
+        settings,
+    )
+
+    with fresh_context() as ctx:
+        settings(batch_size=2, learning_rate=0.1)
+        a = data_layer(name="a", size=5)
+        out = concat_layer(
+            input=[
+                context_projection(a, context_len=3),
+                identity_projection(a, offset=2),
+            ],
+            act=LinearActivation(), name="cc",
+        )
+        outputs(out)
+        tc = ctx.finalize()
+
+    lm = {l.name: l for l in tc.model_config.layers}
+    assert lm["cc"].size == 5 * 3 + (5 - 2), lm["cc"].size
+    gm = GradientMachine(tc.model_config)
+    params = gm.init_params(seed=1)
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 4, 5).astype(np.float32)
+    lens = np.array([4, 3], np.int32)
+    outs, _ = gm.forward(
+        params, {"a": make_seq(jnp.asarray(x), jnp.asarray(lens))}, "test"
+    )
+    got = np.asarray(outs["cc"].value)
+    assert got.shape == (2, 4, 18), got.shape
+    # offset-identity slice: columns 2..5 of the input
+    np.testing.assert_allclose(got[:, :, 15:], x[:, :, 2:], rtol=1e-6)

@@ -111,3 +111,59 @@ def test_sharded_sparse_update_runs():
     params, state = jax.jit(upd)(params, {"emb": g}, state, 2.0)
     w = np.asarray(params["emb"])
     assert not np.allclose(w[1], 1.0) and np.allclose(w[0], 1.0)
+
+
+def test_remat_full_with_sparse_prefetch_matches_plain():
+    """remat='full' on the SPARSE-prefetch grad path (jax.checkpoint
+    around loss2): RowSparseGrad reassembly and dense grads must match
+    the stored-activation path exactly."""
+    import jax
+
+    from paddle_tpu.flagship import example_batch
+    from paddle_tpu.graph import GradientMachine
+    from paddle_tpu.optimizer.sparse import RowSparseGrad
+
+    from paddle_tpu.config.builder import fresh_context
+    from paddle_tpu.trainer_config_helpers import (
+        ParamAttr,
+        SoftmaxActivation,
+        classification_cost,
+        data_layer,
+        embedding_layer,
+        fc_layer,
+        outputs,
+        pooling_layer,
+        settings,
+    )
+
+    with fresh_context() as ctx:
+        settings(batch_size=4, learning_rate=0.1)
+        words = data_layer(name="words", size=100)
+        emb = embedding_layer(
+            input=words, size=8,
+            param_attr=ParamAttr(name="emb", sparse_update=True),
+        )
+        pool = pooling_layer(input=emb)
+        out = fc_layer(input=pool, size=2, act=SoftmaxActivation(), name="out")
+        label = data_layer(name="label", size=2)
+        outputs(classification_cost(input=out, label=label))
+        tc = ctx.finalize()
+    gm = GradientMachine(tc.model_config)
+    assert gm.sparse_prefetch_plan(), "fixture must exercise the sparse path"
+    params = gm.init_params(seed=2)
+    batch = example_batch(dict_dim=100, B=4, T=8)
+    rng = jax.random.PRNGKey(1)
+    la, ga, _, _ = jax.jit(gm.grad_fn(remat="none"))(params, batch, rng)
+    lb, gb, _, _ = jax.jit(gm.grad_fn(remat="full"))(params, batch, rng)
+    assert float(la) == float(lb)
+    for k in ga:
+        a, b = ga[k], gb[k]
+        if isinstance(a, RowSparseGrad):
+            np.testing.assert_array_equal(np.asarray(a.ids), np.asarray(b.ids))
+            np.testing.assert_allclose(
+                np.asarray(a.rows), np.asarray(b.rows), rtol=1e-6, atol=1e-7
+            )
+        else:
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7, err_msg=k
+            )
