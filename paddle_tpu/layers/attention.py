@@ -63,5 +63,6 @@ def multi_head_attention(cfg: LayerConfig, inputs: List[Argument], ctx: LayerCon
     value = jnp.einsum("bte,ed->btd", out, wo)
     value = finalize_output(cfg, value, ctx, mask=arg.seq_mask())
     # zero padded positions so downstream pooling/costs see clean zeros
-    value = value * arg.seq_mask()[..., None]
+    # (mask cast keeps bf16 activations bf16)
+    value = value * arg.seq_mask(dtype=value.dtype)[..., None]
     return with_seq_meta(arg, value)

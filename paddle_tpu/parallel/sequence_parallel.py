@@ -76,7 +76,12 @@ def full_attention(
                 q, k, v, lengths=lengths, causal=causal
             )
     D = q.shape[-1]
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(D)
+    # scores and softmax in f32 even for bf16 q/k/v: the QK matmul takes
+    # bf16 operands with an f32 result; p stays f32 through the PV matmul
+    # (matching the ring path's f32 online-softmax state — narrowing p
+    # would diverge from it)
+    acc_t = jnp.promote_types(q.dtype, jnp.float32)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=acc_t) / math.sqrt(D)
     Tq, Tk = q.shape[1], k.shape[1]
     q_pos = q_offset + jnp.arange(Tq)
     kv_pos = kv_offset + jnp.arange(Tk)
@@ -88,7 +93,8 @@ def full_attention(
         mask &= (kv_pos[None, None, None, :] < lengths[:, None, None, None])
     s = jnp.where(mask, s, _NEG)
     p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v, preferred_element_type=acc_t)
+    return out.astype(q.dtype)
 
 
 def _ring_attention_local(q, k, v, lengths, causal, axis_name):

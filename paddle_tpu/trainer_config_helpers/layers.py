@@ -1074,7 +1074,12 @@ def concat_layer(
             # input width, identity_offset to the remaining slice, and
             # context to in_size * context_length (output_size helper)
             if p.type == "identity_offset":
-                return p.size or (p.input.size - p.extra.get("offset", 0))
+                off = p.extra.get("offset", 0)
+                assert 0 <= off < p.input.size, (
+                    f"identity_projection offset {off} out of range for "
+                    f"input of size {p.input.size}"
+                )
+                return p.size or (p.input.size - off)
             return p.output_size(p.input.size)
 
         sizes = [_c2_size(p) for p in inputs]

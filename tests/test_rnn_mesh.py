@@ -117,3 +117,65 @@ def test_attention_data_seq_parallel_matches_single():
         losses[mesh_shape] = float(loss)
     assert np.isfinite(losses["data=2,seq=4"])
     np.testing.assert_allclose(losses[None], losses["data=2,seq=4"], rtol=1e-4)
+
+
+def test_attention_seq_parallel_bf16_remat_composes():
+    """The full long-context stack composes: bf16 mixed precision +
+    remat="full" + ring attention over a data=2,seq=4 mesh; loss tracks
+    the meshless f32 run within bf16 tolerance."""
+    from paddle_tpu.config.builder import fresh_context
+    from paddle_tpu.graph.machine import compute_dtype_of
+    from paddle_tpu.trainer_config_helpers import (
+        MaxPooling,
+        SoftmaxActivation,
+        classification_cost,
+        data_layer,
+        embedding_layer,
+        fc_layer,
+        multi_head_attention_layer,
+        outputs,
+        pooling_layer,
+        settings,
+    )
+
+    def build(dtype, remat):
+        with fresh_context() as ctx:
+            settings(batch_size=8, learning_rate=1e-3, dtype=dtype, remat=remat)
+            words = data_layer(name="words", size=500)
+            emb = embedding_layer(input=words, size=32)
+            att = multi_head_attention_layer(
+                input=emb, num_heads=4, causal=True, seq_parallel="ring", name="att"
+            )
+            pool = pooling_layer(input=att, pooling_type=MaxPooling())
+            out = fc_layer(input=pool, size=4, act=SoftmaxActivation(), name="output")
+            label = data_layer(name="label", size=4)
+            outputs(classification_cost(input=out, label=label))
+            return ctx.finalize()
+
+    batch = example_batch(dict_dim=500, B=8, T=32, classes=4, seed=3)
+    losses = {}
+    for key, (dtype, remat, mesh_shape) in {
+        "f32": ("float32", "none", None),
+        "bf16+remat+mesh": ("bfloat16", "full", "data=2,seq=4"),
+    }.items():
+        tc = build(dtype, remat)
+        gm = GradientMachine(tc.model_config, compute_dtype=compute_dtype_of(tc.opt_config))
+        up = Updater(tc.opt_config, tc.model_config)
+        params = gm.init_params(seed=4)
+        opt_state = up.init_state(params)
+        grad_fn = gm.grad_fn(remat=tc.opt_config.remat)
+
+        def step(params, opt_state, batch, rng, bs):
+            loss, grads, outputs, su = grad_fn(params, batch, rng)
+            new_params, new_opt = up(params, grads, opt_state, bs)
+            return new_params, new_opt, loss, outputs["att"].value
+
+        if mesh_shape:
+            gm.mesh = make_mesh(mesh_shape)
+        _, _, loss, att = jax.jit(step)(
+            params, opt_state, batch, jax.random.PRNGKey(1), jnp.asarray(8.0)
+        )
+        losses[key] = float(loss)
+        if dtype == "bfloat16":
+            assert att.dtype == jnp.bfloat16
+    np.testing.assert_allclose(losses["f32"], losses["bf16+remat+mesh"], rtol=0.03, atol=0.02)

@@ -155,7 +155,7 @@ def test_remat_full_with_sparse_prefetch_matches_plain():
     rng = jax.random.PRNGKey(1)
     la, ga, _, _ = jax.jit(gm.grad_fn(remat="none"))(params, batch, rng)
     lb, gb, _, _ = jax.jit(gm.grad_fn(remat="full"))(params, batch, rng)
-    assert float(la) == float(lb)
+    np.testing.assert_allclose(float(la), float(lb), rtol=1e-6)
     for k in ga:
         a, b = ga[k], gb[k]
         if isinstance(a, RowSparseGrad):
