@@ -114,22 +114,38 @@ def _mfu_of(flops, dt, steps):
     return (round(m, 4) if m is not None else None), kind
 
 
-def bench_resnet50(B=64, img_size=224, classes=1000, steps=20, warmup=3, trace=True,
+def bench_resnet50(B=None, img_size=224, classes=1000, steps=20, warmup=3, trace=True,
                    dtype=None):
+    """Headline leg. Without an explicit B, tries a descending batch-size
+    ladder (bigger batches fill the MXU better in bf16) and keeps the
+    first size that runs — an OOM at 256 falls back instead of forfeiting
+    the number. PADDLE_TPU_BENCH_RESNET_B pins a size."""
     import jax.numpy as jnp
 
     from paddle_tpu.flagship import make_image_batch, resnet_config
 
-    tc = resnet_config(50, img_size, classes)
-    tc.opt_config.batch_size = B
-    tc.opt_config.dtype = dtype or BENCH_DTYPE
-    step, params, opt_state = _jit_train_step(tc)
-    batch = make_image_batch(B, img_size, classes)
-    dt, flops = _time_steps(
-        step, params, opt_state, batch, jnp.asarray(float(B)), steps, warmup, trace=trace
-    )
-    m, kind = _mfu_of(flops, dt, steps)
-    return B * steps / dt, {"mfu": m, "device_kind": kind, "dtype": tc.opt_config.dtype}
+    env_b = os.environ.get("PADDLE_TPU_BENCH_RESNET_B")
+    ladder = [int(env_b)] if env_b else ([B] if B else [256, 128, 64])
+    last_err = None
+    for b in ladder:
+        try:
+            tc = resnet_config(50, img_size, classes)
+            tc.opt_config.batch_size = b
+            tc.opt_config.dtype = dtype or BENCH_DTYPE
+            step, params, opt_state = _jit_train_step(tc)
+            batch = make_image_batch(b, img_size, classes)
+            dt, flops = _time_steps(
+                step, params, opt_state, batch, jnp.asarray(float(b)), steps, warmup,
+                trace=trace,
+            )
+            m, kind = _mfu_of(flops, dt, steps)
+            return b * steps / dt, {
+                "mfu": m, "device_kind": kind, "dtype": tc.opt_config.dtype, "batch": b,
+            }
+        except Exception as e:  # OOM or compile failure: step down the ladder
+            last_err = e
+            continue
+    raise last_err
 
 
 def bench_lstm_classifier(B=256, T=64, steps=20, warmup=3, dtype=None):
