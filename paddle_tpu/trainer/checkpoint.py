@@ -38,14 +38,17 @@ from paddle_tpu.utils.logging import logger
 PASS_FMT = "pass-%05d"
 
 
-def _flatten(tree: Dict, prefix: str = "") -> Dict[str, np.ndarray]:
+def _flatten(tree: Dict, prefix: str = "") -> Dict[str, Any]:
+    """Flatten nested dicts to 'a/b' keys. Values are NOT materialized —
+    np.savez coerces at write time (single-host), and the sharded writer
+    must see live jax.Arrays to read their addressable shards."""
     out = {}
     for k, v in tree.items():
         key = f"{prefix}{k}"
         if isinstance(v, dict):
             out.update(_flatten(v, key + "/"))
         elif v is not None:
-            out[key] = np.asarray(v)
+            out[key] = v
     return out
 
 
@@ -139,19 +142,10 @@ def save_checkpoint(
         # over the fresh .npz
         shutil.rmtree(path, ignore_errors=True)
         os.makedirs(path, exist_ok=True)
-    trees: Dict[str, Dict] = {"params": _flatten(params) if not multihost else dict(params)}
+    trees: Dict[str, Dict] = {"params": _flatten(params)}
     meta: Dict[str, Any] = {"pass_id": pass_id, "format_version": 2 if multihost else 1}
     if opt_state is not None:
-        if multihost:
-            trees["optimizer_slots"] = {
-                f"{n}/{s}": a for n, d in opt_state.slots.items() for s, a in d.items()
-            }
-            if opt_state.avg_sum is not None:
-                trees["optimizer_avg"] = dict(opt_state.avg_sum)
-            if opt_state.avg_old_sum is not None:
-                trees["optimizer_avg_old"] = dict(opt_state.avg_old_sum)
-        else:
-            trees.update(_optimizer_trees(opt_state))
+        trees.update(_optimizer_trees(opt_state))
         meta["optimizer"] = {
             "step": int(opt_state.step),
             "num_samples": float(opt_state.num_samples),

@@ -17,6 +17,7 @@ sync path.
 from __future__ import annotations
 
 import functools
+import math
 import os
 import sys
 import time
@@ -350,7 +351,7 @@ class Trainer:
         — every sample is processed exactly once."""
         div = self._batch_divisor
         if self._multiproc:
-            div = div * jax.process_count() // _gcd(div, jax.process_count())
+            div = div * jax.process_count() // math.gcd(div, jax.process_count())
         for batch in provider.batches():
             n = _batch_num_samples(batch)
             if div > 1 and n % div:
@@ -367,6 +368,13 @@ class Trainer:
             else:
                 yield n, batch, batch
 
+    def _gather_host(self, outputs, names):
+        """All-gather selected (small) outputs to full host values on
+        every process — see spmd.gather_outputs (distributeEval role)."""
+        from paddle_tpu.parallel.spmd import gather_outputs
+
+        return gather_outputs(outputs, self._mesh, names)
+
     def _eval_outputs(self, evaluators: EvaluatorChain, outputs, gathered=False) -> None:
         """Feed one batch's outputs to the evaluator chain. Multi-process:
         gather the (small) evaluator inputs to every host first, so each
@@ -375,9 +383,7 @@ class Trainer:
         if not evaluators:
             return
         if self._multiproc and not gathered:
-            from paddle_tpu.parallel.spmd import gather_outputs
-
-            outputs = gather_outputs(outputs, self._mesh, evaluators.needed_layers)
+            outputs = self._gather_host(outputs, evaluators.needed_layers)
         evaluators.eval_batch(outputs)
 
     def _warn_remainder(self, n: int) -> None:
@@ -425,14 +431,12 @@ class Trainer:
             if self._multiproc:
                 # gather only what cost + evaluators read, then slice the
                 # padding off host-side
-                from paddle_tpu.parallel.spmd import gather_outputs
-
                 keep = list(
                     dict.fromkeys(
                         self.gm.cost_layer_names() + evaluators.needed_layers
                     )
                 )
-                outputs = gather_outputs(outputs, self._mesh, keep)
+                outputs = self._gather_host(outputs, keep)
             outputs = self._trim_outputs(outputs, n)
             cost = float(self.gm.total_cost(outputs))
             stats.add(cost * n, n)
@@ -465,10 +469,8 @@ class Trainer:
                 outputs = self.test_fwd(params, batch)
                 if self._multiproc:
                     # collective: every host gathers, only process 0 writes
-                    from paddle_tpu.parallel.spmd import gather_outputs
-
-                    outputs = gather_outputs(
-                        outputs, self._mesh, self.gm.network.output_layer_names
+                    outputs = self._gather_host(
+                        outputs, self.gm.network.output_layer_names
                     )
                 outputs = self._trim_outputs(outputs, n)
                 n_total += n
@@ -574,11 +576,7 @@ class Trainer:
                 )
                 outputs = gen_fwd(params, batch)
                 if self._multiproc:
-                    from paddle_tpu.parallel.spmd import gather_outputs
-
-                    outputs = gather_outputs(
-                        outputs, self._mesh, [group, f"{group}@beams"]
-                    )
+                    outputs = self._gather_host(outputs, [group, f"{group}@beams"])
                 outputs = self._trim_outputs(outputs, n)
                 best = outputs[group]
                 beams = outputs.get(f"{group}@beams")
@@ -664,12 +662,6 @@ class Trainer:
         if first is None or first.shape[0] == n:
             return outputs
         return jax.tree_util.tree_map(lambda x: x[:n], outputs)
-
-
-def _gcd(a: int, b: int) -> int:
-    while b:
-        a, b = b, a % b
-    return a
 
 
 def _pad_batch(batch: Dict[str, Argument], m: int) -> Dict[str, Argument]:
