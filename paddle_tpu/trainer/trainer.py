@@ -248,7 +248,9 @@ class Trainer:
         t0 = time.time()
         batch_id = 0
         step_times: list = []
-        for n, _host_batch, batch in self._global_batches(provider):
+        for n, _host_batch, batch in self._device_prefetch(
+            self._global_batches(provider)
+        ):
             if (
                 self.flags.profile_dir
                 and pass_id == self.start_pass
@@ -374,6 +376,35 @@ class Trainer:
         from paddle_tpu.parallel.spmd import gather_outputs
 
         return gather_outputs(outputs, self._mesh, names)
+
+    def _device_prefetch(self, gen):
+        """One-step-lookahead device transfer: the NEXT batch's host→device
+        copy is dispatched (async) while the current step computes — the
+        device-side half of the reference's DoubleBuffer
+        (DataProvider.h:245; the host half is the feeder's prefetch
+        thread). Multi-process batches are already device-resident global
+        arrays (globalize_batch), so they pass through."""
+        if self._multiproc:
+            yield from gen
+            return
+        if self._mesh is not None:
+            from paddle_tpu.parallel.spmd import batch_sharding
+
+            sharding = batch_sharding(self._mesh)
+            put = lambda b: jax.device_put(b, sharding)
+        else:
+            put = jax.device_put
+        it = iter(gen)
+        try:
+            n, host, dev = next(it)
+        except StopIteration:
+            return
+        cur = (n, host, put(dev))
+        for n2, host2, dev2 in it:
+            nxt = (n2, host2, put(dev2))  # dispatches the copy immediately
+            yield cur
+            cur = nxt
+        yield cur
 
     def _eval_outputs(self, evaluators: EvaluatorChain, outputs, gathered=False) -> None:
         """Feed one batch's outputs to the evaluator chain. Multi-process:
