@@ -214,3 +214,21 @@ def test_sharded_ckpt_reshards_to_single_process(two_proc_ckpt):
             err_msg=name,
         )
     assert int(opt_state.step) == int(ref.opt_state.step)
+
+
+def test_merge_model_reads_sharded_checkpoint(two_proc_ckpt, tmp_path):
+    """merge_model bundles a sharded (format-2) checkpoint into one
+    deployable npz — assembled values equal the shard contents."""
+    from paddle_tpu.trainer import checkpoint as ckpt
+
+    out = str(tmp_path / "merged.npz")
+    ckpt.merge_model(os.path.join(two_proc_ckpt, "model"), 0, '{"m":1}', out)
+    with np.load(out) as z:
+        assert "__config_json__" in z.files
+        merged = {k: z[k] for k in z.files if k != "__config_json__"}
+    raw = ckpt._load_tree_numpy(
+        os.path.join(two_proc_ckpt, "model", "pass-00000"), "params"
+    )
+    assert set(merged) == set(raw)
+    for k in raw:
+        np.testing.assert_array_equal(merged[k], raw[k], err_msg=k)
