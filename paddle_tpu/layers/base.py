@@ -11,6 +11,7 @@ backwardActivation semantics, including dropout after activation).
 
 from __future__ import annotations
 
+import functools
 import zlib
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
@@ -136,10 +137,34 @@ def finalize_output(
     return value
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _clip_error(x, t):
+    """Identity forward; backward clips the cotangent to [-t, t] — the
+    reference's per-layer error clipping (Layer.cpp backwardActivation
+    errorClip on the output gradient, configured by
+    ExtraAttr(error_clipping_threshold))."""
+    return x
+
+
+def _clip_error_fwd(x, t):
+    return x, None
+
+
+def _clip_error_bwd(t, _, g):
+    return (jnp.clip(g, -t, t),)
+
+
+_clip_error.defvjp(_clip_error_fwd, _clip_error_bwd)
+
+
 def forward_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Argument:
     fn = layer_registry.get(cfg.type)
     with layer_scope(f"{cfg.name}({cfg.type})"):
         out = fn(cfg, inputs, ctx)
+    if cfg.error_clipping_threshold > 0 and out.value is not None:
+        out = out.replace(
+            value=_clip_error(out.value, float(cfg.error_clipping_threshold))
+        )
     ctx.outputs[cfg.name] = out
     return out
 

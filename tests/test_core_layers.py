@@ -294,3 +294,59 @@ def test_concat2_context_and_offset_sizes():
     assert got.shape == (2, 4, 18), got.shape
     # offset-identity slice: columns 2..5 of the input
     np.testing.assert_allclose(got[:, :, 15:], x[:, :, 2:], rtol=1e-6)
+
+
+def test_error_clipping_threshold_clips_backward_only():
+    """ExtraAttr(error_clipping_threshold): identity forward, cotangent
+    clipped at the layer output on backward (ref Layer.cpp errorClip)."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.config.builder import fresh_context
+    from paddle_tpu.graph import GradientMachine, make_dense
+    from paddle_tpu.trainer_config_helpers import (
+        ExtraAttr,
+        LinearActivation,
+        data_layer,
+        fc_layer,
+        outputs,
+        regression_cost,
+        settings,
+    )
+
+    def build(clip):
+        with fresh_context() as ctx:
+            settings(batch_size=2, learning_rate=0.1)
+            x = data_layer(name="x", size=3)
+            h = fc_layer(input=x, size=4, act=LinearActivation(), name="h",
+                         layer_attr=ExtraAttr(error_clipping_threshold=clip) if clip else None)
+            y = fc_layer(input=h, size=1, act=LinearActivation(), name="y")
+            t = data_layer(name="t", size=1)
+            outputs(regression_cost(input=y, label=t))
+            return ctx.finalize()
+
+    rng = np.random.RandomState(0)
+    batch = {
+        "x": make_dense(rng.randn(2, 3).astype(np.float32)),
+        # huge targets -> large backward error through h
+        "t": make_dense(np.full((2, 1), 1000.0, np.float32)),
+    }
+
+    grads = {}
+    fwd = {}
+    for clip in (0.0, 1e-4):
+        tc = build(clip)
+        gm = GradientMachine(tc.model_config)
+        params = gm.init_params(seed=3)
+        loss, g, outs, _ = jax.jit(gm.grad_fn())(params, batch, None)
+        grads[clip] = g
+        fwd[clip] = float(loss)
+    # forward identical; upstream (h-side) gradients shrink under the clip
+    np.testing.assert_allclose(fwd[0.0], fwd[1e-4], rtol=1e-6)
+    g_plain = np.abs(np.asarray(grads[0.0]["_h.w0"])).max()
+    g_clip = np.abs(np.asarray(grads[1e-4]["_h.w0"])).max()
+    assert g_clip < g_plain * 1e-2, (g_plain, g_clip)
+    # downstream (y-side) gradients are NOT affected by h's clip
+    np.testing.assert_allclose(
+        np.asarray(grads[0.0]["_y.w0"]), np.asarray(grads[1e-4]["_y.w0"]), rtol=1e-5
+    )
