@@ -7,10 +7,11 @@ SequenceReshapeLayer, SubSequenceLayer
 via sequenceStartPositions; here everything is masked reductions/gathers on
 padded [B, T, D] — XLA turns these into fused reduce/gather kernels.
 
-``trans_type`` ("non-seq" | "seq") mirrors the reference's pooling levels:
-with a nested input, "non-seq" pools each subsequence → output is a plain
-sequence over subsequences; with a plain input it pools the whole sequence
-→ dense output.
+``trans_type`` ("non-seq" | "seq") mirrors the reference's pooling levels
+(AggregateLevel): "non-seq" (default) aggregates the WHOLE outer
+sequence — a nested input flattens to one row per sample; "seq"
+aggregates each SUBSEQUENCE (nested input required) → output is a plain
+sequence over subsequences.
 """
 
 from __future__ import annotations
@@ -28,12 +29,31 @@ Array = jax.Array
 
 
 def _pool(cfg: LayerConfig, a: Argument, mode: str) -> Argument:
-    if a.is_nested_seq:
+    """trans_type semantics (ref SequencePoolLayer / MaxLayer.cpp):
+    "non-seq" (AggregateLevel.EACH_TIMESTEP, default) aggregates the
+    WHOLE outer sequence — a nested input flattens to one row per
+    sample; "seq" (EACH_SEQUENCE) aggregates each SUBSEQUENCE and
+    requires a nested input."""
+    per_subseq = cfg.trans_type == "seq"
+    if per_subseq:
+        assert a.is_nested_seq, (
+            f"{cfg.name}: trans_type='seq' needs a nested (sub-sequence) "
+            "input (reference: 'input must hasSubseq')"
+        )
+    if a.is_nested_seq and per_subseq:
         mask = a.sub_seq_mask()  # [B, S, T]
         x = a.value  # [B, S, T, D]
         axis = 2
         lengths = a.sub_seq_lengths
         out_meta = dict(seq_lengths=a.seq_lengths)
+    elif a.is_nested_seq:
+        # "non-seq" over a nested input: one row per SAMPLE, all valid
+        # tokens of all subsequences participate
+        mask = a.sub_seq_mask()  # [B, S, T]
+        x = a.value  # [B, S, T, D]
+        axis = (1, 2)
+        lengths = jnp.sum(a.sub_seq_lengths, axis=1)  # total tokens [B]
+        out_meta = {}
     else:
         assert a.is_seq, f"{cfg.name}: pooling a non-sequence input"
         mask = a.seq_mask()  # [B, T]
@@ -81,12 +101,37 @@ def average_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -
     return Argument(value=v, seq_lengths=out.seq_lengths)
 
 
-def _select_instance(a: Argument, first: bool) -> Argument:
-    if a.is_nested_seq:
+def _select_instance(cfg: LayerConfig, a: Argument, first: bool) -> Argument:
+    """trans_type as in _pool: "seq" selects per SUBSEQUENCE (nested
+    input required); "non-seq" selects from the whole outer sequence."""
+    per_subseq = cfg.trans_type == "seq"
+    if per_subseq:
+        assert a.is_nested_seq, (
+            f"{cfg.name}: trans_type='seq' needs a nested (sub-sequence) "
+            "input (reference: 'input must hasSubseq')"
+        )
         x, lengths = a.value, a.sub_seq_lengths  # [B,S,T,D], [B,S]
         idx = jnp.zeros_like(lengths) if first else jnp.clip(lengths - 1, 0, None)
         out = jnp.take_along_axis(x, idx[..., None, None], axis=2)[:, :, 0]
         return Argument(value=out, seq_lengths=a.seq_lengths)
+    if a.is_nested_seq:
+        # whole-sequence instance over a nested input: first token of the
+        # first subsequence, or last token of the last non-empty one
+        B = a.batch_size
+        if first:
+            s_idx = jnp.zeros((B,), jnp.int32)
+        else:
+            n_subs = (
+                a.seq_lengths
+                if a.seq_lengths is not None
+                else jnp.full((B,), a.value.shape[1], jnp.int32)
+            )
+            s_idx = jnp.clip(n_subs - 1, 0, None)
+        sub = jnp.take_along_axis(a.value, s_idx[:, None, None, None], axis=1)[:, 0]
+        sub_len = jnp.take_along_axis(a.sub_seq_lengths, s_idx[:, None], axis=1)[:, 0]
+        t_idx = jnp.zeros_like(sub_len) if first else jnp.clip(sub_len - 1, 0, None)
+        out = jnp.take_along_axis(sub, t_idx[:, None, None], axis=1)[:, 0]
+        return Argument(value=out)
     assert a.is_seq
     x, lengths = a.value, a.seq_lengths
     idx = jnp.zeros_like(lengths) if first else jnp.clip(lengths - 1, 0, None)
@@ -96,13 +141,13 @@ def _select_instance(a: Argument, first: bool) -> Argument:
 
 @register_layer("seqlastins")
 def seq_last_ins_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Argument:
-    out = _select_instance(inputs[0], first=cfg.select_first)
+    out = _select_instance(cfg, inputs[0], first=cfg.select_first)
     return Argument(value=finalize_output(cfg, out.value, ctx), seq_lengths=out.seq_lengths)
 
 
 @register_layer("seqfirstins")
 def seq_first_ins_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Argument:
-    out = _select_instance(inputs[0], first=True)
+    out = _select_instance(cfg, inputs[0], first=True)
     return Argument(value=finalize_output(cfg, out.value, ctx), seq_lengths=out.seq_lengths)
 
 

@@ -350,3 +350,74 @@ def test_error_clipping_threshold_clips_backward_only():
     np.testing.assert_allclose(
         np.asarray(grads[0.0]["_y.w0"]), np.asarray(grads[1e-4]["_y.w0"]), rtol=1e-5
     )
+
+
+def test_pooling_trans_type_levels_on_nested():
+    """AggregateLevel semantics on nested input (ref SequencePoolLayer,
+    SequenceLastInstanceLayer.cpp:76): 'non-seq' aggregates the whole
+    outer sequence (one row per sample); 'seq' aggregates per
+    subsequence (plain sequence out)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.graph import GradientMachine
+    from paddle_tpu.graph.argument import Argument
+    from paddle_tpu.config.builder import fresh_context
+    from paddle_tpu.trainer_config_helpers import (
+        AggregateLevel,
+        AvgPooling,
+        data_layer,
+        last_seq,
+        outputs,
+        pooling_layer,
+        settings,
+    )
+
+    B, S, T, D = 2, 3, 4, 5
+    rng = np.random.RandomState(7)
+    x = rng.randn(B, S, T, D).astype(np.float32)
+    n_subs = np.array([3, 2], np.int32)
+    sub_lens = np.array([[4, 2, 3], [1, 4, 0]], np.int32)
+
+    def build(agg):
+        with fresh_context() as ctx:
+            settings(batch_size=2, learning_rate=0.1)
+            a = data_layer(name="a", size=D)
+            p = pooling_layer(input=a, pooling_type=AvgPooling(),
+                              agg_level=agg, name="pool")
+            l = last_seq(input=a, agg_level=agg, name="last")
+            outputs(p)
+            outputs(l)
+            return ctx.finalize()
+
+    batch = {
+        "a": Argument(value=jnp.asarray(x), seq_lengths=jnp.asarray(n_subs),
+                      sub_seq_lengths=jnp.asarray(sub_lens)),
+    }
+
+    # 'seq': per-subsequence
+    tc = build(AggregateLevel.EACH_SEQUENCE)
+    gm = GradientMachine(tc.model_config)
+    outs, _ = gm.forward(gm.init_params(seed=1), batch, "test")
+    got = np.asarray(outs["pool"].value)  # [B, S, D]
+    for b in range(B):
+        for s_i in range(n_subs[b]):
+            l = sub_lens[b, s_i]
+            if l:
+                np.testing.assert_allclose(got[b, s_i], x[b, s_i, :l].mean(0),
+                                           rtol=1e-5)
+    last = np.asarray(outs["last"].value)
+    np.testing.assert_allclose(last[0, 0], x[0, 0, 3], rtol=1e-6)  # len 4
+
+    # 'non-seq': whole outer sequence
+    tc = build(AggregateLevel.EACH_TIMESTEP)
+    gm = GradientMachine(tc.model_config)
+    outs, _ = gm.forward(gm.init_params(seed=1), batch, "test")
+    got = np.asarray(outs["pool"].value)  # [B, D]
+    for b in range(B):
+        toks = np.concatenate(
+            [x[b, s_i, : sub_lens[b, s_i]] for s_i in range(n_subs[b])], axis=0
+        )
+        np.testing.assert_allclose(got[b], toks.mean(0), rtol=1e-5, err_msg=str(b))
+    last = np.asarray(outs["last"].value)  # [B, D]
+    np.testing.assert_allclose(last[0], x[0, 2, 2], rtol=1e-6)  # last sub len 3
+    np.testing.assert_allclose(last[1], x[1, 1, 3], rtol=1e-6)  # last sub len 4
