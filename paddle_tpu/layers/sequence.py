@@ -29,11 +29,8 @@ Array = jax.Array
 
 
 def _pool(cfg: LayerConfig, a: Argument, mode: str) -> Argument:
-    """trans_type semantics (ref SequencePoolLayer / MaxLayer.cpp):
-    "non-seq" (AggregateLevel.EACH_TIMESTEP, default) aggregates the
-    WHOLE outer sequence — a nested input flattens to one row per
-    sample; "seq" (EACH_SEQUENCE) aggregates each SUBSEQUENCE and
-    requires a nested input."""
+    """Masked pooling at the configured trans_type level (see the
+    module docstring for the AggregateLevel semantics)."""
     per_subseq = cfg.trans_type == "seq"
     if per_subseq:
         assert a.is_nested_seq, (
@@ -84,6 +81,12 @@ def max_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Ar
     if cfg.output_max_index:
         # ref: MaxLayer with output_max_index — emit argmax positions.
         a = inputs[0]
+        if a.is_nested_seq and cfg.trans_type != "seq":
+            raise NotImplementedError(
+                f"{cfg.name}: output_max_index over a whole nested sequence "
+                "(trans_type='non-seq') is unsupported — use trans_type='seq' "
+                "for per-subsequence indices"
+            )
         mask = a.sub_seq_mask() if a.is_nested_seq else a.seq_mask()
         neg = jnp.finfo(a.value.dtype).min
         axis = 2 if a.is_nested_seq else 1
@@ -102,8 +105,8 @@ def average_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -
 
 
 def _select_instance(cfg: LayerConfig, a: Argument, first: bool) -> Argument:
-    """trans_type as in _pool: "seq" selects per SUBSEQUENCE (nested
-    input required); "non-seq" selects from the whole outer sequence."""
+    """First/last instance at the configured trans_type level (see the
+    module docstring for the AggregateLevel semantics)."""
     per_subseq = cfg.trans_type == "seq"
     if per_subseq:
         assert a.is_nested_seq, (
@@ -116,17 +119,21 @@ def _select_instance(cfg: LayerConfig, a: Argument, first: bool) -> Argument:
         return Argument(value=out, seq_lengths=a.seq_lengths)
     if a.is_nested_seq:
         # whole-sequence instance over a nested input: first token of the
-        # first subsequence, or last token of the last non-empty one
-        B = a.batch_size
+        # first NON-EMPTY subsequence / last token of the last non-empty
+        # one (empty subsequences hold only padding)
+        B, S = a.value.shape[:2]
+        n_subs = (
+            a.seq_lengths
+            if a.seq_lengths is not None
+            else jnp.full((B,), S, jnp.int32)
+        )
+        s_iota = jnp.arange(S, dtype=jnp.int32)[None, :]
+        valid = (s_iota < n_subs[:, None]) & (a.sub_seq_lengths > 0)
         if first:
-            s_idx = jnp.zeros((B,), jnp.int32)
+            s_idx = jnp.min(jnp.where(valid, s_iota, S), axis=1)
         else:
-            n_subs = (
-                a.seq_lengths
-                if a.seq_lengths is not None
-                else jnp.full((B,), a.value.shape[1], jnp.int32)
-            )
-            s_idx = jnp.clip(n_subs - 1, 0, None)
+            s_idx = jnp.max(jnp.where(valid, s_iota, -1), axis=1)
+        s_idx = jnp.clip(s_idx, 0, S - 1)
         sub = jnp.take_along_axis(a.value, s_idx[:, None, None, None], axis=1)[:, 0]
         sub_len = jnp.take_along_axis(a.sub_seq_lengths, s_idx[:, None], axis=1)[:, 0]
         t_idx = jnp.zeros_like(sub_len) if first else jnp.clip(sub_len - 1, 0, None)

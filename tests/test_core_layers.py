@@ -421,3 +421,47 @@ def test_pooling_trans_type_levels_on_nested():
     last = np.asarray(outs["last"].value)  # [B, D]
     np.testing.assert_allclose(last[0], x[0, 2, 2], rtol=1e-6)  # last sub len 3
     np.testing.assert_allclose(last[1], x[1, 1, 3], rtol=1e-6)  # last sub len 4
+
+
+def test_last_instance_skips_empty_subsequences():
+    """seqlastins with 'non-seq' on a nested input returns the last token
+    of the last NON-EMPTY subsequence, not padding (review finding)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.config.builder import fresh_context
+    from paddle_tpu.graph import GradientMachine
+    from paddle_tpu.graph.argument import Argument
+    from paddle_tpu.trainer_config_helpers import (
+        data_layer,
+        first_seq,
+        last_seq,
+        outputs,
+        settings,
+    )
+
+    B, S, T, D = 2, 3, 3, 4
+    rng = np.random.RandomState(9)
+    x = rng.randn(B, S, T, D).astype(np.float32)
+    n_subs = np.array([3, 2], np.int32)
+    sub_lens = np.array([[2, 0, 0], [0, 3, 0]], np.int32)  # trailing/leading empties
+
+    with fresh_context() as ctx:
+        settings(batch_size=2, learning_rate=0.1)
+        a = data_layer(name="a", size=D)
+        outputs(last_seq(input=a, name="last"))
+        outputs(first_seq(input=a, name="first"))
+        tc = ctx.finalize()
+
+    gm = GradientMachine(tc.model_config)
+    outs, _ = gm.forward(
+        gm.init_params(seed=1),
+        {"a": Argument(value=jnp.asarray(x), seq_lengths=jnp.asarray(n_subs),
+                       sub_seq_lengths=jnp.asarray(sub_lens))},
+        "test",
+    )
+    last = np.asarray(outs["last"].value)
+    np.testing.assert_allclose(last[0], x[0, 0, 1], rtol=1e-6)  # subs 1,2 empty
+    np.testing.assert_allclose(last[1], x[1, 1, 2], rtol=1e-6)
+    fst = np.asarray(outs["first"].value)
+    np.testing.assert_allclose(fst[0], x[0, 0, 0], rtol=1e-6)
+    np.testing.assert_allclose(fst[1], x[1, 1, 0], rtol=1e-6)  # sub 0 empty
