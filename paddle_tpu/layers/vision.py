@@ -20,6 +20,7 @@ from typing import List, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from paddle_tpu.graph.argument import Argument
@@ -139,9 +140,21 @@ def pool_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> A
     hi_x = max(0, (ox - 1) * pc.stride + pc.size_x - w - pc.padding)
     pads = ((0, 0), (py, hi_y), (pc.padding, hi_x), (0, 0))
     kind = pc.pool_type
-    # in-image element count per window (constant-folded by XLA); a ceil-mode
-    # window can land entirely in padding — guard those outputs to 0
-    counts = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, window, strides, pads)
+    # in-image element count per window, computed in numpy at trace time
+    # (all static): a full-shape reduce_window over ones compiles to an
+    # O(B*C*H*W*window) constant-fold inside XLA — minutes at B=256 — for
+    # what is really an [out_y] x [out_x] outer product. A ceil-mode
+    # window can land entirely in padding — guard those outputs to 0.
+    def _axis_counts(n_out, stride, pad, k, img):
+        starts = np.arange(n_out) * stride - pad
+        return np.clip(np.minimum(starts + k, img) - np.maximum(starts, 0), 0, None)
+
+    counts = jnp.asarray(
+        np.outer(_axis_counts(oy, sy, py, ky, h),
+                 _axis_counts(ox, pc.stride, pc.padding, pc.size_x, w))
+        [None, :, :, None].astype(np.float32),
+        dtype=x.dtype,
+    )
     if "max" in kind:
         y = lax.reduce_window(x, -jnp.inf, lax.max, window, strides, pads)
         y = jnp.where(counts > 0, y, 0.0)
