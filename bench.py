@@ -289,8 +289,12 @@ def _good_json_line(text):
 def _supervise():
     """Run the real bench in a child with a wall-clock budget; if the
     accelerator leg hangs or crashes (round-1 failure modes), retry on
-    forced CPU. Guarantees exactly one JSON line and rc=0 no matter what."""
-    import subprocess
+    forced CPU. Guarantees exactly one JSON line and rc=0 no matter what.
+
+    Timed-out children are SIGTERMed with a grace period, never SIGKILLed
+    outright — a SIGKILLed holder of the TPU client wedges the tunnel for
+    every later claimant (including the CPU-retry's probe subprocess)."""
+    from paddle_tpu.utils.backend_guard import run_graceful
 
     budget = float(os.environ.get("PADDLE_TPU_BENCH_BUDGET", "1500"))
     deadline = time.monotonic() + budget
@@ -301,36 +305,32 @@ def _supervise():
         dict(os.environ, PADDLE_TPU_BENCH_CHILD="1", PADDLE_TPU_BENCH_PROBE_TIMEOUT="1"),
     ]
     last_err = "no attempt ran"
-    for env in attempts:
+    # a hung accelerator attempt must not starve the forced-CPU retry:
+    # reserve enough budget for the CPU smoke to run after a timeout
+    RETRY_RESERVE_S = 180.0
+    for i, env in enumerate(attempts):
         remaining = deadline - time.monotonic()
         if remaining <= 10:
             break
-        try:
-            out = subprocess.run(
-                [sys.executable, os.path.abspath(__file__)] + sys.argv[1:],
-                env=env,
-                capture_output=True,
-                text=True,
-                timeout=remaining,
-            )
-        except subprocess.TimeoutExpired as te:
-            # salvage: the child may have emitted the headline before a
-            # later leg hung
-            txt = te.stdout or ""
-            if isinstance(txt, bytes):
-                txt = txt.decode(errors="replace")
-            line = _good_json_line(txt)
-            if line is not None:
-                print(line)
-                return 0
-            last_err = f"bench child exceeded {remaining:.0f}s remaining budget"
-            continue
-        sys.stderr.write(out.stderr[-4000:])
-        line = _good_json_line(out.stdout)
+        attempt_budget = remaining
+        if i < len(attempts) - 1 and remaining - RETRY_RESERVE_S > 10:
+            attempt_budget = remaining - RETRY_RESERVE_S
+        rc, stdout, stderr = run_graceful(
+            [sys.executable, os.path.abspath(__file__)] + sys.argv[1:],
+            timeout_s=attempt_budget,
+            env=env,
+        )
+        sys.stderr.write((stderr or "")[-4000:])
+        # salvage even on timeout: the child may have emitted the headline
+        # before a later leg hung
+        line = _good_json_line(stdout or "")
         if line is not None:
             print(line)
             return 0
-        last_err = (out.stderr or out.stdout or "no output")[-500:]
+        if rc is None:
+            last_err = f"bench child exceeded {remaining:.0f}s remaining budget"
+        else:
+            last_err = (stderr or stdout or "no output")[-500:]
     _emit("bench_failed", 0.0, "none", 0.0, error=last_err)
     return 0
 

@@ -152,7 +152,7 @@ def pool_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> A
     counts = jnp.asarray(
         np.outer(_axis_counts(oy, sy, py, ky, h),
                  _axis_counts(ox, pc.stride, pc.padding, pc.size_x, w))
-        [None, :, :, None].astype(np.float32),
+        [None, :, :, None],
         dtype=x.dtype,
     )
     if "max" in kind:

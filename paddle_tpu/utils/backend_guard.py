@@ -73,6 +73,36 @@ def _with_device_count(flags: str, n: int) -> str:
     return " ".join(parts)
 
 
+def run_graceful(cmd, timeout_s, grace_s: float = 15.0, env=None):
+    """subprocess.run(capture_output=True, text=True) with a SIGTERM-first
+    timeout. subprocess.run's own timeout SIGKILLs the child — and a
+    SIGKILLed holder of the accelerator client wedges the tunnel for every
+    later claimant. SIGTERM + a grace period lets the runtime's teardown
+    release the device; SIGKILL only if even that stalls.
+
+    Total wall time is bounded by timeout_s: the grace period is carved
+    out of the budget, not added on top.
+
+    Returns (returncode|None, stdout, stderr); returncode None = timeout.
+    """
+    import subprocess
+
+    proc = subprocess.Popen(
+        cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env
+    )
+    try:
+        out, err = proc.communicate(timeout=max(0.1, timeout_s - grace_s))
+        return proc.returncode, out, err
+    except subprocess.TimeoutExpired:
+        proc.terminate()
+        try:
+            out, err = proc.communicate(timeout=grace_s)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            out, err = proc.communicate()
+        return None, out or "", err or ""
+
+
 def probe_backend(timeout_s: float = 180.0) -> str:
     """Report which jax backend a fresh process can actually initialize.
 
@@ -81,20 +111,11 @@ def probe_backend(timeout_s: float = 180.0) -> str:
     wedge the caller. Returns the backend platform name ('tpu', 'cpu',
     ...) on success, or 'cpu' if init fails or exceeds timeout_s.
     """
-    import subprocess
     import sys
 
     code = "import jax; print(jax.default_backend())"
-    try:
-        out = subprocess.run(
-            [sys.executable, "-c", code],
-            capture_output=True,
-            text=True,
-            timeout=timeout_s,
-        )
-    except subprocess.TimeoutExpired:
+    rc, out, _ = run_graceful([sys.executable, "-c", code], timeout_s)
+    if rc != 0:
         return "cpu"
-    if out.returncode != 0:
-        return "cpu"
-    backend = out.stdout.strip().splitlines()[-1] if out.stdout.strip() else ""
+    backend = out.strip().splitlines()[-1] if out.strip() else ""
     return backend or "cpu"
