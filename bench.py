@@ -52,7 +52,8 @@ def _jit_train_step(tc):
     from paddle_tpu.graph.machine import compute_dtype_of
     from paddle_tpu.optimizer import Updater
 
-    gm = GradientMachine(tc.model_config, compute_dtype=compute_dtype_of(tc.opt_config))
+    gm = GradientMachine(tc.model_config, compute_dtype=compute_dtype_of(tc.opt_config),
+                         scan_unroll=tc.opt_config.scan_unroll)
     updater = Updater(tc.opt_config, tc.model_config)
     params = gm.init_params(seed=1)
     opt_state = updater.init_state(params)
@@ -328,7 +329,7 @@ def _supervise():
             print(line)
             return 0
         if rc is None:
-            last_err = f"bench child exceeded {remaining:.0f}s remaining budget"
+            last_err = f"bench child exceeded its {attempt_budget:.0f}s attempt budget"
         else:
             last_err = (stderr or stdout or "no output")[-500:]
     _emit("bench_failed", 0.0, "none", 0.0, error=last_err)

@@ -205,6 +205,7 @@ def _apply_settings(opt: OptimizationConfig, s: Dict[str, Any]) -> None:
         "dtype",
         "mesh_shape",
         "remat",
+        "scan_unroll",
     ]
     for k in direct:
         if k in s and s[k] is not None:

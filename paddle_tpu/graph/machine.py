@@ -39,7 +39,8 @@ def compute_dtype_of(opt_config) -> Optional[Any]:
 
 
 class GradientMachine:
-    def __init__(self, model: ModelConfig, dtype=jnp.float32, compute_dtype=None):
+    def __init__(self, model: ModelConfig, dtype=jnp.float32, compute_dtype=None,
+                 scan_unroll: int = 1):
         self.model = model
         self.network = Network(model)
         self.dtype = dtype
@@ -47,6 +48,9 @@ class GradientMachine:
         # run in `compute_dtype` (bf16 on the MXU). None = everything in
         # `dtype` (see LayerContext.compute_dtype for the cast rules).
         self.compute_dtype = None if compute_dtype == jnp.float32 else compute_dtype
+        # lax.scan unroll factor for recurrent layers/groups
+        # (OptimizationConfig.scan_unroll)
+        self.scan_unroll = max(1, int(scan_unroll))
         self.mesh = None  # set by the trainer when running on a mesh
         self.param_configs: Dict[str, ParameterConfig] = {p.name: p for p in model.parameters}
         # data layers whose every consumer is a cost layer carry targets/
@@ -90,6 +94,7 @@ class GradientMachine:
             params=params, model=self.model, pass_type=pass_type, rng=rng,
             dtype=self.dtype, mesh=self.mesh, table_overrides=table_overrides,
             compute_dtype=self.compute_dtype, no_cast_inputs=self.no_cast_inputs,
+            scan_unroll=self.scan_unroll,
         )
         self.network.forward(ctx, in_args)
         return ctx.outputs, ctx.state_updates

@@ -136,6 +136,7 @@ def _run_submodel_step(
         mesh=ctx.mesh,
         compute_dtype=ctx.compute_dtype,
         no_cast_inputs=ctx.no_cast_inputs,
+        scan_unroll=ctx.scan_unroll,
     )
     # the parent link lets an inner group's ENTRY resolution (static
     # links, boot layers, nested in-links) see outer-scope layers without
@@ -335,7 +336,9 @@ def _forward_scan(network, cfg: LayerConfig, sub: SubModelConfig, ctx: LayerCont
         jnp.swapaxes(mask_bt, 0, 1),
         jnp.arange(T, dtype=jnp.int32),
     )
-    _, ys = jax.lax.scan(step, init_carries, xs, reverse=bool(sub.reversed))
+    _, ys = jax.lax.scan(
+        step, init_carries, xs, reverse=bool(sub.reversed), unroll=ctx.scan_unroll
+    )
     for link, (y, y_lens) in zip(out_links, ys):
         if y_lens is not None:
             # [S, B, T, D] → nested [B, S, T, D] with per-subseq lengths

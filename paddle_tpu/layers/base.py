@@ -67,6 +67,9 @@ class LayerContext:
     # device mesh for layers that issue explicit collectives (ring
     # attention); None outside meshed execution
     mesh: Any = None
+    # lax.scan unroll factor for recurrent layers/groups
+    # (OptimizationConfig.scan_unroll; 1 = no unrolling)
+    scan_unroll: int = 1
     # sparse-embedding prefetch (GradientMachine::prefetch analog): rows
     # pre-gathered outside autodiff, keyed by (param_name, input_layer);
     # the table projection returns these instead of gathering, so

@@ -31,7 +31,8 @@ from paddle_tpu.proto import LayerConfig
 Array = jax.Array
 
 
-def _scan_time(cell, x_tbd: Array, mask_tb: Array, init_carry, reverse: bool):
+def _scan_time(cell, x_tbd: Array, mask_tb: Array, init_carry, reverse: bool,
+               unroll: int = 1):
     """Scan ``cell`` over the time-major sequence with carry masking.
 
     Padded steps pass the carry through unchanged so that (a) forward scans
@@ -46,7 +47,9 @@ def _scan_time(cell, x_tbd: Array, mask_tb: Array, init_carry, reverse: bool):
         merged = jax.tree_util.tree_map(lambda n, o: m * n + (1.0 - m) * o, new_carry, carry)
         return merged, y * m
 
-    carry, ys = jax.lax.scan(step, init_carry, (x_tbd, mask_tb), reverse=reverse)
+    carry, ys = jax.lax.scan(
+        step, init_carry, (x_tbd, mask_tb), reverse=reverse, unroll=unroll
+    )
     return carry, ys
 
 
@@ -69,7 +72,7 @@ def recurrent_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext)
 
     B = x.shape[1]
     h0 = jnp.zeros((B, cfg.size), x.dtype)
-    _, ys = _scan_time(cell, x, mask, h0, cfg.reversed)
+    _, ys = _scan_time(cell, x, mask, h0, cfg.reversed, unroll=ctx.scan_unroll)
     return Argument(value=jnp.swapaxes(ys, 0, 1), seq_lengths=a.seq_lengths)
 
 
@@ -117,7 +120,7 @@ def lstmemory_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext)
 
     B = x.shape[1]
     init = (jnp.zeros((B, size), x.dtype), jnp.zeros((B, size), x.dtype))
-    _, ys = _scan_time(cell, x, mask, init, cfg.reversed)
+    _, ys = _scan_time(cell, x, mask, init, cfg.reversed, unroll=ctx.scan_unroll)
     return Argument(value=jnp.swapaxes(ys, 0, 1), seq_lengths=a.seq_lengths)
 
 
@@ -158,7 +161,7 @@ def gated_recurrent_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerCo
 
     B = x.shape[1]
     h0 = jnp.zeros((B, size), x.dtype)
-    _, ys = _scan_time(cell, x, mask, h0, cfg.reversed)
+    _, ys = _scan_time(cell, x, mask, h0, cfg.reversed, unroll=ctx.scan_unroll)
     return Argument(value=jnp.swapaxes(ys, 0, 1), seq_lengths=a.seq_lengths)
 
 

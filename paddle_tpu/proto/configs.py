@@ -370,6 +370,10 @@ class OptimizationConfig(Message):
     # forward — trades ~33% more FLOPs for O(1) activation memory, the
     # HBM lever for big models/long sequences (SURVEY.md: jax.checkpoint)
     remat: str = "none"          # none|full
+    # lax.scan unroll factor for recurrent layers / recurrent groups:
+    # unrolling k steps per scan iteration lets XLA pipeline the per-step
+    # MXU matmuls and amortize loop overhead, at k× program size. 1 = off.
+    scan_unroll: int = 1
 
 
 @dataclass

@@ -79,7 +79,8 @@ class Trainer:
         # with f32 master weights + optimizer state (x64 builds stay full)
         compute_dtype = None if flags.use_double else compute_dtype_of(config.opt_config)
         self.gm = GradientMachine(
-            config.model_config, dtype=dtype, compute_dtype=compute_dtype
+            config.model_config, dtype=dtype, compute_dtype=compute_dtype,
+            scan_unroll=config.opt_config.scan_unroll,
         )
         self.updater = Updater(
             config.opt_config, config.model_config,

@@ -167,6 +167,7 @@ def settings(
     dtype: Optional[str] = None,
     mesh_shape: Optional[str] = None,
     remat: Optional[str] = None,
+    scan_unroll: Optional[int] = None,
 ):
     ctx = current_context()
     s, defaults = ctx.settings, ctx.defaults
@@ -195,6 +196,8 @@ def settings(
         s["dtype"] = dtype
     if remat is not None:
         s["remat"] = remat
+    if scan_unroll is not None:
+        s["scan_unroll"] = scan_unroll
     if mesh_shape is not None:
         s["mesh_shape"] = mesh_shape
 

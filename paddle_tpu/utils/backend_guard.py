@@ -90,13 +90,14 @@ def run_graceful(cmd, timeout_s, grace_s: float = 15.0, env=None):
     proc = subprocess.Popen(
         cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env
     )
+    grace = min(grace_s, timeout_s / 2)  # short timeouts keep real runtime
     try:
-        out, err = proc.communicate(timeout=max(0.1, timeout_s - grace_s))
+        out, err = proc.communicate(timeout=timeout_s - grace)
         return proc.returncode, out, err
     except subprocess.TimeoutExpired:
         proc.terminate()
         try:
-            out, err = proc.communicate(timeout=grace_s)
+            out, err = proc.communicate(timeout=grace)
         except subprocess.TimeoutExpired:
             proc.kill()
             out, err = proc.communicate()

@@ -465,3 +465,22 @@ def test_last_instance_skips_empty_subsequences():
     fst = np.asarray(outs["first"].value)
     np.testing.assert_allclose(fst[0], x[0, 0, 0], rtol=1e-6)
     np.testing.assert_allclose(fst[1], x[1, 1, 0], rtol=1e-6)  # sub 0 empty
+
+
+def test_scan_unroll_parity():
+    """scan_unroll is a pure scheduling knob: loss and gradients are
+    unchanged (same ops, unrolled k steps per scan iteration)."""
+    from paddle_tpu.flagship import example_batch, flagship_config
+
+    tc = flagship_config(dict_dim=50, emb_dim=8, hidden=8)
+    batch = example_batch(dict_dim=50, B=4, T=11)
+    results = []
+    for unroll in (1, 4):
+        gm = GradientMachine(tc.model_config, scan_unroll=unroll)
+        params = gm.init_params(seed=5)
+        loss, grads = jax.value_and_grad(lambda p: gm.loss_fn(p, batch, None)[0])(params)
+        results.append((float(loss), grads))
+    (l1, g1), (l4, g4) = results
+    assert np.isclose(l1, l4, rtol=1e-6), (l1, l4)
+    for k in g1:
+        np.testing.assert_allclose(np.asarray(g1[k]), np.asarray(g4[k]), rtol=1e-5, atol=1e-7)
