@@ -206,6 +206,10 @@ def _apply_settings(opt: OptimizationConfig, s: Dict[str, Any]) -> None:
         "mesh_shape",
         "remat",
         "scan_unroll",
+        "c1",
+        "backoff",
+        "owlqn_steps",
+        "max_backoff",
     ]
     for k in direct:
         if k in s and s[k] is not None:

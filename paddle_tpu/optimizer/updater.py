@@ -89,6 +89,10 @@ class Updater:
             return ["m", "v"]
         if m == "adamax":
             return ["m", "u"]
+        if m in ("lbfgs", "owlqn"):
+            # whole-data batch methods (algorithm=owlqn): curvature history
+            # lives host-side in BatchMethod; no per-batch slots
+            return []
         raise ValueError(f"unknown learning_method {m!r}")
 
     def _load_masks(self, params: Params) -> None:

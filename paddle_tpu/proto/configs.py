@@ -348,6 +348,12 @@ class OptimizationConfig(Message):
     l1weight: float = 0.1
     l2weight: float = 0.0
     l2weight_zero_iter: int = 0
+    # whole-data batch algorithms (algorithm=owlqn; config_parser.py
+    # settings c1/backoff/owlqn_steps/max_backoff)
+    c1: float = 0.0001
+    backoff: float = 0.5
+    owlqn_steps: int = 10
+    max_backoff: int = 5
     average_window: float = 0.0
     max_average_window: int = MAX_I64
     do_average_in_cpu: bool = False

@@ -117,6 +117,45 @@ class Trainer:
                 "multi-process training needs a mesh "
                 "(--mesh_shape or --trainer_count)"
             )
+        # whole-data batch algorithms (reference Trainer::trainOnePassBatch,
+        # Trainer.cpp:492, selected by algorithm=owlqn): one quasi-Newton
+        # update per pass, driven host-side between jitted data sweeps
+        self._batch_method = None
+        self._bm_grad_fn = None
+        self._bm_cost_fn = None
+        if config.opt_config.algorithm == "owlqn":
+            if self._multiproc:
+                raise ValueError(
+                    "whole-data batch methods (algorithm=owlqn) run "
+                    "single-process; drop --mesh_shape/multi-host"
+                )
+            from paddle_tpu.optimizer.batch_methods import BatchMethod
+
+            # the line search compares full-data objectives, so the
+            # objective must be deterministic: dropout and batch-statistics
+            # layers are incompatible with whole-data batch methods
+            stochastic = [
+                f"{l.name} ({l.type})"
+                for l in config.model_config.layers
+                if getattr(l, "drop_rate", 0) > 0 or "batch_norm" in l.type
+            ]
+            if stochastic:
+                raise ValueError(
+                    "whole-data batch methods (algorithm=owlqn) need a "
+                    "deterministic objective; remove dropout/batch_norm "
+                    "layers: " + ", ".join(stochastic)
+                )
+            oc = config.opt_config
+            self._batch_method = BatchMethod(
+                method=oc.learning_method if oc.learning_method in ("lbfgs", "owlqn") else "lbfgs",
+                history=oc.owlqn_steps,
+                c1=oc.c1,
+                backoff=oc.backoff,
+                max_backoff=oc.max_backoff,
+                l1weight=oc.l1weight,
+                l2weight=oc.l2weight,
+                learning_rate=oc.learning_rate,
+            )
         self._maybe_restore()
         # StaticPruningHook init semantics: mask values once at startup
         self.params = self.updater.apply_init_hooks(self.params)
@@ -226,6 +265,8 @@ class Trainer:
         num_passes = num_passes or self.flags.num_passes
         train_provider = self._provider(for_test=False)
         assert train_provider is not None, "no train data configured"
+        if self._batch_method is not None:
+            return self._train_batch_mode(num_passes, train_provider)
         rng = jax.random.PRNGKey(self.flags.seed)
         saved_pass = -1
         for pass_id in range(self.start_pass, num_passes):
@@ -238,6 +279,121 @@ class Trainer:
                 saved_pass = pass_id
             logger.info(global_stats.summary())
         if self.save_dir and saved_pass != num_passes - 1:
+            self.save(num_passes - 1, final=True)
+
+    # --------------------------------------------- whole-data batch mode
+
+    def _bm_fns(self):
+        if self._bm_grad_fn is None:
+            gm = self.gm
+            # pass_type="test": the line search needs a deterministic
+            # objective (the dropout/batch_norm guard in __init__ rejects
+            # models where train and test objectives differ)
+            loss = functools.partial(gm.loss_fn, pass_type="test")
+            self._bm_grad_fn = jax.jit(jax.value_and_grad(loss, has_aux=True))
+            self._bm_cost_fn = jax.jit(lambda p, b: loss(p, b, None)[0])
+        return self._bm_grad_fn, self._bm_cost_fn
+
+    def _full_data_sweep(self, params, provider, want_grad: bool):
+        """Stream the whole dataset once; returns (mean cost, mean grads
+        over trainable params as numpy or None, total samples). The
+        jitted per-batch step is the 'one forwardBackward over all data'
+        of reference trainOnePassBatch, streamed to bound device memory."""
+        grad_fn, cost_fn = self._bm_fns()
+        trainable = {k for k, t in self.gm.trainable_mask().items() if t}
+        total_c, total_n, total_g = 0.0, 0, None
+        for batch in provider.batches():
+            n = _batch_num_samples(batch)
+            w = float(n)
+            if want_grad:
+                (loss, _aux), grads = grad_fn(params, batch, None)
+                gw = {k: grads[k] * w for k in trainable}
+                total_g = gw if total_g is None else {
+                    k: total_g[k] + gw[k] for k in trainable
+                }
+            else:
+                loss = cost_fn(params, batch)
+            total_c += float(loss) * w
+            total_n += n
+        assert total_n, "empty training data"
+        # host-side quasi-Newton math runs in float64 regardless of the
+        # device dtype — curvature dot products are precision-sensitive
+        mean_g = (
+            {k: np.asarray(v, np.float64) / total_n for k, v in total_g.items()}
+            if want_grad
+            else None
+        )
+        return total_c / total_n, mean_g, total_n
+
+    def _train_batch_mode(self, num_passes: int, provider: DataProvider) -> None:
+        """One quasi-Newton update per pass (Trainer::trainOnePassBatch,
+        reference Trainer.cpp:492): full-data gradient → L-BFGS/OWL-QN
+        direction → backtracking line search → accept/reject."""
+        bm = self._batch_method
+        static = {
+            k: v for k, v in self.params.items()
+            if not self.gm.trainable_mask().get(k, True)
+        }
+        dtypes = {k: v.dtype for k, v in self.params.items()}
+
+        def merge(xt):
+            # host math is float64; devices keep their configured dtype
+            full = {k: jnp.asarray(v, dtypes[k]) for k, v in xt.items()}
+            full.update(static)
+            return full
+
+        def eval_cost(xt):
+            c, _, _ = self._full_data_sweep(merge(xt), provider, want_grad=False)
+            return c
+
+        for pass_id in range(self.start_pass, num_passes):
+            with stat_timer("onePass"):
+                cost, grads, n = self._full_data_sweep(
+                    self.params, provider, want_grad=True
+                )
+                if not np.isfinite(cost):
+                    raise FloatingPointError(
+                        f"non-finite whole-data cost ({cost}) at pass {pass_id}"
+                    )
+                bm.record_grad(grads)  # completes the previous pass's (s, y)
+                xt = {
+                    k: np.asarray(v, np.float64)
+                    for k, v in self.params.items()
+                    if k not in static
+                }
+                direction = bm.direction(xt, grads)
+                accepted, x_new, f_new = bm.line_search(
+                    xt, cost, grads, direction, eval_cost
+                )
+            if accepted:
+                self.params = merge(x_new)
+            logger.info(
+                "Pass=%d AcceptedPass=%d samples=%d Cost=%g (objective %g%s)",
+                pass_id,
+                bm.n_accepted - 1 if accepted else -1,
+                n,
+                cost,
+                f_new,
+                "" if accepted else ", line search rejected",
+            )
+            with stat_timer("test"):
+                self.test(pass_id=pass_id)
+            if (
+                accepted
+                and self.save_dir
+                and (bm.n_accepted - 1) % max(self.flags.saving_period, 1) == 0
+            ):
+                self.save(pass_id)
+            logger.info(global_stats.summary())
+            if not accepted and not bm.on_reject():
+                # a tempered steepest-descent step already failed; the
+                # deterministic objective would reject identically forever
+                logger.info(
+                    "Pass=%d: line search cannot improve the objective — "
+                    "converged, stopping batch-mode training", pass_id,
+                )
+                break
+        if self.save_dir:
             self.save(num_passes - 1, final=True)
 
     def train_one_pass(self, pass_id: int, provider: DataProvider, rng) -> None:

@@ -22,6 +22,8 @@ __all__ = [
     "RMSPropOptimizer",
     "DecayedAdaGradOptimizer",
     "AdaDeltaOptimizer",
+    "LBFGSOptimizer",
+    "OWLQNOptimizer",
     "BaseRegularization",
     "L1Regularization",
     "L2Regularization",
@@ -106,6 +108,38 @@ class AdaDeltaOptimizer(BaseSGDOptimizer):
         s["ada_epsilon"] = self.epsilon
 
 
+class LBFGSOptimizer(Optimizer):
+    """Whole-data L-BFGS: one line-searched quasi-Newton update per pass
+    (the reference's batch-algorithm mode, Trainer::trainOnePassBatch —
+    selected there by any non-SGD learning method, algorithm='owlqn').
+    Hyperparameter names follow reference config_parser.py settings
+    (c1/backoff/owlqn_steps/max_backoff)."""
+
+    learning_method = "lbfgs"
+
+    def __init__(self, history: int = 10, c1: float = 1e-4, backoff: float = 0.5,
+                 max_backoff: int = 5):
+        self.history, self.c1 = history, c1
+        self.backoff, self.max_backoff = backoff, max_backoff
+
+    def to_settings(self, s, defaults):
+        s["algorithm"] = "owlqn"
+        s["learning_method"] = self.learning_method
+        s["owlqn_steps"] = self.history
+        s["c1"] = self.c1
+        s["backoff"] = self.backoff
+        s["max_backoff"] = self.max_backoff
+
+
+class OWLQNOptimizer(LBFGSOptimizer):
+    """L-BFGS with L1 regularization (orthant-wise limited-memory
+    quasi-Newton). Pair with L1Regularization(rate) — under
+    algorithm='owlqn' the rate becomes OptimizationConfig.l1weight
+    (reference optimizers.py:288 maps regularization the same way)."""
+
+    learning_method = "owlqn"
+
+
 class BaseRegularization(Optimizer):
     def to_settings(self, s, defaults):
         pass
@@ -116,6 +150,11 @@ class L2Regularization(BaseRegularization):
         self.rate = rate
 
     def to_settings(self, s, defaults):
+        if s.get("algorithm") == "owlqn":
+            # batch methods fold l2 into the objective (reference
+            # optimizers.py:288-291 maps the rate to l2weight)
+            s["l2weight"] = self.rate
+            return
         # sgd path: becomes the per-parameter default decay_rate
         # (reference: default_decay_rate(rate))
         defaults["decay_rate"] = self.rate
@@ -126,6 +165,9 @@ class L1Regularization(BaseRegularization):
         self.rate = rate
 
     def to_settings(self, s, defaults):
+        if s.get("algorithm") == "owlqn":
+            s["l1weight"] = self.rate
+            return
         defaults["decay_rate_l1"] = self.rate
 
 
