@@ -392,22 +392,31 @@ def _generate(network, cfg: LayerConfig, sub: SubModelConfig, ctx: LayerContext)
         B = arg.batch_size if B is None else B
     # real sequence in-links: generation consumes one input frame per step
     # (per-step conditioning — each generated token sees x_t next to the
-    # fed-back embedding; sequence length follows the input)
+    # fed-back embedding; sequence length follows the input). A NESTED
+    # in-link ([B, S, T, ...] sub-sequences) feeds one whole subsequence
+    # per generated step — the step sub-network sees it as a flat
+    # sequence, mirroring training's outer-scan-over-subsequences
+    # (createInFrameInfo hasSubseq branch) at generation time.
     in_xs_v: Dict[str, Array] = {}
     in_xs_i: Dict[str, Array] = {}
+    in_xs_l: Dict[str, Array] = {}  # nested links: per-step inner lengths
     in_lengths = None
     L_in = None
     for link in sub.in_links:
-        if link.has_subseq:
-            raise NotImplementedError(
-                f"generation group {cfg.name}: nested in-links unsupported"
-            )
         arg = _scope_lookup(ctx, link.layer_name)
-        assert arg.is_seq, (
-            f"generation in-link {link.layer_name!r} must be a sequence "
-            "(wrap whole-sequence conditions in StaticInput(..., is_seq=True))"
-        )
+        if link.has_subseq:
+            assert arg.is_nested_seq, (
+                f"generation in-link {link.layer_name!r} marked has_subseq "
+                "but is not a nested sequence"
+            )
+        else:
+            assert arg.is_seq, (
+                f"generation in-link {link.layer_name!r} must be a sequence "
+                "(wrap whole-sequence conditions in StaticInput(..., is_seq=True))"
+            )
         B = arg.batch_size if B is None else B
+        # axis 1 is the generation axis either way: frames (flat) or
+        # subsequences (nested)
         L_in = arg.max_len if L_in is None else min(L_in, arg.max_len)
         # generation ends at the SHORTEST in-link per sample — a longer
         # link's frames past that point would be padding, not conditioning
@@ -416,11 +425,13 @@ def _generate(network, cfg: LayerConfig, sub: SubModelConfig, ctx: LayerContext)
             if in_lengths is None
             else jnp.minimum(in_lengths, arg.seq_lengths)
         )
-        ex = _expand_beams(arg, K)  # [B*K, T, ...]
+        ex = _expand_beams(arg, K)  # [B*K, T|S, ...]
         if ex.value is not None:
-            in_xs_v[link.link_name] = jnp.swapaxes(ex.value, 0, 1)  # [T, B*K, D]
+            in_xs_v[link.link_name] = jnp.swapaxes(ex.value, 0, 1)  # [T|S, B*K, ...]
         if ex.ids is not None:
             in_xs_i[link.link_name] = jnp.swapaxes(ex.ids, 0, 1)
+        if link.has_subseq:
+            in_xs_l[link.link_name] = jnp.swapaxes(ex.sub_seq_lengths, 0, 1)  # [S, B*K]
     if L_in is not None:
         L = min(L, L_in)
 
@@ -483,12 +494,15 @@ def _generate(network, cfg: LayerConfig, sub: SubModelConfig, ctx: LayerContext)
     base_rng = ctx.rng
 
     def step(state, inp):
-        t_idx, x_v, x_i = inp
+        t_idx, x_v, x_i, x_l = inp
         carries, prev_tok, cum, finished, history, lens = state
         fed: Dict[str, Argument] = {predict_agent: Argument(ids=prev_tok)}
         for link in sub.in_links:
             fed[link.link_name] = Argument(
-                value=x_v.get(link.link_name), ids=x_i.get(link.link_name)
+                value=x_v.get(link.link_name),
+                ids=x_i.get(link.link_name),
+                # nested links feed one whole subsequence per step
+                seq_lengths=x_l.get(link.link_name),
             )
         for name, arg in statics.items():
             fed[name] = arg
@@ -555,6 +569,7 @@ def _generate(network, cfg: LayerConfig, sub: SubModelConfig, ctx: LayerContext)
         jnp.arange(L, dtype=jnp.int32),
         {k: v[:L] for k, v in in_xs_v.items()},
         {k: v[:L] for k, v in in_xs_i.items()},
+        {k: v[:L] for k, v in in_xs_l.items()},
     )
     state, _ = jax.lax.scan(step, init_state, xs)
     _, _, scores, finished, history, lens = state

@@ -44,13 +44,14 @@ class BatchMethod:
 
     Usage per pass (the trainer owns data sweeps)::
 
-        d        = method.direction(params, grad)
-        accepted, new_params = method.line_search(params, cost, grad, d, eval_cost)
-        # on accept the (s, y) pair is recorded internally
+        method.record_grad(grad)          # completes the previous (s, y)
+        d = method.direction(params, grad)
+        accepted, new_params, f = method.line_search(
+            params, cost, grad, d, eval_cost)
 
-    ``eval_cost(params) -> float`` must return the full-data cost
-    *including* the same l2 term as ``regularized``; the l1 term is added
-    internally when comparing OWL-QN costs.
+    ``eval_cost(params) -> float`` and the ``cost`` argument are the RAW
+    full-data cost; :meth:`regularized` adds the l1/l2 terms on both
+    sides of the comparison internally.
     """
 
     def __init__(

@@ -346,11 +346,21 @@ class Trainer:
             c, _, _ = self._full_data_sweep(merge(xt), provider, want_grad=False)
             return c
 
+        cached = None  # (cost, grads, n) from a rejected pass: params did
+        # not move and the objective is deterministic, so the sweep would
+        # recompute identical values — reuse instead of re-sweeping
+        saved_pass = -1
+        last_pass = self.start_pass - 1
         for pass_id in range(self.start_pass, num_passes):
+            last_pass = pass_id
             with stat_timer("onePass"):
-                cost, grads, n = self._full_data_sweep(
-                    self.params, provider, want_grad=True
-                )
+                if cached is not None:
+                    cost, grads, n = cached
+                    cached = None
+                else:
+                    cost, grads, n = self._full_data_sweep(
+                        self.params, provider, want_grad=True
+                    )
                 if not np.isfinite(cost):
                     raise FloatingPointError(
                         f"non-finite whole-data cost ({cost}) at pass {pass_id}"
@@ -384,17 +394,20 @@ class Trainer:
                 and (bm.n_accepted - 1) % max(self.flags.saving_period, 1) == 0
             ):
                 self.save(pass_id)
+                saved_pass = pass_id
             logger.info(global_stats.summary())
-            if not accepted and not bm.on_reject():
-                # a tempered steepest-descent step already failed; the
-                # deterministic objective would reject identically forever
-                logger.info(
-                    "Pass=%d: line search cannot improve the objective — "
-                    "converged, stopping batch-mode training", pass_id,
-                )
-                break
-        if self.save_dir:
-            self.save(num_passes - 1, final=True)
+            if not accepted:
+                cached = (cost, grads, n)
+                if not bm.on_reject():
+                    # a tempered steepest-descent step already failed; the
+                    # deterministic objective would reject identically forever
+                    logger.info(
+                        "Pass=%d: line search cannot improve the objective — "
+                        "converged, stopping batch-mode training", pass_id,
+                    )
+                    break
+        if self.save_dir and saved_pass != last_pass and last_pass >= 0:
+            self.save(last_pass, final=True)
 
     def train_one_pass(self, pass_id: int, provider: DataProvider, rng) -> None:
         stats = TrainerStats()

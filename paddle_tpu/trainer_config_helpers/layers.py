@@ -1148,6 +1148,21 @@ def memory(
     return LayerOutput(agent_name, "agent", [], size)
 
 
+
+def _subseq_inlink_proxy(ctx, sub, outer, group_name):
+    """Emit the nested in-link triple (sequence_scatter_agent layer,
+    has_subseq LinkConfig, step proxy) shared by recurrent_group and
+    beam_search."""
+    agent_name = f"{outer.name}@{group_name}"
+    ctx.add_layer(
+        LayerConfig(name=agent_name, type="sequence_scatter_agent", size=outer.size)
+    )
+    sub.in_links.append(
+        LinkConfig(layer_name=outer.name, link_name=agent_name, has_subseq=True)
+    )
+    return LayerOutput(agent_name, "sequence_scatter_agent", [outer], outer.size)
+
+
 def recurrent_group(
     step: Callable,
     input,
@@ -1171,11 +1186,7 @@ def recurrent_group(
                 "beam_search(step=..., input=[...]) for generation groups"
             )
         if isinstance(item, SubsequenceInput):
-            outer = item.input
-            agent_name = f"{outer.name}@{name}"
-            ctx.add_layer(LayerConfig(name=agent_name, type="sequence_scatter_agent", size=outer.size))
-            sub.in_links.append(LinkConfig(layer_name=outer.name, link_name=agent_name, has_subseq=True))
-            proxies.append(LayerOutput(agent_name, "sequence_scatter_agent", [outer], outer.size))
+            proxies.append(_subseq_inlink_proxy(ctx, sub, item.input, name))
         elif isinstance(item, StaticInput):
             outer = item.input
             agent_name = f"{outer.name}@{name}"
@@ -1337,6 +1348,10 @@ def beam_search(
                 LinkConfig(layer_name=outer.name, link_name=agent_name, has_subseq=item.is_seq)
             )
             proxies.append(LayerOutput(agent_name, ltype, [outer], item.size))
+        elif isinstance(item, SubsequenceInput):
+            # nested in-link: each generated step consumes one whole
+            # subsequence (the step sees it as a flat sequence)
+            proxies.append(_subseq_inlink_proxy(ctx, sub, outer, name))
         else:
             ctx.add_layer(LayerConfig(name=agent_name, type="scatter_agent", size=outer.size))
             sub.in_links.append(LinkConfig(layer_name=outer.name, link_name=agent_name))
