@@ -405,9 +405,10 @@ def _generate(network, cfg: LayerConfig, sub: SubModelConfig, ctx: LayerContext)
     for link in sub.in_links:
         arg = _scope_lookup(ctx, link.layer_name)
         if link.has_subseq:
-            assert arg.is_nested_seq, (
+            assert arg.is_nested_seq and arg.is_seq, (
                 f"generation in-link {link.layer_name!r} marked has_subseq "
-                "but is not a nested sequence"
+                "needs a nested sequence with OUTER lengths "
+                "(seq_lengths = subsequence count per sample)"
             )
         else:
             assert arg.is_seq, (
@@ -565,13 +566,32 @@ def _generate(network, cfg: LayerConfig, sub: SubModelConfig, ctx: LayerContext)
             lens,
         ), None
 
-    xs = (
-        jnp.arange(L, dtype=jnp.int32),
-        {k: v[:L] for k, v in in_xs_v.items()},
-        {k: v[:L] for k, v in in_xs_i.items()},
-        {k: v[:L] for k, v in in_xs_l.items()},
-    )
-    state, _ = jax.lax.scan(step, init_state, xs)
+    # while_loop instead of a fixed-L scan: generation stops as soon as
+    # every beam of every sample has finished (eos / in-link exhausted) —
+    # with the default max_length=500 and typical outputs of tens of
+    # tokens this is the difference between L steps and ~longest-output
+    # steps per batch. Generation is never differentiated, so while_loop's
+    # no-reverse-AD limitation does not bite.
+    in_v = {k: v[:L] for k, v in in_xs_v.items()}
+    in_i = {k: v[:L] for k, v in in_xs_i.items()}
+    in_l = {k: v[:L] for k, v in in_xs_l.items()}
+
+    def cond(carry):
+        t, state = carry
+        return (t < L) & ~jnp.all(state[3])  # state[3] = finished [B, K]
+
+    def body(carry):
+        t, state = carry
+        inp = (
+            t,
+            {k: v[t] for k, v in in_v.items()},
+            {k: v[t] for k, v in in_i.items()},
+            {k: v[t] for k, v in in_l.items()},
+        )
+        state, _ = step(state, inp)
+        return t + 1, state
+
+    _, state = jax.lax.while_loop(cond, body, (jnp.asarray(0, jnp.int32), init_state))
     _, _, scores, finished, history, lens = state
     # best beam per sample (beams are kept sorted by top_k, but normalize
     # defensively by picking argmax score)

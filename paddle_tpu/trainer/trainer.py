@@ -406,7 +406,7 @@ class Trainer:
                         "converged, stopping batch-mode training", pass_id,
                     )
                     break
-        if self.save_dir and saved_pass != last_pass and last_pass >= 0:
+        if self.save_dir and saved_pass != last_pass and last_pass >= self.start_pass:
             self.save(last_pass, final=True)
 
     def train_one_pass(self, pass_id: int, provider: DataProvider, rng) -> None:
