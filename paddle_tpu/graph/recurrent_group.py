@@ -13,11 +13,13 @@ TPU-native formulation:
   carries (masked so padding passes state through); gather agents are the
   stacked scan outputs. XLA unrolls nothing — one compiled step reused T
   times, backward derived by jax.grad through the scan.
-- generation: a fixed-length ``lax.scan`` over max_num_frames implementing
+- generation: a ``lax.while_loop`` bounded by max_num_frames implementing
   batched beam search with static shapes (beam reindexing via
-  take_along_axis, finished-beam masking) — the replacement for the
-  pointer-chasing beamSearch loop. Groups with real sequence in-links
-  generate one step per input frame (per-step conditioning).
+  take_along_axis, finished-beam masking) that exits as soon as every
+  beam has finished — the replacement for the pointer-chasing beamSearch
+  loop. Groups with real sequence in-links generate one step per input
+  frame (per-step conditioning); nested in-links feed one whole
+  subsequence per step.
 - nested (sub-sequence) groups: the outer scan steps over SUBSEQUENCES
   ([B, S, T, D] in-links feed [B, T, D] sequence frames, ref
   createInFrameInfo hasSubseq branch :564); an inner recurrent group in

@@ -278,7 +278,11 @@ class Trainer:
                 self.save(pass_id)
                 saved_pass = pass_id
             logger.info(global_stats.summary())
-        if self.save_dir and saved_pass != num_passes - 1:
+        if (
+            self.save_dir
+            and saved_pass != num_passes - 1
+            and num_passes > self.start_pass  # at least one pass actually ran
+        ):
             self.save(num_passes - 1, final=True)
 
     # --------------------------------------------- whole-data batch mode
