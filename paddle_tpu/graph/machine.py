@@ -211,17 +211,20 @@ class GradientMachine:
                 plan.extend((pn, ln) for ln, _ in sites)
         return plan
 
-    def grad_fn(self, remat: str = "none"):
+    def grad_fn(self, remat: str = "none", sparse: bool = True):
         """Returns f(params, in_args, rng) → (loss, grads, outputs, state_updates).
 
         Gradients for prefetchable sparse_update tables come back as
         RowSparseGrad (ids + occurrence rows, O(batch·seq) not O(V)) —
         see paddle_tpu.optimizer.sparse; everything else is dense.
+        ``sparse=False`` forces dense gradients everywhere (needed when
+        gradients must be accumulated across batches — RowSparseGrad
+        shapes vary per batch).
 
         ``remat="full"`` (OptimizationConfig.remat) wraps the loss in
         jax.checkpoint: backward recomputes the forward instead of
         storing activations — the HBM-for-FLOPs trade."""
-        plan = self.sparse_prefetch_plan()
+        plan = self.sparse_prefetch_plan() if sparse else []
         loss_fn = self.loss_fn
         if remat == "full":
             loss_fn = jax.checkpoint(loss_fn)

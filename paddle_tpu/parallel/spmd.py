@@ -209,6 +209,54 @@ def shard_train_step(step, mesh: Mesh, gm):
     return call
 
 
+def shard_accum_steps(astep, ustep, mesh: Mesh, gm):
+    """Mesh-shard the gradient-accumulation pair
+    (num_batches_per_send_parameter > 1): ``astep(params, acc, batch,
+    rng, n)`` accumulates one batch's gradients; ``ustep(params,
+    opt_state, acc, total_n)`` applies one optimizer update. The
+    accumulator tree mirrors the parameter tree, so it takes the
+    parameter shardings."""
+    param_shards = _param_shardings(mesh, gm)
+    repl = NamedSharding(mesh, P())
+    bs = batch_sharding(mesh)
+    a_cache: Dict[Any, Any] = {}
+    u_fn = None
+
+    def p_spec(params):
+        return {k: param_shards.get(k, repl) for k in params}
+
+    def a_call(params, acc, batch, rng, n):
+        treedef = jax.tree_util.tree_structure(batch)
+        fn = a_cache.get(treedef)
+        if fn is None:
+            ps = p_spec(params)
+            b_spec = jax.tree_util.tree_map(lambda _: bs, batch)
+            fn = jax.jit(
+                astep,
+                in_shardings=(ps, ps, b_spec, repl, repl),
+                out_shardings=(ps, ps, None, None),
+                donate_argnums=(0, 1),
+            )
+            a_cache[treedef] = fn
+        return fn(params, acc, batch, rng, n)
+
+    def u_call(params, opt_state, acc, total_n):
+        # the opt-state structure is fixed for a run: one jit, built lazily
+        nonlocal u_fn
+        if u_fn is None:
+            ps = p_spec(params)
+            o_spec = _opt_state_sharding(mesh, param_shards, opt_state)
+            u_fn = jax.jit(
+                ustep,
+                in_shardings=(ps, o_spec, ps, repl),
+                out_shardings=(ps, o_spec, ps),
+                donate_argnums=(0, 1, 2),
+            )
+        return u_fn(params, opt_state, acc, total_n)
+
+    return a_call, u_call
+
+
 def shard_test_fwd(fwd, mesh: Mesh, gm):
     param_shards = _param_shardings(mesh, gm)
     repl = NamedSharding(mesh, P())

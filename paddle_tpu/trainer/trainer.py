@@ -156,6 +156,13 @@ class Trainer:
                 l2weight=oc.l2weight,
                 learning_rate=oc.learning_rate,
             )
+        # gradient accumulation: N forward/backwards per optimizer update
+        # (reference num_batches_per_send_parameter, TrainerInternal.cpp)
+        self._accum_n = max(1, int(config.opt_config.num_batches_per_send_parameter))
+        self._accum_fns = None
+        self._acc = None
+        self._acc_batches = 0
+        self._acc_samples = 0
         self._maybe_restore()
         # StaticPruningHook init semantics: mask values once at startup
         self.params = self.updater.apply_init_hooks(self.params)
@@ -197,13 +204,18 @@ class Trainer:
 
     # ------------------------------------------------------------- steps
 
-    def _build_train_step(self):
-        grad_fn = self.gm.grad_fn(remat=self.config.opt_config.remat)
-        updater = self.updater
+    def _kept_out_layers(self):
+        """Layer outputs the train step must return: network outputs plus
+        everything the evaluator chain reads."""
         eval_layers = set()
         for e in self.config.model_config.evaluators:
             eval_layers.update(e.input_layers)
-        out_layers = set(self.gm.network.output_layer_names) | eval_layers
+        return set(self.gm.network.output_layer_names) | eval_layers
+
+    def _build_train_step(self):
+        grad_fn = self.gm.grad_fn(remat=self.config.opt_config.remat)
+        updater = self.updater
+        out_layers = self._kept_out_layers()
 
         def step(params, opt_state, in_args, rng, batch_size):
             loss, grads, outputs, state_updates = grad_fn(params, in_args, rng)
@@ -218,6 +230,41 @@ class Trainer:
 
             return shard_train_step(step, self._mesh, self.gm)
         return jax.jit(step, donate_argnums=(0, 1))
+
+    def _build_accum_steps(self):
+        """Gradient accumulation (num_batches_per_send_parameter = N > 1,
+        reference TrainerInternal: N forwardBackwards per parameter send):
+        ``astep`` folds one batch's sample-weighted gradients into an
+        on-device accumulator; ``ustep`` applies ONE optimizer update from
+        the accumulated mean. Dense gradients only — RowSparseGrad shapes
+        vary per batch and cannot live in a fixed-shape accumulator."""
+        grad_fn = self.gm.grad_fn(remat=self.config.opt_config.remat, sparse=False)
+        updater = self.updater
+        out_layers = self._kept_out_layers()
+
+        def astep(params, acc, in_args, rng, n):
+            loss, grads, outputs, state_updates = grad_fn(params, in_args, rng)
+            new_acc = jax.tree_util.tree_map(lambda a, g: a + g * n, acc, grads)
+            new_params = dict(params)
+            for k, v in state_updates.items():  # BN stats advance per batch
+                new_params[k] = v
+            keep = {k: v for k, v in outputs.items() if k in out_layers}
+            return new_params, new_acc, loss, keep
+
+        def ustep(params, opt_state, acc, total_n):
+            mean = jax.tree_util.tree_map(lambda a: a / total_n, acc)
+            new_params, new_opt = updater(params, mean, opt_state, total_n)
+            zero = jax.tree_util.tree_map(jnp.zeros_like, acc)
+            return new_params, new_opt, zero
+
+        if self._mesh is not None:
+            from paddle_tpu.parallel.spmd import shard_accum_steps
+
+            return shard_accum_steps(astep, ustep, self._mesh, self.gm)
+        return (
+            jax.jit(astep, donate_argnums=(0, 1)),
+            jax.jit(ustep, donate_argnums=(0, 1, 2)),
+        )
 
     def _build_test_fwd(self):
         gm = self.gm
@@ -436,9 +483,13 @@ class Trainer:
             rng, step_rng = jax.random.split(rng)
             t_step = time.perf_counter()
             with stat_timer("train_step"):
-                self.params, self.opt_state, loss, outputs = self.train_step(
-                    self.params, self.opt_state, batch, step_rng, jnp.asarray(float(n))
-                )
+                if self._accum_n > 1:
+                    loss, outputs = self._accum_step(batch, step_rng, n)
+                else:
+                    self.params, self.opt_state, loss, outputs = self.train_step(
+                        self.params, self.opt_state, batch, step_rng,
+                        jnp.asarray(float(n)),
+                    )
             loss_f = float(loss)
             step_times.append(time.perf_counter() - t_step)
             if not np.isfinite(loss_f):
@@ -485,6 +536,10 @@ class Trainer:
                 and batch_id % self.flags.saving_period_by_batches == 0
                 and self.save_dir
             ):
+                if self._accum_n > 1:
+                    # apply pending gradients first or the checkpoint
+                    # would silently drop up to N-1 batches' worth
+                    self._accum_flush()
                 self.save(pass_id, batch_id=batch_id)
             if profiling and batch_id >= (
                 self.flags.profile_start_batch + self.flags.profile_num_batches
@@ -493,6 +548,10 @@ class Trainer:
                 jax.profiler.stop_trace()
                 profiling = False
                 logger.info("profiler trace written to %s", self.flags.profile_dir)
+        if self._accum_n > 1:
+            # end-of-pass remainder: apply whatever is accumulated so no
+            # sample's gradient is dropped (reference flushes on finishPass)
+            self._accum_flush()
         if profiling:
             jax.block_until_ready(self.params)
             jax.profiler.stop_trace()
@@ -510,6 +569,34 @@ class Trainer:
         from paddle_tpu.utils.barrier import step_time_skew_summary
 
         step_time_skew_summary(step_times)
+
+    def _accum_step(self, batch, step_rng, n: int):
+        """One gradient-accumulation batch; applies the optimizer update
+        every N-th call."""
+        if self._accum_fns is None:
+            self._accum_fns = self._build_accum_steps()
+        astep, ustep = self._accum_fns
+        if self._acc is None:
+            self._acc = jax.tree_util.tree_map(jnp.zeros_like, dict(self.params))
+        self.params, self._acc, loss, outputs = astep(
+            self.params, self._acc, batch, step_rng, jnp.asarray(float(n))
+        )
+        self._acc_batches += 1
+        self._acc_samples += n
+        if self._acc_batches >= self._accum_n:
+            self._accum_flush()
+        return loss, outputs
+
+    def _accum_flush(self) -> None:
+        if self._acc_batches == 0 or self._acc is None:
+            return
+        astep, ustep = self._accum_fns
+        self.params, self.opt_state, self._acc = ustep(
+            self.params, self.opt_state, self._acc,
+            jnp.asarray(float(self._acc_samples)),
+        )
+        self._acc_batches = 0
+        self._acc_samples = 0
 
     @property
     def _is_writer(self) -> bool:

@@ -210,6 +210,7 @@ def settings(
     mesh_shape: Optional[str] = None,
     remat: Optional[str] = None,
     scan_unroll: Optional[int] = None,
+    num_batches_per_send_parameter: Optional[int] = None,
 ):
     ctx = current_context()
     s, defaults = ctx.settings, ctx.defaults
@@ -240,6 +241,9 @@ def settings(
         s["remat"] = remat
     if scan_unroll is not None:
         s["scan_unroll"] = scan_unroll
+    if num_batches_per_send_parameter is not None:
+        # gradient accumulation: N batches per optimizer update
+        s["num_batches_per_send_parameter"] = num_batches_per_send_parameter
     if mesh_shape is not None:
         s["mesh_shape"] = mesh_shape
 
