@@ -1,0 +1,99 @@
+"""Summarize a jax.profiler xplane trace: top ops by device self-time.
+
+    python benchmarks/trace_summary.py /path/to/trace_dir [N]
+
+Walks the newest `*.xplane.pb` under the trace dir (written by
+`jax.profiler.trace` / `--profile_dir`), accumulates event durations per
+op on the device planes (TPU or CPU), and prints the top-N table plus
+totals — the quick look that tells you whether the step is matmul-bound
+(good: MXU busy) or drowning in transposes/copies, without opening
+tensorboard. Pure protobuf walking via tensorboard_plugin_profile's
+schema; no TF session anything.
+"""
+
+from __future__ import annotations
+
+import collections
+import glob
+import os
+import sys
+
+
+def _find_xplanes(trace_dir: str):
+    pats = [
+        os.path.join(trace_dir, "**", "*.xplane.pb"),
+    ]
+    files: list = []
+    for p in pats:
+        files.extend(glob.glob(p, recursive=True))
+    return sorted(files, key=os.path.getmtime)
+
+
+def _xplane_pb2():
+    try:
+        from tensorflow.tsl.profiler.protobuf import xplane_pb2  # baked image
+        return xplane_pb2
+    except ImportError:
+        from tensorboard_plugin_profile.protobuf import xplane_pb2  # newer layouts
+        return xplane_pb2
+
+
+def summarize(xplane_path: str):
+    xplane_pb2 = _xplane_pb2()
+
+    space = xplane_pb2.XSpace()
+    with open(xplane_path, "rb") as f:
+        space.ParseFromString(f.read())
+
+    tables = {}
+    for plane in space.planes:
+        name = plane.name
+        # device planes: "/device:TPU:0" (accelerators) or "/host:CPU"
+        # (the XLA-CPU op line under a forced-CPU run); skip the python
+        # host-thread and metadata planes
+        if not (name.startswith("/device:") or "TPU" in name or name == "/host:CPU"):
+            continue
+        ev_names = {i: m.name for i, m in plane.event_metadata.items()}
+        # accelerator planes carry whole-step span lines ("Steps",
+        # "XLA Modules") next to the per-op line — summing those would
+        # double/triple-count and put the module name on top. Prefer the
+        # "XLA Ops" line when present; otherwise take everything except
+        # the known span lines (CPU traces have no "XLA Ops" line).
+        lines = [l for l in plane.lines if l.name == "XLA Ops"] or [
+            l
+            for l in plane.lines
+            if l.name not in ("Steps", "XLA Modules", "Framework Ops", "Source Code")
+        ]
+        durs: collections.Counter = collections.Counter()
+        count: collections.Counter = collections.Counter()
+        for line in lines:
+            for ev in line.events:
+                n = ev_names.get(ev.metadata_id, "?")
+                durs[n] += ev.duration_ps
+                count[n] += 1
+        if durs:
+            tables[name] = (durs, count)
+    return tables
+
+
+def print_summary(trace_dir: str, top: int = 20) -> int:
+    files = _find_xplanes(trace_dir)
+    if not files:
+        print(f"no *.xplane.pb under {trace_dir}", file=sys.stderr)
+        return 1
+    path = files[-1]
+    print(f"# {path}")
+    for plane, (durs, count) in summarize(path).items():
+        total_ps = sum(durs.values())
+        print(f"\n== {plane}  (total {total_ps / 1e9:.3f} ms summed-event time)")
+        print(f"{'op':<58} {'ms':>9} {'%':>6} {'n':>7}")
+        for name, ps in durs.most_common(top):
+            pct = 100.0 * ps / max(total_ps, 1)
+            print(f"{name[:58]:<58} {ps / 1e9:9.3f} {pct:6.1f} {count[name]:7d}")
+    return 0
+
+
+if __name__ == "__main__":
+    d = sys.argv[1] if len(sys.argv) > 1 else "."
+    n = int(sys.argv[2]) if len(sys.argv) > 2 else 20
+    sys.exit(print_summary(d, n))

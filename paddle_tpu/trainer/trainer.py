@@ -117,6 +117,13 @@ class Trainer:
                 "multi-process training needs a mesh "
                 "(--mesh_shape or --trainer_count)"
             )
+        # gradient accumulation: N forward/backwards per optimizer update
+        # (reference num_batches_per_send_parameter, TrainerInternal.cpp)
+        self._accum_n = max(1, int(config.opt_config.num_batches_per_send_parameter))
+        self._accum_fns = None
+        self._acc = None
+        self._acc_batches = 0
+        self._acc_samples = 0
         # whole-data batch algorithms (reference Trainer::trainOnePassBatch,
         # Trainer.cpp:492, selected by algorithm=owlqn): one quasi-Newton
         # update per pass, driven host-side between jitted data sweeps
@@ -128,6 +135,12 @@ class Trainer:
                 raise ValueError(
                     "whole-data batch methods (algorithm=owlqn) run "
                     "single-process; drop --mesh_shape/multi-host"
+                )
+            if self._accum_n > 1:
+                raise ValueError(
+                    "num_batches_per_send_parameter > 1 (gradient "
+                    "accumulation) has no effect under whole-data batch "
+                    "methods — each pass already uses the full dataset"
                 )
             from paddle_tpu.optimizer.batch_methods import BatchMethod
 
@@ -156,13 +169,6 @@ class Trainer:
                 l2weight=oc.l2weight,
                 learning_rate=oc.learning_rate,
             )
-        # gradient accumulation: N forward/backwards per optimizer update
-        # (reference num_batches_per_send_parameter, TrainerInternal.cpp)
-        self._accum_n = max(1, int(config.opt_config.num_batches_per_send_parameter))
-        self._accum_fns = None
-        self._acc = None
-        self._acc_batches = 0
-        self._acc_samples = 0
         self._maybe_restore()
         # StaticPruningHook init semantics: mask values once at startup
         self.params = self.updater.apply_init_hooks(self.params)

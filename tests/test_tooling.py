@@ -54,3 +54,33 @@ def test_cluster_launch_dry_run(tmp_path):
     assert "--process_id=0" in out.stdout and "--process_id=1" in out.stdout
     assert "--coordinator_address=h0:8476" in out.stdout
     assert "u@h1" in out.stdout
+
+
+def test_trace_summary_reads_cpu_trace(tmp_path):
+    """benchmarks/trace_summary.py parses a jax.profiler xplane trace and
+    surfaces the dominant op (dot_general for a matmul-heavy step)."""
+    import io
+    import sys as _sys
+    from contextlib import redirect_stdout
+
+    import jax
+    import jax.numpy as jnp
+
+    _sys.path.insert(0, str(REPO))
+    try:
+        from benchmarks.trace_summary import print_summary
+    finally:
+        _sys.path.remove(str(REPO))
+
+    f = jax.jit(lambda a, b: jnp.tanh(a @ b).sum())
+    a = jnp.ones((256, 256))
+    f(a, a).block_until_ready()
+    with jax.profiler.trace(str(tmp_path)):
+        for _ in range(3):
+            f(a, a).block_until_ready()
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = print_summary(str(tmp_path), 10)
+    out = buf.getvalue()
+    assert rc == 0
+    assert "dot_general" in out and "%" in out
