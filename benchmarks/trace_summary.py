@@ -30,12 +30,20 @@ def _find_xplanes(trace_dir: str):
 
 
 def _xplane_pb2():
-    try:
-        from tensorflow.tsl.profiler.protobuf import xplane_pb2  # baked image
-        return xplane_pb2
-    except ImportError:
-        from tensorboard_plugin_profile.protobuf import xplane_pb2  # newer layouts
-        return xplane_pb2
+    candidates = (
+        "tensorflow.tsl.profiler.protobuf.xplane_pb2",  # this image's TF
+        "tsl.profiler.protobuf.xplane_pb2",             # standalone tsl
+        "xprof.protobuf.xplane_pb2",                    # newer xprof wheels
+    )
+    import importlib
+
+    errs = []
+    for mod in candidates:
+        try:
+            return importlib.import_module(mod)
+        except ImportError as e:
+            errs.append(f"{mod}: {e}")
+    raise ImportError("no xplane_pb2 found; tried:\n  " + "\n  ".join(errs))
 
 
 def summarize(xplane_path: str):
@@ -55,20 +63,39 @@ def summarize(xplane_path: str):
             continue
         ev_names = {i: m.name for i, m in plane.event_metadata.items()}
         # accelerator planes carry whole-step span lines ("Steps",
-        # "XLA Modules") next to the per-op line — summing those would
-        # double/triple-count and put the module name on top. Prefer the
-        # "XLA Ops" line when present; otherwise take everything except
-        # the known span lines (CPU traces have no "XLA Ops" line).
-        lines = [l for l in plane.lines if l.name == "XLA Ops"] or [
-            l
-            for l in plane.lines
-            if l.name not in ("Steps", "XLA Modules", "Framework Ops", "Source Code")
-        ]
+        # "XLA Modules") next to the "XLA Ops" per-op line — summing those
+        # double-counts and puts the module name on top. Prefer the "XLA
+        # Ops" line when present (TPU/GPU). The /host:CPU plane (forced-CPU
+        # runs) interleaves op events with python frames and PjRt wrapper
+        # spans that ENCLOSE them on the same line, so there the filtering
+        # must happen per EVENT: drop source refs ($file.py:..), C++
+        # wrapper methods (Foo::Bar), python dispatch frames.
+        op_lines = [l for l in plane.lines if l.name == "XLA Ops"]
+        event_filter = None
+        if op_lines:
+            lines = op_lines
+        else:
+            lines = [
+                l
+                for l in plane.lines
+                if l.name not in ("Steps", "XLA Modules", "Framework Ops",
+                                  "Source Code", "python")
+            ]
+
+            def event_filter(n):
+                return not (
+                    n.startswith("$")
+                    or "::" in n
+                    or n.startswith(("PjitFunction", "profiler", "Pjit", "jit("))
+                )
+
         durs: collections.Counter = collections.Counter()
         count: collections.Counter = collections.Counter()
         for line in lines:
             for ev in line.events:
                 n = ev_names.get(ev.metadata_id, "?")
+                if event_filter is not None and not event_filter(n):
+                    continue
                 durs[n] += ev.duration_ps
                 count[n] += 1
         if durs:

@@ -97,3 +97,56 @@ def test_accum_under_mesh(ws):
     for k in p_flat:
         np.testing.assert_allclose(p_mesh[k], p_flat[k], rtol=2e-4, atol=2e-5,
                                    err_msg=k)
+
+
+def test_accum_with_sparse_table_falls_back_dense(ws):
+    """A sparse_update embedding under accumulation uses dense gradients
+    (RowSparseGrad shapes vary per batch and cannot be accumulated);
+    training still converges."""
+    train_list = ws / "train.list"
+    train_list.write_text("a\n")
+    src = textwrap.dedent(f"""
+    from paddle_tpu.trainer_config_helpers import *
+
+    define_py_data_sources2(train_list={str(train_list)!r}, test_list=None,
+                            module="seqprov", obj="process")
+    settings(batch_size=16, learning_rate=0.1,
+             learning_method=AdamOptimizer(),
+             num_batches_per_send_parameter=3)
+    words = data_layer(name="words", size=50)
+    emb = embedding_layer(input=words, size=8,
+                          param_attr=ParamAttr(name="emb", sparse_update=True))
+    pool = pooling_layer(input=emb, pooling_type=AvgPooling())
+    output = fc_layer(input=pool, size=2, act=SoftmaxActivation(), name="output")
+    label = data_layer(name="label", size=2)
+    outputs(classification_cost(input=output, label=label))
+    """)
+    p = ws / "cfg_sparse_accum.py"
+    p.write_text(src)
+    (ws / "seqprov.py").write_text(textwrap.dedent("""
+    import numpy as np
+    from paddle_tpu.data import provider, integer_value_sequence, integer_value
+
+    @provider(input_types=[integer_value_sequence(50), integer_value(2)],
+              should_shuffle=False)
+    def process(settings, filename):
+        rng = np.random.RandomState(3)
+        for _ in range(96):
+            y = rng.randint(0, 2)
+            toks = rng.randint(25 * y, 25 * y + 25, rng.randint(3, 8))
+            yield [int(t) for t in toks], int(y)
+    """))
+    FLAGS.save_dir = ""
+    FLAGS.log_period = 0
+    FLAGS.start_pass = 0
+    FLAGS.init_model_path = ""
+    cfg = parse_config(str(p))
+    tr = Trainer(cfg)
+    assert tr._accum_n == 3
+    batch = next(tr._provider(for_test=False).batches())
+    loss0 = float(tr.gm.loss_fn(tr.params, batch, None)[0])
+    tr.train(num_passes=4)
+    loss1 = float(tr.gm.loss_fn(tr.params, batch, None)[0])
+    assert np.isfinite(np.asarray(tr.params["emb"])).all()
+    # the separable classes must be learned through the accumulated path
+    assert loss1 < 0.5 * loss0, (loss0, loss1)
