@@ -22,6 +22,14 @@ ensure_cpu_mesh(8)
 import jax  # noqa: E402
 
 jax.config.update("jax_threefry_partitionable", True)
+# persistent compilation cache: repeat suite runs skip recompiling the
+# big jitted steps (~30% wall-clock on warm cache); JAX_COMPILATION_CACHE_DIR
+# overrides, and a cold cache is merely the old speed
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.environ.get("JAX_COMPILATION_CACHE_DIR", "/tmp/paddle_tpu_jax_cache"),
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 assert len(jax.devices()) == 8, (
     "test suite expects 8 virtual CPU devices; got "
