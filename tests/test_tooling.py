@@ -84,3 +84,34 @@ def test_trace_summary_reads_cpu_trace(tmp_path):
     out = buf.getvalue()
     assert rc == 0
     assert "dot_general" in out and "%" in out
+
+
+def test_mfu_flops_accounting_matches_known_matmul():
+    """benchmarks/mfu.py counts FLOPs via XLA cost analysis of the
+    compiled step — pin it against a matmul whose FLOPs are known
+    (2*M*N*K), so the bench's MFU denominator can't silently drift."""
+    import jax
+    import jax.numpy as jnp
+
+    _sys = __import__("sys")
+    _sys.path.insert(0, str(REPO))
+    try:
+        from benchmarks.mfu import flops_of_compiled, mfu, peak_tflops
+    finally:
+        _sys.path.remove(str(REPO))
+
+    M = N = K = 256
+    f = jax.jit(lambda a, b: a @ b)
+    compiled = f.lower(
+        jax.ShapeDtypeStruct((M, K), jnp.float32),
+        jax.ShapeDtypeStruct((K, N), jnp.float32),
+    ).compile()
+    flops = flops_of_compiled(compiled)
+    expected = 2 * M * N * K
+    assert flops is not None
+    assert 0.9 * expected <= flops <= 1.2 * expected, (flops, expected)
+    # mfu: known device kinds produce a ratio, unknown produce None
+    got = mfu(flops, step_time_s=1e-3, device_kind="TPU v5e")
+    assert got is not None and 0 < got < 1e-3
+    assert mfu(flops, 1e-3, "mystery-chip") is None
+    assert peak_tflops("TPU v4") == 275.0
