@@ -24,7 +24,7 @@ pseudo-gradient/orthant machinery, l2 folds into cost+gradient).
 from __future__ import annotations
 
 from collections import deque
-from typing import Callable, Dict, Optional, Tuple
+from typing import Callable, Dict, Tuple
 
 import numpy as np
 
