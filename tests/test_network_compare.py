@@ -1,0 +1,141 @@
+"""Whole-net equivalence: the same math expressed through two different
+config forms must produce identical outputs and gradients — the analog of
+the reference's gserver/tests/test_NetworkCompare.cpp pairs
+(concat_dotmul_a/b.conf, concat_fullmatrix_a/b.conf, concat_table_a/b.conf:
+projections fed straight to concat_layer vs wrapped in mixed_layer).
+"""
+
+import os
+import tempfile
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.graph import GradientMachine, make_dense, make_ids, make_seq
+
+
+def parse_str(src: str):
+    from paddle_tpu.config import parse_config
+
+    with tempfile.NamedTemporaryFile("w", suffix=".py", delete=False) as f:
+        f.write(textwrap.dedent(src))
+        path = f.name
+    try:
+        return parse_config(path)
+    finally:
+        os.unlink(path)
+
+
+def _compare(src_a: str, src_b: str, batch, out_name="concat"):
+    """Both configs share parameter names, so the same seed gives the
+    same init — outputs and input-cost gradients must agree exactly."""
+    results = []
+    for src in (src_a, src_b):
+        tc = parse_str(src)
+        gm = GradientMachine(tc.model_config)
+        params = gm.init_params(seed=11)
+
+        def loss(p):
+            outs, _ = gm.forward(p, batch, "test")
+            return jnp.sum(outs[out_name].value ** 2), outs[out_name].value
+
+        (l, out), grads = jax.value_and_grad(loss, has_aux=True)(params)
+        results.append((np.asarray(out), float(l),
+                        {k: np.asarray(v) for k, v in grads.items()}))
+    (out_a, l_a, g_a), (out_b, l_b, g_b) = results
+    np.testing.assert_allclose(out_a, out_b, rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(l_a, l_b, rtol=1e-6)
+    assert set(g_a) == set(g_b)
+    for k in g_a:
+        np.testing.assert_allclose(g_a[k], g_b[k], rtol=1e-5, atol=1e-7,
+                                   err_msg=k)
+
+
+DOTMUL_A = """
+from paddle_tpu.trainer_config_helpers import *
+settings(batch_size=16, learning_rate=0.1)
+data = data_layer(name="input", size=20)
+with mixed_layer(size=20, name="m1", bias_attr=False) as layer1:
+    layer1 += dotmul_projection(input=data, param_attr=ParamAttr(name="dm1"))
+with mixed_layer(size=20, name="m2", bias_attr=False) as layer2:
+    layer2 += dotmul_projection(input=data, param_attr=ParamAttr(name="dm2"))
+concat = concat_layer(input=[layer1, layer2], name="concat")
+outputs(concat)
+"""
+
+DOTMUL_B = """
+from paddle_tpu.trainer_config_helpers import *
+settings(batch_size=16, learning_rate=0.1)
+data = data_layer(name="input", size=20)
+proj1 = dotmul_projection(input=data, param_attr=ParamAttr(name="dm1"))
+proj2 = dotmul_projection(input=data, param_attr=ParamAttr(name="dm2"))
+concat = concat_layer(input=[proj1, proj2], name="concat")
+outputs(concat)
+"""
+
+
+def test_concat_dotmul_forms_match():
+    rng = np.random.RandomState(0)
+    batch = {"input": make_dense(rng.randn(6, 20).astype(np.float32))}
+    _compare(DOTMUL_A, DOTMUL_B, batch)
+
+
+FULLMATRIX_A = """
+from paddle_tpu.trainer_config_helpers import *
+settings(batch_size=16, learning_rate=0.1)
+data = data_layer(name="input", size=10)
+with mixed_layer(size=14, name="m1", bias_attr=False) as layer1:
+    layer1 += full_matrix_projection(input=data, param_attr=ParamAttr(name="fm1"))
+with mixed_layer(size=14, name="m2", bias_attr=False) as layer2:
+    layer2 += full_matrix_projection(input=data, param_attr=ParamAttr(name="fm2"))
+concat = concat_layer(input=[layer1, layer2], name="concat")
+outputs(concat)
+"""
+
+FULLMATRIX_B = """
+from paddle_tpu.trainer_config_helpers import *
+settings(batch_size=16, learning_rate=0.1)
+data = data_layer(name="input", size=10)
+proj1 = full_matrix_projection(input=data, size=14, param_attr=ParamAttr(name="fm1"))
+proj2 = full_matrix_projection(input=data, size=14, param_attr=ParamAttr(name="fm2"))
+concat = concat_layer(input=[proj1, proj2], name="concat")
+outputs(concat)
+"""
+
+
+def test_concat_fullmatrix_forms_match():
+    rng = np.random.RandomState(1)
+    batch = {"input": make_dense(rng.randn(5, 10).astype(np.float32))}
+    _compare(FULLMATRIX_A, FULLMATRIX_B, batch)
+
+
+TABLE_A = """
+from paddle_tpu.trainer_config_helpers import *
+settings(batch_size=16, learning_rate=0.1)
+data = data_layer(name="input", size=30)
+with mixed_layer(size=12, name="m1", bias_attr=False) as layer1:
+    layer1 += table_projection(input=data, param_attr=ParamAttr(name="tb1"))
+with mixed_layer(size=12, name="m2", bias_attr=False) as layer2:
+    layer2 += table_projection(input=data, param_attr=ParamAttr(name="tb2"))
+concat = concat_layer(input=[layer1, layer2], name="concat")
+outputs(concat)
+"""
+
+TABLE_B = """
+from paddle_tpu.trainer_config_helpers import *
+settings(batch_size=16, learning_rate=0.1)
+data = data_layer(name="input", size=30)
+proj1 = table_projection(input=data, size=12, param_attr=ParamAttr(name="tb1"))
+proj2 = table_projection(input=data, size=12, param_attr=ParamAttr(name="tb2"))
+concat = concat_layer(input=[proj1, proj2], name="concat")
+outputs(concat)
+"""
+
+
+def test_concat_table_forms_match():
+    rng = np.random.RandomState(2)
+    ids = rng.randint(0, 30, (4,)).astype(np.int32)
+    batch = {"input": make_ids(ids)}
+    _compare(TABLE_A, TABLE_B, batch)
