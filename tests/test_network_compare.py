@@ -139,3 +139,44 @@ def test_concat_table_forms_match():
     ids = rng.randint(0, 30, (4,)).astype(np.int32)
     batch = {"input": make_ids(ids)}
     _compare(TABLE_A, TABLE_B, batch)
+
+
+def test_conv_operator_matches_torch_per_sample():
+    """ConvOperator in a mixed layer: conv(image, filter_input) with
+    PER-SAMPLE dynamic filters (reference ConvOperator.cpp, used for
+    spatial attention). Verified against torch F.conv2d sample by
+    sample."""
+    import torch
+    import torch.nn.functional as TF
+
+    C, H, F, fs = 2, 6, 3, 3
+    src = f"""
+    from paddle_tpu.trainer_config_helpers import *
+    settings(batch_size=4, learning_rate=0.1)
+    img = data_layer(name="img", size={C * H * H})
+    filt = data_layer(name="filt", size={F * C * fs * fs})
+    with mixed_layer(size={F * 4 * 4}, name="convop", bias_attr=False) as m:
+        m += conv_operator(input=[img, filt], filter_size={fs},
+                           num_filters={F}, num_channel={C}, stride=1,
+                           padding=0)
+    outputs(m)
+    """
+    tc = parse_str(src)
+    gm = GradientMachine(tc.model_config)
+    params = gm.init_params(seed=1)
+    rng = np.random.RandomState(4)
+    B = 2
+    img = rng.randn(B, C * H * H).astype(np.float32)
+    filt = rng.randn(B, F * C * fs * fs).astype(np.float32)
+    outs, _ = gm.forward(
+        params,
+        {"img": make_dense(img), "filt": make_dense(filt)},
+        "test",
+    )
+    got = np.asarray(outs["convop"].value)  # [B, F*out*out]
+    for b in range(B):
+        x = torch.from_numpy(img[b].reshape(1, C, H, H))
+        w = torch.from_numpy(filt[b].reshape(F, C, fs, fs))
+        ref = TF.conv2d(x, w, stride=1, padding=0).numpy().reshape(-1)
+        np.testing.assert_allclose(got[b], ref, rtol=1e-4, atol=1e-5,
+                                   err_msg=str(b))
