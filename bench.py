@@ -62,7 +62,7 @@ def _jit_train_step(tc):
     updater = Updater(tc.opt_config, tc.model_config)
     params = gm.init_params(seed=1)
     opt_state = updater.init_state(params)
-    grad_fn = gm.grad_fn()
+    grad_fn = gm.grad_fn(remat=tc.opt_config.remat)
 
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt_state, batch, bs):
@@ -122,22 +122,31 @@ def _mfu_of(flops, dt, steps):
 
 def bench_resnet50(B=None, img_size=224, classes=1000, steps=20, warmup=3, trace=True,
                    dtype=None):
-    """Headline leg. Without an explicit B, tries a descending batch-size
-    ladder (bigger batches fill the MXU better in bf16) and keeps the
-    first size that runs — an OOM at 256 falls back instead of forfeiting
-    the number. PADDLE_TPU_BENCH_RESNET_B pins a size."""
+    """Headline leg. Without an explicit B, tries a descending
+    (batch, remat) ladder — bigger batches fill the MXU better in bf16,
+    and rematerialization can rescue a batch that OOMs before giving up
+    on its size (the +33% recompute FLOPs often beats halving B) — and
+    keeps the first configuration that runs. PADDLE_TPU_BENCH_RESNET_B
+    pins a size."""
     import jax.numpy as jnp
 
     from paddle_tpu.flagship import make_image_batch, resnet_config
 
     env_b = os.environ.get("PADDLE_TPU_BENCH_RESNET_B")
-    ladder = [int(env_b)] if env_b else ([B] if B else [256, 128, 64])
+    env_remat = os.environ.get("PADDLE_TPU_BENCH_RESNET_REMAT", "none")
+    if env_b:
+        ladder = [(int(env_b), env_remat)]
+    elif B:
+        ladder = [(B, "none")]
+    else:
+        ladder = [(b, r) for b in (256, 128, 64) for r in ("none", "full")]
     last_err = None
-    for b in ladder:
+    for b, remat in ladder:
         try:
             tc = resnet_config(50, img_size, classes)
             tc.opt_config.batch_size = b
             tc.opt_config.dtype = dtype or BENCH_DTYPE
+            tc.opt_config.remat = remat
             step, params, opt_state = _jit_train_step(tc)
             batch = make_image_batch(b, img_size, classes)
             dt, flops = _time_steps(
@@ -145,9 +154,16 @@ def bench_resnet50(B=None, img_size=224, classes=1000, steps=20, warmup=3, trace
                 trace=trace,
             )
             m, kind = _mfu_of(flops, dt, steps)
-            return b * steps / dt, {
-                "mfu": m, "device_kind": kind, "dtype": tc.opt_config.dtype, "batch": b,
-            }
+            extras = {"device_kind": kind, "dtype": tc.opt_config.dtype, "batch": b}
+            if remat == "none":
+                extras["mfu"] = m
+            else:
+                # remat recompute FLOPs are in the executed count, so this
+                # is hardware-FLOPs utilization, NOT model-FLOPs (MFU would
+                # be overstated ~33%) — different key, never comparable
+                extras["remat"] = remat
+                extras["hw_flops_util"] = m
+            return b * steps / dt, extras
         except Exception as e:  # OOM or compile failure: step down the ladder
             last_err = e
             continue
