@@ -37,6 +37,12 @@ def data_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> A
     # pure cost inputs (targets/labels/weights) stay full precision.
     assert len(inputs) == 1, f"data layer {cfg.name} not fed"
     a = inputs[0]
+    if a.value is not None and cfg.size > 0 and a.value.shape[-1] != cfg.size:
+        raise ValueError(
+            f"data layer {cfg.name!r} declares size={cfg.size} but was fed "
+            f"width {a.value.shape[-1]} (shape {a.value.shape}) — check the "
+            "provider's input_types against the config's data_layer sizes"
+        )
     if a.value is not None and cfg.name not in ctx.no_cast_inputs:
         cast = ctx.cast_compute(a.value)
         if cast is not a.value:

@@ -133,7 +133,7 @@ def test_lstm_forward_and_grad():
             inputs=[LayerInputConfig(input_layer_name="lstm")],
         )
     )
-    m.layers.append(LayerConfig(name="label", type="data", size=1))
+    m.layers.append(LayerConfig(name="label", type="data", size=hidden))
     m.layers.append(
         LayerConfig(
             name="cost",
