@@ -295,3 +295,63 @@ gen = beam_search(step=gen_step,
 def _np_softmax(x):
     e = np.exp(x - x.max(axis=-1, keepdims=True))
     return e / e.sum(axis=-1, keepdims=True)
+
+
+def test_beam_scores_rescore_exactly():
+    """Every returned beam's reported cumulative log-prob must equal a
+    numpy re-scoring of its token sequence under the model — the
+    bookkeeping check on beam reindexing/freezing (a memoryless step
+    makes exact re-scoring trivial: scores depend only on prev token)."""
+    V, E, EOS = 7, 4, 6
+    tc = parse_str(f"""
+from paddle_tpu.trainer_config_helpers import *
+settings(batch_size=4, learning_rate=1e-3)
+boot = data_layer(name="boot", size={E})
+
+def gen_step(b, cur_emb):
+    h = mixed_layer(size={E}, name="h", bias_attr=False,
+                    input=[full_matrix_projection(cur_emb, param_attr=ParamAttr(name="wx"))])
+    comb = addto_layer(input=[h, b], act=TanhActivation(), bias_attr=False)
+    return fc_layer(input=comb, size={V}, act=SoftmaxActivation(), name="scores")
+
+gen = beam_search(step=gen_step,
+                  input=[StaticInput(boot),
+                         GeneratedInput(size={V}, embedding_name="Tg",
+                                        embedding_size={E})],
+                  bos_id=0, eos_id={EOS}, beam_size=3, max_length=5,
+                  name="generator")
+""")
+    gm = GradientMachine(tc.model_config)
+    params = gm.init_params(seed=8)
+    B = 2
+    rng = np.random.RandomState(2)
+    boot = rng.randn(B, E).astype(np.float32) * 1.5
+    out, _ = gm.forward(params, {"boot": make_dense(boot)}, "gen")
+    beams = out["generator@beams"]
+    ids = np.asarray(beams.ids)            # [B, K, L]
+    scores = np.asarray(beams.value)       # [B, K]
+    lens = np.asarray(beams.sub_seq_lengths)  # [B, K]
+
+    Tg = np.asarray(params["Tg"])
+    Wx = np.asarray(params["wx"])
+    W = np.asarray(params["_scores.w0"])
+    bias = np.asarray(params["_scores.wbias"]).reshape(-1)
+
+    def logp(b, prev, tok):
+        comb = np.tanh(Tg[prev] @ Wx + boot[b])
+        z = comb @ W + bias
+        z = z - z.max()
+        p = np.exp(z) / np.exp(z).sum()
+        return np.log(max(p[tok], 1e-20))
+
+    for b in range(B):
+        for k in range(ids.shape[1]):
+            L = int(lens[b, k])
+            assert L > 0
+            prev, total = 0, 0.0
+            for t in range(L):
+                tok = int(ids[b, k, t])
+                total += logp(b, prev, tok)
+                prev = tok
+            np.testing.assert_allclose(total, scores[b, k], rtol=2e-4,
+                                       atol=2e-4, err_msg=f"{b},{k}")
