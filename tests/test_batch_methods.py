@@ -173,3 +173,28 @@ def test_on_reject_semantics():
     assert len(bm._hist) == 1
     assert bm.on_reject() is True
     assert len(bm._hist) == 0
+
+
+def test_lbfgs_resume_from_checkpoint(tmp_path):
+    """Batch-mode runs resume through the pass-%05d surface: params load,
+    curvature history rebuilds (reference pserver kept it in memory too),
+    and the objective keeps improving."""
+    cfg = parse_config(_bow_lbfgs_config(tmp_path))
+    FLAGS.save_dir = str(tmp_path / "out")
+    FLAGS.log_period = 0
+    FLAGS.start_pass = 0
+    FLAGS.init_model_path = ""
+    tr1 = Trainer(cfg)
+    tr1.train(num_passes=3)
+    c_mid, _, _ = tr1._full_data_sweep(tr1.params, tr1._provider(False), False)
+
+    FLAGS.start_pass = 3
+    cfg2 = parse_config(_bow_lbfgs_config(tmp_path))
+    tr2 = Trainer(cfg2)
+    # restored exactly where the first run stopped
+    c_loaded, _, _ = tr2._full_data_sweep(tr2.params, tr2._provider(False), False)
+    np.testing.assert_allclose(c_loaded, c_mid, rtol=1e-6)
+    tr2.train(num_passes=6)
+    c_end, _, _ = tr2._full_data_sweep(tr2.params, tr2._provider(False), False)
+    assert c_end < c_mid, (c_mid, c_end)
+    FLAGS.start_pass = 0
