@@ -1,0 +1,140 @@
+"""Multi-process GENERATION — the remaining inference surface under a
+cross-process mesh: two processes form one 8-device mesh, run the gen job
+(globalize + pad the batch, shard the forward, gather results on the
+writer process), and the result file must equal the single-process run's.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CONFIG = """
+from paddle_tpu.trainer_config_helpers import *
+define_py_data_sources2(train_list=None, test_list={test_list!r},
+                        module="genprov", obj="gen_process")
+settings(batch_size=8, learning_rate=0.0)
+src = data_layer(name="src", size=11)
+def gen_step(x_t, prev):
+    e = embedding_layer(input=x_t, size=6, name="src_emb",
+                        param_attr=ParamAttr(name="Tsrc"))
+    h = concat_layer(input=[e, prev], name="h")
+    return fc_layer(input=h, size=9, act=SoftmaxActivation(), name="scorer")
+out = beam_search(step=gen_step,
+                  input=[src, GeneratedInput(size=9, embedding_name="Tgen",
+                                             embedding_size=6)],
+                  bos_id=0, eos_id=8, beam_size=2, max_length=6, name="gen")
+"""
+
+GEN_PROV = """
+import random
+from paddle_tpu.data import integer_value_sequence, provider
+
+@provider(input_types={"src": integer_value_sequence(11)})
+def gen_process(settings, file_name):
+    rng = random.Random(int(file_name))
+    for _ in range(16):
+        n = rng.randint(3, 5)
+        yield {"src": [rng.randint(2, 10) for _ in range(n)]}
+"""
+
+WORKER = """
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "").replace("--xla_force_host_platform_device_count=8", "")
+    + " --xla_force_host_platform_device_count=4"
+).strip()
+sys.path.insert(0, {repo!r})
+ws = sys.argv[3]
+sys.path.insert(0, ws)
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax._src.xla_bridge as _xb
+for _n in list(_xb._backend_factories):
+    if _n not in ("cpu", "tpu"):
+        del _xb._backend_factories[_n]
+
+pid = int(sys.argv[1])
+jax.distributed.initialize(coordinator_address="localhost:" + sys.argv[2],
+                           num_processes=2, process_id=pid)
+assert len(jax.devices()) == 8
+
+from paddle_tpu.config import parse_config
+from paddle_tpu.trainer import Trainer
+from paddle_tpu.utils.flags import FLAGS
+
+os.chdir(ws)
+FLAGS.save_dir = ""
+FLAGS.mesh_shape = "data=8"
+FLAGS.log_period = 0
+FLAGS.seed = 5
+FLAGS.gen_result = os.path.join(ws, "mp.txt")
+Trainer(parse_config(os.path.join(ws, "cfg.py"))).generate()
+print("WORKER_OK", pid, flush=True)
+"""
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_generation_matches_single(tmp_path):
+    ws = str(tmp_path)
+    test_list = os.path.join(ws, "test.list")
+    with open(test_list, "w") as f:
+        f.write("7\n")
+    with open(os.path.join(ws, "cfg.py"), "w") as f:
+        f.write(textwrap.dedent(CONFIG.format(test_list=test_list)))
+    with open(os.path.join(ws, "genprov.py"), "w") as f:
+        f.write(textwrap.dedent(GEN_PROV))
+
+    # single-process reference (same 8-device mesh)
+    from paddle_tpu.config import parse_config
+    from paddle_tpu.trainer import Trainer
+    from paddle_tpu.utils.flags import _Flags
+
+    sys.path.insert(0, ws)
+    cwd = os.getcwd()
+    os.chdir(ws)
+    try:
+        flags = _Flags(seed=5, mesh_shape="data=8",
+                       gen_result=os.path.join(ws, "plain.txt"))
+        Trainer(parse_config(os.path.join(ws, "cfg.py")), flags).generate()
+    finally:
+        os.chdir(cwd)
+        sys.path.remove(ws)
+
+    port = _free_port()
+    worker_py = os.path.join(ws, "worker.py")
+    with open(worker_py, "w") as f:
+        f.write(WORKER.format(repo=REPO))
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker_py, str(i), str(port), ws],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        for i in range(2)
+    ]
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        assert p.returncode == 0, err[-3000:]
+        assert "WORKER_OK" in out, (out, err[-2000:])
+
+    plain = open(os.path.join(ws, "plain.txt")).read()
+    mp = open(os.path.join(ws, "mp.txt")).read()
+    assert plain and plain == mp
