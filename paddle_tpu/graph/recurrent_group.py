@@ -126,8 +126,10 @@ def _run_submodel_step(
     ctx: LayerContext,
     fed: Dict[str, Argument],
     rng: Optional[Array],
+    skip: frozenset = frozenset(),
 ) -> Dict[str, Argument]:
-    """Run the sub-model's layers once with pre-fed agent outputs."""
+    """Run the sub-model's layers once with pre-fed agent outputs.
+    ``skip`` names epilogue layers hoisted out of the scan."""
     step_ctx = LayerContext(
         params=ctx.params,
         model=ctx.model,
@@ -148,7 +150,7 @@ def _run_submodel_step(
     step_ctx.outputs.update(fed)
     for name in sub.layer_names:
         lcfg = network.layer_map[name]
-        if lcfg.name in step_ctx.outputs:
+        if lcfg.name in step_ctx.outputs or lcfg.name in skip:
             continue
         if lcfg.type == "recurrent_layer_group":
             # nested group: the inner executor scans the tokens of this
@@ -229,6 +231,85 @@ def _memory_boot_seq(network, mem, ctx: LayerContext, sub: SubModelConfig):
     return (v, boot.seq_lengths)
 
 
+# layer types that are pure per-row functions of their inputs (no
+# sequence/time semantics, no randomness) — safe to re-apply on stacked
+# [T*B, D] rows after the scan instead of per step inside it
+_HOISTABLE_TYPES = frozenset({"fc", "mixed", "addto", "slope_intercept", "concat"})
+
+
+def _plan_epilogue(network, sub: SubModelConfig):
+    """Split the step graph into (inside, epilogue) for training scans.
+
+    Layers that only feed the group's out-links — never a memory, never
+    another inside layer — and are pure per-row ops can run ONCE on the
+    stacked scan outputs instead of once per step. The classic win is an
+    NMT decoder's vocab-softmax projection: inside the scan it re-reads
+    the [D, V] weight from HBM every step and multiplies [B, D] rows;
+    hoisted it is a single [T*B, D] x [D, V] matmul. Returns
+    (epilogue: ordered layer names, frontier: inside outputs the epilogue
+    reads), or None when nothing can be hoisted.
+    """
+    layer_map = network.layer_map
+    names = [n for n in sub.layer_names if n in layer_map]
+    name_set = set(names)
+    for n in names:
+        if layer_map[n].type == "recurrent_layer_group":
+            return None  # nested groups: keep everything inside
+    # consumers within the step graph
+    consumers: Dict[str, set] = {n: set() for n in names}
+    for n in names:
+        for ic in layer_map[n].inputs:
+            if ic.input_layer_name in consumers:
+                consumers[ic.input_layer_name].add(n)
+    # everything a memory reads must stay inside (the carry depends on it)
+    inside_roots = {m.layer_name for m in sub.memories if m.layer_name in name_set}
+    must_inside = set()
+    stack = list(inside_roots)
+    while stack:
+        n = stack.pop()
+        if n in must_inside:
+            continue
+        must_inside.add(n)
+        for ic in layer_map[n].inputs:
+            if ic.input_layer_name in name_set:
+                stack.append(ic.input_layer_name)
+    out_names = {l.layer_name for l in sub.out_links}
+
+    def hoistable(n):
+        lc = layer_map[n]
+        return (
+            lc.type in _HOISTABLE_TYPES
+            and lc.drop_rate == 0.0
+            and n not in must_inside
+        )
+
+    # reverse-topological growth: a layer joins the epilogue when every
+    # step-graph consumer already did (out-link layers additionally have
+    # the implicit "out" consumer, which the epilogue serves)
+    epilogue: list = []
+    in_epi: set = set()
+    for n in reversed(names):
+        if not hoistable(n):
+            continue
+        if not consumers[n] and n not in out_names:
+            continue  # dead layer — leave it alone
+        if all(c in in_epi for c in consumers[n]):
+            in_epi.add(n)
+            epilogue.append(n)
+    epilogue.reverse()
+    if not any(n in out_names for n in epilogue):
+        return None  # hoisting pays only when an out-link moves out
+    # frontier: non-epilogue values the epilogue reads (inside layers or
+    # fed agents)
+    frontier: list = []
+    for n in epilogue:
+        for ic in layer_map[n].inputs:
+            src = ic.input_layer_name
+            if src not in in_epi and src not in frontier:
+                frontier.append(src)
+    return epilogue, frontier
+
+
 def _forward_scan(network, cfg: LayerConfig, sub: SubModelConfig, ctx: LayerContext) -> None:
     assert sub.in_links, f"recurrent group {cfg.name} has no sequence inputs"
     nested = any(link.has_subseq for link in sub.in_links)
@@ -283,6 +364,27 @@ def _forward_scan(network, cfg: LayerConfig, sub: SubModelConfig, ctx: LayerCont
     out_links = list(sub.out_links)
     base_rng = ctx.rng
 
+    # epilogue hoisting: pure per-row suffix layers (e.g. the NMT vocab
+    # projection) run ONCE on stacked scan outputs instead of per step —
+    # one [T*B, D] x [D, V] matmul instead of T weight re-reads. Only for
+    # flat groups whose hoisted layers never read a sequence-valued feed.
+    plan = None if nested else _plan_epilogue(network, sub)
+    if plan is not None:
+        # a hoisted layer must never read a sequence-VALUED feed — its
+        # per-step input would be [B, T2, D] with lengths the frontier
+        # capture can't carry
+        seq_feeds = {m.link_name for m in sub.memories if m.is_sequence}
+        seq_feeds |= {l.link_name for l in sub.in_links if l.has_subseq}
+        seq_feeds |= {l.link_name for l in sub.static_links if l.has_subseq}
+        if any(f in seq_feeds for f in plan[1]):
+            plan = None
+    epilogue, frontier = plan if plan is not None else ([], [])
+    # loop-invariant static feeds are rebuilt outside the scan (tiling a
+    # [B, D] static T times as scan output would waste memory)
+    dyn_frontier = [f for f in frontier if f not in statics]
+    skip = frozenset(epilogue)
+    inside_out_links = [l for l in out_links if l.layer_name not in skip]
+
     def step(carries, inp):
         x_v, x_i, x_sl, m_t, t_idx = inp
         fed: Dict[str, Argument] = {}
@@ -298,7 +400,7 @@ def _forward_scan(network, cfg: LayerConfig, sub: SubModelConfig, ctx: LayerCont
         for i, (mem, carry) in enumerate(zip(memories, carries)):
             fed[mem.link_name] = _memory_feed_arg(mem, carry)
         rng = jax.random.fold_in(base_rng, t_idx) if base_rng is not None else None
-        outs = _run_submodel_step(network, sub, ctx, fed, rng)
+        outs = _run_submodel_step(network, sub, ctx, fed, rng, skip=skip)
         new_carries = []
         m = m_t[:, None]
         for i, (mem, old) in enumerate(zip(memories, carries)):
@@ -316,7 +418,7 @@ def _forward_scan(network, cfg: LayerConfig, sub: SubModelConfig, ctx: LayerCont
                 keep = m > 0 if new.ndim == 2 else m_t > 0
                 new_carries.append(jnp.where(keep, new, old))
         ys = []
-        for l in out_links:
+        for l in inside_out_links:
             out_arg = outs[l.layer_name]
             if out_arg.value.ndim >= 3 and out_arg.seq_lengths is not None:
                 # sequence frame (inner-group output): nested result
@@ -329,7 +431,11 @@ def _forward_scan(network, cfg: LayerConfig, sub: SubModelConfig, ctx: LayerCont
                 )
             else:
                 ys.append((out_arg.value * m.astype(out_arg.value.dtype), None))
-        return tuple(new_carries), tuple(ys)
+        # frontier values for the hoisted epilogue — UNMASKED (the final
+        # out-link mask is applied after the epilogue, matching the
+        # masked-inside semantics exactly)
+        fr = tuple((outs[f].value, outs[f].ids) for f in dyn_frontier)
+        return tuple(new_carries), (tuple(ys), fr)
 
     xs = (
         xs_vals,
@@ -338,10 +444,10 @@ def _forward_scan(network, cfg: LayerConfig, sub: SubModelConfig, ctx: LayerCont
         jnp.swapaxes(mask_bt, 0, 1),
         jnp.arange(T, dtype=jnp.int32),
     )
-    _, ys = jax.lax.scan(
+    _, (ys, frs) = jax.lax.scan(
         step, init_carries, xs, reverse=bool(sub.reversed), unroll=ctx.scan_unroll
     )
-    for link, (y, y_lens) in zip(out_links, ys):
+    for link, (y, y_lens) in zip(inside_out_links, ys):
         if y_lens is not None:
             # [S, B, T, D] → nested [B, S, T, D] with per-subseq lengths
             ctx.outputs[link.link_name] = Argument(
@@ -353,9 +459,65 @@ def _forward_scan(network, cfg: LayerConfig, sub: SubModelConfig, ctx: LayerCont
             ctx.outputs[link.link_name] = Argument(
                 value=jnp.swapaxes(y, 0, 1), seq_lengths=lengths
             )
+    if epilogue:
+        _run_epilogue(
+            network, ctx, epilogue, dyn_frontier, frs, statics, out_links,
+            B, T, mask_bt, lengths,
+        )
     # the group layer itself exposes the first out-link
     if out_links:
         ctx.outputs[cfg.name] = ctx.outputs[out_links[0].link_name]
+
+
+def _run_epilogue(network, ctx, epilogue, dyn_frontier, frs, statics,
+                  out_links, B, T, mask_bt, lengths):
+    """Apply hoisted per-row layers once to the stacked scan outputs."""
+    epi_ctx = LayerContext(
+        params=ctx.params,
+        model=ctx.model,
+        pass_type=ctx.pass_type,
+        rng=None,  # epilogue layers are rng-free by construction
+        states=ctx.states,
+        dtype=ctx.dtype,
+        mesh=ctx.mesh,
+        compute_dtype=ctx.compute_dtype,
+        no_cast_inputs=ctx.no_cast_inputs,
+        scan_unroll=ctx.scan_unroll,
+    )
+    for name, (v, ids) in zip(dyn_frontier, frs):
+        # [T, B, ...] → rows [T*B, ...]
+        flat_v = None if v is None else v.reshape((-1,) + v.shape[2:])
+        flat_i = None if ids is None else ids.reshape((-1,) + ids.shape[2:])
+        epi_ctx.outputs[name] = Argument(value=flat_v, ids=flat_i)
+    for name, arg in statics.items():
+        # loop-invariant feeds: tile the [B, ...] value across the T rows
+
+        def tile(x):
+            if x is None:
+                return None
+            return jnp.broadcast_to(x[None], (T,) + x.shape).reshape(
+                (-1,) + x.shape[1:]
+            )
+
+        if name not in epi_ctx.outputs:
+            epi_ctx.outputs[name] = Argument(value=tile(arg.value), ids=tile(arg.ids))
+    layer_map = network.layer_map
+    for name in epilogue:
+        lcfg = layer_map[name]
+        ins = [
+            network._lookup_input(epi_ctx, ic.input_layer_name, ic.input_layer_argument)
+            for ic in lcfg.inputs
+        ]
+        forward_layer(lcfg, ins, epi_ctx)
+    hoisted = {l.layer_name for l in out_links} & set(epilogue)
+    mask = mask_bt[..., None]
+    for link in out_links:
+        if link.layer_name not in hoisted:
+            continue
+        flat = epi_ctx.outputs[link.layer_name].value          # [T*B, D]
+        y = jnp.swapaxes(flat.reshape((T, B) + flat.shape[1:]), 0, 1)
+        y = y * mask.astype(y.dtype)
+        ctx.outputs[link.link_name] = Argument(value=y, seq_lengths=lengths)
 
 
 # ------------------------------------------------------------ generation
