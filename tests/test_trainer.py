@@ -157,3 +157,26 @@ def test_remat_full_matches_plain_gradients():
 
     with pytest.raises(ValueError):
         gm.grad_fn(remat="bogus")
+
+
+def test_multi_pass_test_job(tmp_path, capsys):
+    """--job=test --test_pass=0 evaluates every saved checkpoint in
+    sequence (the reference Tester's pass-by-pass mode)."""
+    from paddle_tpu import cli
+
+    cfg_path = lr_config(tmp_path)
+    FLAGS.save_dir = str(tmp_path / "out")
+    FLAGS.num_passes = 3
+    FLAGS.log_period = 0
+    FLAGS.start_pass = 0
+    FLAGS.init_model_path = ""
+    Trainer(parse_config(cfg_path)).train(num_passes=3)
+
+    FLAGS.test_pass = 0
+    try:
+        rc = cli.main(["test", f"--config={cfg_path}",
+                       f"--save_dir={tmp_path / 'out'}",
+                       "--num_passes=3", "--test_pass=0"])
+    finally:
+        FLAGS.test_pass = -1
+    assert rc == 0
