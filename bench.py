@@ -120,6 +120,18 @@ def _mfu_of(flops, dt, steps):
     return (round(m, 4) if m is not None else None), kind
 
 
+def _try_ladder(configs, run_one):
+    """Run the first ladder configuration that survives (OOM or compile
+    failure steps down); re-raises the last error when none does."""
+    last_err = None
+    for cfg in configs:
+        try:
+            return run_one(*cfg)
+        except Exception as e:
+            last_err = e
+    raise last_err
+
+
 def bench_resnet50(B=None, img_size=224, classes=1000, steps=20, warmup=3, trace=True,
                    dtype=None):
     """Headline leg. Without an explicit B, tries a descending
@@ -140,34 +152,31 @@ def bench_resnet50(B=None, img_size=224, classes=1000, steps=20, warmup=3, trace
         ladder = [(B, "none")]
     else:
         ladder = [(b, r) for b in (256, 128, 64) for r in ("none", "full")]
-    last_err = None
-    for b, remat in ladder:
-        try:
-            tc = resnet_config(50, img_size, classes)
-            tc.opt_config.batch_size = b
-            tc.opt_config.dtype = dtype or BENCH_DTYPE
-            tc.opt_config.remat = remat
-            step, params, opt_state = _jit_train_step(tc)
-            batch = make_image_batch(b, img_size, classes)
-            dt, flops = _time_steps(
-                step, params, opt_state, batch, jnp.asarray(float(b)), steps, warmup,
-                trace=trace,
-            )
-            m, kind = _mfu_of(flops, dt, steps)
-            extras = {"device_kind": kind, "dtype": tc.opt_config.dtype, "batch": b}
-            if remat == "none":
-                extras["mfu"] = m
-            else:
-                # remat recompute FLOPs are in the executed count, so this
-                # is hardware-FLOPs utilization, NOT model-FLOPs (MFU would
-                # be overstated ~33%) — different key, never comparable
-                extras["remat"] = remat
-                extras["hw_flops_util"] = m
-            return b * steps / dt, extras
-        except Exception as e:  # OOM or compile failure: step down the ladder
-            last_err = e
-            continue
-    raise last_err
+
+    def run_one(b, remat):
+        tc = resnet_config(50, img_size, classes)
+        tc.opt_config.batch_size = b
+        tc.opt_config.dtype = dtype or BENCH_DTYPE
+        tc.opt_config.remat = remat
+        step, params, opt_state = _jit_train_step(tc)
+        batch = make_image_batch(b, img_size, classes)
+        dt, flops = _time_steps(
+            step, params, opt_state, batch, jnp.asarray(float(b)), steps, warmup,
+            trace=trace,
+        )
+        m, kind = _mfu_of(flops, dt, steps)
+        extras = {"device_kind": kind, "dtype": tc.opt_config.dtype, "batch": b}
+        if remat == "none":
+            extras["mfu"] = m
+        else:
+            # remat recompute FLOPs are in the executed count, so this
+            # is hardware-FLOPs utilization, NOT model-FLOPs (MFU would
+            # be overstated ~33%) — different key, never comparable
+            extras["remat"] = remat
+            extras["hw_flops_util"] = m
+        return b * steps / dt, extras
+
+    return _try_ladder(ladder, run_one)
 
 
 def bench_lstm_classifier(B=256, T=64, steps=20, warmup=3, dtype=None):
@@ -185,20 +194,31 @@ def bench_lstm_classifier(B=256, T=64, steps=20, warmup=3, dtype=None):
     return B * T * steps / dt, {"mfu": m, "dtype": tc.opt_config.dtype}
 
 
-def bench_nmt(B=64, T=32, vocab=30000, dim=512, steps=10, warmup=2, dtype=None):
+def bench_nmt(B=None, T=32, vocab=30000, dim=512, steps=10, warmup=2, dtype=None):
     """seqToseq NMT attention encoder-decoder train step; tokens/sec counts
-    target (decoder) tokens — BASELINE.md north-star workload #2."""
+    target (decoder) tokens — BASELINE.md north-star workload #2. Without
+    an explicit B, walks a 256/128/64 batch ladder on OOM (the hoisted
+    vocab projection makes large batches the MXU-filling configuration);
+    an explicit B is pinned, matching bench_resnet50."""
     import jax.numpy as jnp
 
     from paddle_tpu.flagship import nmt_batch, nmt_config
 
-    tc = nmt_config(vocab=vocab, dim=dim, dtype=dtype or BENCH_DTYPE)
-    tc.opt_config.batch_size = B
-    step, params, opt_state = _jit_train_step(tc)
-    batch = nmt_batch(vocab=vocab, B=B, T=T)
-    dt, flops = _time_steps(step, params, opt_state, batch, jnp.asarray(float(B)), steps, warmup)
-    m, _ = _mfu_of(flops, dt, steps)
-    return B * T * steps / dt, {"mfu": m, "dtype": tc.opt_config.dtype, "tokens": "target"}
+    def run_one(b):
+        tc = nmt_config(vocab=vocab, dim=dim, dtype=dtype or BENCH_DTYPE)
+        tc.opt_config.batch_size = b
+        step, params, opt_state = _jit_train_step(tc)
+        batch = nmt_batch(vocab=vocab, B=b, T=T)
+        dt, flops = _time_steps(
+            step, params, opt_state, batch, jnp.asarray(float(b)), steps, warmup
+        )
+        m, _ = _mfu_of(flops, dt, steps)
+        return b * T * steps / dt, {
+            "mfu": m, "dtype": tc.opt_config.dtype, "tokens": "target", "batch": b,
+        }
+
+    ladder = [(B,)] if B else [(256,), (128,), (64,)]
+    return _try_ladder(ladder, run_one)
 
 
 def _emit(metric, value, unit, vs_baseline, **extra):
@@ -247,7 +267,9 @@ def main():
             "lstm_classifier_tokens_per_sec",
         )
     elif which == "nmt":
-        value, extras = bench_nmt(dtype=leg_dtype)
+        # CPU has nothing to OOM the ladder down: pin the pre-ladder B=64
+        # so the leg stays inside the supervisor budget
+        value, extras = bench_nmt(dtype=leg_dtype, **({} if on_tpu else {"B": 64}))
         metric, unit, tkey = ("nmt_train_tokens_per_sec", "tokens/s", "nmt_tokens_per_sec")
     elif on_tpu:
         # headline: bf16 ResNet-50; "all" additionally runs the two

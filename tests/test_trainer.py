@@ -159,9 +159,11 @@ def test_remat_full_matches_plain_gradients():
         gm.grad_fn(remat="bogus")
 
 
-def test_multi_pass_test_job(tmp_path, capsys):
+def test_multi_pass_test_job(tmp_path, caplog):
     """--job=test --test_pass=0 evaluates every saved checkpoint in
     sequence (the reference Tester's pass-by-pass mode)."""
+    import logging
+
     from paddle_tpu import cli
 
     cfg_path = lr_config(tmp_path)
@@ -172,11 +174,21 @@ def test_multi_pass_test_job(tmp_path, capsys):
     FLAGS.init_model_path = ""
     Trainer(parse_config(cfg_path)).train(num_passes=3)
 
+    # the paddle_tpu logger doesn't propagate (own stderr handler) —
+    # attach caplog's handler directly to count per-pass evaluations
+    from paddle_tpu.utils.logging import logger as ptu_logger
+
+    ptu_logger.addHandler(caplog.handler)
     FLAGS.test_pass = 0
     try:
-        rc = cli.main(["test", f"--config={cfg_path}",
-                       f"--save_dir={tmp_path / 'out'}",
-                       "--num_passes=3", "--test_pass=0"])
+        with caplog.at_level(logging.INFO, logger="paddle_tpu"):
+            rc = cli.main(["test", f"--config={cfg_path}",
+                           f"--save_dir={tmp_path / 'out'}",
+                           "--num_passes=3", "--test_pass=0"])
     finally:
         FLAGS.test_pass = -1
+        ptu_logger.removeHandler(caplog.handler)
     assert rc == 0
+    # all three saved passes actually evaluated
+    evaluated = [r for r in caplog.records if "Test (pass" in r.getMessage()]
+    assert len(evaluated) == 3, [r.getMessage() for r in caplog.records][-10:]
