@@ -446,6 +446,11 @@ class Trainer:
             with stat_timer("test"):
                 self.test(pass_id=pass_id)
             if (
+                self.flags.show_parameter_stats_period
+                and (pass_id + 1) % self.flags.show_parameter_stats_period == 0
+            ):
+                self.show_parameter_stats()
+            if (
                 accepted
                 and self.save_dir
                 and (bm.n_accepted - 1) % max(self.flags.saving_period, 1) == 0
