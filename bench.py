@@ -120,16 +120,37 @@ def _mfu_of(flops, dt, steps):
     return (round(m, 4) if m is not None else None), kind
 
 
+def _is_oom(e) -> bool:
+    """True only for memory-exhaustion failures. Anything else (a shape
+    bug, a bad rewrite, a lowering error) must FAIL the leg loudly rather
+    than silently stepping the ladder down and reporting a healthy-looking
+    number for a different configuration."""
+    msg = f"{type(e).__name__}: {e}".lower()
+    return any(
+        s in msg
+        for s in ("resource_exhausted", "resource exhausted", "out of memory",
+                  "failed to allocate", "oom")
+    )
+
+
 def _try_ladder(configs, run_one):
-    """Run the first ladder configuration that survives (OOM or compile
-    failure steps down); re-raises the last error when none does."""
-    last_err = None
-    for cfg in configs:
+    """Run the first ladder configuration that survives an OOM-class
+    failure; any other error re-raises immediately. The successful rung's
+    extras gain a "skipped_rungs" list recording each rung stepped past
+    and why, so the JSON never hides that a smaller configuration ran."""
+    skipped = []
+    for i, cfg in enumerate(configs):
         try:
-            return run_one(*cfg)
+            value, extras = run_one(*cfg)
         except Exception as e:
-            last_err = e
-    raise last_err
+            if i == len(configs) - 1 or not _is_oom(e):
+                raise
+            skipped.append({"rung": list(cfg), "error": f"{type(e).__name__}: {str(e)[:200]}"})
+            continue
+        if skipped:
+            extras = dict(extras or {}, skipped_rungs=skipped)
+        return value, extras
+    raise AssertionError("empty ladder")
 
 
 def bench_resnet50(B=None, img_size=224, classes=1000, steps=20, warmup=3, trace=True,

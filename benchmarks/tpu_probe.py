@@ -1,0 +1,25 @@
+"""One un-supervised TPU claim attempt: import jax, list devices, run a
+small matmul. Exit 0 only if the accelerator actually executed work.
+
+Run this ONLY from the recovery watcher (benchmarks/tpu_watcher.sh) or by
+hand in a disposable shell — it claims the chip in-process, so a wedged
+tunnel makes it hang ~25 min before failing UNAVAILABLE. Everything else
+(bench.py, tests) must keep probing via
+paddle_tpu.utils.backend_guard.probe_backend (subprocess + SIGTERM-first
+timeout).
+"""
+import time
+
+t0 = time.time()
+import jax
+import jax.numpy as jnp
+
+print("import", round(time.time() - t0, 1), flush=True)
+t0 = time.time()
+d = jax.devices()
+print("devices", d, round(time.time() - t0, 1), flush=True)
+assert any(dev.platform != "cpu" for dev in d), f"no accelerator in {d}"
+t0 = time.time()
+x = jnp.ones((1024, 1024), jnp.bfloat16)
+y = (x @ x).block_until_ready()
+print("matmul ok", round(time.time() - t0, 1), flush=True)

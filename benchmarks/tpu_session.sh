@@ -1,0 +1,30 @@
+#!/bin/bash
+# Full TPU measurement session. Run automatically by tpu_watcher.sh the
+# moment a chip claim succeeds, or by hand when the tunnel is known-up.
+#
+# Legs: bench all (bf16 production config, xplane trace of the headline
+# window), f32 ResNet A/B, scan_unroll A/B on the recurrent legs, then a
+# trace summary. Raw output lands in benchmarks/RESULTS_tpu_session_raw.txt
+# inside the repo working tree so the driver's end-of-round auto-commit
+# captures the numbers even if no agent is running when they arrive.
+cd "$(dirname "$0")/.." || exit 1
+OUT=benchmarks/RESULTS_tpu_session_raw.txt
+ERR=/tmp/tpu_session_err.log
+echo "=== TPU session $(date -u)" >> $OUT
+mkdir -p benchmarks/traces
+# headline: all three legs, bf16, trace captured
+PADDLE_TPU_BENCH_TRACE_DIR=$PWD/benchmarks/traces PADDLE_TPU_BENCH_BUDGET=1400 \
+  timeout 1500 python bench.py >> $OUT 2>$ERR
+echo "--- f32 resnet A/B" >> $OUT
+PADDLE_TPU_BENCH_DTYPE=float32 PADDLE_TPU_BENCH_BUDGET=900 \
+  timeout 1000 python bench.py resnet >> $OUT 2>>$ERR
+for u in 4 8; do
+  echo "--- unroll=$u lstm+nmt" >> $OUT
+  PADDLE_TPU_BENCH_UNROLL=$u PADDLE_TPU_BENCH_BUDGET=600 \
+    timeout 700 python bench.py lstm >> $OUT 2>>$ERR
+  PADDLE_TPU_BENCH_UNROLL=$u PADDLE_TPU_BENCH_BUDGET=600 \
+    timeout 700 python bench.py nmt >> $OUT 2>>$ERR
+done
+echo "--- trace summary" >> $OUT
+python benchmarks/trace_summary.py benchmarks/traces 15 >> $OUT 2>>$ERR
+echo "=== session done $(date -u)" >> $OUT

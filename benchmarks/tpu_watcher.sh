@@ -1,0 +1,33 @@
+#!/bin/bash
+# Patient TPU recovery watcher (committed per round-3 verdict: the session
+# must not depend on tribal knowledge living in /tmp).
+#
+# One chip-claim attempt per cycle via benchmarks/tpu_probe.py — the probe
+# is left UN-killed (a SIGKILLed TPU-client holder wedges the tunnel for
+# every later claimant), so a wedged attempt simply occupies its cycle for
+# the ~25 min the tunnel takes to reject it. On the first successful claim
+# it runs the full measurement session once (benchmarks/tpu_session.sh)
+# and exits. Log: /tmp/tpu_recovery_probe.log.
+#
+# Usage: nohup benchmarks/tpu_watcher.sh [max_attempts] & disown
+cd "$(dirname "$0")/.." || exit 1
+LOG=/tmp/tpu_recovery_probe.log
+MAX=${1:-72}
+for i in $(seq 1 "$MAX"); do
+  echo "=== attempt $i $(date -u)" >> $LOG
+  if python benchmarks/tpu_probe.py >> $LOG 2>&1; then
+    echo "RECOVERED $(date -u)" >> $LOG
+    bash benchmarks/tpu_session.sh
+    # only count the session as done if at least one leg produced a real
+    # TPU number — a tunnel that re-wedged right after the probe must not
+    # burn the one-shot session
+    if grep -q '"backend": "[^c]' benchmarks/RESULTS_tpu_session_raw.txt 2>/dev/null; then
+      echo "SESSION COMPLETE $(date -u)" >> $LOG
+      exit 0
+    fi
+    echo "SESSION PRODUCED NO TPU NUMBERS — continuing to watch $(date -u)" >> $LOG
+  fi
+  sleep 300
+done
+echo "GAVE UP after $MAX attempts $(date -u)" >> $LOG
+exit 1

@@ -115,3 +115,47 @@ def test_mfu_flops_accounting_matches_known_matmul():
     assert got is not None and 0 < got < 1e-3
     assert mfu(flops, 1e-3, "mystery-chip") is None
     assert peak_tflops("TPU v4") == 275.0
+
+
+def test_bench_ladder_steps_down_only_on_oom():
+    """bench._try_ladder must step down a rung ONLY for OOM-class errors
+    (RESOURCE_EXHAUSTED / out-of-memory), re-raise anything else at the
+    failing rung, and record every skipped rung + reason in the winning
+    rung's extras so the emitted JSON can't hide a silent downgrade."""
+    sys.path.insert(0, REPO)  # bench.py pins REPO on sys.path itself anyway
+    from bench import _try_ladder
+
+    # OOM at 256 steps down; 128 wins and reports the skipped rung
+    def run_oom(b, r):
+        if b == 256:
+            raise RuntimeError("RESOURCE_EXHAUSTED: Out of memory allocating ...")
+        return 100.0 * b, {"batch": b}
+
+    v, extras = _try_ladder([(256, "none"), (128, "none")], run_oom)
+    assert v == 12800.0
+    assert extras["skipped_rungs"][0]["rung"] == [256, "none"]
+    assert "RESOURCE_EXHAUSTED" in extras["skipped_rungs"][0]["error"]
+
+    # a non-OOM failure (shape bug) re-raises immediately — no downgrade
+    def run_bug(b, r):
+        if b == 256:
+            raise ValueError("dot_general shape mismatch")
+        return 100.0 * b, {}
+
+    try:
+        _try_ladder([(256, "none"), (128, "none")], run_bug)
+    except ValueError:
+        pass
+    else:
+        raise AssertionError("non-OOM error must fail the leg loudly")
+
+    # OOM on the LAST rung re-raises too (nothing left to step to)
+    def run_all_oom(b, r):
+        raise RuntimeError("RESOURCE_EXHAUSTED")
+
+    try:
+        _try_ladder([(64, "none")], run_all_oom)
+    except RuntimeError:
+        pass
+    else:
+        raise AssertionError("exhausted ladder must raise")
