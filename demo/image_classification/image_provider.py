@@ -1,51 +1,105 @@
-"""Synthetic CIFAR-style image provider (ref: demo/image_classification/image_provider.py).
+"""CIFAR-style image provider with train-time augmentation
+(ref: demo/image_classification/image_provider.py).
 
-Deterministic generator: each class plants a distinct low-frequency color
-template; samples are template + noise, so the net has real signal to
-learn. Swap `process` for a reader of the preprocessed CIFAR batches
-(same yield contract) to train on the real dataset.
+Pipeline per sample (paddle_tpu.utils.image_util): random crop from the
+src_size source image + 50% horizontal flip when training, center crop at
+test time, then dataset-mean subtraction — the reference's
+preprocess_img, with an explicit per-file-seeded rng so every pass is
+reproducible.
+
+Data source: deterministic synthetic generator (each class plants a
+distinct low-frequency color template at src_size; samples are template +
+noise). To train on the real dataset instead, run
+demo/image_classification/prepare_data.py over the CIFAR python batches —
+it writes batch files + a meta mean this provider picks up via the
+``meta`` arg (same yield contract, same config).
 """
 
+import os
+import pickle
 import zlib
 
 import numpy as np
 
 from paddle.trainer.PyDataProvider2 import *
+from paddle_tpu.utils import image_util
 
-IMG_SIZE = 32
 CHANNELS = 3
-CLASSES = 10
 SAMPLES_PER_FILE = 256
 
 
-def _class_template(label):
+def _class_template(label, size):
     rng = np.random.RandomState(1000 + label)
     # low-frequency pattern upsampled to full resolution, per channel
     coarse = rng.uniform(-1.0, 1.0, (CHANNELS, 4, 4))
-    return np.kron(coarse, np.ones((IMG_SIZE // 4, IMG_SIZE // 4)))
+    return np.kron(coarse, np.ones((size // 4, size // 4)))
 
 
-_TEMPLATES = None
+_TEMPLATES = {}
 
 
-def _templates():
-    global _TEMPLATES
-    if _TEMPLATES is None:
-        _TEMPLATES = [_class_template(c) for c in range(CLASSES)]
-    return _TEMPLATES
+def _templates(classes, size):
+    key = (classes, size)
+    if key not in _TEMPLATES:
+        _TEMPLATES[key] = [_class_template(c, size) for c in range(classes)]
+    return _TEMPLATES[key]
 
 
-@provider(
-    input_types={
-        "image": dense_vector(IMG_SIZE * IMG_SIZE * CHANNELS),
-        "label": integer_value(CLASSES),
+def hook(settings, img_size=32, src_size=36, num_classes=10, meta=None,
+         is_train=True, **kwargs):
+    """Provider init: declares slot types and resolves the mean image.
+
+    img_size: crop fed to the net; src_size: generated source images
+    (src_size > img_size makes train-time random cropping non-trivial);
+    meta: optional path to a mean file (written by prepare_data.py) —
+    absent, the mean of the class templates stands in.
+    """
+    settings.img_size = img_size
+    settings.src_size = src_size
+    settings.num_classes = num_classes
+    settings.is_train = is_train
+    if meta:
+        # an explicit meta arg that can't be loaded is an error — silently
+        # training on synthetic data while the user believes it's real
+        # CIFAR would be far worse than failing here
+        if not os.path.exists(meta):
+            raise FileNotFoundError(f"meta file not found: {meta}")
+        settings.img_mean = image_util.load_meta(meta, src_size, img_size)
+        settings.real_batches = True
+    else:
+        tmpl = np.stack(_templates(num_classes, src_size))
+        border = (src_size - img_size) // 2
+        settings.img_mean = tmpl.mean(axis=0)[
+            :, border : border + img_size, border : border + img_size
+        ].astype(np.float32)
+        settings.real_batches = False
+    settings.input_types = {
+        "image": dense_vector(img_size * img_size * CHANNELS),
+        "label": integer_value(num_classes),
     }
-)
+
+
+@provider(init_hook=hook)
 def process(settings, file_name):
     seed = zlib.crc32(file_name.encode()) % (2**31)
     rng = np.random.RandomState(seed)
-    tmpl = _templates()
+    if settings.real_batches:
+        with open(file_name, "rb") as f:
+            data = pickle.load(f)
+        images, labels = data["images"], data["labels"]
+        order = rng.permutation(len(images)) if settings.is_train else range(len(images))
+        for i in order:
+            feat = image_util.preprocess_img(
+                images[i], settings.img_mean, settings.img_size,
+                settings.is_train, rng=rng,
+            )
+            yield {"image": feat.astype(np.float32).tolist(), "label": int(labels[i])}
+        return
+    tmpl = _templates(settings.num_classes, settings.src_size)
     for _ in range(SAMPLES_PER_FILE):
-        label = int(rng.randint(CLASSES))
+        label = int(rng.randint(settings.num_classes))
         img = tmpl[label] + rng.normal(0.0, 0.6, tmpl[label].shape)
-        yield {"image": img.astype(np.float32).ravel().tolist(), "label": label}
+        feat = image_util.preprocess_img(
+            img, settings.img_mean, settings.img_size, settings.is_train, rng=rng
+        )
+        yield {"image": feat.astype(np.float32).tolist(), "label": label}

@@ -1,0 +1,107 @@
+"""Convert raw CIFAR python batches into provider batch files + mean meta.
+
+Role analog of the reference's demo/image_classification/data/download_cifar.sh
++ python/paddle/utils/preprocess_img.py pipeline: raw dataset -> shuffled
+batch files + a ``batches.meta`` holding the training-set mean image that
+image_provider.py subtracts from every sample. No network access is
+assumed — point --cifar at an already-downloaded cifar-10-batches-py
+directory (the standard python pickle release with data_batch_1..5 and
+test_batch).
+
+Outputs under --out (default data/cifar-out):
+  batches/train_batch_NNN, batches/test_batch_NNN   pickled
+      {"images": float32 (N,3,32,32) in [0,1], "labels": int list}
+  batches.meta      np.savez with data_mean (3*32*32 float32, train mean)
+  train.list / test.list   one batch path per line
+
+Usage:
+  python prepare_data.py --cifar data/cifar-10-batches-py [--out data/cifar-out]
+Then train with
+  --config_args=meta=data/cifar-out/batches.meta,src_size=32
+and train.list/test.list pointing at the written lists.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import pickle
+
+import numpy as np
+
+SAMPLES_PER_OUT_BATCH = 1024
+
+
+def _load_raw_batch(path):
+    with open(path, "rb") as f:
+        d = pickle.load(f, encoding="bytes")
+    # standard CIFAR python pickles key by bytes; tolerate str too
+    data = d.get(b"data", d.get("data"))
+    labels = d.get(b"labels", d.get("labels", d.get(b"fine_labels")))
+    images = np.asarray(data, np.float32).reshape(-1, 3, 32, 32) / 255.0
+    return images, [int(x) for x in labels]
+
+
+def convert(cifar_dir: str, out_dir: str, samples_per_batch: int = SAMPLES_PER_OUT_BATCH):
+    """Returns (n_train, n_test). Deterministic: fixed shuffle seed."""
+    batches_dir = os.path.join(out_dir, "batches")
+    os.makedirs(batches_dir, exist_ok=True)
+
+    def gather(names):
+        imgs, labs = [], []
+        for name in names:
+            p = os.path.join(cifar_dir, name)
+            if not os.path.exists(p):
+                continue
+            i, l = _load_raw_batch(p)
+            imgs.append(i)
+            labs.extend(l)
+        if not imgs:
+            raise FileNotFoundError(f"no CIFAR batches among {names} in {cifar_dir}")
+        return np.concatenate(imgs), labs
+
+    train_imgs, train_labs = gather([f"data_batch_{i}" for i in range(1, 6)])
+    test_imgs, test_labs = gather(["test_batch"])
+
+    rng = np.random.RandomState(0)
+    order = rng.permutation(len(train_imgs))
+    train_imgs, train_labs = train_imgs[order], [train_labs[i] for i in order]
+
+    def write_split(imgs, labs, prefix):
+        paths = []
+        for b in range(0, len(imgs), samples_per_batch):
+            path = os.path.join(batches_dir, f"{prefix}_batch_{b // samples_per_batch:03d}")
+            with open(path, "wb") as f:
+                pickle.dump(
+                    {"images": imgs[b : b + samples_per_batch],
+                     "labels": labs[b : b + samples_per_batch]},
+                    f, protocol=pickle.HIGHEST_PROTOCOL,
+                )
+            paths.append(path)
+        return paths
+
+    train_paths = write_split(train_imgs, train_labs, "train")
+    test_paths = write_split(test_imgs, test_labs, "test")
+
+    # training-set mean image, flattened like the reference's batches.meta
+    # (write through a handle — np.savez would append .npz to a bare path)
+    with open(os.path.join(out_dir, "batches.meta"), "wb") as f:
+        np.savez(f, data_mean=train_imgs.mean(axis=0).ravel().astype(np.float32))
+    for name, paths in (("train.list", train_paths), ("test.list", test_paths)):
+        with open(os.path.join(out_dir, name), "w") as f:
+            f.write("\n".join(os.path.abspath(p) for p in paths) + "\n")
+    return len(train_imgs), len(test_imgs)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--cifar", required=True, help="cifar-10-batches-py directory")
+    ap.add_argument("--out", default="data/cifar-out")
+    ap.add_argument("--samples_per_batch", type=int, default=SAMPLES_PER_OUT_BATCH)
+    args = ap.parse_args()
+    n_train, n_test = convert(args.cifar, args.out, args.samples_per_batch)
+    print(f"wrote {n_train} train / {n_test} test samples under {args.out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -11,11 +11,20 @@ is_predict = get_config_arg("is_predict", bool, False)
 small = get_config_arg("small", bool, False)
 
 if not is_predict:
+    # src_size > img_size gives train-time random cropping real freedom;
+    # --config_args=meta=data/cifar-out/batches.meta,src_size=32 switches
+    # to the real dataset written by prepare_data.py
     define_py_data_sources2(
         train_list="train.list",
         test_list="test.list",
         module="image_provider",
         obj="process",
+        args={
+            "img_size": 32,
+            "src_size": get_config_arg("src_size", int, 36),
+            "num_classes": 10,
+            "meta": get_config_arg("meta", str, ""),
+        },
     )
 
 settings(

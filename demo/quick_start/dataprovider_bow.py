@@ -22,7 +22,7 @@ def initializer(settings, dictionary, **kwargs):
 
 @provider(init_hook=initializer, cache=CacheType.CACHE_PASS_IN_MEM)
 def process(settings, file_name):
-    for label, words in common.synth_samples(file_name):
+    for label, words in common.samples(file_name):
         ids = sorted({settings.word_dict.get(w, UNK_IDX) for w in words})
         yield ids, label
 
@@ -34,5 +34,5 @@ def predict_initializer(settings, dictionary, **kwargs):
 
 @provider(init_hook=predict_initializer, should_shuffle=False)
 def process_predict(settings, file_name):
-    for _, words in common.synth_samples(file_name, n=100):
+    for _, words in common.samples(file_name, n=100):
         yield sorted({settings.word_dict.get(w, UNK_IDX) for w in words})

@@ -21,7 +21,7 @@ def initializer(settings, dictionary, **kwargs):
 
 @provider(init_hook=initializer)
 def process(settings, file_name):
-    for label, words in common.synth_samples(file_name):
+    for label, words in common.samples(file_name):
         yield [settings.word_dict.get(w, UNK_IDX) for w in words], label
 
 
@@ -32,5 +32,5 @@ def predict_initializer(settings, dictionary, **kwargs):
 
 @provider(init_hook=predict_initializer, should_shuffle=False)
 def process_predict(settings, file_name):
-    for _, words in common.synth_samples(file_name, n=100):
+    for _, words in common.samples(file_name, n=100):
         yield [settings.word_dict.get(w, UNK_IDX) for w in words]

@@ -1,0 +1,80 @@
+"""Convert raw Amazon product-review JSON into quick_start corpus files.
+
+Role analog of the reference's demo/quick_start/data/get_data.sh +
+preprocess.py pipeline (minus the network fetch — no egress here; point
+--reviews at an already-downloaded reviews_Electronics_5.json.gz). Label
+semantics match the reference: rating 5 is positive (label 1 here),
+ratings 1-2 negative (label 0), 3-4 discarded. Tokenization is the
+simple lowercase tokenizer in paddle_tpu.data.datasets (mosesdecoder
+divergence documented in doc/divergences.md).
+
+Outputs under --out (default data/amazon-out):
+  train.txt / test.txt   '<label>\t<tokenized text>' lines, shuffled
+  dict.txt               frequency-ordered vocabulary, id = line number
+  train.list / test.list one corpus path per line
+
+Then train with
+  --config_args=dict=data/amazon-out/dict.txt
+and train.list/test.list pointing at the written lists.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+from paddle_tpu.data import datasets
+
+
+def convert(reviews_path: str, out_dir: str, test_ratio: float = 0.1,
+            seed: int = 42, max_dict: int = 30000):
+    """Returns (n_train, n_test, dict_size). Deterministic under seed."""
+    os.makedirs(out_dir, exist_ok=True)
+    samples = []
+    with datasets.open_maybe_gz(reviews_path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            d = json.loads(line)
+            rating = float(d.get("overall", 0))
+            words = datasets.tokenize(d.get("reviewText", ""))
+            if not words:
+                continue
+            if rating >= 5:
+                samples.append((1, words))
+            elif rating <= 2:
+                samples.append((0, words))
+    rng = random.Random(seed)
+    rng.shuffle(samples)
+    n_test = max(1, int(len(samples) * test_ratio))
+    test, train = samples[:n_test], samples[n_test:]
+
+    words = datasets.build_dict((w for _, w in train), max_size=max_dict)
+    datasets.save_dict(words, os.path.join(out_dir, "dict.txt"))
+    datasets.write_labeled_lines(train, os.path.join(out_dir, "train.txt"))
+    datasets.write_labeled_lines(test, os.path.join(out_dir, "test.txt"))
+    for name in ("train", "test"):
+        with open(os.path.join(out_dir, f"{name}.list"), "w") as f:
+            f.write(os.path.abspath(os.path.join(out_dir, f"{name}.txt")) + "\n")
+    return len(train), len(test), len(words)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--reviews", required=True,
+                    help="reviews_*_5.json[.gz] (one JSON object per line)")
+    ap.add_argument("--out", default="data/amazon-out")
+    ap.add_argument("--test_ratio", type=float, default=0.1)
+    args = ap.parse_args()
+    n_train, n_test, d = convert(args.reviews, args.out, args.test_ratio)
+    print(f"wrote {n_train} train / {n_test} test samples, dict={d} words under {args.out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -7,7 +7,7 @@ from paddle.trainer_config_helpers import *
 
 import common
 
-word_dict = {w: i for i, w in enumerate(common.VOCAB)}
+word_dict = common.resolve_dict(get_config_arg("dict", str, ""))
 
 is_predict = get_config_arg("is_predict", bool, False)
 define_py_data_sources2(train_list="train.list" if not is_predict else None,

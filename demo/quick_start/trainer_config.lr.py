@@ -10,7 +10,7 @@ from paddle.trainer_config_helpers import *
 
 import common
 
-word_dict = {w: i for i, w in enumerate(common.VOCAB)}
+word_dict = common.resolve_dict(get_config_arg("dict", str, ""))
 
 is_predict = get_config_arg("is_predict", bool, False)
 trn = "train.list" if not is_predict else None

@@ -8,3 +8,11 @@ GENRES = 18
 GENDERS = 2
 AGES = 7
 JOBS = 21
+
+
+def load_meta(path):
+    """meta.pkl written by prepare_data.py (dims + movie/user tables)."""
+    import pickle
+
+    with open(path, "rb") as f:
+        return pickle.load(f)

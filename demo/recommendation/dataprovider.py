@@ -1,11 +1,16 @@
-"""Synthetic MovieLens-style data (ref: demo/recommendation/dataprovider.py).
+"""MovieLens-style data provider (ref: demo/recommendation/dataprovider.py).
 
-Deterministic generator: each (movie, user) pair gets a rating from a
-planted low-rank structure so the model has signal to learn. Replace
-`process` with a reader of the real ml-1m files (same yield contract) to
-train on MovieLens.
+Two modes sharing one yield contract:
+- real: when the config passes ``meta`` (meta.pkl from prepare_data.py)
+  and the file-list entries are rating files ('uid::mid::rating' lines),
+  features are joined from the meta tables; ratings 1..5 are mapped to
+  [-1, 1] to match the cos_sim output range.
+- synthetic (default): deterministic generator — each (movie, user) pair
+  gets a rating from a planted low-rank structure so the model has signal
+  to learn with no dataset on disk.
 """
 
+import os
 import random
 
 from paddle.trainer.PyDataProvider2 import *
@@ -13,19 +18,56 @@ from paddle.trainer.PyDataProvider2 import *
 import common as C
 
 
-@provider(
-    input_types={
-        "movie_id": integer_value(C.MOVIE_IDS),
-        "movie_title": integer_value_sequence(C.TITLE_WORDS),
-        "movie_genre": sparse_binary_vector(C.GENRES),
-        "user_id": integer_value(C.USER_IDS),
-        "user_gender": integer_value(C.GENDERS),
-        "user_age": integer_value(C.AGES),
-        "user_job": integer_value(C.JOBS),
+def hook(settings, meta=None, **kwargs):
+    if meta:
+        settings.meta = C.load_meta(meta)
+        d = settings.meta["dims"]
+    else:
+        settings.meta = None
+        d = {"movie_ids": C.MOVIE_IDS, "user_ids": C.USER_IDS,
+             "title_words": C.TITLE_WORDS, "genres": C.GENRES,
+             "genders": C.GENDERS, "ages": C.AGES, "jobs": C.JOBS}
+    settings.input_types = {
+        "movie_id": integer_value(d["movie_ids"]),
+        "movie_title": integer_value_sequence(d["title_words"]),
+        "movie_genre": sparse_binary_vector(d["genres"]),
+        "user_id": integer_value(d["user_ids"]),
+        "user_gender": integer_value(d["genders"]),
+        "user_age": integer_value(d["ages"]),
+        "user_job": integer_value(d["jobs"]),
         "rating": dense_vector(1),
     }
-)
+
+
+@provider(init_hook=hook)
 def process(settings, file_name):
+    if settings.meta is not None:
+        # real mode was requested: a missing ratings file is an error, and
+        # the synthetic generator's C.* id ranges may not even fit the
+        # meta-declared dims — never fall back silently
+        if not os.path.exists(file_name):
+            raise FileNotFoundError(f"ratings file not found: {file_name}")
+        movies, users = settings.meta["movies"], settings.meta["users"]
+        with open(file_name) as f:
+            for line in f:
+                parts = line.strip().split("::")
+                if len(parts) < 3:
+                    continue
+                uid, mid, rating = int(parts[0]), int(parts[1]), float(parts[2])
+                m, u = movies.get(mid), users.get(uid)
+                if m is None or u is None:
+                    continue
+                yield {
+                    "movie_id": mid,
+                    "movie_title": m["title"],
+                    "movie_genre": m["genres"],
+                    "user_id": uid,
+                    "user_gender": u["gender"],
+                    "user_age": u["age"],
+                    "user_job": u["job"],
+                    "rating": [(rating - 3.0) / 2.0],
+                }
+        return
     rng = random.Random(file_name)
     for _ in range(2000):
         mid = rng.randrange(C.MOVIE_IDS)

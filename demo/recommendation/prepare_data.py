@@ -1,0 +1,116 @@
+"""Convert raw MovieLens ml-1m files into provider meta + rating splits.
+
+Role analog of the reference's demo/recommendation/data pipeline
+(ml_data.sh fetch + meta_generator.py + split.py), minus the network
+fetch — point --ml at an extracted ml-1m directory containing
+movies.dat / users.dat / ratings.dat ('::'-separated, latin-1).
+
+Outputs under --out (default data/ml-out):
+  meta.pkl      {"dims": {...}, "movies": {mid: {"title": [word ids],
+                "genres": [idx]}}, "users": {uid: {"gender": i,
+                "age": i, "job": i}}} — the meta_generator.py role
+  train.txt / test.txt   'uid::mid::rating' lines, split per user
+                         (last `test_per_user` ratings of each user held
+                         out — the split.py role)
+  train.list / test.list one path per line
+
+Then train with
+  --config_args=meta=data/ml-out/meta.pkl
+and train.list/test.list pointing at the written lists.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import pickle
+import sys
+from collections import defaultdict
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+from paddle_tpu.data import datasets
+
+ML_AGES = [1, 18, 25, 35, 45, 50, 56]  # ml-1m age buckets, index = feature
+
+
+def _read_dat(path):
+    with open(path, encoding="latin-1") as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                yield line.split("::")
+
+
+def convert(ml_dir: str, out_dir: str, test_per_user: int = 1, max_title_dict: int = 5000):
+    """Returns (n_train, n_test, dims). Deterministic (no RNG: the split
+    holds out each user's most recent `test_per_user` ratings)."""
+    os.makedirs(out_dir, exist_ok=True)
+
+    # movies: id -> title word ids + genre indices
+    raw_movies = list(_read_dat(os.path.join(ml_dir, "movies.dat")))
+    title_tokens = [datasets.tokenize(title) for _, title, _ in raw_movies]
+    title_words = datasets.build_dict(title_tokens, max_size=max_title_dict,
+                                      reserved=("<unk>",))
+    title_ids = {w: i for i, w in enumerate(title_words)}
+    genre_names = sorted({g for _, _, gs in raw_movies for g in gs.split("|")})
+    genre_ids = {g: i for i, g in enumerate(genre_names)}
+    movies = {}
+    for (mid, title, gs), toks in zip(raw_movies, title_tokens):
+        movies[int(mid)] = {
+            "title": [title_ids.get(t, 0) for t in toks] or [0],
+            "genres": sorted(genre_ids[g] for g in gs.split("|")),
+        }
+
+    # users: id -> categorical features
+    users = {}
+    for uid, gender, age, job, _zip in _read_dat(os.path.join(ml_dir, "users.dat")):
+        users[int(uid)] = {
+            "gender": 0 if gender.upper() == "M" else 1,
+            "age": ML_AGES.index(int(age)) if int(age) in ML_AGES else 0,
+            "job": int(job),
+        }
+
+    # ratings: per-user split, most recent test_per_user held out
+    by_user = defaultdict(list)
+    for uid, mid, rating, ts in _read_dat(os.path.join(ml_dir, "ratings.dat")):
+        by_user[int(uid)].append((int(ts), int(mid), float(rating)))
+    train, test = [], []
+    for uid in sorted(by_user):
+        rs = sorted(by_user[uid])
+        for i, (_, mid, r) in enumerate(rs):
+            (test if i >= len(rs) - test_per_user else train).append((uid, mid, r))
+
+    dims = {
+        "movie_ids": max(movies) + 1,
+        "user_ids": max(users) + 1,
+        "title_words": len(title_words),
+        "genres": len(genre_names),
+        "genders": 2,
+        "ages": len(ML_AGES),
+        "jobs": max(u["job"] for u in users.values()) + 1,
+    }
+    with open(os.path.join(out_dir, "meta.pkl"), "wb") as f:
+        pickle.dump({"dims": dims, "movies": movies, "users": users}, f,
+                    protocol=pickle.HIGHEST_PROTOCOL)
+    for name, rows in (("train", train), ("test", test)):
+        with open(os.path.join(out_dir, f"{name}.txt"), "w") as f:
+            for uid, mid, r in rows:
+                f.write(f"{uid}::{mid}::{r}\n")
+        with open(os.path.join(out_dir, f"{name}.list"), "w") as f:
+            f.write(os.path.abspath(os.path.join(out_dir, f"{name}.txt")) + "\n")
+    return len(train), len(test), dims
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--ml", required=True, help="extracted ml-1m directory")
+    ap.add_argument("--out", default="data/ml-out")
+    ap.add_argument("--test_per_user", type=int, default=1)
+    args = ap.parse_args()
+    n_train, n_test, dims = convert(args.ml, args.out, args.test_per_user)
+    print(f"wrote {n_train} train / {n_test} test ratings, dims={dims} under {args.out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -11,10 +11,17 @@ remote parameter updates.
 
 from paddle.trainer_config_helpers import *
 
-# synthetic dataset dimensions shared with dataprovider.py
-from common import AGES, GENDERS, GENRES, JOBS, MOVIE_IDS, TITLE_WORDS, USER_IDS
+# synthetic dataset dimensions shared with dataprovider.py; a real
+# MovieLens meta.pkl (--config_args=meta=...) overrides them
+from common import AGES, GENDERS, GENRES, JOBS, MOVIE_IDS, TITLE_WORDS, USER_IDS, load_meta
 
 is_predict = get_config_arg("is_predict", bool, False)
+meta_path = get_config_arg("meta", str, "")
+if meta_path:
+    _dims = load_meta(meta_path)["dims"]
+    MOVIE_IDS, USER_IDS = _dims["movie_ids"], _dims["user_ids"]
+    TITLE_WORDS, GENRES = _dims["title_words"], _dims["genres"]
+    GENDERS, AGES, JOBS = _dims["genders"], _dims["ages"], _dims["jobs"]
 
 settings(batch_size=64, learning_rate=1e-3, learning_method=RMSPropOptimizer())
 
@@ -50,6 +57,7 @@ similarity = cos_sim(a=construct_movie(), b=construct_user())
 if not is_predict:
     outputs(regression_cost(input=similarity, label=data_layer("rating", size=1)))
     define_py_data_sources2("train.list", "test.list",
-                            module="dataprovider", obj="process")
+                            module="dataprovider", obj="process",
+                            args={"meta": meta_path} if meta_path else None)
 else:
     outputs(similarity)

@@ -40,3 +40,24 @@ def synth_reviews(seed, n=800):
             else:
                 words.append(rng.choice(NEUTRAL))
         yield label, words
+
+def samples(file_name, n=800):
+    """An existing file is read as a '<label>\\t<text>' corpus (written by
+    prepare_data.py); anything else seeds the synthetic generator."""
+    import os
+
+    if os.path.exists(file_name):
+        from paddle_tpu.data import datasets
+
+        yield from datasets.read_labeled_lines(file_name)
+    else:
+        yield from synth_reviews(file_name, n)
+
+
+def resolve_dict(dict_path=""):
+    """word->id map: converter dict file when given, else synthetic vocab."""
+    if dict_path:
+        from paddle_tpu.data import datasets
+
+        return datasets.load_dict(dict_path)
+    return {w: i for i, w in enumerate(VOCAB)}

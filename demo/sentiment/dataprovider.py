@@ -17,5 +17,5 @@ def hook(settings, dictionary, **kwargs):
 
 @provider(init_hook=hook)
 def process(settings, file_name):
-    for label, words in common.synth_reviews(file_name):
+    for label, words in common.samples(file_name):
         yield [settings.word_dict.get(w, UNK_IDX) for w in words], label

@@ -1,0 +1,76 @@
+"""Convert the raw IMDB sentiment dataset (aclImdb) into corpus files.
+
+Role analog of the reference's demo/sentiment/data/get_imdb.sh +
+preprocess.py pipeline, minus the network fetch (no egress here — point
+--imdb at an already-extracted aclImdb directory with
+train/{pos,neg}/*.txt and test/{pos,neg}/*.txt).
+
+Outputs under --out (default data/imdb-out):
+  train.txt / test.txt   '<label>\t<tokenized text>' lines, shuffled
+                         (label 1 = pos, 0 = neg)
+  dict.txt               frequency-ordered vocabulary from the train split
+  train.list / test.list one corpus path per line
+
+Then train with
+  --config_args=dict=data/imdb-out/dict.txt
+and train.list/test.list pointing at the written lists.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+from paddle_tpu.data import datasets
+
+
+def _read_split(imdb_dir: str, split: str):
+    samples = []
+    for label, sub in ((1, "pos"), (0, "neg")):
+        for path in sorted(glob.glob(os.path.join(imdb_dir, split, sub, "*.txt"))):
+            with open(path, encoding="utf-8", errors="replace") as f:
+                words = datasets.tokenize(f.read())
+            if words:
+                samples.append((label, words))
+    return samples
+
+
+def convert(imdb_dir: str, out_dir: str, seed: int = 42, max_dict: int = 30000,
+            cutoff: int = 2):
+    """Returns (n_train, n_test, dict_size). Deterministic under seed."""
+    os.makedirs(out_dir, exist_ok=True)
+    train = _read_split(imdb_dir, "train")
+    test = _read_split(imdb_dir, "test")
+    if not train or not test:
+        raise FileNotFoundError(f"no aclImdb train/test review files under {imdb_dir}")
+    rng = random.Random(seed)
+    rng.shuffle(train)
+    rng.shuffle(test)
+
+    words = datasets.build_dict((w for _, w in train), max_size=max_dict, cutoff=cutoff)
+    datasets.save_dict(words, os.path.join(out_dir, "dict.txt"))
+    datasets.write_labeled_lines(train, os.path.join(out_dir, "train.txt"))
+    datasets.write_labeled_lines(test, os.path.join(out_dir, "test.txt"))
+    for name in ("train", "test"):
+        with open(os.path.join(out_dir, f"{name}.list"), "w") as f:
+            f.write(os.path.abspath(os.path.join(out_dir, f"{name}.txt")) + "\n")
+    return len(train), len(test), len(words)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--imdb", required=True, help="extracted aclImdb directory")
+    ap.add_argument("--out", default="data/imdb-out")
+    ap.add_argument("--max_dict", type=int, default=30000)
+    args = ap.parse_args()
+    n_train, n_test, d = convert(args.imdb, args.out, max_dict=args.max_dict)
+    print(f"wrote {n_train} train / {n_test} test samples, dict={d} words under {args.out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -13,11 +13,13 @@ import common
 
 
 def sentiment_data(is_test=False, is_predict=False,
-                   train_list="train.list", test_list="test.list"):
-    """Declare the synthetic IMDB-style data sources; returns (dict_dim,
-    class_dim). Swap common.synth_samples for a pre-imdb reader to use the
-    real dataset (same provider contract)."""
-    word_dict = {w: i for i, w in enumerate(common.VOCAB)}
+                   train_list="train.list", test_list="test.list",
+                   dict_path=""):
+    """Declare the sentiment data sources; returns (dict_dim, class_dim).
+    dict_path (--config_args=dict=...) switches to a converter-written
+    vocabulary, and file lists pointing at prepare_data.py output feed the
+    real corpus through the same provider."""
+    word_dict = common.resolve_dict(dict_path)
     if is_predict:
         return len(word_dict), common.NUM_CLASSES
     define_py_data_sources2(

@@ -11,7 +11,8 @@ is_predict = get_config_arg("is_predict", bool, False)
 hid_dim = get_config_arg("hid_dim", int, 512)
 stacked_num = get_config_arg("stacked_num", int, 3)
 
-dict_dim, class_dim = sentiment_data(is_test, is_predict)
+dict_dim, class_dim = sentiment_data(is_test, is_predict,
+                                     dict_path=get_config_arg("dict", str, ""))
 
 settings(
     batch_size=128,

@@ -1,49 +1,102 @@
-"""Synthetic translation data for the seqToseq demo.
+"""Translation data provider for the seqToseq demo
+(ref: demo/seqToseq/dataprovider.py).
 
-The reference demo feeds WMT-14 fr→en corpus files
-(/root/reference/demo/seqToseq/dataprovider.py); to keep this demo
-self-contained it synthesizes a deterministic toy "translation": the target
-sentence is the source sentence reversed, over a small shared vocabulary.
-Swap `process` for a corpus reader (same yield contract) to train on real
-data. Token ids 0/1 are reserved for <s>/<e> like the reference's dicts.
+Two modes, one yield contract:
+- real: when the config passes src_dict/trg_dict paths (written by
+  prepare_data.py), file-list entries are corpus shards of
+  '<src sentence>\t<trg sentence>' lines; words map through the dicts
+  with <s>/<e>/<unk> at ids 0/1/2 (the reference's sbeos convention) and
+  teacher forcing frames the target with <s>.../...<e>.
+- synthetic (default): a deterministic toy "translation" — the target is
+  the source reversed over a small shared vocabulary — so the demo runs
+  with no dataset on disk.
 """
 
+import os
 import random
 
 from paddle.trainer.PyDataProvider2 import *
 
-VOCAB = 20          # ids 0..VOCAB-1; 0 = <s>, 1 = <e>
+VOCAB = 20          # ids 0..VOCAB-1; 0 = <s>, 1 = <e>, 2 = <unk>
 MIN_LEN, MAX_LEN = 3, 8
 NUM_SAMPLES = 300
+START, END, UNK = 0, 1, 2
+
+
+def dict_dims(src_dict_path="", trg_dict_path=""):
+    """Layer dims for train.conf/gen.conf: converter dict sizes in real
+    mode, the synthetic VOCAB otherwise. One definition so config-declared
+    dims can never diverge from the provider's mapping."""
+    if src_dict_path and trg_dict_path:
+        from paddle_tpu.data import datasets
+
+        return (len(datasets.load_dict(src_dict_path)),
+                len(datasets.load_dict(trg_dict_path)))
+    return VOCAB, VOCAB
+
+
+def _load_dicts(settings, src_dict_path, trg_dict_path):
+    if src_dict_path and trg_dict_path:
+        from paddle_tpu.data import datasets
+
+        settings.src_dict = datasets.load_dict(src_dict_path)
+        settings.trg_dict = datasets.load_dict(trg_dict_path)
+        return len(settings.src_dict), len(settings.trg_dict)
+    settings.src_dict = settings.trg_dict = None
+    return VOCAB, VOCAB
+
+
+def hook(settings, src_dict=None, trg_dict=None, **kwargs):
+    src_dim, trg_dim = _load_dicts(settings, src_dict, trg_dict)
+    settings.input_types = {
+        "source_language_word": integer_value_sequence(src_dim),
+        "target_language_word": integer_value_sequence(trg_dim),
+        "target_language_next_word": integer_value_sequence(trg_dim),
+    }
+
+
+def gen_hook(settings, src_dict=None, trg_dict=None, **kwargs):
+    src_dim, _ = _load_dicts(settings, src_dict, trg_dict)
+    settings.input_types = {"source_language_word": integer_value_sequence(src_dim)}
 
 
 def _pairs(seed):
     rng = random.Random(seed)
     for _ in range(NUM_SAMPLES):
         n = rng.randint(MIN_LEN, MAX_LEN)
-        src = [rng.randint(2, VOCAB - 1) for _ in range(n)]
+        src = [rng.randint(3, VOCAB - 1) for _ in range(n)]
         trg = list(reversed(src))
         yield src, trg
 
 
-@provider(
-    input_types={
-        "source_language_word": integer_value_sequence(VOCAB),
-        "target_language_word": integer_value_sequence(VOCAB),
-        "target_language_next_word": integer_value_sequence(VOCAB),
-    }
-)
+def _real_pairs(settings, file_name):
+    from paddle_tpu.data import datasets
+
+    for s_toks, t_toks in datasets.read_parallel_lines(file_name):
+        src = [settings.src_dict.get(w, UNK) for w in s_toks]
+        trg = [settings.trg_dict.get(w, UNK) for w in t_toks]
+        yield src, trg
+
+
+def _stream(settings, file_name):
+    if getattr(settings, "src_dict", None) is not None and os.path.exists(file_name):
+        yield from _real_pairs(settings, file_name)
+    else:
+        yield from _pairs(file_name)
+
+
+@provider(init_hook=hook)
 def process(settings, file_name):
     # decoder input = <s> + target; label = target + <e>  (teacher forcing)
-    for src, trg in _pairs(file_name):
+    for src, trg in _stream(settings, file_name):
         yield {
             "source_language_word": src,
-            "target_language_word": [0] + trg,
-            "target_language_next_word": trg + [1],
+            "target_language_word": [START] + trg,
+            "target_language_next_word": trg + [END],
         }
 
 
-@provider(input_types={"source_language_word": integer_value_sequence(VOCAB)})
+@provider(init_hook=gen_hook)
 def gen_process(settings, file_name):
-    for src, _ in _pairs(file_name):
+    for src, _ in _stream(settings, file_name):
         yield {"source_language_word": src}

@@ -1,0 +1,100 @@
+"""Convert raw parallel corpus files into seqToseq dicts + sbeos shards.
+
+Role analog of the reference's demo/seqToseq/data/wmt14_data.sh +
+preprocess pipeline, minus the network fetch — point --train_src /
+--train_trg (and optionally --test_src / --test_trg) at already-downloaded
+plain-text parallel files, one sentence per line, line i of src aligned
+with line i of trg.
+
+Outputs under --out (default data/wmt-out), the reference's corpus layout:
+  src.dict / trg.dict    one word per line; <s>/<e>/<unk> are ids 0/1/2
+  train/part-000...      '<src sentence>\t<trg sentence>' shard files
+  test/part-000...       same for the held-out split
+  train.list / test.list one shard path per line
+
+Then train with
+  --config_args=src_dict=data/wmt-out/src.dict,trg_dict=data/wmt-out/trg.dict
+and train.list/test.list pointing at the written lists.
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+from paddle_tpu.data import datasets
+
+LINES_PER_SHARD = 50000
+
+
+def _pairs(src_path, trg_path):
+    with datasets.open_maybe_gz(src_path) as fs, datasets.open_maybe_gz(trg_path) as ft:
+        for s, t in zip(fs, ft):
+            s_toks, t_toks = s.split(), t.split()
+            if s_toks and t_toks:
+                yield s_toks, t_toks
+
+
+def _write_shards(pairs, out_dir, lines_per_shard):
+    os.makedirs(out_dir, exist_ok=True)
+    paths = []
+    it = iter(pairs)
+    for shard_idx in itertools.count():
+        chunk = list(itertools.islice(it, lines_per_shard))
+        if not chunk:
+            break
+        path = os.path.join(out_dir, f"part-{shard_idx:03d}")
+        with open(path, "w") as f:
+            for s_toks, t_toks in chunk:
+                f.write(f"{' '.join(s_toks)}\t{' '.join(t_toks)}\n")
+        paths.append(path)
+    return paths
+
+
+def convert(train_src, train_trg, out_dir, test_src=None, test_trg=None,
+            max_dict: int = 30000, lines_per_shard: int = LINES_PER_SHARD):
+    """Returns (n_train_shards, n_test_shards, src_dict_size, trg_dict_size)."""
+    os.makedirs(out_dir, exist_ok=True)
+    # two passes: dict building, then sharding (corpora can exceed memory)
+    src_words = datasets.build_dict(
+        (s for s, _ in _pairs(train_src, train_trg)),
+        max_size=max_dict, reserved=datasets.SEQ_RESERVED)
+    trg_words = datasets.build_dict(
+        (t for _, t in _pairs(train_src, train_trg)),
+        max_size=max_dict, reserved=datasets.SEQ_RESERVED)
+    datasets.save_dict(src_words, os.path.join(out_dir, "src.dict"))
+    datasets.save_dict(trg_words, os.path.join(out_dir, "trg.dict"))
+
+    train_paths = _write_shards(_pairs(train_src, train_trg),
+                                os.path.join(out_dir, "train"), lines_per_shard)
+    test_paths = []
+    if test_src and test_trg:
+        test_paths = _write_shards(_pairs(test_src, test_trg),
+                                   os.path.join(out_dir, "test"), lines_per_shard)
+    for name, paths in (("train.list", train_paths), ("test.list", test_paths)):
+        if paths:
+            with open(os.path.join(out_dir, name), "w") as f:
+                f.write("\n".join(os.path.abspath(p) for p in paths) + "\n")
+    return len(train_paths), len(test_paths), len(src_words), len(trg_words)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--train_src", required=True)
+    ap.add_argument("--train_trg", required=True)
+    ap.add_argument("--test_src")
+    ap.add_argument("--test_trg")
+    ap.add_argument("--out", default="data/wmt-out")
+    ap.add_argument("--max_dict", type=int, default=30000)
+    args = ap.parse_args()
+    nt, ns, ds, dt = convert(args.train_src, args.train_trg, args.out,
+                             args.test_src, args.test_trg, args.max_dict)
+    print(f"wrote {nt} train / {ns} test shards, dicts src={ds} trg={dt} under {args.out}")
+
+
+if __name__ == "__main__":
+    main()

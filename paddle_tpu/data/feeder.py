@@ -322,7 +322,12 @@ class DataProvider:
         self.provider = provider_obj
         self.file_list = file_list
         self.batch_size = batch_size
-        self.settings = provider_obj.init(**(provider_kwargs or {}))
+        init_kwargs = dict(provider_kwargs or {})
+        # runtime-injected hook kwargs (reference PyDataProvider2 contract):
+        # user args from the config take precedence if they collide
+        init_kwargs.setdefault("is_train", not for_test)
+        init_kwargs.setdefault("file_list", list(file_list))
+        self.settings = provider_obj.init(**init_kwargs)
         self.assembler = BatchAssembler(self.settings.input_types, slot_names)
         self.async_prefetch = async_prefetch
         self.rng = random.Random(seed)

@@ -186,6 +186,16 @@ def provider(
 
             settings.logger = logging.getLogger("paddle_tpu.data")
             if init_hook is not None:
+                # the runtime injects file_list/is_train (reference
+                # PyDataProvider2.py:161-178 contract); hooks without a
+                # **kwargs catch-all only receive the names they declare
+                import inspect
+
+                sig = inspect.signature(init_hook)
+                if not any(
+                    p.kind == p.VAR_KEYWORD for p in sig.parameters.values()
+                ):
+                    kwargs = {k: v for k, v in kwargs.items() if k in sig.parameters}
                 init_hook(settings, **kwargs)
             if settings.input_types is None:
                 raise ValueError(

@@ -1,0 +1,107 @@
+"""Batched on-device image perturbation: rotate + scale + patch sampling.
+
+Role analog of the reference's GPU augmentation kernels
+(paddle/cuda/src/hl_perturbation_util.cu: kSamplingPatches +
+hl_generate_disturb_params), re-designed for XLA instead of translated:
+the whole batch is one jittable inverse-mapped nearest-neighbor gather
+(static shapes, no per-image host loop), and randomness is an explicit
+jax PRNG key split per call — reproducible under jit, unlike the
+reference's srand(time(NULL)).
+
+Geometry matches the reference kernel: for each output pixel the source
+coordinate is found by translating to the sampled patch center, rotating
+by -theta, unscaling, and rounding to the nearest source pixel;
+out-of-bounds sources read pad_value.
+
+Typical use: augment a host batch right before the train step
+(`perturb` is jit-compatible and fuses with the rest of the step), with
+rotate_angle the max |rotation| in degrees and scale_ratio the total
+relative scale jitter (scale in 1 +/- scale_ratio/2).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["perturb"]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("tgt_size", "sampling_rate", "is_train")
+)
+def perturb(
+    images: jax.Array,
+    key: jax.Array,
+    tgt_size: int,
+    rotate_angle: float = 0.0,
+    scale_ratio: float = 0.0,
+    sampling_rate: int = 1,
+    pad_value: float = 0.0,
+    is_train: bool = True,
+) -> jax.Array:
+    """Sample rotated/scaled patches from a batch of square images.
+
+    images: (N, C, S, S) float array.
+    Returns (N * sampling_rate, C, tgt_size, tgt_size); patch i*k of image
+    i shares that image's rotation/scale draw (reference semantics: one
+    disturbance per image, sampling_rate patch locations).
+
+    Eval mode (is_train=False) is deterministic: no rotation, unit scale,
+    center patch — the key is unused.
+    """
+    n, c, s, _ = images.shape
+    num_patches = n * sampling_rate
+    img_center = (s - 1) / 2.0
+    tgt_center = (tgt_size - 1) / 2.0
+
+    if is_train:
+        k_theta, k_scale, k_center = jax.random.split(key, 3)
+        theta = (rotate_angle * jnp.pi / 180.0) * (
+            jax.random.uniform(k_theta, (n,)) - 0.5
+        )
+        scale = 1.0 + (jax.random.uniform(k_scale, (n,)) - 0.5) * scale_ratio
+        # patch centers anywhere in the source image (reference samples
+        # centers over [0, S-1]; out-of-bounds reads become pad_value)
+        centers = jax.random.uniform(
+            k_center, (num_patches, 2), minval=0.0, maxval=float(s - 1)
+        )
+        center_r, center_c = jnp.round(centers[:, 0]), jnp.round(centers[:, 1])
+    else:
+        theta = jnp.zeros((n,))
+        scale = jnp.ones((n,))
+        center_r = jnp.full((num_patches,), img_center)
+        center_c = jnp.full((num_patches,), img_center)
+
+    # per-patch transform params (patch p belongs to image p // sampling_rate)
+    img_idx = jnp.arange(num_patches) // sampling_rate
+    theta_p = theta[img_idx]
+    scale_p = scale[img_idx]
+
+    # output pixel grid, shared by every patch
+    ys, xs = jnp.meshgrid(jnp.arange(tgt_size), jnp.arange(tgt_size), indexing="ij")
+    # translate into source frame around the sampled center
+    x_new = xs[None] - tgt_center + center_c[:, None, None] - img_center
+    y_new = ys[None] - tgt_center + center_r[:, None, None] - img_center
+    cos_t = jnp.cos(-theta_p)[:, None, None]
+    sin_t = jnp.sin(-theta_p)[:, None, None]
+    xx = cos_t * x_new - sin_t * y_new
+    yy = sin_t * x_new + cos_t * y_new
+    src_x = jnp.round(xx / scale_p[:, None, None] + img_center).astype(jnp.int32)
+    src_y = jnp.round(yy / scale_p[:, None, None] + img_center).astype(jnp.int32)
+
+    in_bounds = (src_x >= 0) & (src_x < s) & (src_y >= 0) & (src_y < s)
+    sx = jnp.clip(src_x, 0, s - 1)
+    sy = jnp.clip(src_y, 0, s - 1)
+
+    # one gather for the whole batch: (P, tgt, tgt) indices into (P, C, S, S)
+    src = images[img_idx]  # (P, C, S, S)
+    patch = src[
+        jnp.arange(num_patches)[:, None, None, None],
+        jnp.arange(c)[None, :, None, None],
+        sy[:, None, :, :],
+        sx[:, None, :, :],
+    ]
+    return jnp.where(in_bounds[:, None, :, :], patch, pad_value)

@@ -1,0 +1,214 @@
+"""Image preprocessing & augmentation (paddle_tpu/utils/image_util.py,
+paddle_tpu/ops/perturbation.py, demo/image_classification pipeline).
+
+Pins shapes, determinism-under-seed, and geometric invariants of the
+reference-parity helpers (python/paddle/utils/image_util.py:30-101 and
+paddle/cuda/src/hl_perturbation_util.cu roles).
+"""
+
+import os
+import pickle
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from paddle_tpu.utils import image_util
+
+
+def test_flip_is_width_mirror_and_involution():
+    im = np.arange(2 * 4 * 6, dtype=np.float32).reshape(2, 4, 6)
+    f = image_util.flip(im)
+    assert f.shape == im.shape
+    np.testing.assert_array_equal(f[:, :, 0], im[:, :, -1])
+    np.testing.assert_array_equal(image_util.flip(f), im)
+    # grayscale HW too
+    g = im[0]
+    np.testing.assert_array_equal(image_util.flip(g)[:, 0], g[:, -1])
+
+
+def test_crop_img_center_and_random_modes():
+    im = np.random.RandomState(0).rand(3, 8, 8).astype(np.float32)
+    # center crop is deterministic and centered
+    c = image_util.crop_img(im, 4, color=True, test=True)
+    assert c.shape == (3, 4, 4)
+    np.testing.assert_array_equal(c, im[:, 2:6, 2:6])
+    # train mode: same seed -> same crop; crop content comes from the image
+    a = image_util.crop_img(im, 4, test=False, rng=np.random.RandomState(7))
+    b = image_util.crop_img(im, 4, test=False, rng=np.random.RandomState(7))
+    np.testing.assert_array_equal(a, b)
+    assert a.shape == (3, 4, 4)
+    # small images are zero-padded up to inner_size (reference semantics)
+    small = np.ones((3, 2, 2), np.float32)
+    p = image_util.crop_img(small, 4, test=True)
+    assert p.shape == (3, 4, 4)
+    assert p.sum() == small.sum() and p[0, 0, 0] == 0.0
+
+
+def test_preprocess_img_subtracts_mean_and_flattens():
+    rng = np.random.RandomState(1)
+    im = rng.rand(3, 6, 6).astype(np.float32)
+    mean = rng.rand(3, 4, 4).astype(np.float32)
+    feat = image_util.preprocess_img(im, mean, 4, is_train=False)
+    assert feat.shape == (3 * 4 * 4,)
+    np.testing.assert_allclose(
+        feat.reshape(3, 4, 4), im[:, 1:5, 1:5] - mean, rtol=1e-6
+    )
+
+
+def test_load_meta_roundtrip_npz_and_pickle(tmp_path):
+    mean = np.arange(3 * 6 * 6, dtype=np.float32)
+    npz_path = tmp_path / "batches.meta"
+    with open(npz_path, "wb") as f:
+        np.savez(f, data_mean=mean)
+    got = image_util.load_meta(str(npz_path), 6, 4)
+    assert got.shape == (3, 4, 4)
+    np.testing.assert_array_equal(got, mean.reshape(3, 6, 6)[:, 1:5, 1:5])
+    # reference cPickle dict format
+    pkl_path = tmp_path / "batches.meta.pkl"
+    with open(pkl_path, "wb") as f:
+        pickle.dump({"data_mean": mean}, f)
+    np.testing.assert_array_equal(image_util.load_meta(str(pkl_path), 6, 4), got)
+
+
+def test_oversample_ten_crops_with_mirrors():
+    im = np.random.RandomState(2).rand(8, 8, 3).astype(np.float32)
+    crops = image_util.oversample([im], (4, 4))
+    assert crops.shape == (10, 4, 4, 3)
+    # crop 0 is the top-left corner; crop 5 is its mirror
+    np.testing.assert_array_equal(crops[0], im[0:4, 0:4, :])
+    np.testing.assert_array_equal(crops[5], crops[0][:, ::-1, :])
+    # crop 4 is the center; crop 9 its mirror
+    np.testing.assert_array_equal(crops[4], im[2:6, 2:6, :])
+    np.testing.assert_array_equal(crops[9], crops[4][:, ::-1, :])
+
+
+def test_image_transformer_compose():
+    hwc = np.random.RandomState(3).rand(5, 5, 3).astype(np.float32)
+    t = image_util.ImageTransformer(
+        transpose=(2, 0, 1), channel_swap=(2, 1, 0), mean=np.array([1.0, 2.0, 3.0])
+    )
+    out = t.transformer(hwc)
+    assert out.shape == (3, 5, 5)
+    np.testing.assert_allclose(out[0], hwc[:, :, 2] - 1.0, rtol=1e-6)
+    np.testing.assert_allclose(out[2], hwc[:, :, 0] - 3.0, rtol=1e-6)
+
+
+def test_perturb_eval_mode_is_center_crop():
+    import jax
+
+    from paddle_tpu.ops.perturbation import perturb
+
+    imgs = np.random.RandomState(4).rand(2, 3, 9, 9).astype(np.float32)
+    out = perturb(
+        jax.numpy.asarray(imgs), jax.random.PRNGKey(0), tgt_size=5, is_train=False
+    )
+    assert out.shape == (2, 3, 5, 5)
+    np.testing.assert_allclose(np.asarray(out), imgs[:, :, 2:7, 2:7], rtol=1e-6)
+
+
+def test_perturb_train_deterministic_and_padded():
+    import jax
+
+    from paddle_tpu.ops.perturbation import perturb
+
+    imgs = np.random.RandomState(5).rand(2, 3, 8, 8).astype(np.float32) + 1.0
+    key = jax.random.PRNGKey(42)
+    a = perturb(jax.numpy.asarray(imgs), key, tgt_size=6, rotate_angle=30.0,
+                scale_ratio=0.4, sampling_rate=2)
+    b = perturb(jax.numpy.asarray(imgs), key, tgt_size=6, rotate_angle=30.0,
+                scale_ratio=0.4, sampling_rate=2)
+    assert a.shape == (4, 3, 6, 6)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # a target bigger than the source must read pad_value outside
+    big = perturb(jax.numpy.asarray(imgs), key, tgt_size=16, is_train=False,
+                  pad_value=-7.0)
+    assert np.asarray(big).min() == -7.0
+    # the source content (all >= 1.0) survives in-bounds
+    assert np.asarray(big).max() >= 1.0
+
+
+def _load_demo_provider():
+    demo = os.path.join(REPO, "demo", "image_classification")
+    compat = os.path.join(REPO, "compat")
+    if compat not in sys.path:  # the provider imports the paddle.* shims
+        sys.path.insert(0, compat)
+    sys.path.insert(0, demo)
+    try:
+        import importlib
+
+        import image_provider
+
+        importlib.reload(image_provider)
+        return image_provider
+    finally:
+        sys.path.remove(demo)
+
+
+def test_demo_provider_augments_in_train_mode_only():
+    ip = _load_demo_provider()
+    # test mode is fully deterministic: two openings yield identical streams
+    s_test = ip.process.init(img_size=32, src_size=36, num_classes=10, is_train=False)
+    t1 = [s for _, s in zip(range(4), ip.process.generator_fn(s_test, "f0"))]
+    t2 = [s for _, s in zip(range(4), ip.process.generator_fn(s_test, "f0"))]
+    for a, b in zip(t1, t2):
+        np.testing.assert_array_equal(a["image"], b["image"])
+        assert len(a["image"]) == 3 * 32 * 32
+    # train mode re-draws crops/flips: same file yields same stream across
+    # openings (seeded by file name) but differs from the test-mode stream
+    s_train = ip.process.init(img_size=32, src_size=36, num_classes=10, is_train=True)
+    r1 = [s for _, s in zip(range(4), ip.process.generator_fn(s_train, "f0"))]
+    r2 = [s for _, s in zip(range(4), ip.process.generator_fn(s_train, "f0"))]
+    for a, b in zip(r1, r2):
+        np.testing.assert_array_equal(a["image"], b["image"])
+    assert any(
+        not np.array_equal(a["image"], b["image"]) for a, b in zip(r1, t1)
+    ), "train-mode augmentation should perturb the test-mode pipeline"
+
+
+def test_cifar_converter_roundtrip(tmp_path):
+    """prepare_data.py: raw CIFAR python pickles -> batch files + meta;
+    the demo provider trains straight off the converted output."""
+    sys.path.insert(0, os.path.join(REPO, "demo", "image_classification"))
+    try:
+        import prepare_data
+    finally:
+        sys.path.remove(os.path.join(REPO, "demo", "image_classification"))
+
+    # tiny synthetic "CIFAR" fixture in the real pickle format
+    raw = tmp_path / "cifar-10-batches-py"
+    raw.mkdir()
+    rng = np.random.RandomState(0)
+    for name, n in [("data_batch_1", 20), ("data_batch_2", 12), ("test_batch", 8)]:
+        with open(raw / name, "wb") as f:
+            pickle.dump(
+                {b"data": rng.randint(0, 256, (n, 3072), dtype=np.uint8),
+                 b"labels": [int(x) for x in rng.randint(0, 10, n)]},
+                f, protocol=2,
+            )
+    out = tmp_path / "cifar-out"
+    n_train, n_test = prepare_data.convert(str(raw), str(out), samples_per_batch=16)
+    assert (n_train, n_test) == (32, 8)
+
+    train_list = (out / "train.list").read_text().strip().splitlines()
+    assert len(train_list) == 2  # 32 samples / 16 per batch
+    with open(train_list[0], "rb") as f:
+        batch = pickle.load(f)
+    assert batch["images"].shape == (16, 3, 32, 32)
+    assert batch["images"].dtype == np.float32
+    assert 0.0 <= batch["images"].min() and batch["images"].max() <= 1.0
+
+    mean = image_util.load_meta(str(out / "batches.meta"), 32, 32)
+    assert mean.shape == (3, 32, 32)
+
+    # provider consumes the converted batches end-to-end (real_batches path)
+    ip = _load_demo_provider()
+    s = ip.process.init(
+        img_size=32, src_size=32, num_classes=10,
+        meta=str(out / "batches.meta"), is_train=True,
+    )
+    samples = list(ip.process.generator_fn(s, train_list[0]))
+    assert len(samples) == 16
+    assert len(samples[0]["image"]) == 3 * 32 * 32
+    assert all(0 <= s["label"] < 10 for s in samples)
