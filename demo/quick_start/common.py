@@ -48,24 +48,16 @@ def synth_samples(seed, n=1000):
         yield label, words
 
 def samples(file_name, n=1000):
-    """Sample stream for a file-list entry: an existing file is read as a
-    real '<label>\\t<text>' corpus (written by prepare_data.py); anything
-    else is a seed token for the synthetic generator."""
-    import os
+    """Real '<label>\\t<text>' corpus when the file-list entry exists
+    (prepare_data.py output), else the synthetic generator."""
+    from paddle_tpu.data import datasets
 
-    if os.path.exists(file_name):
-        from paddle_tpu.data import datasets
-
-        yield from datasets.read_labeled_lines(file_name)
-    else:
-        yield from synth_samples(file_name, n)
+    yield from datasets.labeled_samples_or_synth(file_name, synth_samples, n)
 
 
 def resolve_dict(dict_path=""):
-    """word->id map: the converter-written dict file when given
-    (--config_args=dict=...), else the synthetic vocabulary."""
-    if dict_path:
-        from paddle_tpu.data import datasets
+    """Converter dict file when given (--config_args=dict=...), else the
+    synthetic vocabulary."""
+    from paddle_tpu.data import datasets
 
-        return datasets.load_dict(dict_path)
-    return {w: i for i, w in enumerate(VOCAB)}
+    return datasets.resolve_word_dict(dict_path, VOCAB)

@@ -42,22 +42,15 @@ def synth_reviews(seed, n=800):
         yield label, words
 
 def samples(file_name, n=800):
-    """An existing file is read as a '<label>\\t<text>' corpus (written by
-    prepare_data.py); anything else seeds the synthetic generator."""
-    import os
+    """Real '<label>\\t<text>' corpus when the file-list entry exists
+    (prepare_data.py output), else the synthetic generator."""
+    from paddle_tpu.data import datasets
 
-    if os.path.exists(file_name):
-        from paddle_tpu.data import datasets
-
-        yield from datasets.read_labeled_lines(file_name)
-    else:
-        yield from synth_reviews(file_name, n)
+    yield from datasets.labeled_samples_or_synth(file_name, synth_reviews, n)
 
 
 def resolve_dict(dict_path=""):
-    """word->id map: converter dict file when given, else synthetic vocab."""
-    if dict_path:
-        from paddle_tpu.data import datasets
+    """Converter dict file when given, else the synthetic vocabulary."""
+    from paddle_tpu.data import datasets
 
-        return datasets.load_dict(dict_path)
-    return {w: i for i, w in enumerate(VOCAB)}
+    return datasets.resolve_word_dict(dict_path, VOCAB)

@@ -79,7 +79,12 @@ def _real_pairs(settings, file_name):
 
 
 def _stream(settings, file_name):
-    if getattr(settings, "src_dict", None) is not None and os.path.exists(file_name):
+    if getattr(settings, "src_dict", None) is not None:
+        # real-corpus mode was requested (dicts passed): a missing shard is
+        # an error — silently training on the synthetic toy corpus while
+        # the user believes it's their data would be far worse
+        if not os.path.exists(file_name):
+            raise FileNotFoundError(f"corpus shard not found: {file_name}")
         yield from _real_pairs(settings, file_name)
     else:
         yield from _pairs(file_name)

@@ -122,6 +122,21 @@ def huber_two_class(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext)
     return _finish_cost(cfg, per_step, out, None)
 
 
+@register_layer("auc-validation", "pnpair-validation")
+def validation_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Argument:
+    # ref: ValidationLayer family (paddle/gserver/layers/ValidationLayer.h:
+    # 52 AucValidation, :84 PnpairValidation; registered cost types in
+    # config_parser.py:1703-1704) — metric-only nodes: forward contributes
+    # ZERO cost and no gradient; the metric itself accumulates in the
+    # evaluator the DSL registers alongside (trainer/evaluators.py
+    # AucEvaluator / PnpairEvaluator), reported per log period / pass end.
+    out = inputs[0]
+    ref = out.value if out.value is not None else out.ids
+    per_step = jnp.zeros(ref.shape[:-1] if out.value is not None else ref.shape,
+                         jnp.float32)
+    return _finish_cost(cfg, per_step, out, None)
+
+
 @register_layer("classification_error")
 def classification_error_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Argument:
     # ref: ClassificationErrorLayer — 1.0 where argmax(output) != label.

@@ -116,6 +116,96 @@ def gather_outputs(outputs, mesh: Mesh, names=None):
     return jax.tree_util.tree_map(lambda x: np.asarray(x.addressable_data(0)), rep)
 
 
+class NotRowLocal(Exception):
+    """An output's process-local rows cannot be assembled on this host
+    (non-batch axes sharded across devices, or an exotic layout) — the
+    caller falls back to the full per-batch gather."""
+
+
+def rows_locally_assemblable(outputs, names=None) -> bool:
+    """Decide from GLOBAL sharding metadata whether every selected leaf's
+    rows can be assembled process-locally. The decision must be identical
+    on every process (it gates which collective runs next — a per-process
+    disagreement would deadlock), so it only consults sharding specs,
+    never this process's addressable shards."""
+    picked = outputs if names is None else {k: outputs[k] for k in names if k in outputs}
+
+    def ok(x) -> bool:
+        if not isinstance(x, jax.Array) or x.ndim == 0:
+            return True
+        spec = getattr(x.sharding, "spec", None)
+        if spec is None:
+            return False  # not a NamedSharding: no portable metadata
+        # axes beyond the batch axis must be unsharded (a PartitionSpec
+        # shorter than ndim leaves trailing axes unsharded)
+        return all(p is None for p in tuple(spec)[1:])
+
+    return all(
+        ok(leaf) for leaf in jax.tree_util.tree_leaves(picked)
+    )
+
+
+def local_row_block(outputs, names=None):
+    """Each process's contiguous row block of (selected) batch-leading
+    outputs as host numpy — the input side of sufficient-statistics
+    evaluator merging (reference Evaluator::getState/distributeEval,
+    Evaluator.h:81-82): processes accumulate metrics over disjoint row
+    blocks locally and SUM small state vectors once per period, instead
+    of all-gathering raw [B, V] activations every batch.
+
+    Process p takes rows [B*p/pc, B*(p+1)/pc) of every leaf: replicated
+    leaves are sliced on the host; batch-sharded leaves are assembled from
+    the replica-0 addressable shards, which must tile exactly that block
+    (the standard data-axis layout built by globalize_batch). Check
+    rows_locally_assemblable first; an unexpected layout here raises
+    NotRowLocal, which the caller must treat as fatal (the decision
+    already committed every process to this path).
+    """
+    import numpy as np
+
+    pid, pc = jax.process_index(), jax.process_count()
+    picked = outputs if names is None else {k: outputs[k] for k in names if k in outputs}
+
+    def loc(x):
+        if not isinstance(x, jax.Array) or x.ndim == 0:
+            return np.asarray(x)
+        B = x.shape[0]
+        lo, hi = B * pid // pc, B * (pid + 1) // pc
+        if x.is_fully_addressable:
+            return np.asarray(x)[lo:hi]
+        # replicated across processes: some addressable shard holds the
+        # full batch axis — slice this process's block from it
+        for sh in x.addressable_shards:
+            row_sl = sh.index[0] if sh.index else slice(None)
+            if (row_sl.start or 0) == 0 and row_sl.stop in (None, B):
+                return np.asarray(sh.data)[lo:hi]
+        rows = sorted(
+            ((s.index[0].start or 0, np.asarray(s.data))
+             for s in x.addressable_shards if s.replica_id == 0),
+            key=lambda t: t[0],
+        )
+        expect = lo
+        for start, data in rows:
+            if start != expect:
+                raise NotRowLocal(f"non-contiguous rows at {start} (shape {x.shape})")
+            expect += data.shape[0]
+        if not rows or rows[0][0] != lo or expect != hi:
+            raise NotRowLocal(f"rows {[r[0] for r in rows]} != [{lo}:{hi}] (shape {x.shape})")
+        return np.concatenate([d for _, d in rows], axis=0)
+
+    return jax.tree_util.tree_map(loc, picked)
+
+
+def merge_eval_states(vec):
+    """SUM a small per-process evaluator state vector across processes
+    (one host allgather per read period — the distributeEval merge)."""
+    import numpy as np
+
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(np.asarray(vec))).sum(axis=0)
+
+
 def checkpoint_sharding_fn(mesh: Mesh, gm):
     """(tree_base, flat_key, shape) → NamedSharding for checkpoint restore:
     params and averaging sums take the parameter's sharding; optimizer

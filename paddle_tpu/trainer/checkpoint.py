@@ -77,12 +77,16 @@ def _save_tree_sharded(path: str, base: str, flat: Dict[str, jax.Array]) -> None
             if sh.replica_id != 0:
                 continue  # exactly one process owns each distinct slice
             key = f"{name}::{i}"
-            pieces[key] = np.asarray(sh.data)
+            data = np.asarray(sh.data)
+            pieces[key] = data
             entry["shards"].append(
                 {
                     "file": shard_file,
                     "key": key,
                     "start": [int(sl.start or 0) for sl in sh.index],
+                    # record extent up front so restore can skip
+                    # non-overlapping records without reading them
+                    "shape": list(data.shape),
                 }
             )
         if entry["shards"]:
@@ -211,33 +215,92 @@ def latest_pass(save_dir: str) -> Optional[int]:
     return max(passes) if passes else None
 
 
-def _load_tree_numpy(path: str, base: str) -> Optional[Dict[str, np.ndarray]]:
-    """Read one tree as full host numpy arrays from either format, or
-    None if the tree is absent. Sharded trees are assembled from their
-    shard records (no cross-host transfers — files carry the data)."""
+class _ShardedTreeReader:
+    """Lazy reader over one sharded-format tree: `read_slice` loads ONLY
+    the shard records overlapping the requested slice, so restoring onto a
+    sharded layout costs O(local shard bytes) host memory per parameter —
+    never O(full parameter) on every host (the reference streams blocks
+    the same way, ParameterServer2.cpp:1150-1213). `bytes_read` counts the
+    record bytes actually pulled off disk (tests pin the streaming claim
+    on it)."""
+
+    def __init__(self, path: str, index: Dict[str, Any]):
+        self.path = path
+        self.index = index
+        self._files: Dict[str, Any] = {}
+        self.bytes_read = 0
+
+    def names(self):
+        return self.index.keys()
+
+    def spec(self, name: str) -> Tuple[Tuple[int, ...], np.dtype]:
+        e = self.index[name]
+        return tuple(e["shape"]), np.dtype(e["dtype"])
+
+    def _record(self, rec) -> np.ndarray:
+        z = self._files.get(rec["file"])
+        if z is None:
+            z = self._files[rec["file"]] = np.load(os.path.join(self.path, rec["file"]))
+        data = z[rec["key"]]  # decompresses this member only
+        self.bytes_read += data.nbytes
+        return data
+
+    def read_slice(self, name: str, idx, shape, dtype) -> np.ndarray:
+        """Assemble the sub-array covering `idx` (a tuple of slices as
+        handed out by jax.make_array_from_callback; None bounds mean the
+        full axis)."""
+        want = tuple(
+            slice(s.start or 0, dim if s.stop is None else s.stop)
+            for s, dim in zip(idx, shape)
+        )
+        out = np.zeros([w.stop - w.start for w in want], dtype)
+        for rec in self.index[name]["shards"]:
+            starts = rec["start"]
+            data = None
+            rec_shape = rec.get("shape")
+            if rec_shape is None:  # pre-'shape' checkpoints: the probe
+                data = self._record(rec)  # read doubles as the data read
+                rec_shape = data.shape
+            lo = [max(w.start, st) for w, st in zip(want, starts)]
+            hi = [min(w.stop, st + d) for w, st, d in zip(want, starts, rec_shape)]
+            if any(l >= h for l, h in zip(lo, hi)):
+                continue  # no overlap: record never read (when indexed)
+            if data is None:
+                data = self._record(rec)
+            src = tuple(slice(l - st, h - st) for l, h, st in zip(lo, hi, starts))
+            dst = tuple(slice(l - w.start, h - w.start) for l, h, w in zip(lo, hi, want))
+            out[dst] = data[src]
+        return out
+
+    def close(self):
+        for z in self._files.values():
+            z.close()
+
+
+def _tree_index(path: str, base: str) -> Optional[Dict[str, Any]]:
     idx_path = os.path.join(path, f"{base}.index.json")
     if os.path.exists(idx_path):
         with open(idx_path) as f:
-            index = json.load(f)
-        files: Dict[str, Any] = {}
+            return json.load(f)
+    return None
+
+
+def _load_tree_numpy(path: str, base: str) -> Optional[Dict[str, np.ndarray]]:
+    """Read one tree as full host numpy arrays from either format, or
+    None if the tree is absent (merge_model and single-process restores —
+    the streaming path is load_checkpoint's sharding_for branch)."""
+    index = _tree_index(path, base)
+    if index is not None:
+        reader = _ShardedTreeReader(path, index)
         try:
-            out = {}
-            for name, entry in index.items():
-                full = np.zeros(tuple(entry["shape"]), np.dtype(entry["dtype"]))
-                for rec in entry["shards"]:
-                    z = files.get(rec["file"])
-                    if z is None:
-                        z = files[rec["file"]] = np.load(os.path.join(path, rec["file"]))
-                    data = z[rec["key"]]
-                    sl = tuple(
-                        slice(st, st + d) for st, d in zip(rec["start"], data.shape)
-                    )
-                    full[sl] = data
-                out[name] = full
-            return out
+            return {
+                name: reader.read_slice(
+                    name, (slice(None),) * len(shape), shape, dtype
+                )
+                for name, (shape, dtype) in ((n, reader.spec(n)) for n in reader.names())
+            }
         finally:
-            for z in files.values():
-                z.close()
+            reader.close()
     npz_path = os.path.join(path, f"{base}.npz")
     if os.path.exists(npz_path):
         with np.load(npz_path) as z:
@@ -251,6 +314,7 @@ def load_checkpoint(
     missing: str = "fail",
     expected_params: Optional[Dict[str, jax.Array]] = None,
     sharding_for: Optional[Callable[[str, str, Any], Any]] = None,
+    io_stats: Optional[Dict[str, int]] = None,
 ) -> Tuple[Dict[str, jax.Array], Optional[UpdaterState], Dict[str, Any]]:
     """Load params (+ optimizer state rebuilt onto ``opt_template``).
 
@@ -263,6 +327,12 @@ def load_checkpoint(
     ``jax.make_array_from_callback`` so the restore re-shards onto the
     CURRENT mesh regardless of the layout the checkpoint was written
     with. Without it values load as host-local arrays (single process).
+
+    Sharded-format trees restore STREAMING: each device slice is assembled
+    from only the shard records overlapping it, so peak host memory is
+    O(local shard bytes) per parameter, not O(parameter bytes) — the
+    ParameterServer2 block-wise semantics. ``io_stats`` (optional dict)
+    receives per-tree bytes actually read from shard files.
     """
 
     def put(base: str, key: str, full):
@@ -272,10 +342,47 @@ def load_checkpoint(
         sh = sharding_for(base, key, full.shape)
         return jax.make_array_from_callback(full.shape, sh, lambda idx, _f=full: _f[idx])
 
-    raw = _load_tree_numpy(path, "params")
-    if raw is None:
+    def load_tree(base: str) -> Optional[Dict[str, jax.Array]]:
+        index = _tree_index(path, base)
+        if index is not None:
+            reader = _ShardedTreeReader(path, index)
+            try:
+                out = {}
+                for name in reader.names():
+                    shape, dtype = reader.spec(name)
+                    if sharding_for is None:
+                        out[name] = jnp.asarray(
+                            reader.read_slice(name, (slice(None),) * len(shape), shape, dtype)
+                        )
+                    else:
+                        sh = sharding_for(base, name, shape)
+                        # several local devices may ask for the same slice
+                        # (replication): memoize per parameter so each
+                        # record is decompressed at most once, holding at
+                        # most this parameter's process-local bytes
+                        memo: Dict[Any, np.ndarray] = {}
+
+                        def cb(idx, n=name, s=shape, d=dtype, m=memo):
+                            key = tuple((x.start, x.stop) for x in idx)
+                            if key not in m:
+                                m[key] = reader.read_slice(n, idx, s, d)
+                            return m[key]
+
+                        out[name] = jax.make_array_from_callback(shape, sh, cb)
+                return out
+            finally:
+                if io_stats is not None:
+                    io_stats[base] = reader.bytes_read
+                reader.close()
+        npz_path = os.path.join(path, f"{base}.npz")
+        if not os.path.exists(npz_path):
+            return None
+        with np.load(npz_path) as z:
+            return {k: put(base, k, z[k]) for k in z.files}
+
+    params = load_tree("params")
+    if params is None:
         raise FileNotFoundError(f"no params tree in checkpoint {path}")
-    params = {k: put("params", k, v) for k, v in raw.items()}
     if expected_params is not None:
         for name, val in expected_params.items():
             if name not in params:
@@ -288,22 +395,16 @@ def load_checkpoint(
         with open(meta_path) as f:
             meta = json.load(f)
     opt_state = None
-    raw_slots = _load_tree_numpy(path, "optimizer_slots")
-    if opt_template is not None and raw_slots is not None:
-        slots = _unflatten(
-            {k: put("optimizer_slots", k, v) for k, v in raw_slots.items()}
-        )
+    slot_vals = load_tree("optimizer_slots") if opt_template is not None else None
+    if opt_template is not None and slot_vals is not None:
+        slots = _unflatten(slot_vals)
         om = meta.get("optimizer", {})
         avg_sum = opt_template.avg_sum
-        raw_avg = _load_tree_numpy(path, "optimizer_avg")
-        if avg_sum is not None and raw_avg is not None:
-            avg_sum = {k: put("optimizer_avg", k, v) for k, v in raw_avg.items()}
+        if avg_sum is not None:
+            avg_sum = load_tree("optimizer_avg") or avg_sum
         avg_old_sum = opt_template.avg_old_sum
-        raw_avg_old = _load_tree_numpy(path, "optimizer_avg_old")
-        if avg_old_sum is not None and raw_avg_old is not None:
-            avg_old_sum = {
-                k: put("optimizer_avg_old", k, v) for k, v in raw_avg_old.items()
-            }
+        if avg_old_sum is not None:
+            avg_old_sum = load_tree("optimizer_avg_old") or avg_old_sum
 
         def scalar(v, dtype):
             # multi-process: keep host numpy — jit treats it as replicated

@@ -43,6 +43,22 @@ class Evaluator:
     def summary(self) -> str:
         return " ".join(f"{k}={v:.6g}" for k, v in self.result().items())
 
+    # -- distributed merging (the reference's Evaluator::getState /
+    # mergeState split, Evaluator.h:81-82: trainers ship a SMALL
+    # accumulated state once per period instead of raw activations
+    # per batch)
+
+    def merge_state(self) -> Optional[np.ndarray]:
+        """Flat float64 vector of accumulated state that merges across
+        processes by SUMMATION, or None if this evaluator cannot merge
+        that way (raw-record evaluators, printers) — those eval gathered
+        full outputs per batch instead."""
+        return None
+
+    def load_state(self, vec: np.ndarray) -> None:
+        """Inverse of merge_state: replace accumulators with vec."""
+        raise NotImplementedError(type(self).__name__)
+
     # -- helpers
 
     @staticmethod
@@ -99,6 +115,12 @@ class ClassificationErrorEvaluator(Evaluator):
     def result(self):
         return {"classification_error": self.wrong / max(self.total, 1.0)}
 
+    def merge_state(self):
+        return np.array([self.wrong, self.total], np.float64)
+
+    def load_state(self, vec):
+        self.wrong, self.total = float(vec[0]), float(vec[1])
+
 
 @register_evaluator("sum")
 class SumEvaluator(Evaluator):
@@ -113,6 +135,12 @@ class SumEvaluator(Evaluator):
 
     def result(self):
         return {"sum": self.sum, "mean": self.sum / max(self.total, 1.0)}
+
+    def merge_state(self):
+        return np.array([self.sum, self.total], np.float64)
+
+    def load_state(self, vec):
+        self.sum, self.total = float(vec[0]), float(vec[1])
 
 
 @register_evaluator("last-column-sum")
@@ -129,6 +157,12 @@ class ColumnSumEvaluator(Evaluator):
     def result(self):
         return {"column_sum": self.sum, "column_mean": self.sum / max(self.total, 1.0)}
 
+    def merge_state(self):
+        return np.array([self.sum, self.total], np.float64)
+
+    def load_state(self, vec):
+        self.sum, self.total = float(vec[0]), float(vec[1])
+
 
 @register_evaluator("last-column-auc")
 class AucEvaluator(Evaluator):
@@ -144,9 +178,13 @@ class AucEvaluator(Evaluator):
         out, label = args[0], args[1]
         scores = self._rows(out)[:, -1]
         labels = self._label_rows(label)
+        # optional third input: per-sample weight (adds w to the bin,
+        # reference Evaluator.cpp statPos_/statNeg_ += w)
+        w = (self._rows(args[2])[:, -1] if len(args) > 2
+             else np.ones_like(scores, np.float64))
         idx = np.clip((scores * (self.BINS - 1)).astype(np.int64), 0, self.BINS - 1)
-        np.add.at(self.pos, idx[labels == 1], 1.0)
-        np.add.at(self.neg, idx[labels != 1], 1.0)
+        np.add.at(self.pos, idx[labels == 1], w[labels == 1])
+        np.add.at(self.neg, idx[labels != 1], w[labels != 1])
 
     def result(self):
         # trapezoidal over descending threshold
@@ -159,6 +197,13 @@ class AucEvaluator(Evaluator):
         fpr = np.concatenate([[0.0], fp / tot_n])
         auc = float(np.trapezoid(tpr, fpr))
         return {"auc": auc}
+
+    def merge_state(self):
+        return np.concatenate([self.pos, self.neg]).astype(np.float64)
+
+    def load_state(self, vec):
+        self.pos = np.asarray(vec[: self.BINS], np.float64)
+        self.neg = np.asarray(vec[self.BINS :], np.float64)
 
 
 @register_evaluator("seq_classification_error")
@@ -189,6 +234,12 @@ class SequenceClassificationErrorEvaluator(Evaluator):
 
     def result(self):
         return {"seq_classification_error": self.wrong / max(self.total, 1.0)}
+
+    def merge_state(self):
+        return np.array([self.wrong, self.total], np.float64)
+
+    def load_state(self, vec):
+        self.wrong, self.total = float(vec[0]), float(vec[1])
 
 
 @register_evaluator("rank-auc")
@@ -275,34 +326,38 @@ class PnpairEvaluator(Evaluator):
         out, label = args[0], args[1]
         scores = self._rows(out)[:, -1]
         labels = self._label_rows(label)
-        # optional third input: query id for grouping
+        # optional third input: query id for grouping; fourth: weight
         if len(args) > 2:
             qids = self._label_rows(args[2])
         else:
             qids = np.zeros_like(labels)
-        self.records.extend(zip(qids.tolist(), labels.tolist(), scores.tolist()))
+        w = (self._rows(args[3])[:, -1] if len(args) > 3
+             else np.ones_like(scores, np.float64))
+        self.records.extend(
+            zip(qids.tolist(), labels.tolist(), scores.tolist(), w.tolist()))
 
     def result(self):
         from collections import defaultdict
 
         by_q = defaultdict(list)
-        for q, l, s in self.records:
-            by_q[q].append((l, s))
+        for q, l, s, w in self.records:
+            by_q[q].append((l, s, w))
         pos_minus_neg = 0.0
         total = 0.0
         for items in by_q.values():
             for i in range(len(items)):
                 for j in range(i + 1, len(items)):
-                    li, si = items[i]
-                    lj, sj = items[j]
+                    li, si, wi = items[i]
+                    lj, sj, wj = items[j]
                     if li == lj:
                         continue
-                    total += 1
+                    w = (wi + wj) / 2.0  # reference pair weight
+                    total += w
                     hi, lo = (si, sj) if li > lj else (sj, si)
                     if hi > lo:
-                        pos_minus_neg += 1
+                        pos_minus_neg += w
                     elif hi == lo:
-                        pos_minus_neg += 0.5
+                        pos_minus_neg += 0.5 * w
         return {"pnpair_accuracy": pos_minus_neg / max(total, 1.0)}
 
 
@@ -349,6 +404,12 @@ class CTCErrorEvaluator(Evaluator):
 
     def result(self):
         return {"ctc_error_rate": self.dist / max(self.total_labels, 1.0)}
+
+    def merge_state(self):
+        return np.array([self.dist, self.total_labels], np.float64)
+
+    def load_state(self, vec):
+        self.dist, self.total_labels = float(vec[0]), float(vec[1])
 
 
 @register_evaluator("chunk")
@@ -496,11 +557,44 @@ class EvaluatorChain:
     def __init__(self, model: ModelConfig, names: Optional[List[str]] = None):
         self.model = model
         self.evaluators: List[Evaluator] = []
+        # set by the trainer in multi-process runs when evaluators were fed
+        # process-local rows: vec -> cross-process SUM of vec. Reading
+        # results then merges sufficient statistics once — the reference's
+        # distributeEval (Evaluator.h:81-82) — instead of gathering raw
+        # activations every batch.
+        self.merge_fn = None
         for cfg in model.evaluators:
             if names is not None and cfg.name not in names:
                 continue
             if cfg.type in evaluator_registry:
                 self.evaluators.append(evaluator_registry.get(cfg.type)(cfg))
+
+    def partition(self):
+        """(mergeable, unmergeable) evaluators: mergeable ones carry
+        summable state and can accumulate on local rows."""
+        merge, gather = [], []
+        for e in self.evaluators:
+            (merge if e.merge_state() is not None else gather).append(e)
+        return merge, gather
+
+    @staticmethod
+    def layers_for(evaluators: List[Evaluator]) -> List[str]:
+        seen: List[str] = []
+        for e in evaluators:
+            for n in e.cfg.input_layers:
+                if n not in seen:
+                    seen.append(n)
+        return seen
+
+    def _merged(self, e: Evaluator) -> Evaluator:
+        """A view of e with cross-process-merged state (e itself keeps
+        accumulating local rows; merging at read time is idempotent)."""
+        vec = None if self.merge_fn is None else e.merge_state()
+        if vec is None:
+            return e
+        clone = type(e)(e.cfg)
+        clone.load_state(self.merge_fn(vec))
+        return clone
 
     def __bool__(self) -> bool:
         return bool(self.evaluators)
@@ -520,8 +614,8 @@ class EvaluatorChain:
         for e in self.evaluators:
             e.start()
 
-    def eval_batch(self, outputs: Dict[str, Argument]):
-        for e in self.evaluators:
+    def eval_batch(self, outputs: Dict[str, Argument], only: Optional[List[Evaluator]] = None):
+        for e in (self.evaluators if only is None else only):
             args = [outputs[n] for n in e.cfg.input_layers if n in outputs]
             if len(args) == len(e.cfg.input_layers):
                 e.eval_batch(args)
@@ -529,7 +623,7 @@ class EvaluatorChain:
     def summary(self) -> str:
         parts = []
         for e in self.evaluators:
-            s = e.summary()
+            s = self._merged(e).summary()
             if s:
                 parts.append(f"{e.cfg.name}: {s}")
         return "  ".join(parts)
@@ -537,6 +631,6 @@ class EvaluatorChain:
     def results(self) -> Dict[str, float]:
         out = {}
         for e in self.evaluators:
-            for k, v in e.result().items():
+            for k, v in self._merged(e).result().items():
                 out[f"{e.cfg.name}.{k}"] = v
         return out

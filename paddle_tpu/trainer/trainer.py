@@ -679,14 +679,47 @@ class Trainer:
         yield cur
 
     def _eval_outputs(self, evaluators: EvaluatorChain, outputs, gathered=False) -> None:
-        """Feed one batch's outputs to the evaluator chain. Multi-process:
-        gather the (small) evaluator inputs to every host first, so each
-        computes identical merged metrics (distributeEval analog).
-        ``gathered``: outputs are already full host values."""
+        """Feed one batch's outputs to the evaluator chain.
+
+        Multi-process: evaluators with summable state accumulate over this
+        process's LOCAL row block and merge their small state vectors once
+        per read period (the reference's getState/distributeEval split,
+        Evaluator.h:81-82) — no per-batch [B, V] activation gather.
+        Evaluators without mergeable state (raw-record, printers) still
+        get their layers gathered per batch. The local/gather split is
+        decided ONCE per chain from global sharding metadata so every
+        process runs the same collectives. ``gathered``: outputs are
+        already full host values."""
         if not evaluators:
             return
         if self._multiproc and not gathered:
-            outputs = self._gather_host(outputs, evaluators.needed_layers)
+            from paddle_tpu.parallel import spmd
+
+            plan = getattr(evaluators, "_dist_plan", None)
+            if plan is None:
+                merge_evs, gather_evs = evaluators.partition()
+                local_layers = evaluators.layers_for(merge_evs)
+                if merge_evs and spmd.rows_locally_assemblable(outputs, local_layers):
+                    evaluators.merge_fn = spmd.merge_eval_states
+                else:
+                    # e.g. a vocab-sharded output: local rows are partial —
+                    # fall back to gathering for everything
+                    gather_evs = evaluators.evaluators
+                    merge_evs, local_layers = [], []
+                plan = evaluators._dist_plan = (
+                    merge_evs, local_layers, gather_evs,
+                    evaluators.layers_for(gather_evs),
+                )
+            merge_evs, local_layers, gather_evs, gather_layers = plan
+            if merge_evs:
+                evaluators.eval_batch(
+                    spmd.local_row_block(outputs, local_layers), only=merge_evs
+                )
+            if gather_evs:
+                evaluators.eval_batch(
+                    self._gather_host(outputs, gather_layers), only=gather_evs
+                )
+            return
         evaluators.eval_batch(outputs)
 
     def _warn_remainder(self, n: int) -> None:

@@ -89,6 +89,8 @@ __all__ = [
     "beam_search",
     "regression_cost",
     "classification_cost",
+    "auc_validation",
+    "pnpair_validation",
     "conv_shift_layer",
     "tensor_layer",
     "selective_fc_layer",
@@ -1445,6 +1447,37 @@ def classification_cost(
     if evaluator is None:
         evaluator = classification_error_evaluator
     evaluator(input=input, label=label, name=f"{name}.classification_error")
+    return out
+
+
+def auc_validation(input, label, weight=None, name=None, coeff=1.0):
+    """AUC validation layer (ref: AucValidation,
+    paddle/gserver/layers/ValidationLayer.h:52, registered cost type
+    'auc-validation', config_parser.py:1703): a zero-gradient cost-family
+    node; its AUC accumulates in the evaluator runtime and reports at
+    every log period and pass end."""
+    name = _name(name, "auc_validation")
+    inputs = [input, label] + ([weight] if weight is not None else [])
+    out = _cost_layer("auc-validation", name, inputs, coeff=coeff)
+    from paddle_tpu.trainer_config_helpers.evaluators import evaluator_base
+
+    evaluator_base("last-column-auc", [input, label], weight=weight,
+                   name=f"{name}.auc")
+    return out
+
+
+def pnpair_validation(input, label, info, weight=None, name=None, coeff=1.0):
+    """Positive-negative pair validation layer (ref: PnpairValidation,
+    paddle/gserver/layers/ValidationLayer.h:84, cost type
+    'pnpair-validation', config_parser.py:1704): info carries the query id
+    grouping; pair ordering accuracy reports via the evaluator runtime."""
+    name = _name(name, "pnpair_validation")
+    inputs = [input, label, info] + ([weight] if weight is not None else [])
+    out = _cost_layer("pnpair-validation", name, inputs, coeff=coeff)
+    from paddle_tpu.trainer_config_helpers.evaluators import evaluator_base
+
+    evaluator_base("pnpair", [input, label, info], weight=weight,
+                   name=f"{name}.pnpair")
     return out
 
 

@@ -56,12 +56,22 @@ ws = sys.argv[3]
 trainer = Trainer(parse_config(os.path.join(ws, "cfg.py")))
 trainer.train(num_passes=1)
 
-# distributeEval analog: every process computes the MERGED evaluator
-# metrics over the full globalized batches; results must be identical
-# across processes (asserted host-side) and match the single-process run
+# distributeEval analog, sufficient-statistics form: evaluators
+# accumulate over LOCAL row blocks and merge small state vectors at read
+# time — no per-batch activation gather (asserted: gather_outputs never
+# fires for this all-mergeable chain). Results must be identical across
+# processes and match the single-process run.
 import json
-from paddle_tpu.parallel.spmd import gather_outputs, globalize_batch
+from paddle_tpu.parallel import spmd
+from paddle_tpu.parallel.spmd import globalize_batch
 from paddle_tpu.trainer.evaluators import EvaluatorChain
+
+gather_calls = [0]
+_orig_gather = spmd.gather_outputs
+def _counting_gather(*a, **k):
+    gather_calls[0] += 1
+    return _orig_gather(*a, **k)
+spmd.gather_outputs = _counting_gather
 
 chain = EvaluatorChain(trainer.config.model_config)
 chain.start()
@@ -71,9 +81,12 @@ for batch in provider.batches():
     if b is None:
         continue
     outputs = trainer.test_fwd(trainer.params, b)
-    chain.eval_batch(gather_outputs(outputs, trainer._mesh, chain.needed_layers))
+    trainer._eval_outputs(chain, outputs)
+res = chain.results()
+res["_gather_calls"] = gather_calls[0]
+spmd.gather_outputs = _orig_gather
 with open(os.path.join(ws, "eval_p%d.json" % pid), "w") as f:
-    json.dump(chain.results(), f)
+    json.dump(res, f)
 
 if jax.process_index() == 0:
     import numpy as np
@@ -181,6 +194,10 @@ def test_two_process_training_matches_single(tmp_path):
         eval_p1 = json.load(f)
     assert eval_p0 == eval_p1, (eval_p0, eval_p1)
     assert eval_p0, "no evaluator results produced"
+    # the chain is all-mergeable (classification_error): local rows +
+    # state merge, never a per-batch activation gather
+    assert eval_p0.pop("_gather_calls") == 0
+    eval_p1.pop("_gather_calls")
 
     sys.path.insert(0, PROVIDERS)
     try:

@@ -1,6 +1,10 @@
 """rank-auc and per-sequence classification-error evaluators, config-wired."""
 
+import os
+
 import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 from paddle_tpu.graph.argument import Argument
 from paddle_tpu.proto import EvaluatorConfig
@@ -68,3 +72,58 @@ def test_dsl_wrappers_emit_configs():
         tc = ctx.finalize()
     types = [e.type for e in tc.model_config.evaluators]
     assert "rank-auc" in types and "seq_classification_error" in types
+
+
+def test_validation_layers_parse_train_and_report(tmp_path):
+    """auc-validation / pnpair-validation compat (ref: ValidationLayer.h:
+    52,84; config_parser.py:1703-1704): a reference-style config using
+    both parses, trains, and reports the metrics through test()."""
+    import textwrap
+
+    train_list = tmp_path / "train.list"
+    train_list.write_text("1\n")
+    cfg_src = textwrap.dedent(f"""
+    from paddle_tpu.trainer_config_helpers import *
+    define_py_data_sources2(train_list={str(train_list)!r},
+                            test_list={str(train_list)!r},
+                            module="synthetic_bow", obj="process")
+    settings(batch_size=32, learning_rate=0.3)
+    data = data_layer(name="word", size=100)
+    output = fc_layer(input=data, size=2, act=SoftmaxActivation(), name="output")
+    label = data_layer(name="label", size=2)
+    av = auc_validation(input=output, label=label)
+    # info: one query group for every row (single-column layer -> qid 0)
+    qid = fc_layer(input=data, size=1, act=LinearActivation(), name="qid")
+    pv = pnpair_validation(input=output, label=label, info=qid)
+    outputs(classification_cost(input=output, label=label), av, pv)
+    """)
+    cfg_path = tmp_path / "cfg.py"
+    cfg_path.write_text(cfg_src)
+
+    import sys as _sys
+
+    from paddle_tpu.config import parse_config
+    from paddle_tpu.trainer import Trainer
+    from paddle_tpu.utils.flags import FLAGS
+
+    providers = os.path.join(REPO, "tests", "providers")
+    _sys.path.insert(0, providers)
+    FLAGS.save_dir = ""
+    FLAGS.log_period = 0
+    try:
+        cfg = parse_config(str(cfg_path))
+        types = {l.type for l in cfg.model_config.layers}
+        assert {"auc-validation", "pnpair-validation"} <= types, types
+        trainer = Trainer(cfg)
+        trainer.train(num_passes=2)
+        metrics = trainer.test()
+    finally:
+        _sys.path.remove(providers)
+    # the separable synthetic data trains to a strong ranking
+    # (results keys are '<evaluator name>.<metric>')
+    auc = [v for k, v in metrics.items() if k.endswith(".auc")]
+    pnp = [v for k, v in metrics.items() if k.endswith(".pnpair_accuracy")]
+    assert auc and auc[0] > 0.9, metrics
+    assert pnp and pnp[0] > 0.9, metrics
+    # validation layers contribute zero cost (the real cost dominates)
+    assert np.isfinite(metrics["cost"])

@@ -232,3 +232,59 @@ def test_merge_model_reads_sharded_checkpoint(two_proc_ckpt, tmp_path):
     assert set(merged) == set(raw)
     for k in raw:
         np.testing.assert_array_equal(merged[k], raw[k], err_msg=k)
+
+
+def test_streaming_restore_reads_only_overlapping_shards(tmp_path):
+    """The streaming restore claim (reference block-wise semantics,
+    ParameterServer2.cpp:1150-1213): assembling one device slice of a
+    model-sharded 1M-row table reads ONLY the shard records overlapping
+    it — O(shard bytes), never O(table bytes) — and a full restore reads
+    each record exactly once (no per-device decompression amplification,
+    including under full replication)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from paddle_tpu.trainer import checkpoint as ckpt
+
+    mesh = Mesh(np.array(jax.devices()).reshape(8), ("model",))
+    rows, cols = 1_000_000, 8
+    sh = NamedSharding(mesh, P("model", None))
+    table = jax.device_put(
+        (jnp.arange(rows, dtype=jnp.float32)[:, None] % 997.0)
+        * jnp.ones((1, cols), jnp.float32),
+        sh,
+    )
+    path = str(tmp_path)
+    ckpt._save_tree_sharded(path, "params", {"table": table})
+    ckpt._merge_tree_indexes(path, "params")
+
+    table_bytes = rows * cols * 4
+    shard_rows = rows // 8
+    shard_bytes = shard_rows * cols * 4
+
+    # one device slice costs one record, not the table
+    reader = ckpt._ShardedTreeReader(path, ckpt._tree_index(path, "params"))
+    got = reader.read_slice(
+        "table", (slice(shard_rows, 2 * shard_rows), slice(None)),
+        (rows, cols), np.float32,
+    )
+    np.testing.assert_array_equal(
+        got, np.asarray(table[shard_rows : 2 * shard_rows]))
+    assert reader.bytes_read == shard_bytes, (reader.bytes_read, shard_bytes)
+    reader.close()
+
+    # full sharded restore: every record read exactly once, bit-exact
+    stats = {}
+    params, _, _ = ckpt.load_checkpoint(
+        path, sharding_for=lambda base, key, shape: sh, io_stats=stats)
+    assert stats["params"] == table_bytes, stats
+    np.testing.assert_array_equal(np.asarray(params["table"]), np.asarray(table))
+
+    # fully-replicated restore must not amplify reads across the 8 devices
+    rep = NamedSharding(mesh, P(None, None))
+    stats2 = {}
+    params2, _, _ = ckpt.load_checkpoint(
+        path, sharding_for=lambda base, key, shape: rep, io_stats=stats2)
+    assert stats2["params"] == table_bytes, stats2
+    np.testing.assert_array_equal(np.asarray(params2["table"]), np.asarray(table))
