@@ -1,0 +1,100 @@
+"""Inspect paddle_tpu binary artifacts from the command line.
+
+Role analog of the reference's python/paddle/utils/show_pb.py (which
+dumped varint-framed DataFormat protobuf records); our binary surfaces
+are npz-based, so this tool recognizes and pretty-prints all three:
+
+- binary data shards (paddle_tpu.data.binary write_shard format):
+  slot types, sample count, and the first few samples;
+- checkpoint pass dirs / params.npz trees: parameter names, shapes,
+  dtypes, and value stats;
+- merged models (trainer/checkpoint.py merge_model): the embedded config
+  JSON plus the parameter table.
+
+Usage:
+  python -m paddle_tpu.utils.show_pb FILE_OR_PASS_DIR [--samples N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+_SEQ = {0: "", 1: " seq", 2: " subseq"}
+_TYPE = {0: "dense", 1: "sparse_binary", 2: "sparse_value", 3: "index"}
+
+
+def _show_shard(path: str, n_samples: int) -> None:
+    import itertools
+
+    from paddle_tpu.data.binary import read_shard, shard_input_types
+
+    types = shard_input_types(path)
+    print(f"binary shard {path}")
+    for i, t in enumerate(types):
+        print(f"  slot {i}: {_TYPE.get(t.type, t.type)}{_SEQ.get(t.seq_type, '')} dim={t.dim}")
+    with np.load(path) as z:
+        n = json.loads(bytes(z["__meta__"]).decode())["n"]
+    print(f"  samples: {n}")
+    # decode only what gets printed — shards can be huge
+    for s in itertools.islice(read_shard(path), n_samples):
+        print("   ", " | ".join(str(v)[:70] for v in s))
+
+
+def _show_params(arrays, title: str) -> None:
+    print(title)
+    total = 0
+    for k in sorted(arrays):
+        v = arrays[k]
+        total += v.size
+        stats = (
+            f" mean={float(np.mean(v)):+.4g} absmax={float(np.max(np.abs(v))):.4g}"
+            if v.size else " (empty)"
+        )
+        print(f"  {k:<45} {str(v.shape):<18} {str(v.dtype):<10}{stats}")
+    print(f"  total parameters: {total:,}")
+
+
+def show(path: str, n_samples: int = 4) -> int:
+    if os.path.isdir(path):
+        from paddle_tpu.trainer import checkpoint as ckpt
+
+        arrays = ckpt._load_tree_numpy(path, "params")
+        if arrays is None:
+            print(f"{path}: directory without a params tree", file=sys.stderr)
+            return 1
+        meta_path = os.path.join(path, "meta.json")
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                print("meta:", json.dumps(json.load(f)))
+        _show_params(arrays, f"checkpoint {path}")
+        return 0
+    with np.load(path, allow_pickle=False) as z:
+        files = set(z.files)
+        if "__meta__" in files:
+            _show_shard(path, n_samples)
+            return 0
+        arrays = {k: z[k] for k in z.files if k != "__config_json__"}
+        if "__config_json__" in files:
+            cfg = bytes(z["__config_json__"]).decode()
+            print("merged model config:", cfg[:400] + ("..." if len(cfg) > 400 else ""))
+            _show_params(arrays, f"merged model {path}")
+        else:
+            _show_params(arrays, f"params tree {path}")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("path", help="shard npz, params npz, merged model, or pass dir")
+    ap.add_argument("--samples", type=int, default=4, help="samples to print for shards")
+    args = ap.parse_args(argv)
+    return show(args.path, args.samples)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

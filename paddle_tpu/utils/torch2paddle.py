@@ -1,0 +1,104 @@
+"""Convert torch model weights into a loadable checkpoint.
+
+Role analog of the reference's python/paddle/utils/torch2paddle.py (which
+read torchfile .t7 archives and wrote per-parameter binary files); this
+version reads what today's torch ecosystem actually produces — a .pt/.pth
+file holding a state_dict (name -> tensor) or a plain list of tensors —
+and writes a pass-00000 checkpoint that --init_model_path loads.
+
+Mapping follows the reference's contract: a layers file lists the target
+layer names IN ORDER; tensors pair up as (weight, bias) per layer.
+Layout conversion per tensor rank:
+  2-D  torch Linear [out, in]      -> transposed to our fc w0 [in, out]
+  4-D  torch Conv2d [O, I, kh, kw] -> flattened to [O, I*kh*kw] (the
+       reference conv parameter layout our conv layers reshape from,
+       layers/vision.py)
+  1-D  bias -> wbias unchanged
+
+Usage:
+  python -m paddle_tpu.utils.torch2paddle -i model.pth -l layers.txt -o out_dir
+Then: bin/paddle train --init_model_path=out_dir/pass-00000 ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+
+def convert_tensor(name: str, t) -> np.ndarray:
+    a = np.asarray(t, dtype=np.float32)
+    if a.ndim == 2:
+        return a.T.copy()  # torch Linear [out,in] -> ours [in,out]
+    if a.ndim == 4:
+        return a.reshape(a.shape[0], -1).copy()  # OIHW -> [O, I*kh*kw]
+    if a.ndim == 1:
+        return a
+    raise ValueError(f"{name}: unsupported tensor rank {a.ndim} (shape {a.shape})")
+
+
+def convert(tensors, layer_names) -> dict:
+    """tensors: ordered list of arrays, (weight, bias) per layer name.
+    Returns the params dict ({_<layer>.w0, _<layer>.wbias})."""
+    if len(tensors) != 2 * len(layer_names):
+        raise ValueError(
+            f"{len(tensors)} tensors for {len(layer_names)} layers — expected "
+            "one (weight, bias) pair per layer"
+        )
+    params = {}
+    for i, layer in enumerate(layer_names):
+        w, b = tensors[2 * i], tensors[2 * i + 1]
+        params[f"_{layer}.w0"] = convert_tensor(f"{layer}.weight", w)
+        params[f"_{layer}.wbias"] = convert_tensor(f"{layer}.bias", b)
+    return params
+
+
+def load_tensors(path: str):
+    """Ordered tensor list from a .pt/.pth state_dict or tensor list.
+    Unwraps the common {'state_dict': ...} checkpoint wrapper and skips
+    non-tensor / scalar entries (epoch counters, num_batches_tracked)
+    with a note instead of crashing on them."""
+    import torch
+
+    obj = torch.load(path, map_location="cpu", weights_only=True)
+    if isinstance(obj, dict):
+        for wrapper_key in ("state_dict", "model_state_dict", "model"):
+            if isinstance(obj.get(wrapper_key), dict):
+                obj = obj[wrapper_key]
+                break
+        out = []
+        for k, v in obj.items():
+            if not hasattr(v, "numpy") or getattr(v, "ndim", 0) == 0:
+                print(f"skipping non-parameter entry {k!r}", file=sys.stderr)
+                continue
+            out.append(v.numpy())
+        return out
+    return [np.asarray(v) for v in obj]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-i", "--input", required=True, help=".pt/.pth torch weights")
+    ap.add_argument("-l", "--layers", required=True,
+                    help="file listing target layer names, one per line, in order")
+    ap.add_argument("-o", "--output", required=True, help="checkpoint save_dir")
+    args = ap.parse_args(argv)
+
+    with open(args.layers) as f:
+        layer_names = [ln.strip() for ln in f if ln.strip()]
+    params = convert(load_tensors(args.input), layer_names)
+
+    from paddle_tpu.utils.backend_guard import ensure_cpu_mesh
+
+    ensure_cpu_mesh(1)
+    from paddle_tpu.trainer.checkpoint import save_checkpoint
+
+    path = save_checkpoint(args.output, 0, params, extra_meta={"source": "torch2paddle"})
+    print(f"wrote {len(params)} parameters to {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -159,3 +159,71 @@ def test_bench_ladder_steps_down_only_on_oom():
         pass
     else:
         raise AssertionError("exhausted ladder must raise")
+
+
+def test_show_pb_inspects_shard_and_checkpoint(tmp_path, capsys):
+    """show_pb analog (ref python/paddle/utils/show_pb.py): dumps binary
+    shards, checkpoint trees, and merged models."""
+    import numpy as np
+
+    from paddle_tpu.data.binary import write_shard
+    from paddle_tpu.data.provider import dense_vector, integer_value
+    from paddle_tpu.utils import show_pb
+
+    shard = tmp_path / "shard.npz"
+    write_shard(str(shard), [[[0.5, 1.0], 1], [[2.0, 3.0], 0]],
+                [dense_vector(2), integer_value(2)])
+    assert show_pb.show(str(shard)) == 0
+    out = capsys.readouterr().out
+    assert "samples: 2" in out and "dense" in out and "index" in out
+
+    from paddle_tpu.trainer.checkpoint import save_checkpoint
+
+    save_checkpoint(str(tmp_path / "model"), 0,
+                    {"_fc.w0": np.ones((3, 2), np.float32)})
+    assert show_pb.show(str(tmp_path / "model" / "pass-00000")) == 0
+    out = capsys.readouterr().out
+    assert "_fc.w0" in out and "(3, 2)" in out and "total parameters: 6" in out
+
+
+def test_torch2paddle_converts_and_trains(tmp_path):
+    """torch2paddle analog (ref python/paddle/utils/torch2paddle.py):
+    torch Linear weights convert (transposed) into a checkpoint that
+    initializes our fc layers and reproduces torch's forward."""
+    import subprocess
+
+    import numpy as np
+    import torch
+
+    from paddle_tpu.utils.torch2paddle import convert, convert_tensor
+
+    # layout rules
+    w = np.arange(6, dtype=np.float32).reshape(2, 3)  # torch [out=2, in=3]
+    assert convert_tensor("x", w).shape == (3, 2)
+    c = np.zeros((4, 3, 2, 2), np.float32)  # conv OIHW
+    assert convert_tensor("c", c).shape == (4, 12)
+
+    lin = torch.nn.Linear(4, 2)
+    sd = lin.state_dict()
+    model_path = tmp_path / "m.pth"
+    torch.save(sd, str(model_path))
+    layers = tmp_path / "layers.txt"
+    layers.write_text("out\n")
+
+    env = {**os.environ, "PYTHONPATH": f"{REPO}:{REPO}/compat",
+           "JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""}
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.utils.torch2paddle",
+         "-i", str(model_path), "-l", str(layers), "-o", str(tmp_path / "ckpt")],
+        capture_output=True, text=True, cwd=REPO, env=env,
+    )
+    assert r.returncode == 0, r.stderr
+    assert (tmp_path / "ckpt" / "pass-00000" / "params.npz").exists()
+
+    # the converted fc reproduces torch's forward: x @ w0 + wbias
+    with np.load(tmp_path / "ckpt" / "pass-00000" / "params.npz") as z:
+        w0, wb = z["_out.w0"], z["_out.wbias"]
+    x = np.random.RandomState(0).rand(5, 4).astype(np.float32)
+    ours = x @ w0 + wb
+    theirs = lin(torch.from_numpy(x)).detach().numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=1e-5, atol=1e-6)
