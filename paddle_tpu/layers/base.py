@@ -70,6 +70,14 @@ class LayerContext:
     # lax.scan unroll factor for recurrent layers/groups
     # (OptimizationConfig.scan_unroll; 1 = no unrolling)
     scan_unroll: int = 1
+    # NHWC layout side-table (layer name -> [B, H, W, C] array): the conv
+    # family publishes its pre-flatten output here and prefers consuming
+    # it, so chains of conv/pool/bn/norm skip the per-layer
+    # flat->NCHW->NHWC round-trip (XLA does not reliably cancel it; the
+    # flat Argument.value stays authoritative and is DCE'd when every
+    # consumer took the NHWC view). Recurrent groups build their own
+    # context, so entries never cross a scan boundary.
+    nhwc: Dict[str, Array] = field(default_factory=dict)
     # sparse-embedding prefetch (GradientMachine::prefetch analog): rows
     # pre-gathered outside autodiff, keyed by (param_name, input_layer);
     # the table projection returns these instead of gathering, so
@@ -168,6 +176,9 @@ def forward_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -
         out = out.replace(
             value=_clip_error(out.value, float(cfg.error_clipping_threshold))
         )
+        # a published NHWC view would bypass the clip wrapper — drop it so
+        # every consumer goes through the clipped flat value
+        ctx.nhwc.pop(cfg.name, None)
     ctx.outputs[cfg.name] = out
     return out
 

@@ -183,6 +183,24 @@ def concat2_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -
 
 @register_layer("addto")
 def addto_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Argument:
+    # NHWC fast path: residual junctions between conv-family layers (the
+    # ResNet shortcut) stay in the published layout so the chain never
+    # round-trips through flat NCHW (see LayerContext.nhwc)
+    from paddle_tpu.layers.vision import _publish_nhwc
+    from paddle_tpu.ops.activations import apply_activation, is_elementwise
+
+    nh = [ctx.nhwc.get(ic.input_layer_name) for ic in cfg.inputs]
+    if (
+        all(x is not None for x in nh)
+        and len({x.shape for x in nh}) == 1
+        and not cfg.bias_parameter_name
+        and cfg.drop_rate == 0.0
+        and is_elementwise(cfg.active_type)
+    ):
+        acc = nh[0]
+        for x in nh[1:]:
+            acc = acc + x
+        return _publish_nhwc(ctx, cfg, apply_activation(cfg.active_type, acc))
     acc = inputs[0].value
     for a in inputs[1:]:
         acc = acc + a.value

@@ -47,6 +47,34 @@ def _nhwc_to_flat(x: Array) -> Array:
     return x.transpose(0, 3, 1, 2).reshape(x.shape[0], -1)
 
 
+from paddle_tpu.ops.activations import is_elementwise
+
+
+def _take_nhwc(ctx: LayerContext, input_layer_name: str, arg, channels: int,
+               h: int, w: int) -> Array:
+    """The producer's published NHWC view when shapes agree, else convert
+    from the flat NCHW value (see LayerContext.nhwc)."""
+    x = ctx.nhwc.get(input_layer_name)
+    if x is not None and x.shape[1:] == (h, w, channels):
+        return x
+    return _nchw_to_nhwc(arg.value, channels, h, w)
+
+
+def _dropout(ctx: LayerContext, cfg: LayerConfig, x: Array) -> Array:
+    if cfg.drop_rate > 0.0 and ctx.is_training:
+        keep = 1.0 - cfg.drop_rate
+        m = jax.random.bernoulli(ctx.layer_rng(cfg.name, "dropout"), keep, x.shape)
+        x = jnp.where(m, x / keep, 0.0)
+    return x
+
+
+def _publish_nhwc(ctx: LayerContext, cfg: LayerConfig, y_nhwc: Array) -> Argument:
+    """Publish the NHWC view for downstream conv-family layers and return
+    the flat Argument (DCE'd by XLA if every consumer took the view)."""
+    ctx.nhwc[cfg.name] = y_nhwc
+    return Argument(value=_nhwc_to_flat(y_nhwc))
+
+
 def _conv2d(x_nhwc: Array, w_hwio: Array, stride: Tuple[int, int], padding, groups: int) -> Array:
     # bf16 in/out is safe on TPU: the MXU accumulates partial products in
     # f32 internally regardless of the result dtype, so no explicit
@@ -70,7 +98,7 @@ def _conv_forward(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -
         fy = cc.filter_size_y or cc.filter_size
         sy = cc.stride_y or cc.stride
         py = cc.padding_y if cc.padding_y >= 0 else cc.padding
-        x = _nchw_to_nhwc(arg.value, cc.channels, h, w)
+        x = _take_nhwc(ctx, in_cfg.input_layer_name, arg, cc.channels, h, w)
         wf = ctx.param(in_cfg.input_parameter_name)
         wf = wf.reshape(cfg.num_filters, cc.filter_channels, fy, cc.filter_size)
         w_hwio = wf.transpose(2, 3, 1, 0)  # OIHW → HWIO
@@ -85,13 +113,12 @@ def _conv_forward(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -
             # addUnsharedBias over NCHW rows) — transpose into NHWC
             b_hwf = b.reshape(cfg.num_filters, acc.shape[1], acc.shape[2]).transpose(1, 2, 0)
             acc = acc + b_hwf[None]
-    out = _nhwc_to_flat(acc)
-    out = apply_activation(cfg.active_type, out)
-    if cfg.drop_rate > 0.0 and ctx.is_training:
-        keep = 1.0 - cfg.drop_rate
-        m = jax.random.bernoulli(ctx.layer_rng(cfg.name, "dropout"), keep, out.shape)
-        out = jnp.where(m, out / keep, 0.0)
-    return Argument(value=out)
+    if not is_elementwise(cfg.active_type):
+        out = apply_activation(cfg.active_type, _nhwc_to_flat(acc))
+        out = _dropout(ctx, cfg, out)
+        return Argument(value=out)
+    acc = _dropout(ctx, cfg, apply_activation(cfg.active_type, acc))
+    return _publish_nhwc(ctx, cfg, acc)
 
 
 register_layer("conv", "exconv", "cudnn_conv")(_conv_forward)
@@ -129,7 +156,7 @@ def pool_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> A
     ky = pc.size_y or pc.size_x
     sy = pc.stride_y or pc.stride
     py = pc.padding_y or pc.padding
-    x = _nchw_to_nhwc(inputs[0].value, pc.channels, h, w)
+    x = _take_nhwc(ctx, cfg.inputs[0].input_layer_name, inputs[0], pc.channels, h, w)
     window = (1, ky, pc.size_x, 1)
     strides = (1, sy, pc.stride, 1)
     # the config declares ceil-mode output sizes (reference outputSize with
@@ -163,9 +190,9 @@ def pool_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> A
         # avgPoolForward clips hstart/hend to the image before dividing)
         y = lax.reduce_window(x, 0.0, lax.add, window, strides, pads)
         y = y / jnp.maximum(counts, 1.0)
-    out = _nhwc_to_flat(y)
-    out = apply_activation(cfg.active_type, out)
-    return Argument(value=out)
+    if not is_elementwise(cfg.active_type):
+        return Argument(value=apply_activation(cfg.active_type, _nhwc_to_flat(y)))
+    return _publish_nhwc(ctx, cfg, apply_activation(cfg.active_type, y))
 
 
 @register_layer("batch_norm", "cudnn_batch_norm")
@@ -185,9 +212,17 @@ def batch_norm_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext
         seq_meta = dict(seq_lengths=a.seq_lengths)
         B, T, D = x.shape
         x = x.reshape(B * T, D)
+    x_nhwc = None
     if ic is not None and ic.img_size > 0:
         C, hw = ic.channels, ic.img_size * ic.img_size
-        xr = x.reshape(x.shape[0], C, hw).transpose(0, 2, 1).reshape(-1, C)
+        if not a.is_seq:
+            # NHWC flattens to per-pixel rows of C directly — same row
+            # set as the NCHW transpose dance, so identical statistics
+            x_nhwc = _take_nhwc(ctx, cfg.inputs[0].input_layer_name, a,
+                                C, ic.img_size, ic.img_size)
+            xr = x_nhwc.reshape(-1, C)
+        else:
+            xr = x.reshape(x.shape[0], C, hw).transpose(0, 2, 1).reshape(-1, C)
     else:
         C = cfg.size
         xr = x
@@ -215,7 +250,12 @@ def batch_norm_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext
             f * ctx.params[var_name].reshape(C) + (1.0 - f) * var
         ).reshape(ctx.params[var_name].shape)
     yn = ((xr_hp - mean) * lax.rsqrt(var + eps) * gamma + beta).astype(xr.dtype)
-    if ic is not None and ic.img_size > 0:
+    if x_nhwc is not None and is_elementwise(cfg.active_type):
+        y_img = apply_activation(cfg.active_type, yn.reshape(x_nhwc.shape))
+        return _publish_nhwc(ctx, cfg, y_img)
+    if x_nhwc is not None:
+        y = _nhwc_to_flat(yn.reshape(x_nhwc.shape))
+    elif ic is not None and ic.img_size > 0:
         y = yn.reshape(x.shape[0], hw, C).transpose(0, 2, 1).reshape(x.shape[0], -1)
     else:
         y = yn
@@ -230,7 +270,8 @@ def norm_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> A
     # ref: NormProjectionLayer (cmrnorm-projection): cross-map local
     # response normalization: y = x / (1 + scale/size * sum_window x^2)^pow
     nc = cfg.inputs[0].norm_conf
-    x = _nchw_to_nhwc(inputs[0].value, nc.channels, nc.img_size, nc.img_size)
+    x = _take_nhwc(ctx, cfg.inputs[0].input_layer_name, inputs[0],
+                   nc.channels, nc.img_size, nc.img_size)
     half = nc.size // 2
     sq = jnp.square(x)
     acc = lax.reduce_window(
@@ -239,8 +280,7 @@ def norm_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> A
     # NormConfig.scale already carries scale/size (the reference's
     # config_parser divides before storing; our DSL does the same)
     denom = jnp.power(1.0 + nc.scale * acc, nc.pow)
-    y = x / denom
-    return Argument(value=_nhwc_to_flat(y))
+    return _publish_nhwc(ctx, cfg, x / denom)
 
 
 @register_layer("blockexpand")

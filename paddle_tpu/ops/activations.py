@@ -25,10 +25,21 @@ Array = jax.Array
 # activation(value, mask) -> value. mask is [B, T] (or None for non-seq).
 activation_registry: Registry[Callable] = Registry("activation")
 
+# strictly-elementwise activations (commute with any layout permutation,
+# so e.g. the vision layers' NHWC fast path may apply them pre-flatten).
+# _simple registrations are elementwise by construction; axis-dependent
+# ones (softmax families) must never appear here.
+ELEMENTWISE_ACTS = set()
+
+
+def is_elementwise(name: str) -> bool:
+    return name in ELEMENTWISE_ACTS
+
 
 def _simple(name: str):
     def deco(fn):
         activation_registry.register_obj(name, lambda x, mask=None: fn(x))
+        ELEMENTWISE_ACTS.add(name)
         return fn
 
     return deco

@@ -245,3 +245,95 @@ def test_resnet_predict_graph_builds():
     names = {l.name for l in cfg.model_config.layers}
     assert "output" in names and "label" not in names
     assert len([n for n in names if n.endswith("_sum")]) == sum((3, 4, 23, 3))
+
+
+def test_nhwc_chain_avoids_layout_roundtrips(tmp_path):
+    """The conv family publishes NHWC views between layers
+    (LayerContext.nhwc), so a conv->conv->pool chain must not pay a
+    flat->NCHW->NHWC round-trip per layer. Pinned on the compiled HLO's
+    transpose count: before the side-table this graph compiled to ~2x
+    more transposes (they do NOT all cancel in XLA)."""
+    import re
+    import textwrap
+
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.config import parse_config
+    from paddle_tpu.graph import GradientMachine
+    from paddle_tpu.graph.argument import Argument
+
+    cfg_file = tmp_path / "conf.py"
+    cfg_file.write_text(textwrap.dedent("""
+    from paddle.trainer_config_helpers import *
+    settings(batch_size=4, learning_rate=0.1)
+    img = data_layer('image', size=3*16*16)
+    t = img_conv_group(input=img, num_channels=3, conv_num_filter=[8, 8],
+                       conv_filter_size=3, conv_padding=1,
+                       conv_act=ReluActivation(), pool_size=2, pool_stride=2,
+                       pool_type=MaxPooling())
+    out = fc_layer(input=t, size=4, act=SoftmaxActivation(), name='out')
+    outputs(classification_cost(input=out, label=data_layer('label', size=4)))
+    """))
+    cfg = parse_config(str(cfg_file))
+    gm = GradientMachine(cfg.model_config)
+    params = gm.init_params(seed=1)
+    grad_fn = gm.grad_fn()
+    batch = {"image": Argument(value=jnp.ones((4, 3 * 16 * 16), jnp.float32)),
+             "label": Argument(ids=jnp.zeros((4,), jnp.int32))}
+    f = jax.jit(lambda p, b: grad_fn(p, b, None)[:2])
+    hlo = f.lower(params, batch).compile().as_text()
+    n_transpose = len(re.findall(r"= \S+? transpose\(", hlo))
+    # measured 13 with the side-table (was ~25 without); headroom for
+    # compiler-version drift without letting the round-trips back in
+    assert n_transpose <= 18, f"layout round-trips are back: {n_transpose} transposes"
+
+
+def test_error_clipping_survives_nhwc_fast_path(tmp_path):
+    """error_clipping_threshold wraps only the flat output; the published
+    NHWC view must be dropped for such layers or consumers would bypass
+    the clip (and XLA would DCE the clipped branch entirely)."""
+    import textwrap
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu.config import parse_config
+    from paddle_tpu.graph import GradientMachine
+    from paddle_tpu.graph.argument import Argument
+
+    def grads_for(threshold):
+        cfg_file = tmp_path / f"conf_{threshold}.py"
+        extra = (f", layer_attr=ExtraAttr(error_clipping_threshold={threshold})"
+                 if threshold else "")
+        cfg_file.write_text(textwrap.dedent(f"""
+        from paddle.trainer_config_helpers import *
+        settings(batch_size=4, learning_rate=0.1)
+        img = data_layer('image', size=3*8*8)
+        c1 = img_conv_layer(input=img, num_channels=3, num_filters=4,
+                            filter_size=3, padding=1, act=ReluActivation(),
+                            name='c1'{extra})
+        c2 = img_conv_layer(input=c1, num_channels=4, num_filters=4,
+                            filter_size=3, padding=1, act=ReluActivation(),
+                            name='c2')
+        out = fc_layer(input=c2, size=2, act=SoftmaxActivation(), name='out')
+        outputs(classification_cost(input=out, label=data_layer('label', size=2)))
+        """))
+        cfg = parse_config(str(cfg_file))
+        gm = GradientMachine(cfg.model_config)
+        params = gm.init_params(seed=3)
+        grad_fn = gm.grad_fn()
+        batch = {
+            "image": Argument(value=jnp.asarray(
+                np.random.RandomState(0).rand(4, 3 * 8 * 8), jnp.float32)),
+            "label": Argument(ids=jnp.zeros((4,), jnp.int32)),
+        }
+        _, grads, _, _ = grad_fn(params, batch, None)
+        return float(jnp.abs(grads["_c1.w0"]).max())
+
+    unclipped = grads_for(0)
+    clipped = grads_for(1e-9)
+    assert unclipped > 1e-6, unclipped
+    # a 1e-9 cotangent clip on c1's output must crush c1's weight grads
+    assert clipped < unclipped * 1e-2, (clipped, unclipped)
