@@ -1,0 +1,123 @@
+"""Format a TPU measurement session into RESULTS.md table rows.
+
+Run by benchmarks/tpu_session.sh after the legs finish (or by hand):
+parses the JSON lines in RESULTS_tpu_session_raw.txt, keeps the most
+complete line per configuration, and appends measured rows to
+benchmarks/RESULTS.md — so even an unattended recovery (watcher fires,
+driver auto-commits) leaves the append-only log fully formatted.
+
+Only lines with a non-CPU backend become rows; CPU smoke lines are
+session plumbing, not measurements.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from datetime import datetime, timezone
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def parse_session(raw_path: str):
+    """Yield (context, record) for the last JSON line of each section."""
+    context = "headline"
+    last: dict = {}
+    order: list = []
+    with open(raw_path) as f:
+        for line in f:
+            line = line.strip()
+            if line.startswith("--- "):
+                context = line[4:]
+                continue
+            if line.startswith("=== "):
+                context = "headline"
+                continue
+            if not line.startswith("{"):
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.get("metric") == "bench_failed":
+                continue
+            key = (context, rec.get("metric"))
+            if key not in last:
+                order.append(key)
+            last[key] = rec  # cumulative re-emits: keep the final one
+    return [(ctx, last[(ctx, m)]) for ctx, m in order]
+
+
+def _cell(text) -> str:
+    """Sanitize arbitrary text (XLA errors carry newlines and pipes) for
+    a markdown table cell."""
+    return str(text).replace("\n", " ").replace("|", "\\|")
+
+
+def fmt_row(when: str, context: str, rec: dict) -> list:
+    rows = []
+    backend = rec.get("backend", "?")
+    if backend in ("", "cpu"):
+        return rows
+
+    def one(metric, value, unit, extras):
+        cfg = ", ".join(
+            f"{k}={extras[k]}"
+            for k in ("dtype", "batch", "mfu", "hw_flops_util", "remat",
+                      "device_kind", "skipped_rungs")
+            if extras.get(k) is not None
+        )
+        if context != "headline":
+            cfg = f"{context}; {cfg}"
+        rows.append(
+            f"| {when} | {_cell(metric)} | **{value} {unit}** | {_cell(cfg)} | "
+            f"{backend} | RESULTS_tpu_session_raw.txt |"
+        )
+
+    one(rec.get("metric"), rec.get("value"), rec.get("unit"), rec)
+    for leg, sub in (rec.get("legs") or {}).items():
+        if "error" in sub:
+            rows.append(
+                f"| {when} | {_cell(leg)} | leg failed | {_cell(sub['error'])[:120]} | "
+                f"{backend} | RESULTS_tpu_session_raw.txt |"
+            )
+        else:
+            one(leg, sub.get("value"), sub.get("unit", ""), sub)
+    return rows
+
+
+def main(argv=None) -> int:
+    raw = os.path.join(HERE, "RESULTS_tpu_session_raw.txt")
+    results = os.path.join(HERE, "RESULTS.md")
+    if argv and len(argv) > 0:
+        raw = argv[0]
+    if not os.path.exists(raw):
+        print(f"no session file at {raw}", file=sys.stderr)
+        return 1
+    when = datetime.now(timezone.utc).strftime("%Y-%m-%d %H:%MZ")
+    rows: list = []
+    for context, rec in parse_session(raw):
+        rows.extend(fmt_row(when, context, rec))
+    if not rows:
+        print("session produced no TPU measurements; nothing appended")
+        return 0
+    # rows live in their own headed table section at EOF — the file ends
+    # with prose between rounds, so bare pipe rows would not render
+    section = "## Measured session rows (auto-appended by append_results.py)"
+    existing = ""
+    if os.path.exists(results):
+        with open(results) as f:
+            existing = f.read()
+    with open(results, "a") as f:
+        if section not in existing:
+            f.write(f"\n{section}\n\n")
+            f.write("| when | metric | value | config | backend | source |\n")
+            f.write("|---|---|---|---|---|---|\n")
+        f.write("\n".join(rows) + "\n")
+    print(f"appended {len(rows)} measured rows to {results}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

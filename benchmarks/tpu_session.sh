@@ -8,7 +8,11 @@
 # inside the repo working tree so the driver's end-of-round auto-commit
 # captures the numbers even if no agent is running when they arrive.
 cd "$(dirname "$0")/.." || exit 1
-OUT=benchmarks/RESULTS_tpu_session_raw.txt
+# each session writes its own file, appended to the cumulative raw log at
+# the end — the formatter sees exactly one session, so re-runs can never
+# duplicate or misattribute earlier sessions' rows
+CUM=benchmarks/RESULTS_tpu_session_raw.txt
+OUT=$(mktemp /tmp/tpu_session_XXXX.txt)
 ERR=/tmp/tpu_session_err.log
 echo "=== TPU session $(date -u)" >> $OUT
 mkdir -p benchmarks/traces
@@ -28,3 +32,7 @@ done
 echo "--- trace summary" >> $OUT
 python benchmarks/trace_summary.py benchmarks/traces 15 >> $OUT 2>>$ERR
 echo "=== session done $(date -u)" >> $OUT
+cat $OUT >> $CUM
+# format measured rows into the append-only log so an unattended
+# recovery still leaves RESULTS.md complete
+python benchmarks/append_results.py $OUT >> $ERR 2>&1 || true

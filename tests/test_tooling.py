@@ -227,3 +227,63 @@ def test_torch2paddle_converts_and_trains(tmp_path):
     ours = x @ w0 + wb
     theirs = lin(torch.from_numpy(x)).detach().numpy()
     np.testing.assert_allclose(ours, theirs, rtol=1e-5, atol=1e-6)
+
+
+def test_append_results_formats_tpu_session(tmp_path):
+    """benchmarks/append_results.py: session JSON lines -> append-only
+    RESULTS.md rows (last cumulative line wins, CPU smoke excluded,
+    failed legs and skipped rungs surfaced)."""
+    sys.path.insert(0, REPO)
+    from benchmarks.append_results import fmt_row, parse_session
+
+    raw = tmp_path / "raw.txt"
+    raw.write_text(
+        '=== TPU session\n'
+        '{"metric": "m1", "value": 1.0, "unit": "x/s", "backend": "axon"}\n'
+        '{"metric": "m1", "value": 1.0, "unit": "x/s", "backend": "axon",'
+        ' "legs": {"l1": {"value": 2.0, "unit": "t/s"},'
+        ' "l2": {"error": "E: boom"}}}\n'
+        '--- f32 A/B\n'
+        '{"metric": "m1", "value": 0.5, "unit": "x/s", "backend": "cpu"}\n'
+        '{"metric": "bench_failed", "value": 0, "unit": "none", "error": "x"}\n'
+    )
+    sections = parse_session(str(raw))
+    assert [ctx for ctx, _ in sections] == ["headline", "f32 A/B"]
+    # cumulative: the headline's LAST line (with legs) won
+    assert "legs" in sections[0][1]
+    rows = [r for ctx, rec in sections for r in fmt_row("now", ctx, rec)]
+    joined = "\n".join(rows)
+    assert "**1.0 x/s**" in joined and "**2.0 t/s**" in joined
+    assert "leg failed" in joined and "E: boom" in joined
+    # the CPU line produced no row
+    assert "0.5" not in joined
+
+
+def test_append_results_sanitizes_and_sections(tmp_path, monkeypatch):
+    """Multi-line / pipe-bearing error text must not break the markdown
+    table, rows land in a headed section (header written once), and a
+    second session appends without duplicating the header."""
+    sys.path.insert(0, REPO)
+    from benchmarks import append_results as ar
+
+    import json as _json
+
+    raw = tmp_path / "raw.txt"
+    rec = {"metric": "m", "value": 1.0, "unit": "x", "backend": "axon",
+           "legs": {"l": {"error": "UNAVAILABLE: line1\nline2 | pipe"}}}
+    raw.write_text(_json.dumps(rec) + "\n")
+    results = tmp_path / "RESULTS.md"
+    results.write_text("# log\n\nprose tail\n")
+    monkeypatch.setattr(ar, "HERE", str(tmp_path))
+    assert ar.main([str(raw)]) == 0
+    text = results.read_text()
+    # every appended line is a well-formed single-line table row
+    tail = text.split("prose tail\n", 1)[1]
+    row_lines = [l for l in tail.splitlines() if l.startswith("|")]
+    assert all(l.endswith("|") for l in row_lines), row_lines
+    assert "line1 line2 \\| pipe" in text
+    assert text.count("auto-appended") == 1
+    # second session: rows appended, header not duplicated
+    assert ar.main([str(raw)]) == 0
+    assert results.read_text().count("auto-appended") == 1
+    assert results.read_text().count("leg failed") == 2
