@@ -289,7 +289,8 @@ def block_expand_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerConte
     # flattened patches (OCR-style); output is a sequence of length
     # output_x * output_y per image.
     bc = cfg.inputs[0].block_expand_conf
-    x = _nchw_to_nhwc(inputs[0].value, bc.channels, bc.img_size_y, bc.img_size_x)
+    x = _take_nhwc(ctx, cfg.inputs[0].input_layer_name, inputs[0],
+                   bc.channels, bc.img_size_y, bc.img_size_x)
     patches = lax.conv_general_dilated_patches(
         x.transpose(0, 3, 1, 2),  # NCHW
         filter_shape=(bc.block_y, bc.block_x),

@@ -259,3 +259,71 @@ def test_selective_fc_padded_selection_excludes_column0():
     assert v[0, 2] > 0 and v[0, 3] > 0
     assert v[1, 0] > 0  # genuine col-0 selection still works
     np.testing.assert_allclose(v.sum(axis=1), 1.0, rtol=1e-5)
+
+
+def test_block_expand_extracts_patches_as_sequence(tmp_path):
+    """blockexpand (ref BlockExpandLayer.cpp): sliding blocks become a
+    sequence of flattened patches; pinned against hand-sliced numpy,
+    including when the input arrives via the conv family's NHWC view."""
+    import textwrap
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu.config import parse_config
+    from paddle_tpu.graph import GradientMachine
+    from paddle_tpu.graph.argument import Argument
+
+    cfg_file = tmp_path / "conf.py"
+    cfg_file.write_text(textwrap.dedent("""
+    from paddle.trainer_config_helpers import *
+    settings(batch_size=2, learning_rate=0.1)
+    img = data_layer('image', size=2*4*4)
+    seq = block_expand_layer(input=img, channel=2, block_x=2, block_y=2,
+                             stride_x=2, stride_y=2, name='blocks')
+    outputs(seq)
+    """))
+    cfg = parse_config(str(cfg_file))
+    gm = GradientMachine(cfg.model_config)
+    params = gm.init_params(seed=1)
+    rng = np.random.RandomState(0)
+    img = rng.rand(2, 2, 4, 4).astype(np.float32)  # [B, C, H, W]
+    outputs, _ = gm.forward(
+        params, {"image": Argument(value=jnp.asarray(img.reshape(2, -1)))}
+    )
+    out = outputs["blocks"]
+    got = np.asarray(out.value)
+    assert got.shape == (2, 4, 2 * 2 * 2)  # 2x2 grid of blocks, C*by*bx wide
+    assert np.asarray(out.seq_lengths).tolist() == [4, 4]
+    # block (0,0) of sample 0 = channels-major flatten of img[0,:,0:2,0:2]
+    np.testing.assert_allclose(got[0, 0], img[0, :, 0:2, 0:2].reshape(-1), rtol=1e-6)
+    # block (1,1) = img[:, 2:4, 2:4]
+    np.testing.assert_allclose(got[0, 3], img[0, :, 2:4, 2:4].reshape(-1), rtol=1e-6)
+
+    # NHWC-view path: a conv producer publishes into ctx.nhwc; blockexpand
+    # over the conv output must equal hand-sliced patches of the conv's
+    # own flat output
+    cfg_file2 = tmp_path / "conf2.py"
+    cfg_file2.write_text(textwrap.dedent("""
+    from paddle.trainer_config_helpers import *
+    settings(batch_size=2, learning_rate=0.1)
+    img = data_layer('image', size=2*4*4)
+    c = img_conv_layer(input=img, num_channels=2, num_filters=3, filter_size=3,
+                       padding=1, act=ReluActivation(), name='c1')
+    seq = block_expand_layer(input=c, channel=3, block_x=2, block_y=2,
+                             stride_x=2, stride_y=2, name='blocks')
+    outputs(seq)
+    """))
+    cfg2 = parse_config(str(cfg_file2))
+    gm2 = GradientMachine(cfg2.model_config)
+    params2 = gm2.init_params(seed=2)
+    outputs2, _ = gm2.forward(
+        params2, {"image": Argument(value=jnp.asarray(img.reshape(2, -1)))}
+    )
+    conv_out = np.asarray(outputs2["c1"].value).reshape(2, 3, 4, 4)
+    blocks2 = np.asarray(outputs2["blocks"].value)
+    assert blocks2.shape == (2, 4, 3 * 2 * 2)
+    np.testing.assert_allclose(
+        blocks2[1, 0], conv_out[1, :, 0:2, 0:2].reshape(-1), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        blocks2[1, 3], conv_out[1, :, 2:4, 2:4].reshape(-1), rtol=1e-5, atol=1e-6)
