@@ -105,6 +105,16 @@ CASES = {
                  "vin": Argument(value=_r((B, 8), 2)),
                  "label": Argument(ids=_labels())},
     ),
+    "multiplex": (
+        "idx = data_layer('idx', size=2)\n"
+        "i1 = fc_layer(input=data_layer('x1', size=8), size=6, name='i1')\n"
+        "i2 = fc_layer(input=data_layer('x2', size=8), size=6, name='i2')\n"
+        "top = multiplex_layer(input=[idx, i1, i2])\n" + TAIL,
+        lambda: {"idx": Argument(ids=jnp.asarray([0, 1, 0, 1], jnp.int32)),
+                 "x1": Argument(value=_r((B, 8), 1)),
+                 "x2": Argument(value=_r((B, 8), 2)),
+                 "label": Argument(ids=_labels())},
+    ),
     "out_prod": (
         "a = fc_layer(input=data_layer('ain', size=8), size=4, name='a')\n"
         "b = fc_layer(input=data_layer('bin', size=8), size=3, name='b')\n"
