@@ -1,0 +1,133 @@
+"""Semantics of registry-only layer types (no DSL wrapper in the v0
+config surface — the reference constructs these straight from
+config_parser; here they're exercised at the forward_layer level).
+
+Covers: seqconcat, seqreshape, subseq, seqfirstins, resize,
+featmap_expand, data_norm, prelu, trans — each pinned against
+hand-computed numpy. Reference impls:
+SequenceConcatLayer/SequenceReshapeLayer/SubSequenceLayer/
+SequenceLastInstanceLayer (gserver/layers), ResizeLayer,
+FeatureMapExpandLayer, DataNormLayer, ParameterReluLayer, TransLayer.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.graph.argument import Argument
+from paddle_tpu.layers.base import LayerContext, layer_registry
+from paddle_tpu.proto import LayerConfig, LayerInputConfig, ModelConfig
+
+
+def _ctx(params=None):
+    return LayerContext(params=params or {}, model=ModelConfig(), pass_type="test")
+
+
+def _run(type_name, cfg, inputs, params=None):
+    return layer_registry.get(type_name)(cfg, inputs, _ctx(params))
+
+
+def test_seqconcat_places_b_after_a():
+    a = Argument(value=jnp.asarray(np.arange(12, dtype=np.float32).reshape(2, 2, 3)),
+                 seq_lengths=jnp.asarray([2, 1], jnp.int32))
+    b = Argument(value=jnp.asarray(100 + np.arange(12, dtype=np.float32).reshape(2, 2, 3)),
+                 seq_lengths=jnp.asarray([1, 2], jnp.int32))
+    out = _run("seqconcat", LayerConfig(name="sc", type="seqconcat", size=3), [a, b])
+    assert np.asarray(out.seq_lengths).tolist() == [3, 3]
+    v = np.asarray(out.value)
+    # sample 0: a[0,0], a[0,1], b[0,0]
+    np.testing.assert_array_equal(v[0, 0], [0, 1, 2])
+    np.testing.assert_array_equal(v[0, 1], [3, 4, 5])
+    np.testing.assert_array_equal(v[0, 2], [100, 101, 102])
+    # sample 1: a[1,0], b[1,0], b[1,1]
+    np.testing.assert_array_equal(v[1, 0], [6, 7, 8])
+    np.testing.assert_array_equal(v[1, 1], [106, 107, 108])
+    np.testing.assert_array_equal(v[1, 2], [109, 110, 111])
+
+
+def test_seqreshape_reinterprets_width():
+    a = Argument(value=jnp.asarray(np.arange(24, dtype=np.float32).reshape(2, 2, 6)),
+                 seq_lengths=jnp.asarray([2, 1], jnp.int32))
+    out = _run("seqreshape", LayerConfig(name="sr", type="seqreshape", size=3), [a])
+    v = np.asarray(out.value)
+    assert v.shape == (2, 4, 3)
+    np.testing.assert_array_equal(v[0, 0], [0, 1, 2])
+    np.testing.assert_array_equal(v[0, 1], [3, 4, 5])
+    # lengths scale by D/size = 2
+    assert np.asarray(out.seq_lengths).tolist() == [4, 2]
+
+
+def test_subseq_slices_offset_size():
+    a = Argument(value=jnp.asarray(np.arange(24, dtype=np.float32).reshape(2, 4, 3)),
+                 seq_lengths=jnp.asarray([4, 4], jnp.int32))
+    offs = Argument(ids=jnp.asarray([1, 0], jnp.int32))
+    sizes = Argument(ids=jnp.asarray([2, 3], jnp.int32))
+    out = _run("subseq", LayerConfig(name="ss", type="subseq", size=3), [a, offs, sizes])
+    v = np.asarray(out.value)
+    assert np.asarray(out.seq_lengths).tolist() == [2, 3]
+    np.testing.assert_array_equal(v[0, 0], [3, 4, 5])   # offset 1
+    np.testing.assert_array_equal(v[0, 1], [6, 7, 8])
+    np.testing.assert_array_equal(v[0, 2], [0, 0, 0])   # beyond size: zeroed
+    np.testing.assert_array_equal(v[1, 2], [18, 19, 20])
+
+
+def test_seqfirstins_takes_first_valid_frame():
+    a = Argument(value=jnp.asarray(np.arange(12, dtype=np.float32).reshape(2, 2, 3)),
+                 seq_lengths=jnp.asarray([2, 1], jnp.int32))
+    out = _run("seqfirstins", LayerConfig(name="fi", type="seqfirstins", size=3), [a])
+    v = np.asarray(out.value)
+    np.testing.assert_array_equal(v[0], [0, 1, 2])
+    np.testing.assert_array_equal(v[1], [6, 7, 8])
+
+
+def test_resize_reinterprets_rows():
+    a = Argument(value=jnp.asarray(np.arange(12, dtype=np.float32).reshape(2, 6)))
+    out = _run("resize", LayerConfig(name="rz", type="resize", size=3), [a])
+    v = np.asarray(out.value)
+    assert v.shape == (4, 3)
+    np.testing.assert_array_equal(v[1], [3, 4, 5])
+
+
+def test_featmap_expand_tiles_features():
+    a = Argument(value=jnp.asarray(np.arange(6, dtype=np.float32).reshape(1, 2, 3)),
+                 seq_lengths=jnp.asarray([2], jnp.int32))
+    out = _run("featmap_expand",
+               LayerConfig(name="fe", type="featmap_expand", size=6, num_filters=2), [a])
+    v = np.asarray(out.value)
+    assert v.shape == (1, 2, 6)
+    np.testing.assert_array_equal(v[0, 0], [0, 1, 2, 0, 1, 2])
+
+
+def test_data_norm_zscore_from_stats_param():
+    cfg = LayerConfig(name="dn", type="data_norm", size=2,
+                      data_norm_strategy="z-score")
+    cfg.inputs.append(LayerInputConfig(input_layer_name="x",
+                                       input_parameter_name="dn.stats"))
+    # stats rows: min, max, sum, sum_sq, count over 4 observations
+    xs = np.array([[1.0, 10.0], [2.0, 20.0], [3.0, 30.0], [4.0, 40.0]], np.float32)
+    stats = np.stack([
+        xs.min(0), xs.max(0), xs.sum(0), (xs ** 2).sum(0),
+        np.full(2, 4.0, np.float32),
+    ])
+    a = Argument(value=jnp.asarray(xs))
+    out = _run("data_norm", cfg, [a], params={"dn.stats": jnp.asarray(stats)})
+    mean, std = xs.mean(0), xs.std(0)
+    np.testing.assert_allclose(np.asarray(out.value), (xs - mean) / std, rtol=1e-5)
+
+
+def test_prelu_per_partition_slopes():
+    cfg = LayerConfig(name="pr", type="prelu", size=4, partial_sum=2)
+    cfg.inputs.append(LayerInputConfig(input_layer_name="x",
+                                       input_parameter_name="pr.w"))
+    x = np.array([[1.0, -1.0, 2.0, -2.0]], np.float32)
+    w = np.array([0.1, 0.5], np.float32)  # two partitions of width 2
+    out = _run("prelu", cfg, [Argument(value=jnp.asarray(x))],
+               params={"pr.w": jnp.asarray(w)})
+    np.testing.assert_allclose(
+        np.asarray(out.value), [[1.0, -0.1, 2.0, -1.0]], rtol=1e-6)
+
+
+def test_trans_transposes_batch_matrix():
+    a = Argument(value=jnp.asarray(np.arange(6, dtype=np.float32).reshape(2, 3)))
+    out = _run("trans", LayerConfig(name="tr", type="trans", size=3), [a])
+    np.testing.assert_array_equal(np.asarray(out.value),
+                                  np.arange(6).reshape(2, 3).T)
