@@ -33,13 +33,22 @@ def bench(fn, q, k, v, steps=10):
 
 
 def main():
-    Ts = [int(t) for t in sys.argv[1:]] or [1024, 4096, 8192]
-    B, H, D = 4, 8, 64
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        Ts = [int(t) for t in sys.argv[1:]] or [1024, 4096, 8192]
+        B, H, D = 4, 8, 64
+    else:
+        # CPU: pallas only runs interpreted — tiny shapes, smoke not perf
+        print("no TPU backend: interpret-mode smoke at toy shapes "
+              "(timings are NOT kernel performance)")
+        Ts = [int(t) for t in sys.argv[1:]] or [256]
+        B, H, D = 1, 2, 64
     for T in Ts:
         rng = np.random.RandomState(0)
         mk = lambda: jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
         q, k, v = mk(), mk(), mk()
-        flash = bench(lambda q, k, v: flash_attention(q, k, v, causal=True), q, k, v)
+        flash = bench(lambda q, k, v: flash_attention(
+            q, k, v, causal=True, interpret=not on_tpu), q, k, v, steps=10 if on_tpu else 1)
         try:
             xla = bench(_xla_attn, q, k, v)
         except Exception:  # OOM at long T is the point
