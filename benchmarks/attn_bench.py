@@ -34,14 +34,16 @@ def bench(fn, q, k, v, steps=10):
 
 def main():
     on_tpu = jax.default_backend() == "tpu"
+    cli_ts = [int(t) for t in sys.argv[1:]]
     if on_tpu:
-        Ts = [int(t) for t in sys.argv[1:]] or [1024, 4096, 8192]
+        Ts = cli_ts or [1024, 4096, 8192]
         B, H, D = 4, 8, 64
     else:
-        # CPU: pallas only runs interpreted — tiny shapes, smoke not perf
+        # any non-TPU backend: pallas only runs interpreted — tiny
+        # shapes, smoke not perf
         print("no TPU backend: interpret-mode smoke at toy shapes "
               "(timings are NOT kernel performance)")
-        Ts = [int(t) for t in sys.argv[1:]] or [256]
+        Ts = cli_ts or [256]
         B, H, D = 1, 2, 64
     for T in Ts:
         rng = np.random.RandomState(0)
