@@ -3,27 +3,90 @@
 Seven aligned integer sequences per sample: word ids, the predicate
 broadcast to sentence length, three context-window features, the 0/1
 predicate mark, and the target labels.
+
+Real mode: when the config passes src_dict/tgt_dict paths (written by
+prepare_data.py), file-list entries are 7-field feature lines
+('sentence \t verb \t ctx_n1 \t ctx_0 \t ctx_p1 \t mark \t labels') and
+words map through the dicts with <unk>=0 — the reference provider's
+contract. Default: deterministic synthetic sentences from common.py.
 """
+
+import os
 
 from paddle.trainer.PyDataProvider2 import *
 
 import common
 
+UNK_IDX = 0
 
-def hook(settings, **kwargs):
+
+def _load_dicts(settings, src_dict, tgt_dict):
+    if bool(src_dict) != bool(tgt_dict):
+        raise ValueError(
+            "real mode needs BOTH src_dict and tgt_dict "
+            f"(got src_dict={src_dict!r}, tgt_dict={tgt_dict!r})"
+        )
+    if src_dict and tgt_dict:
+        from paddle_tpu.data import datasets
+
+        settings.word_dict = datasets.load_dict(src_dict)
+        settings.label_dict = datasets.load_dict(tgt_dict)
+        return len(settings.word_dict), len(settings.label_dict)
+    settings.word_dict = settings.label_dict = None
+    return len(common.WORDS), len(common.LABELS)
+
+
+def hook(settings, src_dict=None, tgt_dict=None, **kwargs):
+    words, labels = _load_dicts(settings, src_dict, tgt_dict)
     settings.input_types = [
-        integer_value_sequence(len(common.WORDS)),
-        integer_value_sequence(len(common.WORDS)),
-        integer_value_sequence(len(common.WORDS)),
-        integer_value_sequence(len(common.WORDS)),
-        integer_value_sequence(len(common.WORDS)),
+        integer_value_sequence(words),
+        integer_value_sequence(words),
+        integer_value_sequence(words),
+        integer_value_sequence(words),
+        integer_value_sequence(words),
         integer_value_sequence(2),
-        integer_value_sequence(len(common.LABELS)),
+        integer_value_sequence(labels),
     ]
+
+
+def _real_samples(settings, file_name):
+    wd, ld = settings.word_dict, settings.label_dict
+    with open(file_name) as f:
+        for line in f:
+            parts = line.rstrip("\n").split("\t")
+            if len(parts) != 7:
+                continue
+            sentence, verb, ctx_n1, ctx_0, ctx_p1, mark, labels = parts
+            words = sentence.split()
+            n = len(words)
+            try:
+                # gold labels must be in-dict — mapping an unseen tag to
+                # id 0 would silently score against wrong labels
+                label_ids = [ld[l] for l in labels.split()]
+            except KeyError as e:
+                raise KeyError(
+                    f"{file_name}: label {e.args[0]!r} not in tgt.dict — "
+                    "regenerate dicts with prepare_data.py over this split"
+                ) from None
+            yield (
+                [wd.get(w, UNK_IDX) for w in words],
+                [wd.get(verb, UNK_IDX)] * n,
+                [wd.get(ctx_n1, UNK_IDX)] * n,
+                [wd.get(ctx_0, UNK_IDX)] * n,
+                [wd.get(ctx_p1, UNK_IDX)] * n,
+                [int(m) for m in mark.split()],
+                label_ids,
+            )
 
 
 @provider(init_hook=hook)
 def process(settings, file_name):
+    if settings.word_dict is not None:
+        if not os.path.exists(file_name):
+            # real mode was requested: never fall back to synthetic silently
+            raise FileNotFoundError(f"feature file not found: {file_name}")
+        yield from _real_samples(settings, file_name)
+        return
     for words, verb, labels in common.synth_sentences(file_name):
         n = len(words)
         verb_id = words[verb]

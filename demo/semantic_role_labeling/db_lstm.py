@@ -21,8 +21,19 @@ lr_mult = get_config_arg("lr_mult", float, 1e-2)
 drop_rate = get_config_arg("drop_rate", float, 0.5)
 hidden_dim = get_config_arg("hidden_dim", int, 128)
 
-word_dict_len = len(common.WORDS)
-label_dict_len = len(common.LABELS)
+# real-corpus mode (--config_args=src_dict=...,tgt_dict=...): dims come
+# from the converter-written dicts (prepare_data.py)
+src_dict = get_config_arg("src_dict", str, "")
+tgt_dict = get_config_arg("tgt_dict", str, "")
+if bool(src_dict) != bool(tgt_dict):
+    raise ValueError("real mode needs BOTH src_dict and tgt_dict config args")
+if src_dict and tgt_dict:
+    from paddle_tpu.data import datasets
+    word_dict_len = len(datasets.load_dict(src_dict))
+    label_dict_len = len(datasets.load_dict(tgt_dict))
+else:
+    word_dict_len = len(common.WORDS)
+    label_dict_len = len(common.LABELS)
 mark_dict_len = 2
 word_dim = 32
 mark_dim = 5
@@ -33,7 +44,7 @@ if not is_predict:
         test_list="test.list",
         module="dataprovider",
         obj="process",
-        args={},
+        args={"src_dict": src_dict, "tgt_dict": tgt_dict},
     )
 
 settings(

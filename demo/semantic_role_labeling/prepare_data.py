@@ -1,0 +1,175 @@
+"""Convert raw CoNLL-2005-style SRL data into provider feature lines.
+
+Role analog of the reference's demo/semantic_role_labeling/data pipeline
+(get_data.sh fetch + extract_pairs.py + extract_dict_feature.py), minus
+the network fetch — point --words / --props at already-downloaded
+conll05st-release files:
+
+  words file: one token per line, blank line between sentences;
+  props file: per-token rows, column 0 = predicate lemma (or '-'),
+              one bracketed-span label column per predicate
+              ('(A0*', '*', '*)', '(V*)'), blank line between sentences.
+
+Span columns become B-/I-/O tags (the reference's transform_labels walk),
+then each (sentence, predicate) pair becomes one feature line:
+
+  sentence \t verb \t ctx_n1 \t ctx_0 \t ctx_p1 \t mark \t labels
+
+— the exact format demo dataprovider.py reads in real mode. Outputs under
+--out (default data/srl-out): train.txt (+ test.txt when --test_words /
+--test_props given), src.dict / tgt.dict (word id 0 = <unk>),
+train.list / test.list.
+
+Then train with
+  --config_args=src_dict=data/srl-out/src.dict,tgt_dict=data/srl-out/tgt.dict
+and the file lists pointing at the written lists.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+from paddle_tpu.data import datasets
+
+
+def _read_blocks(path):
+    """Yield lists of non-empty lines, split on blank lines."""
+    block = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                if block:
+                    yield block
+                block = []
+            else:
+                block.append(line)
+    if block:
+        yield block
+
+
+def _span_to_tags(col):
+    """One predicate's bracketed-span column -> B-/I-/O tag sequence
+    (reference transform_labels semantics)."""
+    tags, current, inside = [], "O", False
+    for ll in col:
+        if ll == "*":
+            tags.append("I-" + current if inside else "O")
+        elif ll == "*)":
+            tags.append("I-" + current)
+            inside = False
+        elif "(" in ll and ")" in ll:
+            current = ll[1 : ll.find("*")]
+            tags.append("B-" + current)
+            inside = False
+        elif "(" in ll:
+            current = ll[1 : ll.find("*")]
+            tags.append("B-" + current)
+            inside = True
+        else:
+            raise ValueError(f"unparseable span token {ll!r}")
+    return tags
+
+
+def _feature_lines(words_path, props_path):
+    """Yield the 7-field feature lines for every (sentence, predicate).
+
+    Context/mark semantics mirror the reference's extract_dict_feature.py
+    bit-exactly, INCLUDING its boundary quirk: a predicate at the
+    second-to-last position gets ctx_p1='eos' and no +1 mark (the
+    reference tests `verb_index < len - 2`, not `len - 1`)."""
+    import itertools
+
+    sent_no = 0
+    for words_block, props_block in itertools.zip_longest(
+        _read_blocks(words_path), _read_blocks(props_path)
+    ):
+        sent_no += 1
+        if words_block is None or props_block is None:
+            raise ValueError(
+                f"words/props sentence counts differ at sentence {sent_no} "
+                f"({words_path} vs {props_path})"
+            )
+        if len(words_block) != len(props_block):
+            raise ValueError(
+                f"sentence {sent_no}: {len(words_block)} words but "
+                f"{len(props_block)} prop rows"
+            )
+        sentence = [w.lower() for w in words_block]
+        rows = [p.split() for p in props_block]
+        n_cols = {len(r) for r in rows}
+        if len(n_cols) != 1:
+            raise ValueError(
+                f"sentence {sent_no}: ragged props rows (column counts {sorted(n_cols)})"
+            )
+        n_preds = len(rows[0]) - 1
+        for j in range(n_preds):
+            tags = _span_to_tags([r[j + 1] for r in rows])
+            if "B-V" not in tags:
+                continue
+            verb_index = tags.index("B-V")
+            verb = sentence[verb_index]
+            mark = ["0"] * len(sentence)
+            mark[verb_index] = "1"
+            if verb_index > 0:
+                mark[verb_index - 1] = "1"
+                ctx_n1 = sentence[verb_index - 1]
+            else:
+                ctx_n1 = "bos"
+            if verb_index < len(sentence) - 2:
+                mark[verb_index + 1] = "1"
+                ctx_p1 = sentence[verb_index + 1]
+            else:
+                ctx_p1 = "eos"
+            yield (
+                " ".join(sentence), verb, ctx_n1, verb, ctx_p1,
+                " ".join(mark), " ".join(tags),
+            )
+
+
+def convert(words_path, props_path, out_dir, test_words=None, test_props=None,
+            max_dict: int = 30000):
+    """Returns (n_train, n_test, src_dict_size, tgt_dict_size)."""
+    os.makedirs(out_dir, exist_ok=True)
+    train = list(_feature_lines(words_path, props_path))
+    test = list(_feature_lines(test_words, test_props)) if test_words and test_props else []
+    if not train:
+        raise ValueError(f"no (sentence, predicate) pairs found in {words_path}")
+
+    src_words = datasets.build_dict(
+        (line[0].split() + [line[1], line[2], line[4]] for line in train),
+        max_size=max_dict, reserved=("<unk>",))
+    tgt_words = datasets.build_dict((line[6].split() for line in train))
+    datasets.save_dict(src_words, os.path.join(out_dir, "src.dict"))
+    datasets.save_dict(tgt_words, os.path.join(out_dir, "tgt.dict"))
+
+    for name, rows in (("train", train), ("test", test)):
+        if not rows and name == "test":
+            continue
+        with open(os.path.join(out_dir, f"{name}.txt"), "w") as f:
+            for row in rows:
+                f.write("\t".join(row) + "\n")
+        with open(os.path.join(out_dir, f"{name}.list"), "w") as f:
+            f.write(os.path.abspath(os.path.join(out_dir, f"{name}.txt")) + "\n")
+    return len(train), len(test), len(src_words), len(tgt_words)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--words", required=True, help="conll05 words file")
+    ap.add_argument("--props", required=True, help="conll05 props file")
+    ap.add_argument("--test_words")
+    ap.add_argument("--test_props")
+    ap.add_argument("--out", default="data/srl-out")
+    args = ap.parse_args()
+    nt, ns, ds, dt = convert(args.words, args.props, args.out,
+                             args.test_words, args.test_props)
+    print(f"wrote {nt} train / {ns} test pairs, dicts src={ds} tgt={dt} under {args.out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -228,3 +228,57 @@ def test_converted_corpus_trains_quick_start(tmp_path):
     finally:
         os.chdir(cwd)
     assert metrics["cost"] < 0.65, metrics  # learns above chance (ln2=0.693)
+
+
+def test_srl_conll_converter(tmp_path):
+    """prepare_data.py for SRL: raw CoNLL-05-style words+props files ->
+    feature lines + dicts (extract_pairs + extract_dict_feature roles),
+    consumed end-to-end by the demo provider."""
+    words = tmp_path / "train.words"
+    props = tmp_path / "train.props"
+    # two sentences; first has TWO predicates (two feature lines)
+    words.write_text(
+        "The\ncat\nsat\ndown\n\nDogs\nbark\n\n"
+    )
+    props.write_text(
+        "-    (A0*  *\n"
+        "-    *)    (A0*)\n"
+        "sit  (V*)  *\n"
+        "down *     (V*)\n"
+        "\n"
+        "-    (A0*)\n"
+        "bark (V*)\n"
+        "\n"
+    )
+    pd = _demo_module("semantic_role_labeling", "prepare_data")
+    out = tmp_path / "srl-out"
+    n_train, n_test, ds, dt = pd.convert(str(words), str(props), str(out))
+    assert n_train == 3 and n_test == 0  # 2 + 1 predicates
+
+    from paddle_tpu.data import datasets
+
+    src = datasets.load_dict(str(out / "src.dict"))
+    tgt = datasets.load_dict(str(out / "tgt.dict"))
+    assert src["<unk>"] == 0 and "cat" in src
+    assert {"B-V", "B-A0", "O"} <= set(tgt)
+
+    lines = (out / "train.txt").read_text().strip().splitlines()
+    first = lines[0].split("\t")
+    assert first[0] == "the cat sat down"
+    assert first[1] == "sat"                      # B-V position
+    # reference extract_dict_feature quirk: a second-to-last predicate
+    # gets no +1 mark and ctx_p1='eos'
+    assert first[5].split() == ["0", "1", "1", "0"]
+    assert first[4] == "eos"
+    assert first[6].split() == ["B-A0", "I-A0", "B-V", "O"]
+
+    dp = _demo_module("semantic_role_labeling", "dataprovider")
+    settings = dp.process.init(src_dict=str(out / "src.dict"),
+                               tgt_dict=str(out / "tgt.dict"))
+    samples = list(dp.process.generator_fn(settings, str(out / "train.txt")))
+    assert len(samples) == 3
+    ws, verb, n1, c0, p1, mark, labels = samples[0]
+    assert len(ws) == len(labels) == 4
+    assert verb == [src["sat"]] * 4
+    assert mark == [0, 1, 1, 0]  # reference boundary quirk (see converter)
+    assert labels[2] == tgt["B-V"]
