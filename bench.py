@@ -43,6 +43,10 @@ sys.path.insert(0, REPO)
 PROBE_TIMEOUT_S = float(os.environ.get("PADDLE_TPU_BENCH_PROBE_TIMEOUT", "180"))
 BENCH_DTYPE = os.environ.get("PADDLE_TPU_BENCH_DTYPE", "bfloat16")
 TRACE_DIR = os.environ.get("PADDLE_TPU_BENCH_TRACE_DIR", "")
+# which leg's timed window to trace when TRACE_DIR is set: the resnet
+# headline always traces; "lstm"/"nmt" trace that leg instead (one trace
+# per run keeps the xplane dirs unambiguous)
+TRACE_LEG = os.environ.get("PADDLE_TPU_BENCH_TRACE_LEG", "")
 
 
 def _jit_train_step(tc):
@@ -183,7 +187,7 @@ def bench_resnet50(B=None, img_size=224, classes=1000, steps=20, warmup=3, trace
         batch = make_image_batch(b, img_size, classes)
         dt, flops = _time_steps(
             step, params, opt_state, batch, jnp.asarray(float(b)), steps, warmup,
-            trace=trace,
+            trace=trace and TRACE_LEG in ("", "resnet"),
         )
         m, kind = _mfu_of(flops, dt, steps)
         extras = {"device_kind": kind, "dtype": tc.opt_config.dtype, "batch": b}
@@ -210,7 +214,10 @@ def bench_lstm_classifier(B=256, T=64, steps=20, warmup=3, dtype=None):
     tc.opt_config.dtype = dtype or BENCH_DTYPE
     step, params, opt_state = _jit_train_step(tc)
     batch = example_batch(dict_dim=10000, B=B, T=T)
-    dt, flops = _time_steps(step, params, opt_state, batch, jnp.asarray(float(B)), steps, warmup)
+    dt, flops = _time_steps(
+        step, params, opt_state, batch, jnp.asarray(float(B)), steps, warmup,
+        trace=TRACE_LEG == "lstm",
+    )
     m, _ = _mfu_of(flops, dt, steps)
     return B * T * steps / dt, {"mfu": m, "dtype": tc.opt_config.dtype}
 
@@ -231,7 +238,8 @@ def bench_nmt(B=None, T=32, vocab=30000, dim=512, steps=10, warmup=2, dtype=None
         step, params, opt_state = _jit_train_step(tc)
         batch = nmt_batch(vocab=vocab, B=b, T=T)
         dt, flops = _time_steps(
-            step, params, opt_state, batch, jnp.asarray(float(b)), steps, warmup
+            step, params, opt_state, batch, jnp.asarray(float(b)), steps, warmup,
+            trace=TRACE_LEG == "nmt",
         )
         m, _ = _mfu_of(flops, dt, steps)
         return b * T * steps / dt, {

@@ -29,8 +29,20 @@ for u in 4 8; do
   PADDLE_TPU_BENCH_UNROLL=$u PADDLE_TPU_BENCH_BUDGET=600 \
     timeout 700 python bench.py nmt >> $OUT 2>>$ERR
 done
-echo "--- trace summary" >> $OUT
+# per-leg traces for the recurrent flagships (the headline trace above
+# covers resnet only)
+for leg in lstm nmt; do
+  echo "--- traced $leg" >> $OUT
+  mkdir -p benchmarks/traces_$leg
+  PADDLE_TPU_BENCH_TRACE_LEG=$leg PADDLE_TPU_BENCH_TRACE_DIR=$PWD/benchmarks/traces_$leg \
+    PADDLE_TPU_BENCH_BUDGET=600 timeout 700 python bench.py $leg >> $OUT 2>>$ERR
+done
+echo "--- trace summary (resnet)" >> $OUT
 python benchmarks/trace_summary.py benchmarks/traces 15 >> $OUT 2>>$ERR
+for leg in lstm nmt; do
+  echo "--- trace summary ($leg)" >> $OUT
+  python benchmarks/trace_summary.py benchmarks/traces_$leg 15 >> $OUT 2>>$ERR
+done
 echo "=== session done $(date -u)" >> $OUT
 cat $OUT >> $CUM
 # format measured rows into the append-only log so an unattended

@@ -20,6 +20,16 @@ import common
 UNK_IDX = 0
 
 
+def dict_dims(src_dict="", tgt_dict=""):
+    """Layer dims for db_lstm.py: converter dict sizes in real mode, the
+    synthetic vocab otherwise — one definition shared with the provider
+    hook so config dims can never diverge from the mapping."""
+    class _Bag:  # throwaway attribute bag; _load_dicts sets dict attrs
+        pass
+
+    return _load_dicts(_Bag(), src_dict, tgt_dict)
+
+
 def _load_dicts(settings, src_dict, tgt_dict):
     if bool(src_dict) != bool(tgt_dict):
         raise ValueError(

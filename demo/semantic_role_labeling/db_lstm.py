@@ -25,15 +25,8 @@ hidden_dim = get_config_arg("hidden_dim", int, 128)
 # from the converter-written dicts (prepare_data.py)
 src_dict = get_config_arg("src_dict", str, "")
 tgt_dict = get_config_arg("tgt_dict", str, "")
-if bool(src_dict) != bool(tgt_dict):
-    raise ValueError("real mode needs BOTH src_dict and tgt_dict config args")
-if src_dict and tgt_dict:
-    from paddle_tpu.data import datasets
-    word_dict_len = len(datasets.load_dict(src_dict))
-    label_dict_len = len(datasets.load_dict(tgt_dict))
-else:
-    word_dict_len = len(common.WORDS)
-    label_dict_len = len(common.LABELS)
+import dataprovider as _dp
+word_dict_len, label_dict_len = _dp.dict_dims(src_dict, tgt_dict)
 mark_dict_len = 2
 word_dim = 32
 mark_dim = 5

@@ -60,6 +60,8 @@ def _span_to_tags(col):
         if ll == "*":
             tags.append("I-" + current if inside else "O")
         elif ll == "*)":
+            if not inside:
+                raise ValueError("span close '*)' with no open span")
             tags.append("I-" + current)
             inside = False
         elif "(" in ll and ")" in ll:
@@ -143,7 +145,9 @@ def convert(words_path, props_path, out_dir, test_words=None, test_props=None,
     src_words = datasets.build_dict(
         (line[0].split() + [line[1], line[2], line[4]] for line in train),
         max_size=max_dict, reserved=("<unk>",))
-    tgt_words = datasets.build_dict((line[6].split() for line in train))
+    # label tags are a closed set: build the dict over BOTH splits so a
+    # test-only tag can never fall outside it
+    tgt_words = datasets.build_dict((line[6].split() for line in train + test))
     datasets.save_dict(src_words, os.path.join(out_dir, "src.dict"))
     datasets.save_dict(tgt_words, os.path.join(out_dir, "tgt.dict"))
 
