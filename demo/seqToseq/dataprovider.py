@@ -27,6 +27,8 @@ def dict_dims(src_dict_path="", trg_dict_path=""):
     """Layer dims for train.conf/gen.conf: converter dict sizes in real
     mode, the synthetic VOCAB otherwise. One definition so config-declared
     dims can never diverge from the provider's mapping."""
+    if bool(src_dict_path) != bool(trg_dict_path):
+        raise ValueError("real mode needs BOTH src_dict and trg_dict config args")
     if src_dict_path and trg_dict_path:
         from paddle_tpu.data import datasets
 
@@ -36,6 +38,11 @@ def dict_dims(src_dict_path="", trg_dict_path=""):
 
 
 def _load_dicts(settings, src_dict_path, trg_dict_path):
+    if bool(src_dict_path) != bool(trg_dict_path):
+        raise ValueError(
+            "real mode needs BOTH src_dict and trg_dict "
+            f"(got src_dict={src_dict_path!r}, trg_dict={trg_dict_path!r})"
+        )
     if src_dict_path and trg_dict_path:
         from paddle_tpu.data import datasets
 
