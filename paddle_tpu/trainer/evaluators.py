@@ -344,21 +344,35 @@ class PnpairEvaluator(Evaluator):
             by_q[q].append((l, s, w))
         pos_minus_neg = 0.0
         total = 0.0
+        CHUNK = 256  # bounds pair-walk temporaries to CHUNK*n entries
         for items in by_q.values():
-            for i in range(len(items)):
-                for j in range(i + 1, len(items)):
-                    li, si, wi = items[i]
-                    lj, sj, wj = items[j]
-                    if li == lj:
-                        continue
-                    w = (wi + wj) / 2.0  # reference pair weight
-                    total += w
-                    hi, lo = (si, sj) if li > lj else (sj, si)
-                    if hi > lo:
-                        pos_minus_neg += w
-                    elif hi == lo:
-                        pos_minus_neg += 0.5 * w
-        return {"pnpair_accuracy": pos_minus_neg / max(total, 1.0)}
+            # vectorized pair walk in row chunks (semantics identical to
+            # the reference's O(n^2) loop, PnpairEvaluator::stat: pair
+            # weight = mean of the two samples' weights, ties 0.5) —
+            # memory stays O(CHUNK*n) even when every record lands in one
+            # group (the no-qid default)
+            n = len(items)
+            l = np.asarray([it[0] for it in items], np.float64)
+            sc = np.asarray([it[1] for it in items], np.float64)
+            w = np.asarray([it[2] for it in items], np.float64)
+            col = np.arange(n)
+            for i0 in range(0, n - 1, CHUNK):
+                rows = np.arange(i0, min(i0 + CHUNK, n - 1))
+                pair = col[None, :] > rows[:, None]          # j > i
+                diff = pair & (l[None, :] != l[rows][:, None])
+                if not diff.any():
+                    continue
+                ri, cj = np.nonzero(diff)
+                iu, ju = rows[ri], cj
+                pw = (w[iu] + w[ju]) / 2.0
+                hi_is_i = l[iu] > l[ju]
+                hi = np.where(hi_is_i, sc[iu], sc[ju])
+                lo = np.where(hi_is_i, sc[ju], sc[iu])
+                total += float(pw.sum())
+                pos_minus_neg += float(pw[hi > lo].sum() + 0.5 * pw[hi == lo].sum())
+        # raw total as the denominator: max(total, 1) would deflate the
+        # metric whenever the total pair weight is < 1
+        return {"pnpair_accuracy": pos_minus_neg / total if total > 0 else 0.0}
 
 
 @register_evaluator("ctc_edit_distance")

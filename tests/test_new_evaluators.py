@@ -127,3 +127,47 @@ def test_validation_layers_parse_train_and_report(tmp_path):
     assert pnp and pnp[0] > 0.9, metrics
     # validation layers contribute zero cost (the real cost dominates)
     assert np.isfinite(metrics["cost"])
+
+
+def test_pnpair_vectorized_matches_reference_loop():
+    """The vectorized pair walk must agree with the reference's O(n^2)
+    loop semantics (PnpairEvaluator::stat: pair weight = mean of sample
+    weights, ties 0.5) on randomized grouped data."""
+    rng = np.random.RandomState(0)
+    e = ev.evaluator_registry.get("pnpair")(EvaluatorConfig(name="p", type="pnpair"))
+    n = 120
+    qids = rng.randint(0, 5, n)
+    labels = rng.randint(0, 3, n)
+    scores = np.round(rng.rand(n), 2)  # rounding forces ties
+    weights = rng.rand(n) + 0.5
+    e.records = list(zip(qids.tolist(), labels.tolist(),
+                         scores.tolist(), weights.tolist()))
+    got = e.result()["pnpair_accuracy"]
+    # sub-unit total pair weight must not deflate the metric
+    e2 = ev.evaluator_registry.get("pnpair")(EvaluatorConfig(name="p2", type="pnpair"))
+    e2.records = [(0, 1, 0.9, 0.5), (0, 0, 0.1, 0.5)]  # one pair, weight 0.5
+    assert e2.result()["pnpair_accuracy"] == 1.0
+
+    # reference loop
+    from collections import defaultdict
+
+    by_q = defaultdict(list)
+    for q, l, s, w in e.records:
+        by_q[q].append((l, s, w))
+    pos, total = 0.0, 0.0
+    for items in by_q.values():
+        for i in range(len(items)):
+            for j in range(i + 1, len(items)):
+                li, si, wi = items[i]
+                lj, sj, wj = items[j]
+                if li == lj:
+                    continue
+                w = (wi + wj) / 2.0
+                total += w
+                hi, lo = (si, sj) if li > lj else (sj, si)
+                if hi > lo:
+                    pos += w
+                elif hi == lo:
+                    pos += 0.5 * w
+    expected = pos / total
+    np.testing.assert_allclose(got, expected, rtol=1e-12)
