@@ -226,22 +226,40 @@ def batch_norm_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext
     else:
         C = cfg.size
         xr = x
-    # statistics and normalization run in (at least) f32 even when the
-    # activations are bf16 — bf16 mean/var over big batches is too lossy;
-    # gamma/beta/running stats are master-dtype params (cast=False)
+    # STATISTICS run in f32 even when activations are bf16 (bf16 mean/var
+    # over big batches is too lossy), but the full-size activation is never
+    # upcast: the reductions accumulate in f32 directly over the bf16 rows
+    # (XLA fuses the widening convert into the reduce) and the NORMALIZATION
+    # applies as a per-channel scale/offset in the activation dtype. The
+    # previous hp(xr)-then-normalize-in-f32 formulation materialized f32
+    # copies/reshapes of every BN input — ~60% of the ResNet-50 bf16 step's
+    # device time on TPU (see benchmarks/RESULTS.md round-4 trace analysis).
+    # gamma/beta/running stats are master-dtype params (cast=False).
     gamma = ctx.param(cfg.inputs[0].input_parameter_name, cast=False).reshape(C)
-    beta = ctx.param(cfg.bias_parameter_name, cast=False).reshape(C) if cfg.bias_parameter_name else 0.0
+    beta = (
+        ctx.param(cfg.bias_parameter_name, cast=False).reshape(C)
+        if cfg.bias_parameter_name
+        else None
+    )
     mean_name = cfg.inputs[1].input_parameter_name
     var_name = cfg.inputs[2].input_parameter_name
     eps = 1e-5
-    xr_hp = hp(xr)
     use_global = cfg.use_global_stats or not ctx.is_training
     if use_global:
         mean = ctx.params[mean_name].reshape(C)
         var = ctx.params[var_name].reshape(C)
+        centered = (hp(xr) - mean).astype(xr.dtype)
     else:
-        mean = jnp.mean(xr_hp, axis=0)
-        var = jnp.var(xr_hp, axis=0)
+        # at-least-f32 accumulation (f64 under the x64 gradient check)
+        acc_dt = jnp.promote_types(xr.dtype, jnp.float32)
+        mean = jnp.mean(xr, axis=0, dtype=acc_dt)
+        # center against the EXACT f32 mean (a bf16-rounded mean would
+        # bias every centered value and inflate the stored running var);
+        # the convert-sub-convert chain fuses, so no f32 tensor reaches
+        # HBM. Two-pass variance: no cancellation risk, unlike
+        # E[x^2]-E[x]^2 on bf16 squares.
+        centered = (hp(xr) - mean).astype(xr.dtype)
+        var = jnp.mean(jnp.square(centered), axis=0, dtype=acc_dt)
         f = cfg.moving_average_fraction
         ctx.state_updates[mean_name] = (
             f * ctx.params[mean_name].reshape(C) + (1.0 - f) * mean
@@ -249,7 +267,13 @@ def batch_norm_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext
         ctx.state_updates[var_name] = (
             f * ctx.params[var_name].reshape(C) + (1.0 - f) * var
         ).reshape(ctx.params[var_name].shape)
-    yn = ((xr_hp - mean) * lax.rsqrt(var + eps) * gamma + beta).astype(xr.dtype)
+    scale = hp(gamma) * lax.rsqrt(hp(var) + eps)  # f32 [C]
+    # center-then-scale in the activation dtype (both branches): folding
+    # the mean into a bf16 offset would cancel catastrophically for
+    # channels whose mean is large relative to their std
+    yn = centered * scale.astype(xr.dtype)
+    if beta is not None:
+        yn = yn + beta.astype(xr.dtype)
     if x_nhwc is not None and is_elementwise(cfg.active_type):
         y_img = apply_activation(cfg.active_type, yn.reshape(x_nhwc.shape))
         return _publish_nhwc(ctx, cfg, y_img)
