@@ -464,9 +464,12 @@ def _forward_scan(network, cfg: LayerConfig, sub: SubModelConfig, ctx: LayerCont
             network, ctx, epilogue, dyn_frontier, frs, statics, out_links,
             B, T, mask_bt, lengths,
         )
-    # the group layer itself exposes the first out-link
+    # the group layer itself exposes the first out-link (logits alias
+    # included, so a cost wired to the group name keeps the fused path)
     if out_links:
         ctx.outputs[cfg.name] = ctx.outputs[out_links[0].link_name]
+        if out_links[0].link_name in ctx.logits:
+            ctx.logits[cfg.name] = ctx.logits[out_links[0].link_name]
 
 
 def _run_epilogue(network, ctx, epilogue, dyn_frontier, frs, statics,
@@ -518,6 +521,15 @@ def _run_epilogue(network, ctx, epilogue, dyn_frontier, frs, statics,
         y = jnp.swapaxes(flat.reshape((T, B) + flat.shape[1:]), 0, 1)
         y = y * mask.astype(y.dtype)
         ctx.outputs[link.link_name] = Argument(value=y, seq_lengths=lengths)
+        z = epi_ctx.logits.get(link.layer_name)
+        if z is not None:
+            # re-publish the hoisted layer's pre-softmax logits under the
+            # out-link name so the fused cross-entropy path survives the
+            # hoist (the probabilities' transpose is then DCE-able when
+            # only the loss consumes this link)
+            ctx.logits[link.link_name] = jnp.swapaxes(
+                z.reshape((T, B) + z.shape[1:]), 0, 1
+            )
 
 
 # ------------------------------------------------------------ generation

@@ -78,6 +78,14 @@ class LayerContext:
     # consumer took the NHWC view). Recurrent groups build their own
     # context, so entries never cross a scan boundary.
     nhwc: Dict[str, Array] = field(default_factory=dict)
+    # pre-softmax logits side-table (layer name -> pre-activation array):
+    # finalize_output publishes here when the activation is a plain
+    # feature-axis softmax, so a downstream multi-class cross-entropy can
+    # compute fused log-softmax CE from the logits instead of
+    # re-upcasting the materialized probabilities ([B*T, V] f32 traffic
+    # at NMT vocab sizes). The softmax output stays authoritative for
+    # every other consumer and is DCE'd when only the loss reads it.
+    logits: Dict[str, Array] = field(default_factory=dict)
     # sparse-embedding prefetch (GradientMachine::prefetch analog): rows
     # pre-gathered outside autodiff, keyed by (param_name, input_layer);
     # the table projection returns these instead of gathering, so
@@ -137,6 +145,10 @@ def finalize_output(
     """Shared bias + activation + dropout tail of a layer forward."""
     if cfg.bias_parameter_name:
         value = value + ctx.param(cfg.bias_parameter_name)
+    # dropout after softmax would make the probabilities the only honest
+    # source, so the logits view is published only for dropout-free layers
+    if cfg.active_type == "softmax" and not cfg.drop_rate:
+        ctx.logits[cfg.name] = value
     value = apply_activation(cfg.active_type, value, mask)
     if cfg.drop_rate > 0.0 and ctx.is_training:
         keep = 1.0 - cfg.drop_rate
@@ -176,9 +188,10 @@ def forward_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -
         out = out.replace(
             value=_clip_error(out.value, float(cfg.error_clipping_threshold))
         )
-        # a published NHWC view would bypass the clip wrapper — drop it so
-        # every consumer goes through the clipped flat value
+        # a published NHWC or logits view would bypass the clip wrapper —
+        # drop them so every consumer goes through the clipped value
         ctx.nhwc.pop(cfg.name, None)
+        ctx.logits.pop(cfg.name, None)
     ctx.outputs[cfg.name] = out
     return out
 

@@ -26,6 +26,9 @@ from paddle_tpu.ops.precision import hp as _hp
 
 Array = jax.Array
 _EPS = 1e-10
+# test hook: force the probability-path cross-entropy (parity tests
+# compare the fused-logits formulation against it on the same graph)
+_USE_FUSED_CE = True
 
 
 def _finish_cost(cfg: LayerConfig, per_step: Array, arg: Argument, weight_arg: Optional[Argument]) -> Argument:
@@ -47,14 +50,37 @@ def _label_ids(label: Argument) -> Array:
     return jnp.argmax(label.value, axis=-1).astype(jnp.int32)
 
 
+def _fused_softmax_ce(z: Array, ids: Array) -> Array:
+    """-log softmax(z)[ids] from logits, never materializing the
+    full-width probabilities in f32: the max is exact in any float dtype,
+    exp runs in the logits dtype, and only the reduction accumulates in
+    (at least) f32 — XLA fuses the widening convert into the reduce. The
+    gradient autodiff derives is softmax(z) - onehot in the logits dtype,
+    the standard mixed-precision formulation."""
+    m = jax.lax.stop_gradient(jnp.max(z, axis=-1, keepdims=True))
+    acc = jnp.promote_types(z.dtype, jnp.float32)
+    se = jnp.sum(jnp.exp(z - m), axis=-1, dtype=acc)
+    lse = _hp(jnp.squeeze(m, -1)) + jnp.log(se)
+    picked = _hp(jnp.take_along_axis(z, ids[..., None], axis=-1)[..., 0])
+    return lse - picked
+
+
 @register_layer("multi-class-cross-entropy")
 def multi_class_cross_entropy(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Argument:
     # inputs: [probabilities (post-softmax), label(, weight)]
     out, label = inputs[0], inputs[1]
     weight = inputs[2] if len(inputs) > 2 else None
     ids = _label_ids(label)
-    p = jnp.take_along_axis(_hp(out.value), ids[..., None], axis=-1)[..., 0]
-    per_step = -jnp.log(jnp.clip(p, _EPS, None))
+    z = (
+        ctx.logits.get(cfg.inputs[0].input_layer_name)
+        if _USE_FUSED_CE and not cfg.inputs[0].input_layer_argument
+        else None
+    )
+    if z is not None and z.shape == out.value.shape:
+        per_step = _fused_softmax_ce(z, ids)
+    else:
+        p = jnp.take_along_axis(_hp(out.value), ids[..., None], axis=-1)[..., 0]
+        per_step = -jnp.log(jnp.clip(p, _EPS, None))
     return _finish_cost(cfg, per_step, out, weight)
 
 

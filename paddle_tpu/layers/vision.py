@@ -252,14 +252,20 @@ def batch_norm_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext
     else:
         # at-least-f32 accumulation (f64 under the x64 gradient check)
         acc_dt = jnp.promote_types(xr.dtype, jnp.float32)
+        # one-pass statistics: mean and E[x^2] are independent reductions
+        # over the same input, so XLA fuses them into a single traversal
+        # (a two-pass centered variance would read the activation twice —
+        # the var reduce depends on the mean). The squares are exact
+        # (bf16->f32 widening then f32 multiply inside the fusion);
+        # the E[x^2]-mean^2 cancellation at f32 only bites for channels
+        # with |mean|/std >~ 1e3, far beyond post-conv activations.
         mean = jnp.mean(xr, axis=0, dtype=acc_dt)
+        msq = jnp.mean(jnp.square(hp(xr)), axis=0, dtype=acc_dt)
+        var = jnp.maximum(msq - jnp.square(mean), 0.0)
         # center against the EXACT f32 mean (a bf16-rounded mean would
-        # bias every centered value and inflate the stored running var);
-        # the convert-sub-convert chain fuses, so no f32 tensor reaches
-        # HBM. Two-pass variance: no cancellation risk, unlike
-        # E[x^2]-E[x]^2 on bf16 squares.
+        # bias every centered value); the convert-sub-convert chain
+        # fuses, so no f32 tensor reaches HBM
         centered = (hp(xr) - mean).astype(xr.dtype)
-        var = jnp.mean(jnp.square(centered), axis=0, dtype=acc_dt)
         f = cfg.moving_average_fraction
         ctx.state_updates[mean_name] = (
             f * ctx.params[mean_name].reshape(C) + (1.0 - f) * mean

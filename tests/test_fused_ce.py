@@ -1,0 +1,129 @@
+"""Fused log-softmax cross-entropy (logits side-table).
+
+When a layer's activation is a plain softmax and a multi-class
+cross-entropy consumes it, the cost computes from the published
+pre-softmax logits (paddle_tpu/layers/cost.py `_fused_softmax_ce`)
+instead of re-upcasting the materialized probabilities — the TPU
+bandwidth fix for big-vocab losses (reference workload:
+demo/seqToseq, /root/reference/paddle/gserver/layers/CostLayer.cpp
+multi-class CE semantics). These tests pin (a) numerical equivalence
+with the probability-path formulation, (b) that the fused path actually
+engages for the direct-softmax and hoisted-epilogue (NMT) graphs, and
+(c) that dropout/error-clipping layers keep the honest probability path.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.graph import GradientMachine  # noqa: F401  (import order: graph before layers)
+from paddle_tpu.layers import cost as cost_mod
+
+
+def test_fused_matches_prob_path_values_and_grads():
+    rng = np.random.RandomState(0)
+    z = jnp.asarray(rng.randn(16, 50).astype("float32") * 3.0)
+    ids = jnp.asarray(rng.randint(0, 50, (16,)).astype("int32"))
+
+    def fused(z):
+        return jnp.sum(cost_mod._fused_softmax_ce(z, ids))
+
+    def probs(z):
+        p = jax.nn.softmax(z, axis=-1)
+        picked = jnp.take_along_axis(p, ids[:, None], axis=-1)[..., 0]
+        return jnp.sum(-jnp.log(picked))
+
+    np.testing.assert_allclose(fused(z), probs(z), rtol=1e-5)
+    gf, gp = jax.grad(fused)(z), jax.grad(probs)(z)
+    np.testing.assert_allclose(np.asarray(gf), np.asarray(gp), atol=1e-5)
+
+
+def _count_fused(monkeypatch):
+    calls = []
+    orig = cost_mod._fused_softmax_ce
+
+    def spy(z, ids):
+        calls.append(z.shape)
+        return orig(z, ids)
+
+    monkeypatch.setattr(cost_mod, "_fused_softmax_ce", spy)
+    return calls
+
+
+def _loss_of(tc, batch, seed=1):
+    from paddle_tpu.graph import GradientMachine
+
+    gm = GradientMachine(tc.model_config)
+    params = gm.init_params(seed=seed)
+    loss, _, _, _ = gm.grad_fn()(params, batch, jax.random.PRNGKey(0))
+    return float(loss)
+
+
+def test_fused_path_engages_for_softmax_classifier(monkeypatch):
+    from paddle_tpu.flagship import example_batch, flagship_config
+
+    calls = _count_fused(monkeypatch)
+    tc = flagship_config()
+    loss = _loss_of(tc, example_batch(B=4, T=8))
+    assert calls, "softmax classifier should take the fused CE path"
+    assert np.isfinite(loss) and loss < 2 * np.log(2)
+
+
+def test_fused_path_engages_for_hoisted_nmt(monkeypatch):
+    from paddle_tpu.flagship import nmt_batch, nmt_config
+
+    calls = _count_fused(monkeypatch)
+    tc = nmt_config(vocab=120, dim=16, batch_size=4)
+    loss = _loss_of(tc, nmt_batch(vocab=120, B=4, T=6))
+    # the vocab projection is hoisted out of the decoder scan; the fused
+    # path must survive via the re-published out-link logits
+    assert any(s[-1] == 120 for s in calls), calls
+    assert np.isfinite(loss)
+
+
+def test_fused_loss_matches_prob_loss_when_disabled(monkeypatch):
+    from paddle_tpu.flagship import nmt_batch, nmt_config
+
+    tc = nmt_config(vocab=80, dim=16, batch_size=4)
+    batch = nmt_batch(vocab=80, B=4, T=5)
+    fused_loss = _loss_of(tc, batch)
+    # forcing the probability path must agree in f32 — this catches any
+    # misalignment (transpose/reshape) in the hoisted logits re-publish
+    monkeypatch.setattr(cost_mod, "_USE_FUSED_CE", False)
+    prob_loss = _loss_of(tc, batch)
+    np.testing.assert_allclose(fused_loss, prob_loss, rtol=1e-5)
+
+
+def test_dropout_softmax_layer_keeps_prob_path(monkeypatch):
+    from paddle_tpu.config.builder import fresh_context
+    from paddle_tpu.trainer_config_helpers import (
+        ExtraAttr,
+        SoftmaxActivation,
+        classification_cost,
+        data_layer,
+        fc_layer,
+        outputs,
+        settings,
+    )
+
+    calls = _count_fused(monkeypatch)
+    with fresh_context() as ctx:
+        settings(batch_size=4, learning_rate=0.1)
+        x = data_layer(name="x", size=8)
+        out = fc_layer(input=x, size=4, act=SoftmaxActivation(),
+                       name="out", layer_attr=ExtraAttr(drop_rate=0.5))
+        label = data_layer(name="label", size=4)
+        outputs(classification_cost(input=out, label=label))
+        tc = ctx.finalize()
+
+    from paddle_tpu.graph.argument import make_dense, make_ids
+
+    rng = np.random.RandomState(0)
+    batch = {
+        "x": make_dense(rng.randn(4, 8).astype("float32")),
+        "label": make_ids(rng.randint(0, 4, (4,)).astype("int32")),
+    }
+    loss = _loss_of(tc, batch)
+    assert np.isfinite(loss)
+    assert not calls, "dropout-after-softmax must not take the logits path"
