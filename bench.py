@@ -29,7 +29,6 @@ a parseable JSON line with an "error" field rather than a traceback.
 
 from __future__ import annotations
 
-import functools
 import json
 import os
 import sys
@@ -52,7 +51,21 @@ TRACE_LEG = os.environ.get("PADDLE_TPU_BENCH_TRACE_LEG", "")
 # the small recurrent legs through the remote tunnel (device busy ~60%
 # on the lstm leg at k=1). Throughput semantics are unchanged: the same
 # batch is consumed per step either way, and the JSON reports the knob.
-STEPS_PER_LAUNCH = int(os.environ.get("PADDLE_TPU_BENCH_STEPS_PER_LAUNCH", "1"))
+# parsed leniently here; validated in main() so a bad value still flows
+# through the child's catch-all into the guaranteed bench_failed JSON line
+# instead of killing the supervisor before any JSON is printed
+_SPL_RAW = os.environ.get("PADDLE_TPU_BENCH_STEPS_PER_LAUNCH", "1")
+try:
+    STEPS_PER_LAUNCH = int(_SPL_RAW)
+except ValueError:
+    STEPS_PER_LAUNCH = 0  # out of range; rejected in main()
+
+
+def _leg_extras(**kw):
+    """Per-leg JSON extras; tags the fused-launch knob when it is active."""
+    if STEPS_PER_LAUNCH > 1:
+        kw["steps_per_launch"] = STEPS_PER_LAUNCH
+    return kw
 
 
 def _jit_train_step(tc):
@@ -100,7 +113,9 @@ def _jit_train_step(tc):
 
 
 def _time_steps(step, params, opt_state, batch, bs, steps, warmup, trace=False):
-    """Returns (elapsed seconds, flops-per-step or None)."""
+    """Returns (elapsed seconds, flops-per-LAUNCH or None) — a launch is
+    STEPS_PER_LAUNCH fused optimizer steps, and the elapsed time likewise
+    covers ``steps`` launches, so callers must treat both as per-launch."""
     import jax
 
     from benchmarks.mfu import flops_of_compiled
@@ -110,6 +125,12 @@ def _time_steps(step, params, opt_state, batch, bs, steps, warmup, trace=False):
     try:
         compiled = step.lower(params, opt_state, batch, bs).compile()
         flops = flops_of_compiled(compiled)
+        # XLA's cost analysis counts a while/fori body ONCE regardless of
+        # trip count (verified empirically: fori_loop(8) over a matmul
+        # reports the same flops as one matmul), so the fused-launch knob
+        # must scale the count or MFU understates by k
+        if flops is not None:
+            flops *= STEPS_PER_LAUNCH
         step = compiled
     except Exception:
         flops = None  # fall back to the jit dispatch path
@@ -210,9 +231,7 @@ def bench_resnet50(B=None, img_size=224, classes=1000, steps=20, warmup=3, trace
             trace=trace and TRACE_LEG in ("", "resnet"),
         )
         m, kind = _mfu_of(flops, dt, steps)
-        extras = {"device_kind": kind, "dtype": tc.opt_config.dtype, "batch": b}
-        if STEPS_PER_LAUNCH > 1:
-            extras["steps_per_launch"] = STEPS_PER_LAUNCH
+        extras = _leg_extras(device_kind=kind, dtype=tc.opt_config.dtype, batch=b)
         if remat == "none":
             extras["mfu"] = m
         else:
@@ -241,9 +260,7 @@ def bench_lstm_classifier(B=256, T=64, steps=20, warmup=3, dtype=None):
         trace=TRACE_LEG == "lstm",
     )
     m, _ = _mfu_of(flops, dt, steps)
-    extras = {"mfu": m, "dtype": tc.opt_config.dtype}
-    if STEPS_PER_LAUNCH > 1:
-        extras["steps_per_launch"] = STEPS_PER_LAUNCH
+    extras = _leg_extras(mfu=m, dtype=tc.opt_config.dtype)
     return B * T * steps * STEPS_PER_LAUNCH / dt, extras
 
 
@@ -267,9 +284,7 @@ def bench_nmt(B=None, T=32, vocab=30000, dim=512, steps=10, warmup=2, dtype=None
             trace=TRACE_LEG == "nmt",
         )
         m, _ = _mfu_of(flops, dt, steps)
-        extras = {"mfu": m, "dtype": tc.opt_config.dtype, "tokens": "target", "batch": b}
-        if STEPS_PER_LAUNCH > 1:
-            extras["steps_per_launch"] = STEPS_PER_LAUNCH
+        extras = _leg_extras(mfu=m, dtype=tc.opt_config.dtype, tokens="target", batch=b)
         return b * T * steps * STEPS_PER_LAUNCH / dt, extras
 
     ladder = [(B,)] if B else [(256,), (128,), (64,)]
@@ -288,6 +303,11 @@ def _emit(metric, value, unit, vs_baseline, **extra):
 
 
 def main():
+    if STEPS_PER_LAUNCH < 1:
+        raise ValueError(
+            "PADDLE_TPU_BENCH_STEPS_PER_LAUNCH must be an integer >= 1, "
+            f"got {_SPL_RAW!r}"
+        )
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
     if which not in ("all", "resnet", "lstm", "nmt"):
         print(

@@ -29,6 +29,15 @@ for u in 4 8; do
   PADDLE_TPU_BENCH_UNROLL=$u PADDLE_TPU_BENCH_BUDGET=600 \
     timeout 700 python bench.py nmt >> $OUT 2>>$ERR
 done
+# fused-launch A/B: k optimizer steps per device launch amortize the
+# remote tunnel's per-dispatch latency on the small recurrent legs
+for k in 8; do
+  echo "--- steps_per_launch=$k lstm+nmt" >> $OUT
+  PADDLE_TPU_BENCH_STEPS_PER_LAUNCH=$k PADDLE_TPU_BENCH_BUDGET=600 \
+    timeout 700 python bench.py lstm >> $OUT 2>>$ERR
+  PADDLE_TPU_BENCH_STEPS_PER_LAUNCH=$k PADDLE_TPU_BENCH_BUDGET=900 \
+    timeout 1000 python bench.py nmt >> $OUT 2>>$ERR
+done
 # per-leg traces for the recurrent flagships (the headline trace above
 # covers resnet only)
 for leg in lstm nmt; do
