@@ -206,6 +206,7 @@ def _apply_settings(opt: OptimizationConfig, s: Dict[str, Any]) -> None:
         "mesh_shape",
         "remat",
         "scan_unroll",
+        "batches_per_launch",
         "c1",
         "backoff",
         "owlqn_steps",

@@ -380,6 +380,11 @@ class OptimizationConfig(Message):
     # unrolling k steps per scan iteration lets XLA pipeline the per-step
     # MXU matmuls and amortize loop overhead, at k× program size. 1 = off.
     scan_unroll: int = 1
+    # fuse k consecutive same-shape batches into ONE device launch
+    # (lax.scan over stacked batches): amortizes per-dispatch host latency
+    # when single steps are short — each batch still gets its own optimizer
+    # update, so numerics match k=1. 1 = off. See doc/performance.md.
+    batches_per_launch: int = 1
 
 
 @dataclass

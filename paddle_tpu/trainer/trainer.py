@@ -120,6 +120,23 @@ class Trainer:
         # gradient accumulation: N forward/backwards per optimizer update
         # (reference num_batches_per_send_parameter, TrainerInternal.cpp)
         self._accum_n = max(1, int(config.opt_config.num_batches_per_send_parameter))
+        # fused launches: k consecutive same-shape batches per device
+        # dispatch (lax.scan over stacked batches); each batch keeps its
+        # own optimizer update, so numerics match the unfused loop
+        self._fuse_k = max(1, int(config.opt_config.batches_per_launch))
+        if self._fuse_k > 1 and self._accum_n > 1:
+            raise ValueError(
+                "batches_per_launch > 1 cannot combine with "
+                "num_batches_per_send_parameter > 1 — fuse launches of "
+                "accumulation micro-batches are not supported; pick one"
+            )
+        if self._fuse_k > 1 and self._mesh is not None:
+            logger.warning(
+                "batches_per_launch > 1 is a single-chip dispatch-latency "
+                "optimization; ignored under a mesh"
+            )
+            self._fuse_k = 1
+        self._fused_step_fn = None
         self._accum_fns = None
         self._acc = None
         self._acc_batches = 0
@@ -218,8 +235,13 @@ class Trainer:
             eval_layers.update(e.input_layers)
         return set(self.gm.network.output_layer_names) | eval_layers
 
-    def _build_train_step(self):
-        grad_fn = self.gm.grad_fn(remat=self.config.opt_config.remat)
+    def _one_batch_step(self, sparse: bool = True):
+        """The single-batch grad→update→state→keep body shared by the
+        ordinary train step and the fused-launch scan, so the two paths
+        cannot diverge."""
+        grad_fn = self.gm.grad_fn(
+            remat=self.config.opt_config.remat, sparse=sparse
+        )
         updater = self.updater
         out_layers = self._kept_out_layers()
 
@@ -230,6 +252,11 @@ class Trainer:
                 new_params[k] = v
             keep = {k: v for k, v in outputs.items() if k in out_layers}
             return new_params, new_opt, loss, keep
+
+        return step
+
+    def _build_train_step(self):
+        step = self._one_batch_step()
 
         if self._mesh is not None:
             from paddle_tpu.parallel.spmd import shard_train_step
@@ -271,6 +298,73 @@ class Trainer:
             jax.jit(astep, donate_argnums=(0, 1)),
             jax.jit(ustep, donate_argnums=(0, 1, 2)),
         )
+
+    def _build_fused_step(self):
+        """k optimizer steps over k stacked batches in ONE device launch
+        (``batches_per_launch``): a lax.scan whose carry is (params,
+        opt_state) and whose xs are the stacked inputs + per-batch rngs +
+        sample counts. Dense gradients only (same constraint and reason as
+        gradient accumulation: sparse row sets vary per batch and cannot
+        ride a fixed-shape scan input)."""
+        one = self._one_batch_step(sparse=False)
+
+        def fstep(params, opt_state, stacked, rngs, ns):
+            def body(carry, xs):
+                p, o = carry
+                in_args, rng, n = xs
+                p2, o2, loss, keep = one(p, o, in_args, rng, n)
+                return (p2, o2), (loss, keep)
+
+            (p, o), (losses, keeps) = jax.lax.scan(
+                body, (params, opt_state), (stacked, rngs, ns)
+            )
+            return p, o, losses, keeps
+
+        return jax.jit(fstep, donate_argnums=(0, 1))
+
+    @property
+    def fused_step(self):
+        if self._fused_step_fn is None:
+            self._fused_step_fn = self._build_fused_step()
+        return self._fused_step_fn
+
+    def _launch_groups(self, gen):
+        """Group the (n, host, device) batch stream for fused launches.
+
+        Yields ("fused", [k items]) for runs of k consecutive batches with
+        identical tree structure/shapes/sample count, and ("single", item)
+        otherwise (shape changes, end-of-pass remainders) — partial groups
+        run through the ordinary one-batch step rather than compiling a
+        scan variant per remainder length."""
+        if self._fuse_k <= 1:
+            for item in gen:
+                yield "single", item
+            return
+        jtu = jax.tree_util
+
+        def sig_of(item):
+            n, _host, dev = item
+            leaves, treedef = jtu.tree_flatten(dev)
+            return (
+                n,
+                treedef,
+                tuple((getattr(l, "shape", ()), str(getattr(l, "dtype", ""))) for l in leaves),
+            )
+
+        buf, sig = [], None
+        for item in gen:
+            s = sig_of(item)
+            if buf and s != sig:
+                for it in buf:
+                    yield "single", it
+                buf = []
+            sig = s
+            buf.append(item)
+            if len(buf) == self._fuse_k:
+                yield "fused", buf
+                buf, sig = [], None
+        for it in buf:
+            yield "single", it
 
     def _build_test_fwd(self):
         gm = self.gm
@@ -480,84 +574,133 @@ class Trainer:
         t0 = time.time()
         batch_id = 0
         step_times: list = []
-        for n, _host_batch, batch in self._device_prefetch(
-            self._global_batches(provider)
+        profiled = False
+        for kind, group in self._launch_groups(
+            self._device_prefetch(self._global_batches(provider))
         ):
             if (
                 self.flags.profile_dir
                 and pass_id == self.start_pass
-                and batch_id == self.flags.profile_start_batch
+                and not profiling
+                and not profiled
+                and batch_id >= self.flags.profile_start_batch
             ):
+                # fused launches advance batch_id by k: trigger at launch
+                # granularity (the window covers whole launches)
                 jax.profiler.start_trace(self.flags.profile_dir)
                 profiling = True
                 logger.info("profiler trace started → %s", self.flags.profile_dir)
             rng, step_rng = jax.random.split(rng)
             t_step = time.perf_counter()
-            with stat_timer("train_step"):
-                if self._accum_n > 1:
-                    loss, outputs = self._accum_step(batch, step_rng, n)
-                else:
-                    self.params, self.opt_state, loss, outputs = self.train_step(
-                        self.params, self.opt_state, batch, step_rng,
-                        jnp.asarray(float(n)),
+            if kind == "fused":
+                items = group
+                kf = len(items)
+                ns = [it[0] for it in items]
+                stacked = jax.tree_util.tree_map(
+                    lambda *xs: jnp.stack(xs), *[it[2] for it in items]
+                )
+                rngs = jax.random.split(step_rng, kf)
+                with stat_timer("train_step"):
+                    self.params, self.opt_state, losses, keeps = self.fused_step(
+                        self.params, self.opt_state, stacked, rngs,
+                        jnp.asarray([float(x) for x in ns]),
                     )
-            loss_f = float(loss)
-            step_times.append(time.perf_counter() - t_step)
-            if not np.isfinite(loss_f):
-                # FP trap role (ref: feenableexcept(FE_INVALID|FE_DIVBYZERO|
-                # FE_OVERFLOW), TrainerMain.cpp:96): a NaN/Inf must abort the
-                # run, not train on silently. loss is already read back to the
-                # host each batch, so this check costs nothing extra.
-                raise FloatingPointError(
-                    f"non-finite loss ({loss_f}) at pass {pass_id} batch "
-                    f"{batch_id} — aborting. Try --job=checkgrad, a lower "
-                    "learning rate, or gradient clipping to locate the cause."
-                )
-            stats.add(loss_f * n, n)
-            self._eval_outputs(evaluators, outputs)
-            batch_id += 1
-            if self.flags.dot_period and batch_id % self.flags.dot_period == 0:
-                print(".", end="", flush=True, file=sys.stderr)
-                self._dots_pending = True
-            if (
-                self.flags.test_period
-                and batch_id % self.flags.test_period == 0
-            ):
-                self._end_dot_line()
-                with stat_timer("test"):
-                    self.test(pass_id=pass_id)
-            if (
-                self.flags.show_parameter_stats_period
-                and batch_id % self.flags.show_parameter_stats_period == 0
-            ):
-                self._end_dot_line()
-                self.show_parameter_stats()
-            if log_period and batch_id % log_period == 0:
-                self._end_dot_line()
-                logger.info(
-                    "Pass %d batch %d  %s  %s",
-                    pass_id,
-                    batch_id,
-                    stats.summary(),
-                    evaluators.summary(),
-                )
-                stats.reset_window()
-            if (
-                self.flags.saving_period_by_batches
-                and batch_id % self.flags.saving_period_by_batches == 0
-                and self.save_dir
-            ):
-                if self._accum_n > 1:
-                    # apply pending gradients first or the checkpoint
-                    # would silently drop up to N-1 batches' worth
-                    self._accum_flush()
-                self.save(pass_id, batch_id=batch_id)
+                losses_host = np.asarray(losses)
+                if not np.isfinite(losses_host).all():
+                    # gate BEFORE any per-batch housekeeping: params already
+                    # contain all k updates, so a periodic save fired for an
+                    # earlier batch of this launch would checkpoint
+                    # NaN-poisoned weights as if they were pre-NaN
+                    bad = int(np.flatnonzero(~np.isfinite(losses_host))[0])
+                    raise FloatingPointError(
+                        f"non-finite loss ({losses_host[bad]}) at pass "
+                        f"{pass_id} batch {batch_id + bad} (launch of {kf}) "
+                        "— aborting. Try --job=checkgrad, a lower learning "
+                        "rate, or gradient clipping to locate the cause."
+                    )
+                # ONE device→host transfer for the launch's kept outputs;
+                # numpy slicing below adds no further device dispatches
+                keeps_host = jax.device_get(keeps)
+                step_dt = (time.perf_counter() - t_step) / kf
+                results = [
+                    (
+                        float(losses_host[i]),
+                        jax.tree_util.tree_map(lambda x, i=i: x[i], keeps_host),
+                        ns[i],
+                    )
+                    for i in range(kf)
+                ]
+            else:
+                n, _host_batch, batch = group
+                with stat_timer("train_step"):
+                    if self._accum_n > 1:
+                        loss, outputs = self._accum_step(batch, step_rng, n)
+                    else:
+                        self.params, self.opt_state, loss, outputs = self.train_step(
+                            self.params, self.opt_state, batch, step_rng,
+                            jnp.asarray(float(n)),
+                        )
+                loss_f = float(loss)
+                step_dt = time.perf_counter() - t_step
+                results = [(loss_f, outputs, n)]
+            for loss_f, outputs, n in results:
+                step_times.append(step_dt)
+                if not np.isfinite(loss_f):
+                    # FP trap role (ref: feenableexcept(FE_INVALID|FE_DIVBYZERO|
+                    # FE_OVERFLOW), TrainerMain.cpp:96): a NaN/Inf must abort the
+                    # run, not train on silently. loss is already read back to the
+                    # host each batch, so this check costs nothing extra.
+                    raise FloatingPointError(
+                        f"non-finite loss ({loss_f}) at pass {pass_id} batch "
+                        f"{batch_id} — aborting. Try --job=checkgrad, a lower "
+                        "learning rate, or gradient clipping to locate the cause."
+                    )
+                stats.add(loss_f * n, n)
+                self._eval_outputs(evaluators, outputs)
+                batch_id += 1
+                if self.flags.dot_period and batch_id % self.flags.dot_period == 0:
+                    print(".", end="", flush=True, file=sys.stderr)
+                    self._dots_pending = True
+                if (
+                    self.flags.test_period
+                    and batch_id % self.flags.test_period == 0
+                ):
+                    self._end_dot_line()
+                    with stat_timer("test"):
+                        self.test(pass_id=pass_id)
+                if (
+                    self.flags.show_parameter_stats_period
+                    and batch_id % self.flags.show_parameter_stats_period == 0
+                ):
+                    self._end_dot_line()
+                    self.show_parameter_stats()
+                if log_period and batch_id % log_period == 0:
+                    self._end_dot_line()
+                    logger.info(
+                        "Pass %d batch %d  %s  %s",
+                        pass_id,
+                        batch_id,
+                        stats.summary(),
+                        evaluators.summary(),
+                    )
+                    stats.reset_window()
+                if (
+                    self.flags.saving_period_by_batches
+                    and batch_id % self.flags.saving_period_by_batches == 0
+                    and self.save_dir
+                ):
+                    if self._accum_n > 1:
+                        # apply pending gradients first or the checkpoint
+                        # would silently drop up to N-1 batches' worth
+                        self._accum_flush()
+                    self.save(pass_id, batch_id=batch_id)
             if profiling and batch_id >= (
                 self.flags.profile_start_batch + self.flags.profile_num_batches
             ):
                 jax.block_until_ready(self.params)
                 jax.profiler.stop_trace()
                 profiling = False
+                profiled = True
                 logger.info("profiler trace written to %s", self.flags.profile_dir)
         if self._accum_n > 1:
             # end-of-pass remainder: apply whatever is accumulated so no

@@ -211,6 +211,7 @@ def settings(
     remat: Optional[str] = None,
     scan_unroll: Optional[int] = None,
     num_batches_per_send_parameter: Optional[int] = None,
+    batches_per_launch: Optional[int] = None,
 ):
     ctx = current_context()
     s, defaults = ctx.settings, ctx.defaults
@@ -241,6 +242,8 @@ def settings(
         s["remat"] = remat
     if scan_unroll is not None:
         s["scan_unroll"] = scan_unroll
+    if batches_per_launch is not None:
+        s["batches_per_launch"] = batches_per_launch
     if num_batches_per_send_parameter is not None:
         # gradient accumulation: N batches per optimizer update
         s["num_batches_per_send_parameter"] = num_batches_per_send_parameter

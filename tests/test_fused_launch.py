@@ -1,0 +1,138 @@
+"""batches_per_launch (fused device launches): k consecutive same-shape
+batches train in ONE dispatch via lax.scan, each with its own optimizer
+update — numerics match the unfused loop (the TPU-native answer to
+per-step dispatch latency; no reference counterpart, see
+doc/performance.md).
+"""
+
+import os
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from paddle_tpu.config import parse_config
+from paddle_tpu.trainer import Trainer
+from paddle_tpu.utils.flags import FLAGS
+
+PROVIDER_DIR = os.path.join(os.path.dirname(__file__), "providers")
+
+
+@pytest.fixture(autouse=True)
+def _provider_path():
+    sys.path.insert(0, PROVIDER_DIR)
+    yield
+    sys.path.remove(PROVIDER_DIR)
+
+
+def _config(tmp_path, extra_settings=""):
+    train_list = tmp_path / "train.list"
+    train_list.write_text("1\n2\n3\n")
+    test_list = tmp_path / "test.list"
+    test_list.write_text("99\n")
+    src = textwrap.dedent(f"""
+    from paddle_tpu.trainer_config_helpers import *
+
+    define_py_data_sources2(train_list={str(train_list)!r},
+                            test_list={str(test_list)!r},
+                            module="synthetic_bow", obj="process")
+    settings(batch_size=64, learning_rate=0.02,
+             learning_method=AdamOptimizer(){extra_settings})
+    data = data_layer(name="word", size=100)
+    output = fc_layer(input=data, size=2, act=SoftmaxActivation(), name="output")
+    label = data_layer(name="label", size=2)
+    outputs(classification_cost(input=output, label=label))
+    """)
+    cfg_path = tmp_path / f"cfg{abs(hash(extra_settings)) % 997}.py"
+    cfg_path.write_text(src)
+    return parse_config(str(cfg_path))
+
+
+def _fresh_flags(tmp_path, name):
+    FLAGS.save_dir = str(tmp_path / name)
+    FLAGS.num_passes = 2
+    FLAGS.log_period = 0
+    FLAGS.start_pass = 0
+    FLAGS.init_model_path = ""
+    FLAGS.seed = 7
+
+
+def test_fused_matches_unfused(tmp_path):
+    _fresh_flags(tmp_path, "out1")
+    t1 = Trainer(_config(tmp_path))
+    t1.train(num_passes=2)
+    r1 = t1.test()
+
+    _fresh_flags(tmp_path, "out3")
+    cfg3 = _config(tmp_path, extra_settings=", batches_per_launch=3")
+    assert cfg3.opt_config.batches_per_launch == 3  # settings() plumbing
+    t3 = Trainer(cfg3)
+    assert t3._fuse_k == 3
+    t3.train(num_passes=2)
+    r3 = t3.test()
+
+    # same batches in the same order, one optimizer update per batch either
+    # way — parameters agree to float tolerance (fusion only changes how
+    # XLA schedules the same math) and the optimizer stepped once per batch
+    assert int(t1.opt_state.step) == int(t3.opt_state.step)
+    for k in t1.params:
+        np.testing.assert_allclose(
+            np.asarray(t1.params[k]), np.asarray(t3.params[k]),
+            rtol=2e-5, atol=2e-6, err_msg=k,
+        )
+    for k, v in r1.items():
+        assert abs(v - r3[k]) < 1e-4, (k, v, r3[k])
+
+
+def test_fused_remainder_runs_single(tmp_path):
+    # 1200 samples / batch 64 = 18 full batches + one 48-sample remainder:
+    # with k=4 the remainder (and the flushed tail of full batches) must
+    # run through the single-step path, never dropping a batch
+    _fresh_flags(tmp_path, "out4")
+    cfg = _config(tmp_path, extra_settings=", batches_per_launch=4")
+    t = Trainer(cfg)
+    t.train(num_passes=1)
+    assert int(t.opt_state.step) == 19  # every batch updated exactly once
+
+
+def test_launch_groups_grouping(tmp_path):
+    _fresh_flags(tmp_path, "out5")
+    cfg = _config(tmp_path, extra_settings=", batches_per_launch=2")
+    t = Trainer(cfg)
+
+    def item(n, shape):
+        return (n, None, {"x": np.zeros(shape, np.float32)})
+
+    stream = [
+        item(4, (4, 3)),  # a
+        item(4, (4, 3)),  # b -> fused(a,b)
+        item(4, (4, 3)),  # c
+        item(4, (4, 5)),  # shape change: c flushes single
+        item(4, (4, 5)),  # -> fused(d,e)
+        item(2, (2, 5)),  # tail -> single
+    ]
+    got = [(kind, g) for kind, g in t._launch_groups(iter(stream))]
+    kinds = [k for k, _ in got]
+    assert kinds == ["fused", "single", "fused", "single"]
+    assert [len(g) for k, g in got if k == "fused"] == [2, 2]
+    # order preserved overall
+    flat = []
+    for k, g in got:
+        flat.extend(g if k == "fused" else [g])
+    assert [f[0] for f in flat] == [4, 4, 4, 4, 4, 2]
+    assert [f[2]["x"].shape for f in flat] == [
+        (4, 3), (4, 3), (4, 3), (4, 5), (4, 5), (2, 5)
+    ]
+
+
+def test_fused_rejects_accumulation(tmp_path):
+    _fresh_flags(tmp_path, "out6")
+    cfg = _config(
+        tmp_path,
+        extra_settings=(
+            ", batches_per_launch=2, num_batches_per_send_parameter=2"
+        ),
+    )
+    with pytest.raises(ValueError, match="batches_per_launch"):
+        Trainer(cfg)
