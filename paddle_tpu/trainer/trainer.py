@@ -605,7 +605,11 @@ class Trainer:
                         self.params, self.opt_state, stacked, rngs,
                         jnp.asarray([float(x) for x in ns]),
                     )
-                losses_host = np.asarray(losses)
+                # ONE device→host transfer per launch (losses + kept
+                # outputs together); numpy slicing below adds no further
+                # device dispatches
+                losses_host, keeps_host = jax.device_get((losses, keeps))
+                losses_host = np.asarray(losses_host)
                 if not np.isfinite(losses_host).all():
                     # gate BEFORE any per-batch housekeeping: params already
                     # contain all k updates, so a periodic save fired for an
@@ -618,9 +622,6 @@ class Trainer:
                         "— aborting. Try --job=checkgrad, a lower learning "
                         "rate, or gradient clipping to locate the cause."
                     )
-                # ONE device→host transfer for the launch's kept outputs;
-                # numpy slicing below adds no further device dispatches
-                keeps_host = jax.device_get(keeps)
                 step_dt = (time.perf_counter() - t_step) / kf
                 results = [
                     (
@@ -643,6 +644,7 @@ class Trainer:
                 loss_f = float(loss)
                 step_dt = time.perf_counter() - t_step
                 results = [(loss_f, outputs, n)]
+            batch_id_start = batch_id
             for loss_f, outputs, n in results:
                 step_times.append(step_dt)
                 if not np.isfinite(loss_f):
@@ -661,39 +663,38 @@ class Trainer:
                 if self.flags.dot_period and batch_id % self.flags.dot_period == 0:
                     print(".", end="", flush=True, file=sys.stderr)
                     self._dots_pending = True
-                if (
-                    self.flags.test_period
-                    and batch_id % self.flags.test_period == 0
-                ):
-                    self._end_dot_line()
-                    with stat_timer("test"):
-                        self.test(pass_id=pass_id)
-                if (
-                    self.flags.show_parameter_stats_period
-                    and batch_id % self.flags.show_parameter_stats_period == 0
-                ):
-                    self._end_dot_line()
-                    self.show_parameter_stats()
-                if log_period and batch_id % log_period == 0:
-                    self._end_dot_line()
-                    logger.info(
-                        "Pass %d batch %d  %s  %s",
-                        pass_id,
-                        batch_id,
-                        stats.summary(),
-                        evaluators.summary(),
-                    )
-                    stats.reset_window()
-                if (
-                    self.flags.saving_period_by_batches
-                    and batch_id % self.flags.saving_period_by_batches == 0
-                    and self.save_dir
-                ):
-                    if self._accum_n > 1:
-                        # apply pending gradients first or the checkpoint
-                        # would silently drop up to N-1 batches' worth
-                        self._accum_flush()
-                    self.save(pass_id, batch_id=batch_id)
+
+            # periodic housekeeping fires at LAUNCH boundaries: params hold
+            # every update of the launch, so a save labeled with a
+            # mid-launch batch_id would contain later batches' updates and
+            # a resume from it would double-apply them. ``crossed`` is the
+            # plain modulo check when a launch is one batch.
+            def crossed(period):
+                return period and batch_id // period > batch_id_start // period
+
+            if crossed(self.flags.test_period):
+                self._end_dot_line()
+                with stat_timer("test"):
+                    self.test(pass_id=pass_id)
+            if crossed(self.flags.show_parameter_stats_period):
+                self._end_dot_line()
+                self.show_parameter_stats()
+            if crossed(log_period):
+                self._end_dot_line()
+                logger.info(
+                    "Pass %d batch %d  %s  %s",
+                    pass_id,
+                    batch_id,
+                    stats.summary(),
+                    evaluators.summary(),
+                )
+                stats.reset_window()
+            if crossed(self.flags.saving_period_by_batches) and self.save_dir:
+                if self._accum_n > 1:
+                    # apply pending gradients first or the checkpoint
+                    # would silently drop up to N-1 batches' worth
+                    self._accum_flush()
+                self.save(pass_id, batch_id=batch_id)
             if profiling and batch_id >= (
                 self.flags.profile_start_batch + self.flags.profile_num_batches
             ):
