@@ -62,9 +62,11 @@ except ValueError:
 
 
 def _leg_extras(**kw):
-    """Per-leg JSON extras; tags the fused-launch knob when it is active."""
+    """Per-leg JSON extras; tags the A/B knobs that are active."""
     if STEPS_PER_LAUNCH > 1:
         kw["steps_per_launch"] = STEPS_PER_LAUNCH
+    if os.environ.get("PADDLE_TPU_BENCH_PALLAS_LSTM") == "1":
+        kw["pallas_lstm"] = True
     return kw
 
 
@@ -76,13 +78,16 @@ def _jit_train_step(tc):
     from paddle_tpu.graph.machine import compute_dtype_of
     from paddle_tpu.optimizer import Updater
 
-    # A/B knob for the recurrent legs (no-op for ResNet: no scans)
+    # A/B knobs for the recurrent legs (no-op for ResNet: no scans)
     env_unroll = os.environ.get("PADDLE_TPU_BENCH_UNROLL")
     if env_unroll:
         tc.opt_config.scan_unroll = int(env_unroll)
+    if os.environ.get("PADDLE_TPU_BENCH_PALLAS_LSTM") == "1":
+        tc.opt_config.pallas_lstm = True
 
     gm = GradientMachine(tc.model_config, compute_dtype=compute_dtype_of(tc.opt_config),
-                         scan_unroll=tc.opt_config.scan_unroll)
+                         scan_unroll=tc.opt_config.scan_unroll,
+                         pallas_lstm=tc.opt_config.pallas_lstm)
     updater = Updater(tc.opt_config, tc.model_config)
     params = gm.init_params(seed=1)
     opt_state = updater.init_state(params)

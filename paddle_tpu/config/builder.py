@@ -207,6 +207,7 @@ def _apply_settings(opt: OptimizationConfig, s: Dict[str, Any]) -> None:
         "remat",
         "scan_unroll",
         "batches_per_launch",
+        "pallas_lstm",
         "c1",
         "backoff",
         "owlqn_steps",

@@ -113,6 +113,23 @@ def lstmemory_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext)
     w = ctx.param(cfg.inputs[0].input_parameter_name).reshape(size, 4 * size)
     bias = ctx.param(cfg.bias_parameter_name).reshape(-1) if cfg.bias_parameter_name else None
 
+    # fused Pallas path: single-device TPU only (inside a GSPMD-sharded jit
+    # the pallas custom call has no partitioning rule; non-TPU backends
+    # would run the Python interpreter — tests force it via
+    # PADDLE_TPU_PALLAS_INTERPRET=1, production falls back to the scan)
+    if ctx.pallas_lstm and ctx.mesh is None:
+        import os
+
+        from paddle_tpu.ops import pallas_lstm as pk
+
+        on_tpu = jax.default_backend() == "tpu"
+        force_interpret = os.environ.get("PADDLE_TPU_PALLAS_INTERPRET") == "1"
+        if (on_tpu or force_interpret) and pk.usable(cfg, x):
+            ys = pk.lstm_layer_forward(
+                cfg, x, mask, w, bias, interpret=not on_tpu,
+            )
+            return Argument(value=jnp.swapaxes(ys, 0, 1), seq_lengths=a.seq_lengths)
+
     def cell(carry, x_t):
         h, c = carry
         h2, c2 = lstm_cell_step(cfg, x_t, h, c, w, bias)

@@ -1,0 +1,308 @@
+"""Fused LSTM sequence kernel (Pallas TPU): the whole time scan in ONE
+kernel launch.
+
+The XLA path (`layers/recurrent.py` ``_scan_time``) compiles the LSTM to a
+`lax.while` whose per-step body is a small [B, H]x[H, 4H] matmul plus ~7
+separate gate/mask/slice fusions — on the traced bench leg those per-step
+fusions are ~36% of device time and the while-loop wrappers dominate the
+rest. Here one Pallas kernel walks the sequential grid over T with the
+recurrent weight and the (h, c) carry resident in VMEM: per step, one MXU
+dot plus VPU gate math, no HBM round-trips for the carry and no per-step
+kernel launches. Backward is a second sequential kernel (reverse grid)
+that accumulates dW / peephole grads in VMEM across steps — the classic
+fused-LSTM backward.
+
+Cell semantics are exactly `lstm_cell_step` (reference LstmLayer.cpp /
+LstmCompute.cu contract, see layers/recurrent.py:79): gate order
+[candidate, input, forget, output]; bias = 4 gate biases + 3 peephole
+vectors; carry masking keeps padded steps transparent. Activation
+derivatives are computed from the SAVED post-activation values (tanh' =
+1-y², sigmoid' = y(1-y)), so the forward saves (a, i, f, o) once and the
+backward rebuilds everything else.
+
+Correctness: interpret-mode parity against the XLA scan path in
+tests/test_pallas_lstm.py (forward + grads, masked + reversed + peephole
+cases). Enabled per-config via settings(pallas_lstm=True); the layer
+falls back to the scan path for unsupported shapes/activations.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # unavailable when jax has no TPU platform registered (CPU test env)
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # noqa: BLE001
+    pltpu = None
+
+Array = jax.Array
+
+_ACTS = ("tanh", "sigmoid", "relu", "linear")
+
+
+def _act(name: str, v: Array) -> Array:
+    if name == "tanh":
+        return jnp.tanh(v)
+    if name == "sigmoid":
+        return jax.nn.sigmoid(v)
+    if name == "relu":
+        return jnp.maximum(v, 0.0)
+    return v  # linear
+
+
+def _dact(name: str, y: Array) -> Array:
+    """Derivative from the SAVED post-activation value y = act(x)."""
+    if name == "tanh":
+        return 1.0 - y * y
+    if name == "sigmoid":
+        return y * (1.0 - y)
+    if name == "relu":
+        return (y > 0.0).astype(y.dtype)
+    return jnp.ones_like(y)  # linear
+
+
+def supported(act_in: str, act_gate: str, act_state: str, B: int, H: int) -> bool:
+    return (
+        pltpu is not None  # kernels need TPU scratch shapes even interpreted
+        and act_in in _ACTS and act_gate in _ACTS and act_state in _ACTS
+        and H % 128 == 0 and B % 8 == 0
+    )
+
+
+def _split4(g: Array, H: int):
+    return g[:, :H], g[:, H : 2 * H], g[:, 2 * H : 3 * H], g[:, 3 * H :]
+
+
+def _fwd_kernel(x4_ref, m_ref, w_ref, peep_ref,
+                y_ref, acts_ref, hprev_ref, cprev_ref,
+                h_scr, c_scr, *, act_in, act_gate, act_state):
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _init():
+        h_scr[:] = jnp.zeros_like(h_scr)
+        c_scr[:] = jnp.zeros_like(c_scr)
+
+    H = w_ref.shape[0]
+    h_prev = h_scr[:]                                   # [B, H] f32
+    c_prev = c_scr[:]
+    w = w_ref[:]
+    x4 = x4_ref[0].astype(jnp.float32)                  # [B, 4H]
+    gates = x4 + jax.lax.dot(
+        h_prev.astype(w.dtype), w, preferred_element_type=jnp.float32
+    )
+    peep = peep_ref[:].astype(jnp.float32)              # [3, H]
+    pi, pf, po = peep[0:1], peep[1:2], peep[2:3]        # [1, H] each
+    ga, gi, gf, go = _split4(gates, H)
+    i = _act(act_gate, gi + pi * c_prev)
+    f = _act(act_gate, gf + pf * c_prev)
+    a = _act(act_in, ga)
+    c_new = f * c_prev + i * a
+    o = _act(act_gate, go + po * c_new)
+    h_new = o * _act(act_state, c_new)
+    m = m_ref[:, 0:1].astype(jnp.float32)               # [B, 1]
+
+    hprev_ref[0] = h_prev.astype(hprev_ref.dtype)       # residuals (pre-update)
+    cprev_ref[0] = c_prev
+    acts_ref[0] = jnp.concatenate([a, i, f, o], axis=1).astype(acts_ref.dtype)
+    y_ref[0] = (m * h_new).astype(y_ref.dtype)
+    h_scr[:] = m * h_new + (1.0 - m) * h_prev
+    c_scr[:] = m * c_new + (1.0 - m) * c_prev
+
+
+def _bwd_kernel(dy_ref, acts_ref, hprev_ref, cprev_ref, m_ref, w_ref, peep_ref,
+                dx4_ref, dw_ref, dpeep_ref,
+                dh_scr, dc_scr, *, act_in, act_gate, act_state):
+    idx = pl.program_id(0)  # walks t = T-1 .. 0 via the index maps
+
+    @pl.when(idx == 0)
+    def _init():
+        dh_scr[:] = jnp.zeros_like(dh_scr)
+        dc_scr[:] = jnp.zeros_like(dc_scr)
+        dw_ref[:] = jnp.zeros_like(dw_ref)
+        dpeep_ref[:] = jnp.zeros_like(dpeep_ref)
+
+    H = w_ref.shape[0]
+    acts = acts_ref[0].astype(jnp.float32)
+    a, i, f, o = _split4(acts, H)
+    c_prev = cprev_ref[0]
+    h_prev = hprev_ref[0]
+    m = m_ref[:, 0:1].astype(jnp.float32)
+    peep = peep_ref[:].astype(jnp.float32)
+    pi, pf, po = peep[0:1], peep[1:2], peep[2:3]
+    DH = dh_scr[:]
+    DC = dc_scr[:]
+
+    c_new = f * c_prev + i * a
+    s_c = _act(act_state, c_new)
+    dy = dy_ref[0].astype(jnp.float32)
+    dh_new = m * (DH + dy)                    # cell path; (1-m) passes through
+    dgo = dh_new * s_c * _dact(act_gate, o)
+    dc_new = dh_new * o * _dact(act_state, s_c) + m * DC + dgo * po
+    dgi = dc_new * a * _dact(act_gate, i)
+    dgf = dc_new * c_prev * _dact(act_gate, f)
+    dga = dc_new * i * _dact(act_in, a)
+    dgates = jnp.concatenate([dga, dgi, dgf, dgo], axis=1)   # [B, 4H]
+    dx4_ref[0] = dgates.astype(dx4_ref.dtype)
+
+    w = w_ref[:]
+    dh_prev = jax.lax.dot_general(
+        dgates.astype(w.dtype), w, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                                        # [B, H]
+    dh_scr[:] = dh_prev + (1.0 - m) * DH
+    dc_scr[:] = dc_new * f + dgi * pi + dgf * pf + (1.0 - m) * DC
+    dw_ref[:] += jax.lax.dot_general(
+        h_prev.astype(jnp.float32), dgates, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                                        # [H, 4H]
+    dpeep_ref[:] += jnp.concatenate(
+        [
+            jnp.sum(dgi * c_prev, axis=0, keepdims=True),
+            jnp.sum(dgf * c_prev, axis=0, keepdims=True),
+            jnp.sum(dgo * c_new, axis=0, keepdims=True),
+        ],
+        axis=0,
+    )                                                        # [3, H]
+
+
+def _params(n):
+    if pltpu is None:
+        return None
+    return pltpu.CompilerParams(dimension_semantics=("arbitrary",) * n)
+
+
+def _run_fwd(x4, mask_bt, w, peep, acts, interpret):
+    T, B, H4 = x4.shape
+    H = H4 // 4
+    step_spec4 = pl.BlockSpec((1, B, H4), lambda t: (t, 0, 0))
+    step_spec = pl.BlockSpec((1, B, H), lambda t: (t, 0, 0))
+    mask_spec = pl.BlockSpec((B, 1), lambda t: (0, t))
+    const2 = lambda shape: pl.BlockSpec(shape, lambda t: (0, 0))
+    kern = functools.partial(
+        _fwd_kernel, act_in=acts[0], act_gate=acts[1], act_state=acts[2]
+    )
+    return pl.pallas_call(
+        kern,
+        grid=(T,),
+        in_specs=[step_spec4, mask_spec, const2(w.shape), const2(peep.shape)],
+        out_specs=[step_spec, step_spec4, step_spec, step_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((T, B, H), x4.dtype),       # ys
+            jax.ShapeDtypeStruct((T, B, H4), x4.dtype),      # acts (a,i,f,o)
+            jax.ShapeDtypeStruct((T, B, H), x4.dtype),       # h_prev
+            jax.ShapeDtypeStruct((T, B, H), jnp.float32),    # c_prev
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((B, H), jnp.float32),
+            pltpu.VMEM((B, H), jnp.float32),
+        ] if pltpu is not None else [],
+        interpret=interpret,
+        compiler_params=_params(1),
+    )(x4, mask_bt, w, peep)
+
+
+def _run_bwd(dy, saved, mask_bt, w, peep, acts, interpret):
+    acts_seq, hprev, cprev = saved
+    T, B, H4 = acts_seq.shape
+    H = H4 // 4
+    rev4 = pl.BlockSpec((1, B, H4), lambda i: (T - 1 - i, 0, 0))
+    rev = pl.BlockSpec((1, B, H), lambda i: (T - 1 - i, 0, 0))
+    mask_spec = pl.BlockSpec((B, 1), lambda i: (0, T - 1 - i))
+    const2 = lambda shape: pl.BlockSpec(shape, lambda i: (0, 0))
+    kern = functools.partial(
+        _bwd_kernel, act_in=acts[0], act_gate=acts[1], act_state=acts[2]
+    )
+    dx4, dw, dpeep = pl.pallas_call(
+        kern,
+        grid=(T,),
+        in_specs=[rev, rev4, rev, rev, mask_spec, const2(w.shape), const2(peep.shape)],
+        out_specs=[rev4, const2(w.shape), const2(peep.shape)],
+        out_shape=[
+            jax.ShapeDtypeStruct((T, B, H4), dy.dtype),
+            jax.ShapeDtypeStruct(w.shape, jnp.float32),
+            jax.ShapeDtypeStruct(peep.shape, jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((B, H), jnp.float32),
+            pltpu.VMEM((B, H), jnp.float32),
+        ] if pltpu is not None else [],
+        interpret=interpret,
+        compiler_params=_params(1),
+    )(dy, acts_seq, hprev, cprev, mask_bt, w, peep)
+    return dx4, dw.astype(w.dtype), dpeep.astype(peep.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def fused_lstm(x4, mask, w, peep, acts, interpret):
+    """ys [T, B, H] = masked LSTM over time-major x-projections.
+
+    x4: [T, B, 4H] x-projection with gate biases already added;
+    mask: [T, B] valid-step mask; w: [H, 4H] recurrent weight;
+    peep: [3, H] peephole vectors (zeros when absent);
+    acts: (act_in, act_gate, act_state) static name triple.
+    """
+    ys, _, _, _ = _run_fwd(x4, mask.T, w, peep, acts, interpret)
+    return ys
+
+
+def _fused_fwd(x4, mask, w, peep, acts, interpret):
+    ys, acts_seq, hprev, cprev = _run_fwd(x4, mask.T, w, peep, acts, interpret)
+    return ys, (acts_seq, hprev, cprev, mask, w, peep)
+
+
+def _fused_bwd(acts, interpret, res, dy):
+    acts_seq, hprev, cprev, mask, w, peep = res
+    dx4, dw, dpeep = _run_bwd(
+        dy, (acts_seq, hprev, cprev), mask.T, w, peep, acts, interpret
+    )
+    return dx4, jnp.zeros_like(mask), dw, dpeep
+
+
+fused_lstm.defvjp(_fused_fwd, _fused_bwd)
+
+
+def lstm_layer_forward(cfg, x, mask, w, bias, interpret):
+    """The lstmemory layer body on the fused kernel: returns ys [T, B, H].
+
+    x: [T, B, 4H] (pre-bias x-projection), mask: [T, B], w: [H, 4H],
+    bias: [7H] (4 gate biases + 3 peepholes) or None. Handles
+    cfg.reversed by flipping time outside the kernel (padded steps then
+    run first with mask 0, which leaves the carry at init — the same
+    semantics as lax.scan(reverse=True) with carry masking)."""
+    H = cfg.size
+    if bias is not None:
+        x = x + bias[: 4 * H].astype(x.dtype)
+        peep = jnp.stack(
+            [bias[4 * H : 5 * H], bias[5 * H : 6 * H], bias[6 * H : 7 * H]]
+        )
+    else:
+        peep = jnp.zeros((3, H), x.dtype)
+    if cfg.reversed:
+        x = jnp.flip(x, 0)
+        mask = jnp.flip(mask, 0)
+    acts = (
+        cfg.active_type or "tanh",
+        cfg.active_gate_type or "sigmoid",
+        cfg.active_state_type or "sigmoid",
+    )
+    ys = fused_lstm(x, mask, w, peep, acts, interpret)
+    if cfg.reversed:
+        ys = jnp.flip(ys, 0)
+    return ys
+
+
+def usable(cfg, x) -> bool:
+    """Shapes/activations the kernel handles (layer falls back otherwise)."""
+    T, B, H4 = x.shape
+    return supported(
+        cfg.active_type or "tanh",
+        cfg.active_gate_type or "sigmoid",
+        cfg.active_state_type or "sigmoid",
+        B,
+        cfg.size,
+    ) and H4 == 4 * cfg.size and x.dtype in (jnp.float32, jnp.bfloat16)

@@ -1,0 +1,146 @@
+"""Fused Pallas LSTM kernel parity vs the XLA scan path (interpret mode).
+
+The kernel (ops/pallas_lstm.py) must reproduce layers/recurrent.py's
+``lstm_cell_step`` + ``_scan_time`` semantics bit-for-tolerance: gate
+order [candidate, input, forget, output], peephole bias layout, carry
+masking of padded steps, reversed scans — forward values AND gradients
+(the backward kernel is hand-derived, so the gradient check against
+jax.grad of the scan path is the real test).
+"""
+
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu.graph  # noqa: F401  (break the layers<->graph import cycle)
+from paddle_tpu.layers.recurrent import _scan_time, lstm_cell_step
+from paddle_tpu.ops import pallas_lstm as pk
+
+
+def _cfg(reversed_=False, act="tanh", gate="sigmoid", state="sigmoid", size=128):
+    return types.SimpleNamespace(
+        size=size,
+        reversed=reversed_,
+        active_type=act,
+        active_gate_type=gate,
+        active_state_type=state,
+    )
+
+
+def _ref(cfg, x, mask, w, bias):
+    """The production scan path, verbatim semantics of lstmemory_layer."""
+
+    def cell(carry, x_t):
+        h, c = carry
+        h2, c2 = lstm_cell_step(cfg, x_t, h, c, w, bias)
+        return (h2, c2), h2
+
+    B = x.shape[1]
+    init = (jnp.zeros((B, cfg.size), x.dtype), jnp.zeros((B, cfg.size), x.dtype))
+    _, ys = _scan_time(cell, x, mask, init, cfg.reversed)
+    return ys
+
+
+def _rand(key, T=5, B=8, H=128, dtype=jnp.float32, with_bias=True):
+    ks = jax.random.split(key, 4)
+    x = jax.random.normal(ks[0], (T, B, 4 * H), dtype) * 0.5
+    w = (jax.random.normal(ks[1], (H, 4 * H), dtype) * float(1.0 / np.sqrt(H))).astype(dtype)
+    bias = (jax.random.normal(ks[2], (7 * H,), dtype) * 0.1) if with_bias else None
+    lengths = jax.random.randint(ks[3], (B,), 1, T + 1)
+    mask = (jnp.arange(T)[:, None] < lengths[None, :]).astype(dtype)
+    return x, w, bias, mask
+
+
+@pytest.mark.parametrize("reversed_", [False, True])
+@pytest.mark.parametrize("with_bias", [True, False])
+def test_forward_parity(reversed_, with_bias):
+    cfg = _cfg(reversed_=reversed_)
+    x, w, bias, mask = _rand(jax.random.PRNGKey(0), with_bias=with_bias)
+    ref = _ref(cfg, x, mask, w, bias)
+    got = pk.lstm_layer_forward(cfg, x, mask, w, bias, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("reversed_", [False, True])
+def test_gradient_parity(reversed_):
+    cfg = _cfg(reversed_=reversed_)
+    x, w, bias, mask = _rand(jax.random.PRNGKey(1))
+    cot = jax.random.normal(jax.random.PRNGKey(2), (5, 8, 128))
+
+    def loss_ref(x, w, bias):
+        return jnp.sum(_ref(cfg, x, mask, w, bias) * cot)
+
+    def loss_pk(x, w, bias):
+        return jnp.sum(pk.lstm_layer_forward(cfg, x, mask, w, bias, interpret=True) * cot)
+
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(x, w, bias)
+    gp = jax.grad(loss_pk, argnums=(0, 1, 2))(x, w, bias)
+    for r, p, name in zip(gr, gp, ("dx", "dw", "dbias")):
+        np.testing.assert_allclose(
+            np.asarray(p), np.asarray(r), rtol=2e-4, atol=2e-4, err_msg=name
+        )
+
+
+def test_gradient_parity_no_bias_tanh_state():
+    # the common DSL configuration: tanh state activation, no peepholes
+    cfg = _cfg(state="tanh")
+    x, w, _, mask = _rand(jax.random.PRNGKey(3), with_bias=False)
+    cot = jax.random.normal(jax.random.PRNGKey(4), (5, 8, 128))
+
+    gr = jax.grad(lambda x, w: jnp.sum(_ref(cfg, x, mask, w, None) * cot), (0, 1))(x, w)
+    gp = jax.grad(
+        lambda x, w: jnp.sum(
+            pk.lstm_layer_forward(cfg, x, mask, w, None, interpret=True) * cot
+        ),
+        (0, 1),
+    )(x, w)
+    for r, p, name in zip(gr, gp, ("dx", "dw")):
+        np.testing.assert_allclose(
+            np.asarray(p), np.asarray(r), rtol=2e-4, atol=2e-4, err_msg=name
+        )
+
+
+def test_bf16_forward_parity():
+    cfg = _cfg()
+    x, w, bias, mask = _rand(jax.random.PRNGKey(5), dtype=jnp.bfloat16)
+    ref = _ref(cfg, x, mask, w, bias)
+    got = pk.lstm_layer_forward(cfg, x, mask, w, bias, interpret=True)
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(ref, np.float32), rtol=0.1, atol=0.05
+    )
+
+
+def test_machine_level_parity(monkeypatch):
+    # whole-graph check: same params, same batch, pallas on vs off —
+    # loss and every parameter gradient agree. The env var forces the
+    # interpreted kernel on CPU (production non-TPU runs take the scan).
+    monkeypatch.setenv("PADDLE_TPU_PALLAS_INTERPRET", "1")
+    from paddle_tpu.flagship import example_batch, flagship_config
+    from paddle_tpu.graph import GradientMachine
+
+    tc = flagship_config(dict_dim=200, emb_dim=32, hidden=128, classes=2)
+    tc.opt_config.batch_size = 16
+    gm_off = GradientMachine(tc.model_config)
+    gm_on = GradientMachine(tc.model_config, pallas_lstm=True)
+    params = gm_off.init_params(seed=3)
+    batch = example_batch(dict_dim=200, B=16, T=12)
+
+    l_off, g_off, _, _ = gm_off.grad_fn()(params, batch, None)
+    l_on, g_on, _, _ = gm_on.grad_fn()(params, batch, None)
+    np.testing.assert_allclose(float(l_on), float(l_off), rtol=1e-5)
+    for k in g_off:
+        np.testing.assert_allclose(
+            np.asarray(g_on[k]), np.asarray(g_off[k]), rtol=5e-4, atol=5e-5,
+            err_msg=k,
+        )
+
+
+def test_unsupported_shapes_fall_back():
+    # H not a lane multiple → usable() false; the layer silently uses scan
+    assert not pk.usable(_cfg(size=96), jnp.zeros((4, 8, 384)))
+    assert not pk.usable(_cfg(size=128), jnp.zeros((4, 6, 512)))  # B % 8
+    assert pk.usable(_cfg(size=128), jnp.zeros((4, 8, 512)))
