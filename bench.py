@@ -65,8 +65,8 @@ def _leg_extras(**kw):
     """Per-leg JSON extras; tags the A/B knobs that are active."""
     if STEPS_PER_LAUNCH > 1:
         kw["steps_per_launch"] = STEPS_PER_LAUNCH
-    if os.environ.get("PADDLE_TPU_BENCH_PALLAS_LSTM") == "1":
-        kw["pallas_lstm"] = True
+    if os.environ.get("PADDLE_TPU_BENCH_PALLAS_RNN") == "1":
+        kw["pallas_rnn"] = True
     return kw
 
 
@@ -82,12 +82,12 @@ def _jit_train_step(tc):
     env_unroll = os.environ.get("PADDLE_TPU_BENCH_UNROLL")
     if env_unroll:
         tc.opt_config.scan_unroll = int(env_unroll)
-    if os.environ.get("PADDLE_TPU_BENCH_PALLAS_LSTM") == "1":
-        tc.opt_config.pallas_lstm = True
+    if os.environ.get("PADDLE_TPU_BENCH_PALLAS_RNN") == "1":
+        tc.opt_config.pallas_rnn = True
 
     gm = GradientMachine(tc.model_config, compute_dtype=compute_dtype_of(tc.opt_config),
                          scan_unroll=tc.opt_config.scan_unroll,
-                         pallas_lstm=tc.opt_config.pallas_lstm)
+                         pallas_rnn=tc.opt_config.pallas_rnn)
     updater = Updater(tc.opt_config, tc.model_config)
     params = gm.init_params(seed=1)
     opt_state = updater.init_state(params)

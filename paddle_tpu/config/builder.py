@@ -207,7 +207,7 @@ def _apply_settings(opt: OptimizationConfig, s: Dict[str, Any]) -> None:
         "remat",
         "scan_unroll",
         "batches_per_launch",
-        "pallas_lstm",
+        "pallas_rnn",
         "c1",
         "backoff",
         "owlqn_steps",

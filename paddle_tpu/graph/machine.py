@@ -40,7 +40,7 @@ def compute_dtype_of(opt_config) -> Optional[Any]:
 
 class GradientMachine:
     def __init__(self, model: ModelConfig, dtype=jnp.float32, compute_dtype=None,
-                 scan_unroll: int = 1, pallas_lstm: bool = False):
+                 scan_unroll: int = 1, pallas_rnn: bool = False):
         self.model = model
         self.network = Network(model)
         self.dtype = dtype
@@ -51,8 +51,8 @@ class GradientMachine:
         # lax.scan unroll factor for recurrent layers/groups
         # (OptimizationConfig.scan_unroll)
         self.scan_unroll = max(1, int(scan_unroll))
-        # lstmemory layers via the fused Pallas kernel (ops/pallas_lstm)
-        self.pallas_lstm = bool(pallas_lstm)
+        # recurrent layers via the fused Pallas kernels (ops/pallas_lstm)
+        self.pallas_rnn = bool(pallas_rnn)
         self.mesh = None  # set by the trainer when running on a mesh
         self.param_configs: Dict[str, ParameterConfig] = {p.name: p for p in model.parameters}
         # data layers whose every consumer is a cost layer carry targets/
@@ -96,7 +96,7 @@ class GradientMachine:
             params=params, model=self.model, pass_type=pass_type, rng=rng,
             dtype=self.dtype, mesh=self.mesh, table_overrides=table_overrides,
             compute_dtype=self.compute_dtype, no_cast_inputs=self.no_cast_inputs,
-            scan_unroll=self.scan_unroll, pallas_lstm=self.pallas_lstm,
+            scan_unroll=self.scan_unroll, pallas_rnn=self.pallas_rnn,
         )
         self.network.forward(ctx, in_args)
         return ctx.outputs, ctx.state_updates

@@ -70,9 +70,9 @@ class LayerContext:
     # lax.scan unroll factor for recurrent layers/groups
     # (OptimizationConfig.scan_unroll; 1 = no unrolling)
     scan_unroll: int = 1
-    # OptimizationConfig.pallas_lstm: lstmemory layers use the fused
-    # Pallas sequence kernel when shapes/activations allow
-    pallas_lstm: bool = False
+    # OptimizationConfig.pallas_rnn: lstmemory/gated_recurrent layers use
+    # the fused Pallas sequence kernels when shapes/activations allow
+    pallas_rnn: bool = False
     # NHWC layout side-table (layer name -> [B, H, W, C] array): the conv
     # family publishes its pre-flatten output here and prefers consuming
     # it, so chains of conv/pool/bn/norm skip the per-layer

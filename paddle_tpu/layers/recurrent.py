@@ -105,6 +105,25 @@ def lstm_cell_step(
     return h, c
 
 
+def _pallas_rnn_path(ctx, cfg, a, x, mask, w, bias, usable_fn, fwd_fn):
+    """The fused Pallas kernel path shared by lstmemory/gated_recurrent,
+    or None to take the scan. Gating: single-device TPU only (inside a
+    GSPMD-sharded jit the pallas custom call has no partitioning rule;
+    non-TPU backends would run the Python interpreter — tests force it
+    via PADDLE_TPU_PALLAS_INTERPRET=1, production falls back to the
+    scan); shapes/activations/VMEM checked by the kernel's usable()."""
+    if not ctx.pallas_rnn or ctx.mesh is not None:
+        return None
+    import os
+
+    on_tpu = jax.default_backend() == "tpu"
+    force_interpret = os.environ.get("PADDLE_TPU_PALLAS_INTERPRET") == "1"
+    if not (on_tpu or force_interpret) or not usable_fn(cfg, x):
+        return None
+    ys = fwd_fn(cfg, x, mask, w, bias, interpret=not on_tpu)
+    return Argument(value=jnp.swapaxes(ys, 0, 1), seq_lengths=a.seq_lengths)
+
+
 @register_layer("lstmemory")
 def lstmemory_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Argument:
     a = inputs[0]
@@ -113,22 +132,14 @@ def lstmemory_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext)
     w = ctx.param(cfg.inputs[0].input_parameter_name).reshape(size, 4 * size)
     bias = ctx.param(cfg.bias_parameter_name).reshape(-1) if cfg.bias_parameter_name else None
 
-    # fused Pallas path: single-device TPU only (inside a GSPMD-sharded jit
-    # the pallas custom call has no partitioning rule; non-TPU backends
-    # would run the Python interpreter — tests force it via
-    # PADDLE_TPU_PALLAS_INTERPRET=1, production falls back to the scan)
-    if ctx.pallas_lstm and ctx.mesh is None:
-        import os
-
+    if ctx.pallas_rnn:
         from paddle_tpu.ops import pallas_lstm as pk
 
-        on_tpu = jax.default_backend() == "tpu"
-        force_interpret = os.environ.get("PADDLE_TPU_PALLAS_INTERPRET") == "1"
-        if (on_tpu or force_interpret) and pk.usable(cfg, x):
-            ys = pk.lstm_layer_forward(
-                cfg, x, mask, w, bias, interpret=not on_tpu,
-            )
-            return Argument(value=jnp.swapaxes(ys, 0, 1), seq_lengths=a.seq_lengths)
+        out = _pallas_rnn_path(
+            ctx, cfg, a, x, mask, w, bias, pk.usable, pk.lstm_layer_forward
+        )
+        if out is not None:
+            return out
 
     def cell(carry, x_t):
         h, c = carry
@@ -171,6 +182,15 @@ def gated_recurrent_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerCo
     size = cfg.size
     w = ctx.param(cfg.inputs[0].input_parameter_name).reshape(size, 3 * size)
     bias = ctx.param(cfg.bias_parameter_name).reshape(-1) if cfg.bias_parameter_name else None
+
+    if ctx.pallas_rnn:
+        from paddle_tpu.ops import pallas_gru as pg
+
+        out = _pallas_rnn_path(
+            ctx, cfg, a, x, mask, w, bias, pg.usable, pg.gru_layer_forward
+        )
+        if out is not None:
+            return out
 
     def cell(h, x_t):
         h2 = gru_cell_step(cfg, x_t, h, w, bias)

@@ -1,0 +1,242 @@
+"""Fused GRU sequence kernel (Pallas TPU) — the gated_recurrent analog of
+ops/pallas_lstm.py: the whole time scan in one kernel launch, carry and
+both recurrent weight blocks resident in VMEM.
+
+Cell semantics are exactly `gru_cell_step` (reference
+GatedRecurrentLayer.cpp / GruCompute contract, layers/recurrent.py:127):
+weight [H, 3H] split [update, reset | candidate]; bias 3H = 2H gate +
+H candidate (pre-added to the x-projection outside the kernel, so bias
+gradients ride the dx3 sum); output = update * prev + (1-update) * cand.
+Per step the kernel runs TWO MXU dots (gates: [B,H]x[H,2H]; candidate:
+[B,H]x[H,H]) plus VPU gate math. Backward is a reverse-grid kernel
+accumulating dW in VMEM, derivatives rebuilt from the saved
+post-activation (u, r, c) values.
+
+Correctness: interpret-mode parity in tests/test_pallas_gru.py.
+Enabled together with the LSTM kernel via settings(pallas_rnn=True).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from paddle_tpu.ops.pallas_lstm import _act, _dact, _params, pltpu, shape_ok
+
+Array = jax.Array
+
+
+def supported(act_in: str, act_gate: str, B: int, H: int,
+              itemsize: int = 4) -> bool:
+    return shape_ok((act_in, act_gate), B, H, gates=3, itemsize=itemsize,
+                    f32_state=False)
+
+
+def _cell_fwd(x3_ref, w_ref, h_scr, act_in, act_gate):
+    H = w_ref.shape[0]
+    h_prev = h_scr[:]                                   # [B, H] f32
+    w = w_ref[:]
+    wg, wc = w[:, : 2 * H], w[:, 2 * H :]
+    x3 = x3_ref[0].astype(jnp.float32)                  # [B, 3H]
+    xg, xc = x3[:, : 2 * H], x3[:, 2 * H :]
+    hp = h_prev.astype(w.dtype)
+    g = _act(act_gate, xg + jax.lax.dot(hp, wg, preferred_element_type=jnp.float32))
+    u, r = g[:, :H], g[:, H:]
+    cand = xc + jax.lax.dot(
+        (r * h_prev).astype(w.dtype), wc, preferred_element_type=jnp.float32
+    )
+    c = _act(act_in, cand)
+    h_new = u * h_prev + (1.0 - u) * c
+    return h_prev, h_new, u, r, c
+
+
+def _fwd_kernel(x3_ref, m_ref, w_ref, y_ref, acts_ref, hprev_ref,
+                h_scr, *, act_in, act_gate):
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _init():
+        h_scr[:] = jnp.zeros_like(h_scr)
+
+    h_prev, h_new, u, r, c = _cell_fwd(x3_ref, w_ref, h_scr, act_in, act_gate)
+    m = m_ref[:, 0:1].astype(jnp.float32)               # [B, 1]
+
+    hprev_ref[0] = h_prev.astype(hprev_ref.dtype)       # residuals (pre-update)
+    acts_ref[0] = jnp.concatenate([u, r, c], axis=1).astype(acts_ref.dtype)
+    y_ref[0] = (m * h_new).astype(y_ref.dtype)
+    h_scr[:] = m * h_new + (1.0 - m) * h_prev
+
+
+def _fwd_kernel_light(x3_ref, m_ref, w_ref, y_ref, h_scr, *, act_in, act_gate):
+    """Inference/eval variant: ys only (pallas outputs are never DCE'd)."""
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _init():
+        h_scr[:] = jnp.zeros_like(h_scr)
+
+    h_prev, h_new, _u, _r, _c = _cell_fwd(x3_ref, w_ref, h_scr, act_in, act_gate)
+    m = m_ref[:, 0:1].astype(jnp.float32)
+    y_ref[0] = (m * h_new).astype(y_ref.dtype)
+    h_scr[:] = m * h_new + (1.0 - m) * h_prev
+
+
+def _bwd_kernel(dy_ref, acts_ref, hprev_ref, m_ref, w_ref,
+                dx3_ref, dw_ref, dh_scr, *, act_in, act_gate):
+    idx = pl.program_id(0)  # walks t = T-1 .. 0 via the index maps
+
+    @pl.when(idx == 0)
+    def _init():
+        dh_scr[:] = jnp.zeros_like(dh_scr)
+        dw_ref[:] = jnp.zeros_like(dw_ref)
+
+    H = w_ref.shape[0]
+    acts = acts_ref[0].astype(jnp.float32)
+    u, r, c = acts[:, :H], acts[:, H : 2 * H], acts[:, 2 * H :]
+    h_prev = hprev_ref[0].astype(jnp.float32)
+    m = m_ref[:, 0:1].astype(jnp.float32)
+    DH = dh_scr[:]
+
+    dy = dy_ref[0].astype(jnp.float32)
+    dh = m * (DH + dy)                        # cell path; (1-m) passes through
+    du = dh * (h_prev - c)
+    dcand = dh * (1.0 - u) * _dact(act_in, c)
+    w = w_ref[:]
+    wg, wc = w[:, : 2 * H], w[:, 2 * H :]
+    drh = jax.lax.dot_general(
+        dcand.astype(w.dtype), wc, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                          # d(r*h_prev) [B, H]
+    dr = drh * h_prev
+    dgu = du * _dact(act_gate, u)
+    dgr = dr * _dact(act_gate, r)
+    dg = jnp.concatenate([dgu, dgr], axis=1)   # [B, 2H]
+    dx3_ref[0] = jnp.concatenate([dg, dcand], axis=1).astype(dx3_ref.dtype)
+
+    dh_prev = (
+        dh * u
+        + drh * r
+        + jax.lax.dot_general(
+            dg.astype(w.dtype), wg, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+    )
+    dh_scr[:] = dh_prev + (1.0 - m) * DH
+    dwg = jax.lax.dot_general(
+        h_prev, dg, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    dwc = jax.lax.dot_general(
+        r * h_prev, dcand, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    dw_ref[:] += jnp.concatenate([dwg, dwc], axis=1)     # [H, 3H]
+
+
+def _run_fwd(x3, mask_bt, w, acts, interpret, residuals=True):
+    T, B, H3 = x3.shape
+    H = H3 // 3
+    step3 = pl.BlockSpec((1, B, H3), lambda t: (t, 0, 0))
+    step1 = pl.BlockSpec((1, B, H), lambda t: (t, 0, 0))
+    mask_spec = pl.BlockSpec((B, 1), lambda t: (0, t))
+    wspec = pl.BlockSpec(w.shape, lambda t: (0, 0))
+    kern = functools.partial(
+        _fwd_kernel if residuals else _fwd_kernel_light,
+        act_in=acts[0], act_gate=acts[1],
+    )
+    out_specs = [step1]
+    out_shape = [jax.ShapeDtypeStruct((T, B, H), x3.dtype)]  # ys
+    if residuals:
+        out_specs += [step3, step1]
+        out_shape += [
+            jax.ShapeDtypeStruct((T, B, H3), x3.dtype),  # acts (u, r, c)
+            jax.ShapeDtypeStruct((T, B, H), x3.dtype),   # h_prev
+        ]
+    return pl.pallas_call(
+        kern,
+        grid=(T,),
+        in_specs=[step3, mask_spec, wspec],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=[pltpu.VMEM((B, H), jnp.float32)] if pltpu is not None else [],
+        interpret=interpret,
+        compiler_params=_params(1),
+    )(x3, mask_bt, w)
+
+
+def _run_bwd(dy, acts_seq, hprev, mask_bt, w, acts, interpret):
+    T, B, H3 = acts_seq.shape
+    H = H3 // 3
+    rev3 = pl.BlockSpec((1, B, H3), lambda i: (T - 1 - i, 0, 0))
+    rev1 = pl.BlockSpec((1, B, H), lambda i: (T - 1 - i, 0, 0))
+    mask_spec = pl.BlockSpec((B, 1), lambda i: (0, T - 1 - i))
+    wspec = pl.BlockSpec(w.shape, lambda i: (0, 0))
+    kern = functools.partial(_bwd_kernel, act_in=acts[0], act_gate=acts[1])
+    dx3, dw = pl.pallas_call(
+        kern,
+        grid=(T,),
+        in_specs=[rev1, rev3, rev1, mask_spec, wspec],
+        out_specs=[rev3, wspec],
+        out_shape=[
+            jax.ShapeDtypeStruct((T, B, H3), dy.dtype),
+            jax.ShapeDtypeStruct(w.shape, jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((B, H), jnp.float32)] if pltpu is not None else [],
+        interpret=interpret,
+        compiler_params=_params(1),
+    )(dy, acts_seq, hprev, mask_bt, w)
+    return dx3, dw.astype(w.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def fused_gru(x3, mask, w, acts, interpret):
+    """ys [T, B, H] = masked GRU over time-major x-projections.
+
+    x3: [T, B, 3H] x-projection with biases already added; mask: [T, B];
+    w: [H, 3H]; acts: (act_in, act_gate) static name pair."""
+    (ys,) = _run_fwd(x3, mask.T, w, acts, interpret, residuals=False)
+    return ys
+
+
+def _fused_fwd(x3, mask, w, acts, interpret):
+    ys, acts_seq, hprev = _run_fwd(x3, mask.T, w, acts, interpret)
+    return ys, (acts_seq, hprev, mask, w)
+
+
+def _fused_bwd(acts, interpret, res, dy):
+    acts_seq, hprev, mask, w = res
+    dx3, dw = _run_bwd(dy, acts_seq, hprev, mask.T, w, acts, interpret)
+    return dx3, jnp.zeros_like(mask), dw
+
+
+fused_gru.defvjp(_fused_fwd, _fused_bwd)
+
+
+def gru_layer_forward(cfg, x, mask, w, bias, interpret):
+    """The gated_recurrent layer body on the fused kernel: ys [T, B, H].
+
+    x: [T, B, 3H] pre-bias x-projection, bias: [3H] or None; handles
+    cfg.reversed by flipping time outside the kernel (same carry-masking
+    argument as the LSTM kernel)."""
+    if bias is not None:
+        x = x + bias.astype(x.dtype)
+    if cfg.reversed:
+        x = jnp.flip(x, 0)
+        mask = jnp.flip(mask, 0)
+    acts = (cfg.active_type or "tanh", cfg.active_gate_type or "sigmoid")
+    ys = fused_gru(x, mask, w, acts, interpret)
+    if cfg.reversed:
+        ys = jnp.flip(ys, 0)
+    return ys
+
+
+def usable(cfg, x) -> bool:
+    T, B, H3 = x.shape
+    if x.dtype not in (jnp.float32, jnp.bfloat16) or H3 != 3 * cfg.size:
+        return False
+    return supported(
+        cfg.active_type or "tanh", cfg.active_gate_type or "sigmoid", B, cfg.size,
+        itemsize=jnp.dtype(x.dtype).itemsize,
+    )

@@ -22,7 +22,7 @@ backward rebuilds everything else.
 
 Correctness: interpret-mode parity against the XLA scan path in
 tests/test_pallas_lstm.py (forward + grads, masked + reversed + peephole
-cases). Enabled per-config via settings(pallas_lstm=True); the layer
+cases). Enabled per-config via settings(pallas_rnn=True); the layer
 falls back to the scan path for unsupported shapes/activations.
 """
 
@@ -65,28 +65,53 @@ def _dact(name: str, y: Array) -> Array:
     return jnp.ones_like(y)  # linear
 
 
-def supported(act_in: str, act_gate: str, act_state: str, B: int, H: int) -> bool:
+# VMEM budget for one kernel invocation (per-core VMEM is ~16MB; leave
+# headroom for the compiler's own buffers). The backward kernel is the
+# binding case: it holds the recurrent weight, an f32 dW accumulator,
+# carry scratch, and double-buffered per-step blocks simultaneously —
+# configurations over budget fall back to the scan path instead of dying
+# in a VMEM-exceeded compile error. (bf16 flagship shapes: LSTM
+# B=256,H=512 ≈ 12.3MB; GRU encoder B=256,H=512 ≈ 8MB; an H=1024 LSTM
+# ≈ 25MB is correctly rejected.)
+_VMEM_BUDGET_BYTES = 14 * 1024 * 1024
+
+
+def _bwd_vmem_bytes(B: int, H: int, gates: int, itemsize: int,
+                    f32_state: bool) -> int:
+    w_and_dw = H * gates * H * (itemsize + 4)
+    per_step_in = B * gates * H * itemsize + 2 * B * H * itemsize
+    if f32_state:
+        per_step_in += B * H * 4                   # saved c_prev rides in f32
+    out_block = B * gates * H * itemsize
+    scratch = (2 if f32_state else 1) * B * H * 4
+    return w_and_dw + 2 * per_step_in + out_block + scratch
+
+
+def shape_ok(acts, B: int, H: int, gates: int, itemsize: int,
+             f32_state: bool) -> bool:
+    """Shared kernel gate: TPU pallas available, whitelisted activations,
+    MXU-friendly tiling, and the backward's VMEM residency fits."""
     return (
         pltpu is not None  # kernels need TPU scratch shapes even interpreted
-        and act_in in _ACTS and act_gate in _ACTS and act_state in _ACTS
+        and all(a in _ACTS for a in acts)
         and H % 128 == 0 and B % 8 == 0
+        and _bwd_vmem_bytes(B, H, gates, itemsize, f32_state) < _VMEM_BUDGET_BYTES
     )
+
+
+def supported(act_in: str, act_gate: str, act_state: str, B: int, H: int,
+              itemsize: int = 4) -> bool:
+    return shape_ok((act_in, act_gate, act_state), B, H, gates=4,
+                    itemsize=itemsize, f32_state=True)
 
 
 def _split4(g: Array, H: int):
     return g[:, :H], g[:, H : 2 * H], g[:, 2 * H : 3 * H], g[:, 3 * H :]
 
 
-def _fwd_kernel(x4_ref, m_ref, w_ref, peep_ref,
-                y_ref, acts_ref, hprev_ref, cprev_ref,
-                h_scr, c_scr, *, act_in, act_gate, act_state):
-    t = pl.program_id(0)
-
-    @pl.when(t == 0)
-    def _init():
-        h_scr[:] = jnp.zeros_like(h_scr)
-        c_scr[:] = jnp.zeros_like(c_scr)
-
+def _cell_fwd(x4_ref, w_ref, peep_ref, h_scr, c_scr, act_in, act_gate, act_state):
+    """One forward cell step from the VMEM carry; returns everything the
+    residual-saving kernel needs."""
     H = w_ref.shape[0]
     h_prev = h_scr[:]                                   # [B, H] f32
     c_prev = c_scr[:]
@@ -104,11 +129,47 @@ def _fwd_kernel(x4_ref, m_ref, w_ref, peep_ref,
     c_new = f * c_prev + i * a
     o = _act(act_gate, go + po * c_new)
     h_new = o * _act(act_state, c_new)
+    return h_prev, c_prev, h_new, c_new, a, i, f, o
+
+
+def _fwd_kernel(x4_ref, m_ref, w_ref, peep_ref,
+                y_ref, acts_ref, hprev_ref, cprev_ref,
+                h_scr, c_scr, *, act_in, act_gate, act_state):
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _init():
+        h_scr[:] = jnp.zeros_like(h_scr)
+        c_scr[:] = jnp.zeros_like(c_scr)
+
+    h_prev, c_prev, h_new, c_new, a, i, f, o = _cell_fwd(
+        x4_ref, w_ref, peep_ref, h_scr, c_scr, act_in, act_gate, act_state
+    )
     m = m_ref[:, 0:1].astype(jnp.float32)               # [B, 1]
 
     hprev_ref[0] = h_prev.astype(hprev_ref.dtype)       # residuals (pre-update)
     cprev_ref[0] = c_prev
     acts_ref[0] = jnp.concatenate([a, i, f, o], axis=1).astype(acts_ref.dtype)
+    y_ref[0] = (m * h_new).astype(y_ref.dtype)
+    h_scr[:] = m * h_new + (1.0 - m) * h_prev
+    c_scr[:] = m * c_new + (1.0 - m) * c_prev
+
+
+def _fwd_kernel_light(x4_ref, m_ref, w_ref, peep_ref, y_ref,
+                      h_scr, c_scr, *, act_in, act_gate, act_state):
+    """Inference/eval variant: ys only, no residual writes (pallas outputs
+    are never DCE'd, so the primal must not emit them at all)."""
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _init():
+        h_scr[:] = jnp.zeros_like(h_scr)
+        c_scr[:] = jnp.zeros_like(c_scr)
+
+    h_prev, c_prev, h_new, c_new, _a, _i, _f, _o = _cell_fwd(
+        x4_ref, w_ref, peep_ref, h_scr, c_scr, act_in, act_gate, act_state
+    )
+    m = m_ref[:, 0:1].astype(jnp.float32)
     y_ref[0] = (m * h_new).astype(y_ref.dtype)
     h_scr[:] = m * h_new + (1.0 - m) * h_prev
     c_scr[:] = m * c_new + (1.0 - m) * c_prev
@@ -176,7 +237,7 @@ def _params(n):
     return pltpu.CompilerParams(dimension_semantics=("arbitrary",) * n)
 
 
-def _run_fwd(x4, mask_bt, w, peep, acts, interpret):
+def _run_fwd(x4, mask_bt, w, peep, acts, interpret, residuals=True):
     T, B, H4 = x4.shape
     H = H4 // 4
     step_spec4 = pl.BlockSpec((1, B, H4), lambda t: (t, 0, 0))
@@ -184,19 +245,24 @@ def _run_fwd(x4, mask_bt, w, peep, acts, interpret):
     mask_spec = pl.BlockSpec((B, 1), lambda t: (0, t))
     const2 = lambda shape: pl.BlockSpec(shape, lambda t: (0, 0))
     kern = functools.partial(
-        _fwd_kernel, act_in=acts[0], act_gate=acts[1], act_state=acts[2]
+        _fwd_kernel if residuals else _fwd_kernel_light,
+        act_in=acts[0], act_gate=acts[1], act_state=acts[2],
     )
+    out_specs = [step_spec]
+    out_shape = [jax.ShapeDtypeStruct((T, B, H), x4.dtype)]          # ys
+    if residuals:
+        out_specs += [step_spec4, step_spec, step_spec]
+        out_shape += [
+            jax.ShapeDtypeStruct((T, B, H4), x4.dtype),      # acts (a,i,f,o)
+            jax.ShapeDtypeStruct((T, B, H), x4.dtype),       # h_prev
+            jax.ShapeDtypeStruct((T, B, H), jnp.float32),    # c_prev
+        ]
     return pl.pallas_call(
         kern,
         grid=(T,),
         in_specs=[step_spec4, mask_spec, const2(w.shape), const2(peep.shape)],
-        out_specs=[step_spec, step_spec4, step_spec, step_spec],
-        out_shape=[
-            jax.ShapeDtypeStruct((T, B, H), x4.dtype),       # ys
-            jax.ShapeDtypeStruct((T, B, H4), x4.dtype),      # acts (a,i,f,o)
-            jax.ShapeDtypeStruct((T, B, H), x4.dtype),       # h_prev
-            jax.ShapeDtypeStruct((T, B, H), jnp.float32),    # c_prev
-        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((B, H), jnp.float32),
             pltpu.VMEM((B, H), jnp.float32),
@@ -246,7 +312,7 @@ def fused_lstm(x4, mask, w, peep, acts, interpret):
     peep: [3, H] peephole vectors (zeros when absent);
     acts: (act_in, act_gate, act_state) static name triple.
     """
-    ys, _, _, _ = _run_fwd(x4, mask.T, w, peep, acts, interpret)
+    (ys,) = _run_fwd(x4, mask.T, w, peep, acts, interpret, residuals=False)
     return ys
 
 
@@ -299,10 +365,13 @@ def lstm_layer_forward(cfg, x, mask, w, bias, interpret):
 def usable(cfg, x) -> bool:
     """Shapes/activations the kernel handles (layer falls back otherwise)."""
     T, B, H4 = x.shape
+    if x.dtype not in (jnp.float32, jnp.bfloat16) or H4 != 4 * cfg.size:
+        return False
     return supported(
         cfg.active_type or "tanh",
         cfg.active_gate_type or "sigmoid",
         cfg.active_state_type or "sigmoid",
         B,
         cfg.size,
-    ) and H4 == 4 * cfg.size and x.dtype in (jnp.float32, jnp.bfloat16)
+        itemsize=jnp.dtype(x.dtype).itemsize,
+    )

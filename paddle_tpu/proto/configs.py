@@ -380,12 +380,13 @@ class OptimizationConfig(Message):
     # unrolling k steps per scan iteration lets XLA pipeline the per-step
     # MXU matmuls and amortize loop overhead, at k× program size. 1 = off.
     scan_unroll: int = 1
-    # run lstmemory layers through the fused Pallas sequence kernel
+    # run lstmemory/gated_recurrent layers through the fused Pallas
+    # sequence kernels
     # (ops/pallas_lstm.py): whole time scan in one kernel launch, carry +
     # recurrent weight resident in VMEM. Off by default until measured
     # faster on the target chip; layers fall back to lax.scan for
     # unsupported shapes/activations either way.
-    pallas_lstm: bool = False
+    pallas_rnn: bool = False
     # fuse k consecutive same-shape batches into ONE device launch
     # (lax.scan over stacked batches): amortizes per-dispatch host latency
     # when single steps are short — each batch still gets its own optimizer

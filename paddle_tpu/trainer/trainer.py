@@ -81,7 +81,7 @@ class Trainer:
         self.gm = GradientMachine(
             config.model_config, dtype=dtype, compute_dtype=compute_dtype,
             scan_unroll=config.opt_config.scan_unroll,
-            pallas_lstm=config.opt_config.pallas_lstm,
+            pallas_rnn=config.opt_config.pallas_rnn,
         )
         self.updater = Updater(
             config.opt_config, config.model_config,

@@ -283,6 +283,27 @@ def gru_group(
     gru_layer_attr=None,
 ) -> LayerOutput:
     name = name or current_context().unique_name("gru_group")
+    # The fixed step here is exactly one gru_unit, and the reference
+    # documents gru_group as "exactly the same calculation as the
+    # grumemory layer" (reference networks.py:741-755) — so at top level
+    # lower straight to the fused gated_recurrent layer: identical layer
+    # name, parameter names and shapes (checkpoint-compatible), one
+    # lax.scan instead of a per-step layer group, and the fused Pallas
+    # kernel applies under settings(pallas_rnn=True). Inside another
+    # recurrent_group the group form is kept (nested sub-scan contract).
+    if not current_context().submodel_stack:
+        assert size is None or input.size == 3 * size, (
+            f"gru_group size {size} does not match input size {input.size}"
+        )
+        return grumemory(
+            input=input,
+            name=name,
+            reverse=reverse,
+            act=act,
+            gate_act=gate_act,
+            bias_attr=gru_bias_attr if gru_bias_attr is not None else True,
+            layer_attr=gru_layer_attr,
+        )
 
     def _step(ipt):
         return gru_unit(

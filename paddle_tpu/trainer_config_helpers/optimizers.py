@@ -212,7 +212,7 @@ def settings(
     scan_unroll: Optional[int] = None,
     num_batches_per_send_parameter: Optional[int] = None,
     batches_per_launch: Optional[int] = None,
-    pallas_lstm: Optional[bool] = None,
+    pallas_rnn: Optional[bool] = None,
 ):
     ctx = current_context()
     s, defaults = ctx.settings, ctx.defaults
@@ -245,8 +245,8 @@ def settings(
         s["scan_unroll"] = scan_unroll
     if batches_per_launch is not None:
         s["batches_per_launch"] = batches_per_launch
-    if pallas_lstm is not None:
-        s["pallas_lstm"] = pallas_lstm
+    if pallas_rnn is not None:
+        s["pallas_rnn"] = pallas_rnn
     if num_batches_per_send_parameter is not None:
         # gradient accumulation: N batches per optimizer update
         s["num_batches_per_send_parameter"] = num_batches_per_send_parameter

@@ -125,12 +125,20 @@ def test_machine_level_parity(monkeypatch):
     tc = flagship_config(dict_dim=200, emb_dim=32, hidden=128, classes=2)
     tc.opt_config.batch_size = 16
     gm_off = GradientMachine(tc.model_config)
-    gm_on = GradientMachine(tc.model_config, pallas_lstm=True)
+    gm_on = GradientMachine(tc.model_config, pallas_rnn=True)
     params = gm_off.init_params(seed=3)
     batch = example_batch(dict_dim=200, B=16, T=12)
 
+    calls = []
+    orig = pk.lstm_layer_forward
+    monkeypatch.setattr(
+        pk, "lstm_layer_forward",
+        lambda *a, **k: (calls.append(1), orig(*a, **k))[1],
+    )
     l_off, g_off, _, _ = gm_off.grad_fn()(params, batch, None)
+    assert not calls  # pallas off → scan path
     l_on, g_on, _, _ = gm_on.grad_fn()(params, batch, None)
+    assert calls  # the kernel path actually engaged
     np.testing.assert_allclose(float(l_on), float(l_off), rtol=1e-5)
     for k in g_off:
         np.testing.assert_allclose(

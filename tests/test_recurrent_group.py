@@ -41,12 +41,17 @@ m1 = mixed_layer(name="proj_a", size=18,
 g1 = grumemory(input=m1, name="gru_fused",
                param_attr=ParamAttr(name="w_rec"),
                bias_attr=ParamAttr(name="b_rec"))
-# recurrent_group built from gru_step
+# recurrent_group built from gru_step (explicitly: gru_group itself now
+# LOWERS to the fused layer at top level, so the group form under test
+# must be constructed by hand)
 m2 = mixed_layer(name="proj_b", size=18,
                  input=[full_matrix_projection(x, param_attr=ParamAttr(name="w_in"))],
                  bias_attr=False)
-g2 = gru_group(input=m2, name="gru_grouped", size=6,
-               gru_bias_attr=ParamAttr(name="b_rec2"))
+g2 = recurrent_group(
+    name="gru_grouped_recurrent_group",
+    step=lambda ipt: gru_unit(input=ipt, name="gru_grouped", size=6,
+                              gru_bias_attr=ParamAttr(name="b_rec2")),
+    input=m2)
 outputs(g1)
 outputs(g2)
 """
@@ -70,6 +75,93 @@ def test_gru_group_matches_fused():
     fused = np.asarray(out["gru_fused"].value)
     grouped = np.asarray(out["gru_grouped"].value)
     np.testing.assert_allclose(fused, grouped, rtol=2e-5, atol=1e-5)
+
+
+def test_gru_group_inside_group_keeps_group_form():
+    """gru_group called inside another recurrent_group's step must keep
+    the group form (the lowering is top-level only: a gated_recurrent
+    full-sequence layer cannot run inside a sub-scan), and its numerics
+    must equal flat grumemory on each subsequence."""
+    from paddle_tpu.graph import make_seq
+    from paddle_tpu.graph.argument import Argument
+
+    NESTED = """
+from paddle_tpu.trainer_config_helpers import *
+settings(batch_size=4, learning_rate=1e-3)
+x = data_layer(name="x", size=12)
+def outer_step(sub):
+    m = mixed_layer(name="proj", size=18, bias_attr=False,
+        input=[full_matrix_projection(sub, param_attr=ParamAttr(name="w_in"))])
+    return gru_group(input=m, name="igru", size=6,
+                     gru_bias_attr=ParamAttr(name="b_rec"))
+out = recurrent_group(step=outer_step, input=SubsequenceInput(x), name="outer")
+outputs(out)
+"""
+    FLAT = """
+from paddle_tpu.trainer_config_helpers import *
+settings(batch_size=4, learning_rate=1e-3)
+x = data_layer(name="x", size=12)
+m = mixed_layer(name="proj", size=18, bias_attr=False,
+    input=[full_matrix_projection(x, param_attr=ParamAttr(name="w_in"))])
+g = grumemory(input=m, name="gflat", param_attr=ParamAttr(name="w_rec2"),
+              bias_attr=ParamAttr(name="b_rec"))
+outputs(g)
+"""
+    tc_n = parse_str(NESTED)
+    types = {l.name: l.type for l in tc_n.model_config.layers}
+    assert types["igru"] == "gru_step"  # group form kept inside a submodel
+
+    B, S, T = 2, 2, 4
+    rng = np.random.RandomState(1)
+    x_nest = rng.randn(B, S, T, 12).astype(np.float32)
+    n_subs = np.array([2, 1], np.int32)
+    sub_lens = np.array([[4, 2], [3, 0]], np.int32)
+    gm_n = GradientMachine(tc_n.model_config)
+    params = gm_n.init_params(seed=3)
+    out_n, _ = gm_n.forward(params, {"x": Argument(
+        value=jnp.asarray(x_nest),
+        seq_lengths=jnp.asarray(n_subs),
+        sub_seq_lengths=jnp.asarray(sub_lens),
+    )}, "test")
+    nested = np.asarray(out_n["outer"].value)          # [B, S, T, 6]
+
+    pairs = [(b, s) for b in range(B) for s in range(n_subs[b])]
+    x_flat = np.stack([x_nest[b, s] for b, s in pairs])
+    l_flat = np.array([sub_lens[b, s] for b, s in pairs], np.int32)
+    tc_f = parse_str(FLAT)
+    gm_f = GradientMachine(tc_f.model_config)
+    params_f = gm_f.init_params(seed=4)
+    params_f["w_in"] = params["w_in"]
+    params_f["b_rec"] = params["b_rec"]
+    inner_w = [k for k in params if k.startswith("_igru.w")][0]
+    params_f["w_rec2"] = params[inner_w].reshape(params_f["w_rec2"].shape)
+    out_f, _ = gm_f.forward(
+        params_f, {"x": make_seq(jnp.asarray(x_flat), jnp.asarray(l_flat))}, "test"
+    )
+    flat = np.asarray(out_f["gflat"].value)
+    for i, (b, s) in enumerate(pairs):
+        l = int(sub_lens[b, s])
+        np.testing.assert_allclose(
+            nested[b, s, :l], flat[i, :l], rtol=2e-5, atol=1e-6,
+            err_msg=f"subseq {(b, s)}",
+        )
+
+
+def test_gru_group_lowers_to_fused_layer():
+    # top-level gru_group emits ONE gated_recurrent layer (the reference
+    # documents the two as computing the same thing; the fused form is
+    # the fast one) with the group-era layer/parameter names preserved
+    tc = parse_str("""
+from paddle_tpu.trainer_config_helpers import *
+settings(batch_size=4, learning_rate=1e-3)
+x = data_layer(name="x", size=12)
+g = simple_gru(input=x, name="enc", size=4)
+outputs(g)
+""")
+    types = {l.name: l.type for l in tc.model_config.layers}
+    assert types["enc"] == "gated_recurrent"
+    assert "enc_recurrent_group" not in types
+    assert any(p.name == "_enc.w0" for p in tc.model_config.parameters)
 
 
 LSTM_PAIR = """
