@@ -336,6 +336,16 @@ def main():
     if not on_tpu:
         ensure_cpu_mesh(1)
 
+    # persistent compilation cache: repeat measurement sessions skip the
+    # slow (remote-tunnel) recompiles of unchanged steps; a cold cache is
+    # merely the old speed
+    import jax
+
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.environ.get("JAX_COMPILATION_CACHE_DIR", "/tmp/paddle_tpu_jax_cache"),
+    )
+
     # bf16 on XLA CPU is emulated and slow — CPU fallbacks run f32 so
     # their numbers stay comparable run-to-run
     leg_dtype = None if on_tpu else "float32"

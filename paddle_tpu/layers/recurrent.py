@@ -111,8 +111,10 @@ def _pallas_rnn_path(ctx, cfg, a, x, mask, w, bias, usable_fn, fwd_fn):
     GSPMD-sharded jit the pallas custom call has no partitioning rule;
     non-TPU backends would run the Python interpreter — tests force it
     via PADDLE_TPU_PALLAS_INTERPRET=1, production falls back to the
-    scan); shapes/activations/VMEM checked by the kernel's usable()."""
-    if not ctx.pallas_rnn or ctx.mesh is not None:
+    scan); shapes/activations/VMEM checked by the kernel's usable().
+    Callers guard on ctx.pallas_rnn BEFORE importing the kernel module,
+    keeping the ops import lazy on the default path."""
+    if ctx.mesh is not None:
         return None
     import os
 
