@@ -23,28 +23,32 @@ echo "--- f32 resnet A/B" >> $OUT
 PADDLE_TPU_BENCH_DTYPE=float32 PADDLE_TPU_BENCH_BUDGET=900 \
   timeout 1000 python bench.py resnet >> $OUT 2>>$ERR
 for u in 4 8; do
-  echo "--- unroll=$u lstm+nmt" >> $OUT
-  PADDLE_TPU_BENCH_UNROLL=$u PADDLE_TPU_BENCH_BUDGET=600 \
+  # SPL pinned to 1: the lstm leg's default is now k=8, and these rows
+  # must stay comparable with earlier k=1 unroll measurements
+  echo "--- unroll=$u lstm+nmt (k=1 control)" >> $OUT
+  PADDLE_TPU_BENCH_UNROLL=$u PADDLE_TPU_BENCH_STEPS_PER_LAUNCH=1 \
+    PADDLE_TPU_BENCH_BUDGET=600 \
     timeout 700 python bench.py lstm >> $OUT 2>>$ERR
-  PADDLE_TPU_BENCH_UNROLL=$u PADDLE_TPU_BENCH_BUDGET=600 \
+  PADDLE_TPU_BENCH_UNROLL=$u PADDLE_TPU_BENCH_STEPS_PER_LAUNCH=1 \
+    PADDLE_TPU_BENCH_BUDGET=600 \
     timeout 700 python bench.py nmt >> $OUT 2>>$ERR
 done
-# fused-launch A/B: k optimizer steps per device launch amortize the
-# remote tunnel's per-dispatch latency on the small recurrent legs
-for k in 8; do
-  echo "--- steps_per_launch=$k lstm+nmt" >> $OUT
-  PADDLE_TPU_BENCH_STEPS_PER_LAUNCH=$k PADDLE_TPU_BENCH_BUDGET=600 \
-    timeout 700 python bench.py lstm >> $OUT 2>>$ERR
-  PADDLE_TPU_BENCH_STEPS_PER_LAUNCH=$k PADDLE_TPU_BENCH_BUDGET=900 \
-    timeout 1000 python bench.py nmt >> $OUT 2>>$ERR
-done
+# fused-launch A/B vs the k=1 control (the lstm leg DEFAULTS to k=8 on
+# the accelerator now, so the control is the pinned run)
+echo "--- steps_per_launch=1 lstm control" >> $OUT
+PADDLE_TPU_BENCH_STEPS_PER_LAUNCH=1 PADDLE_TPU_BENCH_BUDGET=600 \
+  timeout 700 python bench.py lstm >> $OUT 2>>$ERR
+echo "--- steps_per_launch=8 nmt" >> $OUT
+PADDLE_TPU_BENCH_STEPS_PER_LAUNCH=8 PADDLE_TPU_BENCH_BUDGET=900 \
+  timeout 1000 python bench.py nmt >> $OUT 2>>$ERR
 # fused Pallas recurrent kernel A/B (whole scan in one kernel launch;
-# the nmt leg exercises the GRU kernel through the lowered encoder)
-echo "--- pallas_rnn lstm" >> $OUT
+# the nmt leg exercises the GRU kernel through the lowered encoder).
+# lstm runs both at the k=8 default and a pinned k=1 control
+echo "--- pallas_rnn lstm (k=8 default)" >> $OUT
 PADDLE_TPU_BENCH_PALLAS_RNN=1 PADDLE_TPU_BENCH_BUDGET=600 \
   timeout 700 python bench.py lstm >> $OUT 2>>$ERR
-echo "--- pallas_rnn + steps_per_launch=8 lstm" >> $OUT
-PADDLE_TPU_BENCH_PALLAS_RNN=1 PADDLE_TPU_BENCH_STEPS_PER_LAUNCH=8 \
+echo "--- pallas_rnn lstm (k=1 control)" >> $OUT
+PADDLE_TPU_BENCH_PALLAS_RNN=1 PADDLE_TPU_BENCH_STEPS_PER_LAUNCH=1 \
   PADDLE_TPU_BENCH_BUDGET=600 timeout 700 python bench.py lstm >> $OUT 2>>$ERR
 echo "--- pallas_rnn nmt" >> $OUT
 PADDLE_TPU_BENCH_PALLAS_RNN=1 PADDLE_TPU_BENCH_BUDGET=900 \
