@@ -103,6 +103,30 @@ def summarize(xplane_path: str):
     return tables
 
 
+_CATEGORIES = (
+    # (label, substrings matched against the lowered op name); first match
+    # wins, so scan whiles (whole loop bodies, matmul + elementwise mixed)
+    # are split out before the generic buckets
+    ("scan/while bodies", ("%while",)),
+    ("matmul/conv (MXU)", ("convolution", "dot")),
+    ("dynamic-slice/update", ("dynamic-slice", "dynamic-update")),
+    ("copy/transpose/reshape", ("copy", "transpose", "reshape", "bitcast")),
+    ("reduce", ("reduce",)),
+    ("fusion (elementwise etc.)", ("fusion",)),
+)
+
+
+def _category(name: str) -> str:
+    # match the DEFINING name only ("%fusion.26" of
+    # "%fusion.26 = bf16[...] fusion(f32[...] %reshape.4582, ...)") —
+    # the operand list repeats other ops' names and would misclassify
+    low = name.split(" = ", 1)[0].lower()
+    for label, keys in _CATEGORIES:
+        if any(k in low for k in keys):
+            return label
+    return "other"
+
+
 def print_summary(trace_dir: str, top: int = 20) -> int:
     files = _find_xplanes(trace_dir)
     if not files:
@@ -113,7 +137,13 @@ def print_summary(trace_dir: str, top: int = 20) -> int:
     for plane, (durs, count) in summarize(path).items():
         total_ps = sum(durs.values())
         print(f"\n== {plane}  (total {total_ps / 1e9:.3f} ms summed-event time)")
-        print(f"{'op':<58} {'ms':>9} {'%':>6} {'n':>7}")
+        # category roll-up first: the one-glance MXU-vs-overhead split
+        cats: collections.Counter = collections.Counter()
+        for name, ps in durs.items():
+            cats[_category(name)] += ps
+        for label, ps in cats.most_common():
+            print(f"  {label:<28} {ps / 1e9:9.3f} ms {100.0 * ps / max(total_ps, 1):6.1f}%")
+        print(f"\n{'op':<58} {'ms':>9} {'%':>6} {'n':>7}")
         for name, ps in durs.most_common(top):
             pct = 100.0 * ps / max(total_ps, 1)
             print(f"{name[:58]:<58} {ps / 1e9:9.3f} {pct:6.1f} {count[name]:7d}")

@@ -162,6 +162,29 @@ def test_fused_sequence_model_trains(tmp_path):
     assert err < 0.2
 
 
+def test_fused_nan_gate_fires_before_housekeeping(tmp_path):
+    # a non-finite loss inside a fused launch must abort with the launch
+    # batch index BEFORE any periodic housekeeping can observe (and e.g.
+    # checkpoint) the poisoned params
+    _fresh_flags(tmp_path, "out_nan")
+    cfg = _config(tmp_path, extra_settings=", batches_per_launch=4")
+    # Adam normalizes updates, so a large-but-finite lr keeps the loss
+    # finite; an inf lr poisons the params after the first update and the
+    # SECOND batch of the first launch sees a non-finite loss
+    cfg.opt_config.learning_rate = float("inf")
+    FLAGS.saving_period_by_batches = 1  # housekeeping WOULD save each batch
+    try:
+        t = Trainer(cfg)
+        with pytest.raises(FloatingPointError, match="launch of"):
+            t.train(num_passes=1)
+    finally:
+        FLAGS.saving_period_by_batches = 0
+    # the gate fired before per-batch housekeeping: despite a save period
+    # of one batch, no checkpoint of the poisoned params was written
+    save_dir = str(tmp_path / "out_nan")
+    assert not os.path.exists(save_dir) or not os.listdir(save_dir)
+
+
 def test_fused_rejects_accumulation(tmp_path):
     _fresh_flags(tmp_path, "out6")
     cfg = _config(
