@@ -147,6 +147,26 @@ def test_machine_level_parity(monkeypatch):
         )
 
 
+def test_remat_composes_with_kernel(monkeypatch):
+    # remat="full" wraps the loss in jax.checkpoint: the custom_vjp kernel
+    # must replay (forward-only primal) and still produce the same grads
+    monkeypatch.setenv("PADDLE_TPU_PALLAS_INTERPRET", "1")
+    from paddle_tpu.flagship import example_batch, flagship_config
+    from paddle_tpu.graph import GradientMachine
+
+    tc = flagship_config(dict_dim=200, emb_dim=32, hidden=128, classes=2)
+    gm = GradientMachine(tc.model_config, pallas_rnn=True)
+    params = gm.init_params(seed=3)
+    batch = example_batch(dict_dim=200, B=16, T=12)
+    l0, g0, _, _ = gm.grad_fn(remat="none")(params, batch, None)
+    l1, g1, _, _ = gm.grad_fn(remat="full")(params, batch, None)
+    np.testing.assert_allclose(float(l1), float(l0), rtol=1e-6)
+    for k in g0:
+        np.testing.assert_allclose(
+            np.asarray(g1[k]), np.asarray(g0[k]), rtol=1e-5, atol=1e-6, err_msg=k
+        )
+
+
 def test_unsupported_shapes_fall_back():
     # H not a lane multiple → usable() false; the layer silently uses scan
     assert not pk.usable(_cfg(size=96), jnp.zeros((4, 8, 384)))
