@@ -32,7 +32,7 @@ TPU-native formulation:
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -127,9 +127,13 @@ def _run_submodel_step(
     fed: Dict[str, Argument],
     rng: Optional[Array],
     skip: frozenset = frozenset(),
+    mixed_prologue: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Argument]:
     """Run the sub-model's layers once with pre-fed agent outputs.
-    ``skip`` names epilogue layers hoisted out of the scan."""
+    ``skip`` names epilogue layers hoisted out of the scan;
+    ``mixed_prologue`` maps a mixed layer to (skip_input_indices,
+    precomputed [B, out] slice) for projections hoisted BEFORE the scan
+    (see _plan_prologue)."""
     step_ctx = LayerContext(
         params=ctx.params,
         model=ctx.model,
@@ -141,6 +145,7 @@ def _run_submodel_step(
         compute_dtype=ctx.compute_dtype,
         no_cast_inputs=ctx.no_cast_inputs,
         scan_unroll=ctx.scan_unroll,
+        mixed_prologue=mixed_prologue,
     )
     # the parent link lets an inner group's ENTRY resolution (static
     # links, boot layers, nested in-links) see outer-scope layers without
@@ -310,6 +315,42 @@ def _plan_epilogue(network, sub: SubModelConfig):
     return epilogue, frontier
 
 
+def _plan_prologue(network, sub: SubModelConfig, epilogue: frozenset):
+    """Projection PROLOGUE hoisting: the input-side dual of the epilogue.
+
+    A mixed layer inside the scan often sums a carry-dependent projection
+    (attention context) with projections of plain scan inputs (the NMT
+    decoder's target-word projection, reference seqToseq_net.py:120-124).
+    The scan-input projections are time-parallel: compute them ONCE
+    outside the scan as a single [T, B, D] x [D, out] matmul (full MXU
+    tiles, one weight read) and feed the per-step slices in as extra scan
+    inputs; the step's mixed layer starts its sum from the precomputed
+    slice and skips those projection inputs.
+
+    Returns {mixed_layer_name: (input_index, ...)} naming the
+    weight-bearing projections (fc/trans_fc) whose source is a plain
+    non-subseq in-link agent. Epilogue layers are excluded (they already
+    run outside the scan).
+    """
+    layer_map = network.layer_map
+    in_links = {l.link_name for l in sub.in_links if not l.has_subseq}
+    plan = {}
+    for n in sub.layer_names:
+        lc = layer_map.get(n)
+        if lc is None or lc.type != "mixed" or n in epilogue:
+            continue
+        idxs = tuple(
+            idx
+            for idx, ic in enumerate(lc.inputs)
+            if ic.proj_conf is not None
+            and ic.proj_conf.type in ("fc", "trans_fc")
+            and ic.input_layer_name in in_links
+        )
+        if idxs:
+            plan[n] = idxs
+    return plan
+
+
 def _forward_scan(network, cfg: LayerConfig, sub: SubModelConfig, ctx: LayerContext) -> None:
     assert sub.in_links, f"recurrent group {cfg.name} has no sequence inputs"
     nested = any(link.has_subseq for link in sub.in_links)
@@ -385,8 +426,28 @@ def _forward_scan(network, cfg: LayerConfig, sub: SubModelConfig, ctx: LayerCont
     skip = frozenset(epilogue)
     inside_out_links = [l for l in out_links if l.layer_name not in skip]
 
+    # prologue hoisting: time-parallel projections of plain scan inputs
+    # computed once outside the scan (see _plan_prologue)
+    pro_plan = {} if nested else _plan_prologue(network, sub, skip)
+    pro_feeds: Dict[str, Array] = {}
+    if pro_plan:
+        from paddle_tpu.layers.core import apply_projection
+
+        for lname, idxs in pro_plan.items():
+            lc = network.layer_map[lname]
+            acc = None
+            for idx in idxs:
+                ic = lc.inputs[idx]
+                # the SAME projection code the in-scan path uses, applied
+                # to the [T, B, D] time-major stacked in-link in one matmul
+                y = apply_projection(
+                    ic.proj_conf, ic, Argument(value=xs_vals[ic.input_layer_name]), ctx
+                )
+                acc = y if acc is None else acc + y
+            pro_feeds[lname] = acc
+
     def step(carries, inp):
-        x_v, x_i, x_sl, m_t, t_idx = inp
+        x_v, x_i, x_sl, m_t, t_idx, x_pro = inp
         fed: Dict[str, Argument] = {}
         for link in sub.in_links:
             name = link.link_name
@@ -400,7 +461,12 @@ def _forward_scan(network, cfg: LayerConfig, sub: SubModelConfig, ctx: LayerCont
         for i, (mem, carry) in enumerate(zip(memories, carries)):
             fed[mem.link_name] = _memory_feed_arg(mem, carry)
         rng = jax.random.fold_in(base_rng, t_idx) if base_rng is not None else None
-        outs = _run_submodel_step(network, sub, ctx, fed, rng, skip=skip)
+        mixed_pro = {
+            lname: (pro_plan[lname], x_pro[lname]) for lname in x_pro
+        }
+        outs = _run_submodel_step(
+            network, sub, ctx, fed, rng, skip=skip, mixed_prologue=mixed_pro
+        )
         new_carries = []
         m = m_t[:, None]
         for i, (mem, old) in enumerate(zip(memories, carries)):
@@ -443,6 +509,7 @@ def _forward_scan(network, cfg: LayerConfig, sub: SubModelConfig, ctx: LayerCont
         xs_sublens,
         jnp.swapaxes(mask_bt, 0, 1),
         jnp.arange(T, dtype=jnp.int32),
+        pro_feeds,
     )
     _, (ys, frs) = jax.lax.scan(
         step, init_carries, xs, reverse=bool(sub.reversed), unroll=ctx.scan_unroll

@@ -73,6 +73,11 @@ class LayerContext:
     # OptimizationConfig.pallas_rnn: lstmemory/gated_recurrent layers use
     # the fused Pallas sequence kernels when shapes/activations allow
     pallas_rnn: bool = False
+    # recurrent-group prologue hoisting (graph/recurrent_group.py
+    # _plan_prologue): mixed layer name -> (skip_input_indices,
+    # precomputed [B, out] slice) for scan-input projections computed
+    # outside the scan; set only on per-step contexts
+    mixed_prologue: Optional[Dict[str, Any]] = None
     # NHWC layout side-table (layer name -> [B, H, W, C] array): the conv
     # family publishes its pre-flatten output here and prefers consuming
     # it, so chains of conv/pool/bn/norm skip the per-layer

@@ -145,10 +145,15 @@ def apply_projection(
 @register_layer("mixed")
 def mixed_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Argument:
     # ref: MixedLayer.cpp — sum of per-input projections plus operators.
-    acc: Optional[Array] = None
-    for in_cfg, arg in zip(cfg.inputs, inputs):
-        if in_cfg.proj_conf is None:
-            continue  # operator-only input
+    # Inside a recurrent-group scan, projections of plain scan inputs may
+    # have been hoisted before the scan (prologue hoisting): the sum then
+    # starts from the precomputed slice and skips those inputs.
+    pro = ctx.mixed_prologue.get(cfg.name) if ctx.mixed_prologue else None
+    skip_idx = frozenset(pro[0]) if pro else frozenset()
+    acc: Optional[Array] = pro[1] if pro else None
+    for i, (in_cfg, arg) in enumerate(zip(cfg.inputs, inputs)):
+        if in_cfg.proj_conf is None or i in skip_idx:
+            continue  # operator-only input / prologue-hoisted projection
         y = apply_projection(in_cfg.proj_conf, in_cfg, arg, ctx)
         acc = y if acc is None else acc + y
     for op in cfg.operator_confs:
