@@ -65,6 +65,7 @@ def fmt_row(when: str, context: str, rec: dict) -> list:
         cfg = ", ".join(
             f"{k}={extras[k]}"
             for k in ("dtype", "batch", "mfu", "hw_flops_util", "remat",
+                      "steps_per_launch", "pallas_rnn",
                       "device_kind", "skipped_rungs")
             if extras.get(k) is not None
         )
