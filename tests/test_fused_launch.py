@@ -162,6 +162,67 @@ def test_fused_sequence_model_trains(tmp_path):
     assert err < 0.2
 
 
+def test_fused_launch_composes_with_pallas_rnn(tmp_path, monkeypatch):
+    # both knobs at once: the pallas sequence kernel runs inside the
+    # fused-launch lax.scan body (a custom call in the scan is fine) and
+    # the trained parameters match the plain (unfused, scan-path) loop.
+    # B must satisfy the kernel's B % 8 gate or the pallas path silently
+    # declines (tests/test_pallas_lstm.py pins that rejection).
+    monkeypatch.setenv("PADDLE_TPU_PALLAS_INTERPRET", "1")
+    train_list = tmp_path / "train.list"
+    train_list.write_text("1\n")
+    test_list = tmp_path / "test.list"
+    test_list.write_text("99\n")
+
+    def cfg_src(extra):
+        return textwrap.dedent(f"""
+        from paddle_tpu.trainer_config_helpers import *
+
+        define_py_data_sources2(train_list={str(train_list)!r},
+                                test_list={str(test_list)!r},
+                                module="synthetic_bow", obj="process_seq")
+        settings(batch_size=40, learning_rate=0.01,
+                 learning_method=AdamOptimizer(){extra})
+        words = data_layer(name="words", size=100)
+        emb = embedding_layer(input=words, size=16)
+        lstm = simple_lstm(input=emb, size=128)
+        pool = pooling_layer(input=lstm, pooling_type=MaxPooling())
+        output = fc_layer(input=pool, size=2, act=SoftmaxActivation(), name="output")
+        label = data_layer(name="label", size=2)
+        outputs(classification_cost(input=output, label=label))
+        """)
+
+    from paddle_tpu.ops import pallas_lstm as pk
+
+    calls = []
+    orig = pk.lstm_layer_forward
+    monkeypatch.setattr(
+        pk, "lstm_layer_forward",
+        lambda *a, **k: (calls.append(1), orig(*a, **k))[1],
+    )
+
+    p_base = tmp_path / "base.py"
+    p_base.write_text(cfg_src(""))
+    _fresh_flags(tmp_path, "out_base")
+    t_base = Trainer(parse_config(str(p_base)))
+    t_base.train(num_passes=1)
+    assert not calls  # baseline: plain loop, scan path
+
+    p_both = tmp_path / "both.py"
+    p_both.write_text(cfg_src(", batches_per_launch=2, pallas_rnn=True"))
+    _fresh_flags(tmp_path, "out_both")
+    t_both = Trainer(parse_config(str(p_both)))
+    t_both.train(num_passes=1)
+    assert calls  # the kernel ran inside the fused-launch scan
+
+    assert int(t_both.opt_state.step) == int(t_base.opt_state.step) == 5
+    for k in t_base.params:
+        np.testing.assert_allclose(
+            np.asarray(t_both.params[k]), np.asarray(t_base.params[k]),
+            rtol=5e-4, atol=5e-5, err_msg=k,
+        )
+
+
 def test_fused_nan_gate_fires_before_housekeeping(tmp_path):
     # a non-finite loss inside a fused launch must abort with the launch
     # batch index BEFORE any periodic housekeeping can observe (and e.g.
