@@ -139,6 +139,25 @@ def test_machine_level_parity(monkeypatch):
         )
 
 
+def test_edge_lengths():
+    cfg = _cfg()
+    T, B, H = 3, 8, 128
+    ks = jax.random.split(jax.random.PRNGKey(7), 3)
+    x = jax.random.normal(ks[0], (T, B, 3 * H)) * 0.5
+    w = jax.random.normal(ks[1], (H, 3 * H)) * 0.05
+    bias = jax.random.normal(ks[2], (3 * H,)) * 0.1
+    lengths = jnp.asarray([0, 1, 3, 2, 0, 3, 1, 2], jnp.int32)
+    mask = (jnp.arange(T)[:, None] < lengths[None, :]).astype(x.dtype)
+    ref = _ref(cfg, x, mask, w, bias)
+    got = pg.gru_layer_forward(cfg, x, mask, w, bias, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5)
+    np.testing.assert_array_equal(np.asarray(got)[:, 0], 0.0)
+
+    ref1 = _ref(cfg, x[:1], mask[:1], w, bias)
+    got1 = pg.gru_layer_forward(cfg, x[:1], mask[:1], w, bias, interpret=True)
+    np.testing.assert_allclose(np.asarray(got1), np.asarray(ref1), rtol=2e-5, atol=2e-5)
+
+
 def test_unsupported_shapes_fall_back():
     assert not pg.usable(_cfg(size=96), jnp.zeros((4, 8, 288)))
     assert not pg.usable(_cfg(size=128), jnp.zeros((4, 6, 384)))  # B % 8

@@ -167,6 +167,30 @@ def test_remat_composes_with_kernel(monkeypatch):
         )
 
 
+def test_edge_lengths():
+    # zero-length sequences (all steps masked), T=1, and full-length rows
+    # in one batch — carry stays at init for masked steps, matching scan
+    cfg = _cfg()
+    T, B, H = 3, 8, 128
+    ks = jax.random.split(jax.random.PRNGKey(7), 3)
+    x = jax.random.normal(ks[0], (T, B, 4 * H)) * 0.5
+    w = jax.random.normal(ks[1], (H, 4 * H)) * 0.05
+    bias = jax.random.normal(ks[2], (7 * H,)) * 0.1
+    lengths = jnp.asarray([0, 1, 3, 2, 0, 3, 1, 2], jnp.int32)
+    mask = (jnp.arange(T)[:, None] < lengths[None, :]).astype(x.dtype)
+    ref = _ref(cfg, x, mask, w, bias)
+    got = pk.lstm_layer_forward(cfg, x, mask, w, bias, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5)
+    # zero-length rows emit exactly zeros
+    np.testing.assert_array_equal(np.asarray(got)[:, 0], 0.0)
+    np.testing.assert_array_equal(np.asarray(got)[:, 4], 0.0)
+
+    # T=1
+    ref1 = _ref(cfg, x[:1], mask[:1], w, bias)
+    got1 = pk.lstm_layer_forward(cfg, x[:1], mask[:1], w, bias, interpret=True)
+    np.testing.assert_allclose(np.asarray(got1), np.asarray(ref1), rtol=2e-5, atol=2e-5)
+
+
 def test_unsupported_shapes_fall_back():
     # H not a lane multiple → usable() false; the layer silently uses scan
     assert not pk.usable(_cfg(size=96), jnp.zeros((4, 8, 384)))
