@@ -74,6 +74,8 @@ def _leg_extras(spl=1, **kw):
         kw["steps_per_launch"] = spl
     if os.environ.get("PADDLE_TPU_BENCH_PALLAS_RNN") == "1":
         kw["pallas_rnn"] = True
+    if os.environ.get("PADDLE_TPU_BENCH_S2D") == "1":
+        kw["conv_s2d"] = True
     return kw
 
 
@@ -91,10 +93,13 @@ def _jit_train_step(tc, spl=1):
         tc.opt_config.scan_unroll = int(env_unroll)
     if os.environ.get("PADDLE_TPU_BENCH_PALLAS_RNN") == "1":
         tc.opt_config.pallas_rnn = True
+    if os.environ.get("PADDLE_TPU_BENCH_S2D") == "1":
+        tc.opt_config.conv_s2d = True
 
     gm = GradientMachine(tc.model_config, compute_dtype=compute_dtype_of(tc.opt_config),
                          scan_unroll=tc.opt_config.scan_unroll,
-                         pallas_rnn=tc.opt_config.pallas_rnn)
+                         pallas_rnn=tc.opt_config.pallas_rnn,
+                         conv_s2d=tc.opt_config.conv_s2d)
     updater = Updater(tc.opt_config, tc.model_config)
     params = gm.init_params(seed=1)
     opt_state = updater.init_state(params)

@@ -22,6 +22,9 @@ PADDLE_TPU_BENCH_TRACE_DIR=$PWD/benchmarks/traces PADDLE_TPU_BENCH_BUDGET=1400 \
 echo "--- f32 resnet A/B" >> $OUT
 PADDLE_TPU_BENCH_DTYPE=float32 PADDLE_TPU_BENCH_BUDGET=900 \
   timeout 1000 python bench.py resnet >> $OUT 2>>$ERR
+echo "--- resnet s2d stem A/B" >> $OUT
+PADDLE_TPU_BENCH_S2D=1 PADDLE_TPU_BENCH_BUDGET=900 \
+  timeout 1000 python bench.py resnet >> $OUT 2>>$ERR
 for u in 4 8; do
   # SPL pinned to 1: the lstm leg's default is now k=8, and these rows
   # must stay comparable with earlier k=1 unroll measurements

@@ -208,6 +208,7 @@ def _apply_settings(opt: OptimizationConfig, s: Dict[str, Any]) -> None:
         "scan_unroll",
         "batches_per_launch",
         "pallas_rnn",
+        "conv_s2d",
         "c1",
         "backoff",
         "owlqn_steps",

@@ -40,7 +40,8 @@ def compute_dtype_of(opt_config) -> Optional[Any]:
 
 class GradientMachine:
     def __init__(self, model: ModelConfig, dtype=jnp.float32, compute_dtype=None,
-                 scan_unroll: int = 1, pallas_rnn: bool = False):
+                 scan_unroll: int = 1, pallas_rnn: bool = False,
+                 conv_s2d: bool = False):
         self.model = model
         self.network = Network(model)
         self.dtype = dtype
@@ -53,6 +54,8 @@ class GradientMachine:
         self.scan_unroll = max(1, int(scan_unroll))
         # recurrent layers via the fused Pallas kernels (ops/pallas_lstm)
         self.pallas_rnn = bool(pallas_rnn)
+        # stem conv space-to-depth rewrite (layers/vision.py)
+        self.conv_s2d = bool(conv_s2d)
         self.mesh = None  # set by the trainer when running on a mesh
         self.param_configs: Dict[str, ParameterConfig] = {p.name: p for p in model.parameters}
         # data layers whose every consumer is a cost layer carry targets/
@@ -97,6 +100,7 @@ class GradientMachine:
             dtype=self.dtype, mesh=self.mesh, table_overrides=table_overrides,
             compute_dtype=self.compute_dtype, no_cast_inputs=self.no_cast_inputs,
             scan_unroll=self.scan_unroll, pallas_rnn=self.pallas_rnn,
+            conv_s2d=self.conv_s2d,
         )
         self.network.forward(ctx, in_args)
         return ctx.outputs, ctx.state_updates

@@ -90,6 +90,48 @@ def _conv2d(x_nhwc: Array, w_hwio: Array, stride: Tuple[int, int], padding, grou
     )
 
 
+def _stem_s2d_conv(x: Array, w_hwio: Array) -> Array:
+    """Space-to-depth rewrite of the 7x7/stride-2/pad-3 few-channel stem
+    conv (the ResNet conv1): C=3 wastes the MXU's 128-deep contraction,
+    so re-express the conv EXACTLY as a 4x4/stride-1 VALID conv over a
+    2x2 space-to-depth view with 4C input channels (the MLPerf trick).
+
+    Derivation: with x padded (4, 2) per spatial dim and the kernel
+    zero-padded 7→8 at the FRONT, y[i,j] = Σ_{u',v'<8} w'[u',v']
+    xp[2i+u', 2j+v']; substituting u' = 2α+a turns the sum into a 4x4
+    conv over X[i,j,(a,b,c)] = xp[2i+a, 2j+b, c]. Summation order aside,
+    this is the same arithmetic (parity pinned in tests/test_s2d.py)."""
+    B, H, W, C = x.shape
+    O = w_hwio.shape[-1]
+    xp = jnp.pad(x, ((0, 0), (4, 2), (4, 2), (0, 0)))
+    Hp, Wp = H + 6, W + 6
+    X = (
+        xp.reshape(B, Hp // 2, 2, Wp // 2, 2, C)
+        .transpose(0, 1, 3, 2, 4, 5)
+        .reshape(B, Hp // 2, Wp // 2, 4 * C)
+    )
+    w8 = jnp.pad(w_hwio, ((1, 0), (1, 0), (0, 0), (0, 0)))  # 7→8, zero row/col FIRST
+    w4 = (
+        w8.reshape(4, 2, 4, 2, C, O)
+        .transpose(0, 2, 1, 3, 4, 5)
+        .reshape(4, 4, 4 * C, O)
+    )
+    return _conv2d(X, w4, (1, 1), "VALID", 1)
+
+
+def _stem_s2d_applies(ctx, cc, fy, sy, py, h, w) -> bool:
+    return (
+        ctx.conv_s2d
+        and cc.channels <= 4
+        and fy == cc.filter_size == 7
+        and sy == cc.stride == 2
+        and py == cc.padding == 3
+        and cc.groups == 1
+        and h % 2 == 0
+        and w % 2 == 0
+    )
+
+
 def _conv_forward(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Argument:
     acc = None
     for in_cfg, arg in zip(cfg.inputs, inputs):
@@ -102,7 +144,10 @@ def _conv_forward(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -
         wf = ctx.param(in_cfg.input_parameter_name)
         wf = wf.reshape(cfg.num_filters, cc.filter_channels, fy, cc.filter_size)
         w_hwio = wf.transpose(2, 3, 1, 0)  # OIHW → HWIO
-        y = _conv2d(x, w_hwio, (sy, cc.stride), [(py, py), (cc.padding, cc.padding)], cc.groups)
+        if _stem_s2d_applies(ctx, cc, fy, sy, py, h, w):
+            y = _stem_s2d_conv(x, w_hwio)
+        else:
+            y = _conv2d(x, w_hwio, (sy, cc.stride), [(py, py), (cc.padding, cc.padding)], cc.groups)
         acc = y if acc is None else acc + y
     if cfg.bias_parameter_name:
         b = ctx.param(cfg.bias_parameter_name)

@@ -387,6 +387,11 @@ class OptimizationConfig(Message):
     # faster on the target chip; layers fall back to lax.scan for
     # unsupported shapes/activations either way.
     pallas_rnn: bool = False
+    # space-to-depth rewrite of few-channel 7x7/s2 stem convs (ResNet
+    # conv1) into an MXU-friendly 4x4/s1 conv over a 2x2-block view —
+    # exact arithmetic, summation order aside (layers/vision.py
+    # _stem_s2d_conv). Off by default until measured on the target chip.
+    conv_s2d: bool = False
     # fuse k consecutive same-shape batches into ONE device launch
     # (lax.scan over stacked batches): amortizes per-dispatch host latency
     # when single steps are short — each batch still gets its own optimizer

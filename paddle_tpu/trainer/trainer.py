@@ -82,6 +82,7 @@ class Trainer:
             config.model_config, dtype=dtype, compute_dtype=compute_dtype,
             scan_unroll=config.opt_config.scan_unroll,
             pallas_rnn=config.opt_config.pallas_rnn,
+            conv_s2d=config.opt_config.conv_s2d,
         )
         self.updater = Updater(
             config.opt_config, config.model_config,

@@ -213,6 +213,7 @@ def settings(
     num_batches_per_send_parameter: Optional[int] = None,
     batches_per_launch: Optional[int] = None,
     pallas_rnn: Optional[bool] = None,
+    conv_s2d: Optional[bool] = None,
 ):
     ctx = current_context()
     s, defaults = ctx.settings, ctx.defaults
@@ -247,6 +248,8 @@ def settings(
         s["batches_per_launch"] = batches_per_launch
     if pallas_rnn is not None:
         s["pallas_rnn"] = pallas_rnn
+    if conv_s2d is not None:
+        s["conv_s2d"] = conv_s2d
     if num_batches_per_send_parameter is not None:
         # gradient accumulation: N batches per optimizer update
         s["num_batches_per_send_parameter"] = num_batches_per_send_parameter

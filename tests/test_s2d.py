@@ -1,0 +1,107 @@
+"""Space-to-depth stem rewrite (conv_s2d): the 7x7/stride-2/pad-3
+few-channel conv re-expressed as a 4x4/stride-1 VALID conv over a 2x2
+space-to-depth view must be ARITHMETICALLY identical (summation order
+aside) to the direct convolution — values and gradients.
+"""
+
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu.graph  # noqa: F401  (break the layers<->graph import cycle)
+from paddle_tpu.layers.vision import _conv2d, _stem_s2d_conv
+
+
+def _pair(key, B=2, H=16, C=3, O=8):
+    kx, kw = jax.random.split(key)
+    x = jax.random.normal(kx, (B, H, H, C))
+    w = jax.random.normal(kw, (7, 7, C, O)) * 0.1
+    return x, w
+
+
+def _direct(x, w):
+    return _conv2d(x, w, (2, 2), [(3, 3), (3, 3)], 1)
+
+
+def test_value_parity():
+    for seed, H in ((0, 16), (1, 32), (2, 224)):
+        x, w = _pair(jax.random.PRNGKey(seed), B=1 if H == 224 else 2, H=H)
+        ref = _direct(x, w)
+        got = _stem_s2d_conv(x, w)
+        assert got.shape == ref.shape == (x.shape[0], H // 2, H // 2, 8)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_gradient_parity():
+    x, w = _pair(jax.random.PRNGKey(3))
+    cot = jax.random.normal(jax.random.PRNGKey(4), (2, 8, 8, 8))
+    gr = jax.grad(lambda x, w: jnp.sum(_direct(x, w) * cot), (0, 1))(x, w)
+    gs = jax.grad(lambda x, w: jnp.sum(_stem_s2d_conv(x, w) * cot), (0, 1))(x, w)
+    for r, s, name in zip(gr, gs, ("dx", "dw")):
+        np.testing.assert_allclose(np.asarray(s), np.asarray(r),
+                                   rtol=1e-4, atol=1e-5, err_msg=name)
+
+
+def test_machine_level_parity(tmp_path):
+    # a DSL conv layer with the stem shape: conv_s2d on vs off — same
+    # forward output through the whole layer (bias + activation included)
+    from paddle_tpu.config import parse_config
+    from paddle_tpu.graph import GradientMachine, make_dense
+
+    src = textwrap.dedent("""
+    from paddle_tpu.trainer_config_helpers import *
+
+    settings(batch_size=4, learning_rate=1e-3)
+    img = data_layer(name="input", size=16 * 16 * 3)
+    conv = img_conv_layer(name="stem", input=img, filter_size=7,
+                          num_filters=8, num_channels=3, stride=2,
+                          padding=3, act=ReluActivation())
+    outputs(conv)
+    """)
+    p = tmp_path / "stem.py"
+    p.write_text(src)
+    tc = parse_config(str(p))
+    gm_off = GradientMachine(tc.model_config)
+    gm_on = GradientMachine(tc.model_config, conv_s2d=True)
+    params = gm_off.init_params(seed=5)
+    rng = np.random.RandomState(0)
+    batch = {"input": make_dense(rng.randn(4, 3 * 16 * 16).astype(np.float32))}
+    out_off, _ = gm_off.forward(params, batch, "test")
+    out_on, _ = gm_on.forward(params, batch, "test")
+    np.testing.assert_allclose(
+        np.asarray(out_on["stem"].value), np.asarray(out_off["stem"].value),
+        rtol=1e-5, atol=1e-5,
+    )
+
+
+def test_non_stem_shapes_unchanged(tmp_path):
+    # a 3x3/s1 conv must NOT take the rewrite even with the knob on
+    from paddle_tpu.config import parse_config
+    from paddle_tpu.graph import GradientMachine, make_dense
+
+    src = textwrap.dedent("""
+    from paddle_tpu.trainer_config_helpers import *
+
+    settings(batch_size=2, learning_rate=1e-3)
+    img = data_layer(name="input", size=8 * 8 * 3)
+    conv = img_conv_layer(name="c3", input=img, filter_size=3,
+                          num_filters=4, num_channels=3, stride=1,
+                          padding=1, act=LinearActivation())
+    outputs(conv)
+    """)
+    p = tmp_path / "c3.py"
+    p.write_text(src)
+    tc = parse_config(str(p))
+    gm_off = GradientMachine(tc.model_config)
+    gm_on = GradientMachine(tc.model_config, conv_s2d=True)
+    params = gm_off.init_params(seed=6)
+    rng = np.random.RandomState(1)
+    batch = {"input": make_dense(rng.randn(2, 3 * 8 * 8).astype(np.float32))}
+    out_off, _ = gm_off.forward(params, batch, "test")
+    out_on, _ = gm_on.forward(params, batch, "test")
+    np.testing.assert_array_equal(
+        np.asarray(out_on["c3"].value), np.asarray(out_off["c3"].value)
+    )
