@@ -35,6 +35,18 @@ def test_value_parity():
                                    rtol=1e-5, atol=1e-5)
 
 
+def test_value_parity_other_channel_counts():
+    # the gate allows C <= 4 (e.g. grayscale or RGBA stems)
+    for C in (1, 2, 4):
+        kx, kw = jax.random.split(jax.random.PRNGKey(10 + C))
+        x = jax.random.normal(kx, (2, 16, 16, C))
+        w = jax.random.normal(kw, (7, 7, C, 8)) * 0.1
+        np.testing.assert_allclose(
+            np.asarray(_stem_s2d_conv(x, w)), np.asarray(_direct(x, w)),
+            rtol=1e-5, atol=1e-5, err_msg=f"C={C}",
+        )
+
+
 def test_gradient_parity():
     x, w = _pair(jax.random.PRNGKey(3))
     cot = jax.random.normal(jax.random.PRNGKey(4), (2, 8, 8, 8))
