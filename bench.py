@@ -316,6 +316,26 @@ def bench_nmt(B=None, T=32, vocab=30000, dim=512, steps=10, warmup=2, dtype=None
     return _try_ladder(ladder, run_one)
 
 
+def _load_last_measured():
+    """Newest committed real-TPU rows (benchmarks/measured_tpu.json,
+    refreshed by append_results.py after every measurement session).
+    Embedded under "last_measured" whenever this run falls back to CPU
+    smoke, so the driver's bench artifact always carries the best
+    available hardware evidence — clearly labeled as prior-window
+    measurements, never mixed into the live numbers."""
+    path = os.path.join(REPO, "benchmarks", "measured_tpu.json")
+    try:
+        with open(path) as f:
+            rows = json.load(f).get("rows")
+        if not rows:
+            return None
+        return {"note": "prior-window real-TPU measurements (this run fell "
+                        "back to CPU); see benchmarks/RESULTS.md",
+                **rows}
+    except Exception:
+        return None
+
+
 def _emit(metric, value, unit, vs_baseline, **extra):
     line = {
         "metric": metric,
@@ -400,6 +420,8 @@ def main():
     target = targets.get(tkey) if tkey else None
     vs_baseline = value / target if target else 1.0
     common = dict(backend=backend, baseline_kind="estimated" if target else "none")
+    if not on_tpu:
+        common["last_measured"] = _load_last_measured()
     # emit the headline IMMEDIATELY — if a later leg hangs past the
     # supervisor budget, the measured number is already on stdout (the
     # supervisor keeps the LAST parseable line and salvages timed-out

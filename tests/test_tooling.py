@@ -287,3 +287,53 @@ def test_append_results_sanitizes_and_sections(tmp_path, monkeypatch):
     assert ar.main([str(raw)]) == 0
     assert results.read_text().count("auto-appended") == 1
     assert results.read_text().count("leg failed") == 2
+
+
+def test_refresh_measured_json_headline_precedence(tmp_path, monkeypatch):
+    """measured_tpu.json refresh: the production ("headline") row must win
+    over later A/B contexts for the same metric, newest wins within a
+    precedence class, legs are flattened, and a prior embedded
+    last_measured key can never feed back into the file."""
+    sys.path.insert(0, REPO)
+    from benchmarks import append_results as ar
+
+    import json as _json
+
+    raw = tmp_path / "raw.txt"
+    raw.write_text(
+        '=== TPU session\n'
+        '{"metric": "m_res", "value": 2400.0, "unit": "i/s", "backend": "axon",'
+        ' "dtype": "bfloat16", "last_measured": {"old": 1},'
+        ' "legs": {"m_lstm": {"value": 6.0, "unit": "t/s"}}}\n'
+        '--- f32 A/B\n'
+        '{"metric": "m_res", "value": 1300.0, "unit": "i/s", "backend": "axon",'
+        ' "dtype": "float32"}\n'
+        '--- pallas nmt\n'
+        '{"metric": "m_nmt", "value": 400.0, "unit": "t/s", "backend": "axon"}\n'
+        '--- pallas nmt retry\n'
+        '{"metric": "m_nmt", "value": 410.0, "unit": "t/s", "backend": "axon"}\n'
+        '--- cpu smoke\n'
+        '{"metric": "m_cpu", "value": 1.0, "unit": "i/s", "backend": "cpu"}\n'
+    )
+    monkeypatch.setattr(ar, "HERE", str(tmp_path))
+    n = ar.refresh_measured_json(ar.parse_session(str(raw)), "2026-07-31 16:00Z")
+    assert n == 3
+    doc = _json.loads((tmp_path / "measured_tpu.json").read_text())
+    rows = doc["rows"]
+    # headline beat the later f32 A/B for the same metric
+    assert rows["m_res"]["value"] == 2400.0 and rows["m_res"]["dtype"] == "bfloat16"
+    assert "session_leg" not in rows["m_res"]
+    # headline legs are flattened with the session backend
+    assert rows["m_lstm"]["value"] == 6.0 and rows["m_lstm"]["backend"] == "axon"
+    # newest non-headline wins when the headline lacks the metric
+    assert rows["m_nmt"]["value"] == 410.0
+    assert rows["m_nmt"]["session_leg"] == "pallas nmt retry"
+    # CPU smoke never lands; embedded last_measured never feeds back
+    assert "m_cpu" not in rows
+    assert "last_measured" not in rows["m_res"]
+
+    # a malformed file must not abort main()'s RESULTS.md append
+    (tmp_path / "measured_tpu.json").write_text('{"rows": "oops"}')
+    (tmp_path / "RESULTS.md").write_text("# log\n")
+    assert ar.main([str(raw)]) == 0
+    assert "m_res" in (tmp_path / "RESULTS.md").read_text()
