@@ -5,8 +5,9 @@ north-star workload from BASELINE.md. The default run ("all") also times
 the two sequence flagships — the stacked-LSTM classifier and the seqToseq
 NMT attention encoder-decoder (demo/seqToseq, reference
 demo/seqToseq/seqToseq_net.py:65-181) — and reports them in the same JSON
-line under "legs", plus an MFU figure (see benchmarks/mfu.py: XLA
-cost-analysis FLOPs of the compiled step / wall-clock / chip peak).
+line under "legs", plus an MFU figure (see benchmarks/mfu.py: analytic
+model matmul FLOPs from a jaxpr walk of the step / wall-clock / chip
+peak).
 `python bench.py resnet|lstm|nmt` runs a single leg. vs_baseline is
 measured against benchmarks/targets.json when present (the reference
 publishes no numbers — BASELINE.md; targets are clearly-labeled estimates,
@@ -126,31 +127,59 @@ def _jit_train_step(tc, spl=1):
         step = jax.jit(multi, donate_argnums=(0, 1))
     else:
         step = jax.jit(one_step, donate_argnums=(0, 1))
-    return step, params, opt_state
+    # one_step is returned for FLOP counting: always the per-step
+    # computation, so _time_steps' explicit ×spl stays correct however
+    # the fused fori lowers
+    return step, params, opt_state, one_step
 
 
-def _time_steps(step, params, opt_state, batch, bs, steps, warmup, trace=False, spl=1):
+def _time_steps(step, params, opt_state, batch, bs, steps, warmup, trace=False, spl=1,
+                count_fn=None):
     """Returns (elapsed seconds, flops-per-LAUNCH or None) — a launch is
     ``spl`` fused optimizer steps, and the elapsed time likewise covers
-    ``steps`` launches, so callers must treat both as per-launch."""
+    ``steps`` launches, so callers must treat both as per-launch.
+
+    FLOPs are analytic MODEL matmul FLOPs from a jaxpr walk of
+    ``count_fn`` (the per-step function) — NOT XLA's cost analysis, which
+    counts scan/while bodies once regardless of trip count and so
+    understated the recurrent legs' MFU several-fold in round 4 (and
+    cannot see inside pallas_call custom calls at all). See
+    paddle_tpu/ops/kernel_flops.py. Cost analysis remains the fallback
+    when no count_fn is given."""
     import jax
 
     from benchmarks.mfu import flops_of_compiled
+    from paddle_tpu.ops.kernel_flops import capture as kernel_flops_capture
+    from paddle_tpu.ops.kernel_flops import train_step_flops
 
+    flops = None
+    if count_fn is not None:
+        try:
+            flops = train_step_flops(count_fn, params, opt_state, batch, bs)
+        except Exception:
+            flops = None
     # AOT-compile ONCE and drive the loop with the same executable the
-    # cost analysis describes (jit dispatch would compile a second time)
+    # cost analysis describes (jit dispatch would compile a second time).
+    # The capture collects analytic FLOP counts recorded by any fused
+    # Pallas kernels traced inside the step — the cost-analysis fallback
+    # cannot see into a pallas_call custom call
     try:
-        compiled = step.lower(params, opt_state, batch, bs).compile()
-        flops = flops_of_compiled(compiled)
-        # XLA's cost analysis counts a while/fori body ONCE regardless of
-        # trip count (verified empirically: fori_loop(8) over a matmul
-        # reports the same flops as one matmul), so the fused-launch knob
-        # must scale the count or MFU understates by k
+        with kernel_flops_capture() as kernel_log:
+            lowered = step.lower(params, opt_state, batch, bs)
+        compiled = lowered.compile()
+        if flops is None:
+            flops = flops_of_compiled(compiled)
+            if flops is not None and kernel_log:
+                flops += sum(kernel_log)
+        # per-launch basis: count_fn counts ONE step, and XLA's cost
+        # analysis counts a fori body once (verified empirically), so
+        # both bases scale by the fused-launch factor
         if flops is not None:
             flops *= spl
         step = compiled
     except Exception:
-        flops = None  # fall back to the jit dispatch path
+        if flops is not None:
+            flops *= spl  # still per-launch on the jit dispatch path
     # sync via host readback: on the axon TPU platform block_until_ready
     # returns before execution finishes, but a device→host transfer of the
     # loss (which transitively depends on every step) cannot
@@ -242,11 +271,11 @@ def bench_resnet50(B=None, img_size=224, classes=1000, steps=20, warmup=3, trace
         tc.opt_config.dtype = dtype or BENCH_DTYPE
         tc.opt_config.remat = remat
         spl = _leg_spl(1)  # long compute-bound steps: fusing launches is noise
-        step, params, opt_state = _jit_train_step(tc, spl)
+        step, params, opt_state, one_step = _jit_train_step(tc, spl)
         batch = make_image_batch(b, img_size, classes)
         dt, flops = _time_steps(
             step, params, opt_state, batch, jnp.asarray(float(b)), steps, warmup,
-            trace=trace and TRACE_LEG in ("", "resnet"), spl=spl,
+            trace=trace and TRACE_LEG in ("", "resnet"), spl=spl, count_fn=one_step,
         )
         m, kind = _mfu_of(flops, dt, steps)
         extras = _leg_extras(spl=spl, device_kind=kind, dtype=tc.opt_config.dtype, batch=b)
@@ -277,11 +306,11 @@ def bench_lstm_classifier(B=256, T=64, steps=20, warmup=3, dtype=None):
     # vs 4.31M tok/s at k=1 — this leg is dispatch-latency-bound); plain
     # single launches on the CPU smoke path
     spl = _leg_spl(8 if jax.default_backend() != "cpu" else 1)
-    step, params, opt_state = _jit_train_step(tc, spl)
+    step, params, opt_state, one_step = _jit_train_step(tc, spl)
     batch = example_batch(dict_dim=10000, B=B, T=T)
     dt, flops = _time_steps(
         step, params, opt_state, batch, jnp.asarray(float(B)), steps, warmup,
-        trace=TRACE_LEG == "lstm", spl=spl,
+        trace=TRACE_LEG == "lstm", spl=spl, count_fn=one_step,
     )
     m, _ = _mfu_of(flops, dt, steps)
     extras = _leg_extras(spl=spl, mfu=m, dtype=tc.opt_config.dtype)
@@ -302,11 +331,11 @@ def bench_nmt(B=None, T=32, vocab=30000, dim=512, steps=10, warmup=2, dtype=None
         tc = nmt_config(vocab=vocab, dim=dim, dtype=dtype or BENCH_DTYPE)
         tc.opt_config.batch_size = b
         spl = _leg_spl(1)  # k=8 unmeasured here (big-graph compile risk)
-        step, params, opt_state = _jit_train_step(tc, spl)
+        step, params, opt_state, one_step = _jit_train_step(tc, spl)
         batch = nmt_batch(vocab=vocab, B=b, T=T)
         dt, flops = _time_steps(
             step, params, opt_state, batch, jnp.asarray(float(b)), steps, warmup,
-            trace=TRACE_LEG == "nmt", spl=spl,
+            trace=TRACE_LEG == "nmt", spl=spl, count_fn=one_step,
         )
         m, _ = _mfu_of(flops, dt, steps)
         extras = _leg_extras(spl=spl, mfu=m, dtype=tc.opt_config.dtype, tokens="target", batch=b)
@@ -421,7 +450,9 @@ def main():
     vs_baseline = value / target if target else 1.0
     common = dict(backend=backend, baseline_kind="estimated" if target else "none")
     if not on_tpu:
-        common["last_measured"] = _load_last_measured()
+        last_measured = _load_last_measured()
+        if last_measured:
+            common["last_measured"] = last_measured
     # emit the headline IMMEDIATELY — if a later leg hangs past the
     # supervisor budget, the measured number is already on stdout (the
     # supervisor keeps the LAST parseable line and salvages timed-out

@@ -1,10 +1,16 @@
 """MFU (model FLOPs utilization) accounting for bench.py.
 
-MFU = executed FLOPs per second / peak bf16 FLOPs of the chip. The FLOP
-count comes from XLA's own cost analysis of the *compiled* train step
-(`jitted.lower(...).compile().cost_analysis()['flops']`) — the same
-computation the timed loop executes, so together with the wall-clock
-step time this is the standard MFU formula. `bench.py` can additionally
+MFU = model FLOPs per second / peak bf16 FLOPs of the chip. Since round
+5 the model-FLOP count comes from an exact jaxpr walk of the per-step
+train function (`paddle_tpu.ops.kernel_flops.train_step_flops`): dot and
+conv FLOPs, scan bodies multiplied by their static length, pallas kernel
+bodies multiplied by their grid size. XLA's own cost analysis
+(`flops_of_compiled` below) remains as the fallback basis, but it counts
+a scan/while body ONCE regardless of trip count and cannot see inside
+pallas_call custom calls — which understated the recurrent legs' MFU
+several-fold through round 4 (restated in RESULTS.md). When the
+fallback is used with pallas kernels in the step, their analytic counts
+(recorded at trace time) are added to partially compensate. `bench.py` can additionally
 capture an xplane trace of the timed window (PADDLE_TPU_BENCH_TRACE_DIR)
 for profile-level verification of the step time; the trace is for
 inspection, the MFU number printed in the bench JSON comes from the

@@ -122,7 +122,10 @@ def _pallas_rnn_path(ctx, cfg, a, x, mask, w, bias, usable_fn, fwd_fn):
     force_interpret = os.environ.get("PADDLE_TPU_PALLAS_INTERPRET") == "1"
     if not (on_tpu or force_interpret) or not usable_fn(cfg, x):
         return None
-    ys = fwd_fn(cfg, x, mask, w, bias, interpret=not on_tpu)
+    # the env flag wins even on TPU so a compiled-kernel discrepancy can
+    # be A/B'd in interpret mode on the device where it manifests (off
+    # TPU the guard above already required the flag)
+    ys = fwd_fn(cfg, x, mask, w, bias, interpret=force_interpret)
     return Argument(value=jnp.swapaxes(ys, 0, 1), seq_lengths=a.seq_lengths)
 
 

@@ -196,17 +196,29 @@ def fused_gru(x3, mask, w, acts, interpret):
 
     x3: [T, B, 3H] x-projection with biases already added; mask: [T, B];
     w: [H, 3H]; acts: (act_in, act_gate) static name pair."""
+    from paddle_tpu.ops import kernel_flops
+
+    T, B, H3 = x3.shape
+    kernel_flops.record(kernel_flops.gru_fwd_flops(T, B, H3 // 3))
     (ys,) = _run_fwd(x3, mask.T, w, acts, interpret, residuals=False)
     return ys
 
 
 def _fused_fwd(x3, mask, w, acts, interpret):
+    from paddle_tpu.ops import kernel_flops
+
+    T, B, H3 = x3.shape
+    kernel_flops.record(kernel_flops.gru_fwd_flops(T, B, H3 // 3))
     ys, acts_seq, hprev = _run_fwd(x3, mask.T, w, acts, interpret)
     return ys, (acts_seq, hprev, mask, w)
 
 
 def _fused_bwd(acts, interpret, res, dy):
+    from paddle_tpu.ops import kernel_flops
+
     acts_seq, hprev, mask, w = res
+    T, B, H3 = acts_seq.shape
+    kernel_flops.record(kernel_flops.gru_bwd_flops(T, B, H3 // 3))
     dx3, dw = _run_bwd(dy, acts_seq, hprev, mask.T, w, acts, interpret)
     return dx3, jnp.zeros_like(mask), dw
 

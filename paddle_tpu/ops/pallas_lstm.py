@@ -312,17 +312,29 @@ def fused_lstm(x4, mask, w, peep, acts, interpret):
     peep: [3, H] peephole vectors (zeros when absent);
     acts: (act_in, act_gate, act_state) static name triple.
     """
+    from paddle_tpu.ops import kernel_flops
+
+    T, B, H4 = x4.shape
+    kernel_flops.record(kernel_flops.lstm_fwd_flops(T, B, H4 // 4))
     (ys,) = _run_fwd(x4, mask.T, w, peep, acts, interpret, residuals=False)
     return ys
 
 
 def _fused_fwd(x4, mask, w, peep, acts, interpret):
+    from paddle_tpu.ops import kernel_flops
+
+    T, B, H4 = x4.shape
+    kernel_flops.record(kernel_flops.lstm_fwd_flops(T, B, H4 // 4))
     ys, acts_seq, hprev, cprev = _run_fwd(x4, mask.T, w, peep, acts, interpret)
     return ys, (acts_seq, hprev, cprev, mask, w, peep)
 
 
 def _fused_bwd(acts, interpret, res, dy):
+    from paddle_tpu.ops import kernel_flops
+
     acts_seq, hprev, cprev, mask, w, peep = res
+    T, B, H4 = acts_seq.shape
+    kernel_flops.record(kernel_flops.lstm_bwd_flops(T, B, H4 // 4))
     dx4, dw, dpeep = _run_bwd(
         dy, (acts_seq, hprev, cprev), mask.T, w, peep, acts, interpret
     )
