@@ -592,7 +592,6 @@ class Trainer:
                 jax.profiler.start_trace(self.flags.profile_dir)
                 profiling = True
                 logger.info("profiler trace started → %s", self.flags.profile_dir)
-            rng, step_rng = jax.random.split(rng)
             t_step = time.perf_counter()
             if kind == "fused":
                 items = group
@@ -601,7 +600,21 @@ class Trainer:
                 stacked = jax.tree_util.tree_map(
                     lambda *xs: jnp.stack(xs), *[it[2] for it in items]
                 )
-                rngs = jax.random.split(step_rng, kf)
+                # the stacked copy is what the launch consumes — drop the
+                # per-batch device arrays now instead of holding ~2x the
+                # launch's input data in HBM across the step. Cleared IN
+                # PLACE: _launch_groups' suspended frame still aliases
+                # this list (its buf rebind only runs on the next resume)
+                group.clear()
+                items = group = None
+                # consume one split of the pass chain PER BATCH, exactly
+                # as the unfused loop does, so batches_per_launch=k
+                # reproduces k=1 numerics for rng-using models (dropout)
+                step_keys = []
+                for _ in range(kf):
+                    rng, sr = jax.random.split(rng)
+                    step_keys.append(sr)
+                rngs = jnp.stack(step_keys)
                 with stat_timer("train_step"):
                     self.params, self.opt_state, losses, keeps = self.fused_step(
                         self.params, self.opt_state, stacked, rngs,
@@ -634,6 +647,7 @@ class Trainer:
                     for i in range(kf)
                 ]
             else:
+                rng, step_rng = jax.random.split(rng)
                 n, _host_batch, batch = group
                 with stat_timer("train_step"):
                     if self._accum_n > 1:

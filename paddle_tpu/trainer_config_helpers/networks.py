@@ -281,6 +281,7 @@ def gru_group(
     act=None,
     gate_act=None,
     gru_layer_attr=None,
+    force_group: bool = False,
 ) -> LayerOutput:
     name = name or current_context().unique_name("gru_group")
     # The fixed step here is exactly one gru_unit, and the reference
@@ -291,7 +292,11 @@ def gru_group(
     # lax.scan instead of a per-step layer group, and the fused Pallas
     # kernel applies under settings(pallas_rnn=True). Inside another
     # recurrent_group the group form is kept (nested sub-scan contract).
-    if not current_context().submodel_stack:
+    # Consequence (doc/divergences.md): the '<name>_recurrent_group'
+    # submodel and its step-level memory no longer exist at top level —
+    # configs that reference them (get_output/memory against the step
+    # form) pass force_group=True to keep the group form.
+    if not current_context().submodel_stack and not force_group:
         assert size is None or input.size == 3 * size, (
             f"gru_group size {size} does not match input size {input.size}"
         )

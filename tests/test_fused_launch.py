@@ -26,25 +26,41 @@ def _provider_path():
     sys.path.remove(PROVIDER_DIR)
 
 
-def _config(tmp_path, extra_settings=""):
+DEFAULT_BODY = """
+    data = data_layer(name="word", size=100)
+    output = fc_layer(input=data, size=2, act=SoftmaxActivation(), name="output")
+"""
+
+DROPOUT_BODY = """
+    data = data_layer(name="word", size=100)
+    hid = fc_layer(input=data, size=32, act=ReluActivation())
+    drop = dropout_layer(input=hid, dropout_rate=0.5)
+    output = fc_layer(input=drop, size=2, act=SoftmaxActivation(), name="output")
+"""
+
+
+def _config(tmp_path, extra_settings="", body=DEFAULT_BODY, with_test=True):
     train_list = tmp_path / "train.list"
     train_list.write_text("1\n2\n3\n")
-    test_list = tmp_path / "test.list"
-    test_list.write_text("99\n")
+    if with_test:
+        test_list = tmp_path / "test.list"
+        test_list.write_text("99\n")
+        test_ref = str(test_list)
+    else:
+        test_ref = None
     src = textwrap.dedent(f"""
     from paddle_tpu.trainer_config_helpers import *
 
     define_py_data_sources2(train_list={str(train_list)!r},
-                            test_list={str(test_list)!r},
+                            test_list={test_ref!r},
                             module="synthetic_bow", obj="process")
     settings(batch_size=64, learning_rate=0.02,
              learning_method=AdamOptimizer(){extra_settings})
-    data = data_layer(name="word", size=100)
-    output = fc_layer(input=data, size=2, act=SoftmaxActivation(), name="output")
+{body}
     label = data_layer(name="label", size=2)
     outputs(classification_cost(input=output, label=label))
     """)
-    cfg_path = tmp_path / f"cfg{abs(hash(extra_settings)) % 997}.py"
+    cfg_path = tmp_path / f"cfg{abs(hash(extra_settings + body)) % 997}.py"
     cfg_path.write_text(src)
     return parse_config(str(cfg_path))
 
@@ -256,3 +272,27 @@ def test_fused_rejects_accumulation(tmp_path):
     )
     with pytest.raises(ValueError, match="batches_per_launch"):
         Trainer(cfg)
+
+
+def test_fused_matches_unfused_with_dropout(tmp_path):
+    """rng-using models too: the fused path consumes one split of the
+    pass rng chain PER BATCH exactly like the unfused loop, so dropout
+    masks are identical and k>1 reproduces k=1 numerics bitwise (up to
+    float scheduling tolerance)."""
+
+    _fresh_flags(tmp_path, "outd1")
+    t1 = Trainer(_config(tmp_path, body=DROPOUT_BODY, with_test=False))
+    t1.train(num_passes=1)
+
+    _fresh_flags(tmp_path, "outd3")
+    t3 = Trainer(_config(tmp_path, ", batches_per_launch=3",
+                         body=DROPOUT_BODY, with_test=False))
+    t3.train(num_passes=1)
+
+    assert int(t1.opt_state.step) == int(t3.opt_state.step)
+    for k in t1.params:
+        np.testing.assert_allclose(
+            np.asarray(t1.params[k], dtype=np.float32),
+            np.asarray(t3.params[k], dtype=np.float32),
+            rtol=2e-5, atol=2e-6, err_msg=k,
+        )

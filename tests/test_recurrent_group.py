@@ -239,6 +239,22 @@ outputs(g)
     assert any(p.name == "_enc.w0" for p in tc.model_config.parameters)
 
 
+def test_gru_group_force_group_keeps_group_form():
+    # escape hatch (doc/divergences.md): force_group=True keeps the
+    # reference's '<name>_recurrent_group' submodel + step-level memory
+    # for configs that reference the step form
+    tc = parse_str("""
+from paddle_tpu.trainer_config_helpers import *
+settings(batch_size=4, learning_rate=1e-3)
+x = data_layer(name="x", size=12)
+g = gru_group(input=x, name="enc", size=4, force_group=True)
+outputs(g)
+""")
+    types = {l.name: l.type for l in tc.model_config.layers}
+    assert "gated_recurrent" not in types.values()
+    assert any("enc_recurrent_group" in s.name for s in tc.model_config.sub_models)
+
+
 LSTM_PAIR = """
 from paddle_tpu.trainer_config_helpers import *
 settings(batch_size=4, learning_rate=1e-3)
