@@ -139,6 +139,10 @@ class Trainer:
             )
             self._fuse_k = 1
         self._fused_step_fn = None
+        # per-pass held-out results appended by train(): [(pass_id, {...})]
+        # — programmatic convergence-curve access (quality tracking tests,
+        # plotcurve's structured counterpart)
+        self.test_history: list = []
         self._accum_fns = None
         self._acc = None
         self._acc_batches = 0
@@ -422,7 +426,9 @@ class Trainer:
             rng, pass_rng = jax.random.split(rng)
             self.train_one_pass(pass_id, train_provider, pass_rng)
             with stat_timer("test"):
-                self.test(pass_id=pass_id)
+                pass_results = self.test(pass_id=pass_id)
+            if pass_results:
+                self.test_history.append((pass_id, pass_results))
             if self.save_dir and (pass_id + 1) % max(self.flags.saving_period, 1) == 0:
                 self.save(pass_id)
                 saved_pass = pass_id

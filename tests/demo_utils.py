@@ -1,0 +1,48 @@
+"""Shared demo-driving harness for tests: copy a demo's .py files into a
+scratch dir, write the synthetic list files, train via the Trainer API
+from inside that dir, and restore cwd — the one workflow previously
+re-implemented per test module (test_quick_start, test_recommendation,
+test_quality_curves)."""
+
+import os
+import shutil
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def setup_demo(tmp_path, demo, train_lines, test_lines=None):
+    """Copy demo/<demo>/*.py to tmp_path and write train/test lists.
+    train_lines/test_lines: iterable of list-file entries (each entry
+    seeds the demo's deterministic synthetic generator)."""
+    demo_dir = os.path.join(REPO, "demo", demo)
+    for f in os.listdir(demo_dir):
+        if f.endswith(".py"):
+            shutil.copy(os.path.join(demo_dir, f), tmp_path)
+    (tmp_path / "train.list").write_text("".join(f"{s}\n" for s in train_lines))
+    if test_lines is not None:
+        (tmp_path / "test.list").write_text("".join(f"{s}\n" for s in test_lines))
+
+
+def train_demo(tmp_path, cfg_name, num_passes, dtype=None, log_period=0,
+               run_final_test=False, **flag_overrides):
+    """parse_config + Trainer.train() from inside tmp_path (the demos use
+    relative module imports and list paths). Returns (trainer, final test
+    results or None)."""
+    from paddle_tpu.config import parse_config
+    from paddle_tpu.trainer import Trainer
+    from paddle_tpu.utils.flags import _Flags
+
+    cwd = os.getcwd()
+    os.chdir(tmp_path)
+    try:
+        cfg = parse_config(cfg_name)
+        if dtype:
+            cfg.opt_config.dtype = dtype
+        flags = _Flags(config=cfg_name, num_passes=num_passes,
+                       log_period=log_period, use_tpu=False, **flag_overrides)
+        trainer = Trainer(cfg, flags)
+        trainer.train()
+        results = trainer.test() if run_final_test else None
+        return trainer, results
+    finally:
+        os.chdir(cwd)

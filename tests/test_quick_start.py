@@ -7,41 +7,23 @@ planted signal is learned (cross-entropy well below chance).
 """
 
 import os
-import shutil
 
 import numpy as np
 import pytest
+
+from demo_utils import setup_demo, train_demo
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DEMO = os.path.join(REPO, "demo", "quick_start")
 
 
 def _setup(tmp_path):
-    for f in os.listdir(DEMO):
-        if f.endswith(".py"):
-            shutil.copy(os.path.join(DEMO, f), tmp_path)
-    (tmp_path / "train.list").write_text("train-seed-1\n")
-    (tmp_path / "test.list").write_text("test-seed-1\n")
+    setup_demo(tmp_path, "quick_start", ["train-seed-1"], ["test-seed-1"])
 
 
 def _train(tmp_path, cfg_name, num_passes=3, dtype=None):
-    from paddle_tpu.config import parse_config
-    from paddle_tpu.trainer import Trainer
-    from paddle_tpu.utils.flags import _Flags
-
-    cwd = os.getcwd()
-    os.chdir(tmp_path)
-    try:
-        cfg = parse_config(cfg_name)
-        if dtype:
-            cfg.opt_config.dtype = dtype
-        flags = _Flags(config=cfg_name, num_passes=num_passes,
-                       log_period=100, use_tpu=False)
-        trainer = Trainer(cfg, flags)
-        trainer.train()
-        return trainer, trainer.test()
-    finally:
-        os.chdir(cwd)
+    return train_demo(tmp_path, cfg_name, num_passes=num_passes, dtype=dtype,
+                      log_period=100, run_final_test=True)
 
 
 def test_lr_learns(tmp_path):

@@ -1,45 +1,30 @@
 """Recommendation demo (sparse embedding CTR) end-to-end smoke test."""
 
 import os
-import shutil
 
-import pytest
+import numpy as np
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-DEMO = os.path.join(REPO, "demo", "recommendation")
+from demo_utils import setup_demo, train_demo
 
 
 def test_recommendation_trains(tmp_path):
-    for f in os.listdir(DEMO):
-        if f.endswith(".py"):
-            shutil.copy(os.path.join(DEMO, f), tmp_path)
-    (tmp_path / "train.list").write_text("seed1\n")
-    (tmp_path / "test.list").write_text("seed2\n")
-
-    from paddle_tpu.config import parse_config
-    from paddle_tpu.trainer import Trainer
-    from paddle_tpu.utils.flags import _Flags
-
+    setup_demo(tmp_path, "recommendation", ["seed1"], ["seed2"])
+    trainer, _ = train_demo(tmp_path, "trainer_config.py", num_passes=3,
+                            log_period=100)
+    # embeddings must have been marked sparse_update by the config
+    sparse = [p.name for p in trainer.config.model_config.parameters
+              if p.sparse_update]
+    assert "_movie_id_emb" in sparse and "_title_emb" in sparse
+    # planted structure is learnable: train cost must drop well below
+    # the 1.0 baseline (squared error of predicting 0)
     cwd = os.getcwd()
-    os.chdir(tmp_path)
+    os.chdir(tmp_path)  # provider reads the list files relatively
     try:
-        cfg = parse_config(str(tmp_path / "trainer_config.py"))
-        # embeddings must have been marked sparse_update by the config
-        sparse = [p.name for p in cfg.model_config.parameters if p.sparse_update]
-        assert "_movie_id_emb" in sparse and "_title_emb" in sparse
-        flags = _Flags(config="trainer_config.py", num_passes=3,
-                       log_period=100, use_tpu=False)
-        trainer = Trainer(cfg, flags)
-        trainer.train()
-        # planted structure is learnable: train cost must drop well below
-        # the 1.0 baseline (squared error of predicting 0)
-        from paddle_tpu.data.feeder import create_data_provider
         provider = trainer._provider(for_test=False)
-        import numpy as np
         costs = []
         for batch in provider.batches():
             outputs = trainer.test_fwd(trainer.params, batch)
             costs.append(float(trainer.gm.total_cost(outputs)))
-        assert np.mean(costs) < 0.5, f"CTR model did not learn: {np.mean(costs)}"
     finally:
         os.chdir(cwd)
+    assert np.mean(costs) < 0.5, f"CTR model did not learn: {np.mean(costs)}"
