@@ -2,29 +2,80 @@
 # Full TPU measurement session. Run automatically by tpu_watcher.sh the
 # moment a chip claim succeeds, or by hand when the tunnel is known-up.
 #
-# Legs: bench all (bf16 production config, xplane trace of the headline
-# window), f32 ResNet A/B, scan_unroll A/B on the recurrent legs, then a
-# trace summary. Raw output lands in benchmarks/RESULTS_tpu_session_raw.txt
-# inside the repo working tree so the driver's end-of-round auto-commit
-# captures the numbers even if no agent is running when they arrive.
+# LEG ORDER IS PRIORITY ORDER: the round-4 tunnel window lasted ~3h and
+# this session is ~3.3h if everything runs — the unmeasured round-4 perf
+# queue (pallas kernels, fused launches) must land BEFORE the A/B
+# controls, so a window that dies mid-session still measured the things
+# that decide defaults. Raw output lands in
+# benchmarks/RESULTS_tpu_session_raw.txt inside the repo working tree so
+# the driver's end-of-round auto-commit captures the numbers even if no
+# agent is running when they arrive.
 cd "$(dirname "$0")/.." || exit 1
-# each session writes its own file, appended to the cumulative raw log at
-# the end — the formatter sees exactly one session, so re-runs can never
-# duplicate or misattribute earlier sessions' rows
+# the in-flight session file lives IN THE REPO: if the tunnel wedges
+# mid-session (the round-4 failure mode), the driver's end-of-round
+# auto-commit still captures every completed leg. PID-unique name so a
+# manual run and a watcher-fired run can overlap without interleaving.
+# On clean completion it is appended to the cumulative raw log and
+# removed — the formatter sees exactly one session per file, so re-runs
+# can never duplicate earlier rows.
 CUM=benchmarks/RESULTS_tpu_session_raw.txt
-OUT=$(mktemp /tmp/tpu_session_XXXX.txt)
+OUT=benchmarks/RESULTS_tpu_session_partial.$$.txt
 ERR=/tmp/tpu_session_err.log
+# salvage any leftover partial from a previously wedged session FIRST —
+# its rows exist nowhere else (the formatter never ran for it)
+for stale in benchmarks/RESULTS_tpu_session_partial.*.txt; do
+  if [ -s "$stale" ] && [ "$stale" != "$OUT" ]; then
+    echo "salvaging wedged-session partial $stale" >&2
+    python benchmarks/append_results.py "$stale" >> $ERR 2>&1 || true
+    cat "$stale" >> $CUM && rm -f "$stale"
+  fi
+done
+: > $OUT
 echo "=== TPU session $(date -u)" >> $OUT
 mkdir -p benchmarks/traces
-# headline: all three legs, bf16, trace captured
+# 1) headline: all three legs, bf16, trace captured (resnet ladders from
+#    B=512 now; MFU on the round-5 analytic model-FLOPs basis)
 PADDLE_TPU_BENCH_TRACE_DIR=$PWD/benchmarks/traces PADDLE_TPU_BENCH_BUDGET=1400 \
   timeout 1500 python bench.py >> $OUT 2>$ERR
-echo "--- f32 resnet A/B" >> $OUT
-PADDLE_TPU_BENCH_DTYPE=float32 PADDLE_TPU_BENCH_BUDGET=900 \
-  timeout 1000 python bench.py resnet >> $OUT 2>>$ERR
+# 2) the round-4 unmeasured queue: fused Pallas recurrent kernels
+#    (whole scan in one kernel launch; first-ever hardware compile —
+#    bench falls back gracefully if Mosaic rejects them) and fused
+#    launches on nmt. The nmt leg exercises the GRU kernel through the
+#    lowered encoder.
+echo "--- pallas_rnn lstm (k=8 default)" >> $OUT
+PADDLE_TPU_BENCH_PALLAS_RNN=1 PADDLE_TPU_BENCH_BUDGET=600 \
+  timeout 700 python bench.py lstm >> $OUT 2>>$ERR
+echo "--- pallas_rnn lstm (k=1 control)" >> $OUT
+PADDLE_TPU_BENCH_PALLAS_RNN=1 PADDLE_TPU_BENCH_STEPS_PER_LAUNCH=1 \
+  PADDLE_TPU_BENCH_BUDGET=600 timeout 700 python bench.py lstm >> $OUT 2>>$ERR
+echo "--- pallas_rnn nmt" >> $OUT
+PADDLE_TPU_BENCH_PALLAS_RNN=1 PADDLE_TPU_BENCH_BUDGET=900 \
+  timeout 1000 python bench.py nmt >> $OUT 2>>$ERR
+echo "--- steps_per_launch=8 nmt" >> $OUT
+PADDLE_TPU_BENCH_STEPS_PER_LAUNCH=8 PADDLE_TPU_BENCH_BUDGET=900 \
+  timeout 1000 python bench.py nmt >> $OUT 2>>$ERR
+echo "--- pallas_rnn + steps_per_launch=8 nmt (combined)" >> $OUT
+PADDLE_TPU_BENCH_PALLAS_RNN=1 PADDLE_TPU_BENCH_STEPS_PER_LAUNCH=8 \
+  PADDLE_TPU_BENCH_BUDGET=900 timeout 1000 python bench.py nmt >> $OUT 2>>$ERR
+# 3) stem space-to-depth A/B
 echo "--- resnet s2d stem A/B" >> $OUT
 PADDLE_TPU_BENCH_S2D=1 PADDLE_TPU_BENCH_BUDGET=900 \
   timeout 1000 python bench.py resnet >> $OUT 2>>$ERR
+# 4) per-leg traces for the recurrent flagships on CURRENT HEAD (the
+#    committed round-4 summaries predate the BN/CE rework)
+for leg in lstm nmt; do
+  echo "--- traced $leg" >> $OUT
+  mkdir -p benchmarks/traces_$leg
+  PADDLE_TPU_BENCH_TRACE_LEG=$leg PADDLE_TPU_BENCH_TRACE_DIR=$PWD/benchmarks/traces_$leg \
+    PADDLE_TPU_BENCH_BUDGET=600 timeout 700 python bench.py $leg >> $OUT 2>>$ERR
+done
+# 5) controls: f32 resnet, k=1 lstm, scan-unroll sweeps
+echo "--- f32 resnet A/B" >> $OUT
+PADDLE_TPU_BENCH_DTYPE=float32 PADDLE_TPU_BENCH_BUDGET=900 \
+  timeout 1000 python bench.py resnet >> $OUT 2>>$ERR
+echo "--- steps_per_launch=1 lstm control" >> $OUT
+PADDLE_TPU_BENCH_STEPS_PER_LAUNCH=1 PADDLE_TPU_BENCH_BUDGET=600 \
+  timeout 700 python bench.py lstm >> $OUT 2>>$ERR
 for u in 4 8; do
   # SPL pinned to 1: the lstm leg's default is now k=8, and these rows
   # must stay comparable with earlier k=1 unroll measurements
@@ -36,34 +87,7 @@ for u in 4 8; do
     PADDLE_TPU_BENCH_BUDGET=600 \
     timeout 700 python bench.py nmt >> $OUT 2>>$ERR
 done
-# fused-launch A/B vs the k=1 control (the lstm leg DEFAULTS to k=8 on
-# the accelerator now, so the control is the pinned run)
-echo "--- steps_per_launch=1 lstm control" >> $OUT
-PADDLE_TPU_BENCH_STEPS_PER_LAUNCH=1 PADDLE_TPU_BENCH_BUDGET=600 \
-  timeout 700 python bench.py lstm >> $OUT 2>>$ERR
-echo "--- steps_per_launch=8 nmt" >> $OUT
-PADDLE_TPU_BENCH_STEPS_PER_LAUNCH=8 PADDLE_TPU_BENCH_BUDGET=900 \
-  timeout 1000 python bench.py nmt >> $OUT 2>>$ERR
-# fused Pallas recurrent kernel A/B (whole scan in one kernel launch;
-# the nmt leg exercises the GRU kernel through the lowered encoder).
-# lstm runs both at the k=8 default and a pinned k=1 control
-echo "--- pallas_rnn lstm (k=8 default)" >> $OUT
-PADDLE_TPU_BENCH_PALLAS_RNN=1 PADDLE_TPU_BENCH_BUDGET=600 \
-  timeout 700 python bench.py lstm >> $OUT 2>>$ERR
-echo "--- pallas_rnn lstm (k=1 control)" >> $OUT
-PADDLE_TPU_BENCH_PALLAS_RNN=1 PADDLE_TPU_BENCH_STEPS_PER_LAUNCH=1 \
-  PADDLE_TPU_BENCH_BUDGET=600 timeout 700 python bench.py lstm >> $OUT 2>>$ERR
-echo "--- pallas_rnn nmt" >> $OUT
-PADDLE_TPU_BENCH_PALLAS_RNN=1 PADDLE_TPU_BENCH_BUDGET=900 \
-  timeout 1000 python bench.py nmt >> $OUT 2>>$ERR
-# per-leg traces for the recurrent flagships (the headline trace above
-# covers resnet only)
-for leg in lstm nmt; do
-  echo "--- traced $leg" >> $OUT
-  mkdir -p benchmarks/traces_$leg
-  PADDLE_TPU_BENCH_TRACE_LEG=$leg PADDLE_TPU_BENCH_TRACE_DIR=$PWD/benchmarks/traces_$leg \
-    PADDLE_TPU_BENCH_BUDGET=600 timeout 700 python bench.py $leg >> $OUT 2>>$ERR
-done
+# 6) trace summaries
 echo "--- trace summary (resnet)" >> $OUT
 python benchmarks/trace_summary.py benchmarks/traces 15 >> $OUT 2>>$ERR
 for leg in lstm nmt; do
@@ -71,7 +95,14 @@ for leg in lstm nmt; do
   python benchmarks/trace_summary.py benchmarks/traces_$leg 15 >> $OUT 2>>$ERR
 done
 echo "=== session done $(date -u)" >> $OUT
-cat $OUT >> $CUM
-# format measured rows into the append-only log so an unattended
-# recovery still leaves RESULTS.md complete
+# format measured rows into the append-only log (also refreshes
+# measured_tpu.json for bench.py's outage-time last_measured embedding),
+# THEN fold the session file into the cumulative log and remove it
 python benchmarks/append_results.py $OUT >> $ERR 2>&1 || true
+# exit status tells the watcher whether THIS session produced any real
+# TPU rows (the watcher must not trust a grep of the cumulative log —
+# earlier sessions' rows would make it trivially true)
+grep -q '"backend": "[^c]' $OUT
+ok=$?
+cat $OUT >> $CUM && rm -f $OUT
+exit $ok

@@ -17,11 +17,11 @@ for i in $(seq 1 "$MAX"); do
   echo "=== attempt $i $(date -u)" >> $LOG
   if python benchmarks/tpu_probe.py >> $LOG 2>&1; then
     echo "RECOVERED $(date -u)" >> $LOG
-    bash benchmarks/tpu_session.sh
-    # only count the session as done if at least one leg produced a real
-    # TPU number — a tunnel that re-wedged right after the probe must not
+    # the session exits 0 only if ITS OWN legs produced a real TPU row
+    # (grepping the cumulative log would be trivially true from earlier
+    # sessions) — a tunnel that re-wedged right after the probe must not
     # burn the one-shot session
-    if grep -q '"backend": "[^c]' benchmarks/RESULTS_tpu_session_raw.txt 2>/dev/null; then
+    if bash benchmarks/tpu_session.sh; then
       echo "SESSION COMPLETE $(date -u)" >> $LOG
       exit 0
     fi

@@ -336,6 +336,12 @@ class DataProvider:
         # keep order for test/gen (matches the reference trainer)
         shuffle = self.settings.should_shuffle
         self.shuffle = (not for_test) if shuffle is None else bool(shuffle)
+        # length-sorted bucketing (TPU-native @provider extension, see
+        # data/provider.py): only ever applied on the shuffled training
+        # path — test/generation sample order must never change
+        self.sort_by_length = (
+            self.shuffle and bool(getattr(self.settings, "sort_by_length", False))
+        )
         self._cache: Optional[List] = None
         self._use_cache = getattr(provider_obj, "cache", 0) == 1
 
@@ -377,15 +383,49 @@ class DataProvider:
                 yield from self._drain(pool, final=False)
         yield from self._drain(pool, final=True)
 
+    def _sample_len(self, sample) -> int:
+        """Padded-cost key for length sorting. SEQUENCE slots cost their
+        length; SUB_SEQUENCE slots pad to [S, T] (see _subseq_slot), so
+        their key is the padded AREA S·max(sub length) — sorting by
+        subsequence count alone would group samples with wildly different
+        sub-lengths and deliver no padding reduction."""
+        cost = 0
+        for i, (name, tp) in enumerate(
+            zip(self.assembler.slot_names, self.assembler.input_types)
+        ):
+            if tp.seq_type == SequenceType.NO_SEQUENCE:
+                continue
+            v = sample[name] if isinstance(sample, dict) else sample[i]
+            if tp.seq_type == SequenceType.SUB_SEQUENCE:
+                cost = max(cost, len(v) * max((len(s) for s in v), default=0))
+            else:
+                cost = max(cost, len(v))
+        return cost
+
     def _drain(self, pool: List, final: bool) -> Iterator[Dict[str, Argument]]:
         if self.shuffle:
             self.rng.shuffle(pool)
-        # keep a remainder in the pool between drains so shuffling mixes
-        # across pool boundaries
-        while len(pool) >= self.batch_size:
-            batch = pool[: self.batch_size]
-            del pool[: self.batch_size]
-            yield self.assembler.assemble(batch)
+        if self.sort_by_length:
+            # shuffle-then-stable-sort: similar-length samples become
+            # batch neighbors (tight padding), equal-length runs stay
+            # randomly ordered, and the BATCH order is re-shuffled below
+            # so the pass still visits lengths in random order
+            pool.sort(key=self._sample_len)
+            batches = []
+            while len(pool) >= self.batch_size:
+                batches.append(pool[: self.batch_size])
+                del pool[: self.batch_size]
+            self.rng.shuffle(batches)
+            for batch in batches:
+                yield self.assembler.assemble(batch)
+            # the remainder (the longest leftovers) mixes into the next drain
+        else:
+            # keep a remainder in the pool between drains so shuffling
+            # mixes across pool boundaries
+            while len(pool) >= self.batch_size:
+                batch = pool[: self.batch_size]
+                del pool[: self.batch_size]
+                yield self.assembler.assemble(batch)
         if final and pool and not self.drop_last:
             yield self.assembler.assemble(pool)
             pool.clear()

@@ -134,6 +134,7 @@ class _ProviderSettings:
         self.input_types = None
         self.should_shuffle = None
         self.pool_size = -1
+        self.sort_by_length = False
         self.logger = None
 
     def __setattr__(self, k, v):
@@ -149,12 +150,20 @@ def provider(
     calc_batch_size: Optional[Callable] = None,
     cache: int = CacheType.NO_CACHE,
     init_hook: Optional[Callable] = None,
+    sort_by_length: bool = False,
     **outter_kwargs,
 ):
     """Decorate a sample generator ``fn(settings, filename)``.
 
     The decorated object exposes the declaration (`input_types`, flags) and
     an ``open(filename)`` iterator used by the runtime feeder.
+
+    ``sort_by_length`` is a TPU-native extension (doc/divergences.md): the
+    training feeder length-sorts each shuffle pool before slicing batches
+    (batch ORDER stays shuffled), so a batch's padded length is set by
+    similar-length neighbors instead of the pool max — the static-shape
+    answer to the reference's no-padding SequenceToBatch packing
+    (SequenceToBatch.h:41). Test/generation order is never changed.
     """
 
     def deco(fn):
@@ -173,6 +182,7 @@ def provider(
         p.can_over_batch_size = can_over_batch_size
         p.calc_batch_size = calc_batch_size
         p.cache = cache
+        p.sort_by_length = sort_by_length
         p.init_hook = init_hook
         p.outter_kwargs = outter_kwargs
         p.name = fn.__name__
@@ -182,6 +192,7 @@ def provider(
             settings.input_types = p.input_types
             settings.should_shuffle = p.should_shuffle
             settings.pool_size = p.pool_size
+            settings.sort_by_length = p.sort_by_length
             import logging
 
             settings.logger = logging.getLogger("paddle_tpu.data")
