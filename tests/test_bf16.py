@@ -249,7 +249,7 @@ def test_resnet_bf16_reaches_every_convolution():
     tc = resnet_config(50, 32, 16)
     tc.opt_config.batch_size = 4
     tc.opt_config.dtype = "bfloat16"
-    step, params, opt_state = bench._jit_train_step(tc)
+    step, params, opt_state, _one = bench._jit_train_step(tc)
     batch = make_image_batch(4, 32, 16)
     txt = step.lower(params, opt_state, batch, jnp.asarray(4.0)).as_text()
     convs = [l for l in txt.splitlines() if "stablehlo.convolution" in l]
