@@ -30,6 +30,7 @@ a parseable JSON line with an "error" field rather than a traceback.
 
 from __future__ import annotations
 
+import functools
 import json
 import os
 import sys
@@ -224,6 +225,42 @@ def _is_oom(e) -> bool:
     )
 
 
+def _pallas_fallback(leg_fn):
+    """The fused Pallas kernels have never been compiled on real hardware
+    (interpret-mode parity only): if a leg fails with the pallas knob on
+    — a Mosaic rejection, a VMEM miss in the real compiler, anything —
+    rerun it on the XLA scan path instead of forfeiting the A/B leg, and
+    tag the JSON so the fallback can never masquerade as a pallas win."""
+
+    @functools.wraps(leg_fn)
+    def wrapped(*args, **kwargs):
+        if os.environ.get("PADDLE_TPU_BENCH_PALLAS_RNN") != "1":
+            return leg_fn(*args, **kwargs)
+        try:
+            return leg_fn(*args, **kwargs)
+        except Exception as e:
+            err = f"{type(e).__name__}: {str(e)[:300]}"
+            sys.stderr.write(f"pallas_rnn leg failed, retrying on the scan "
+                             f"path: {err}\n")
+            os.environ["PADDLE_TPU_BENCH_PALLAS_RNN"] = "0"
+            try:
+                value, extras = leg_fn(*args, **kwargs)
+            except Exception as e2:
+                # keep the pallas diagnosis in the parseable record, not
+                # just stderr — the rerun's error alone would lose it
+                raise RuntimeError(
+                    f"{type(e2).__name__}: {str(e2)[:300]} "
+                    f"(scan-path rerun after pallas failure: {err})"
+                ) from e2
+            finally:
+                os.environ["PADDLE_TPU_BENCH_PALLAS_RNN"] = "1"
+            extras = dict(extras or {})
+            extras["pallas_rnn"] = f"FELL BACK to scan path ({err})"
+            return value, extras
+
+    return wrapped
+
+
 def _try_ladder(configs, run_one):
     """Run the first ladder configuration that survives an OOM-class
     failure; any other error re-raises immediately. The successful rung's
@@ -295,6 +332,7 @@ def bench_resnet50(B=None, img_size=224, classes=1000, steps=20, warmup=3, trace
     return _try_ladder(ladder, run_one)
 
 
+@_pallas_fallback
 def bench_lstm_classifier(B=256, T=64, steps=20, warmup=3, dtype=None):
     import jax.numpy as jnp
 
@@ -320,6 +358,7 @@ def bench_lstm_classifier(B=256, T=64, steps=20, warmup=3, dtype=None):
     return B * T * steps * spl / dt, extras
 
 
+@_pallas_fallback
 def bench_nmt(B=None, T=32, vocab=30000, dim=512, steps=10, warmup=2, dtype=None):
     """seqToseq NMT attention encoder-decoder train step; tokens/sec counts
     target (decoder) tokens — BASELINE.md north-star workload #2. Without

@@ -337,3 +337,61 @@ def test_refresh_measured_json_headline_precedence(tmp_path, monkeypatch):
     (tmp_path / "RESULTS.md").write_text("# log\n")
     assert ar.main([str(raw)]) == 0
     assert "m_res" in (tmp_path / "RESULTS.md").read_text()
+
+
+def test_pallas_fallback_decorator(monkeypatch):
+    """A leg failing with PADDLE_TPU_BENCH_PALLAS_RNN=1 reruns on the
+    scan path with an honest JSON tag; without the env it fails loudly;
+    the env value is restored either way."""
+    sys.path.insert(0, REPO)
+    from bench import _pallas_fallback
+
+    calls = []
+
+    @_pallas_fallback
+    def leg(**kw):
+        calls.append(os.environ.get("PADDLE_TPU_BENCH_PALLAS_RNN"))
+        if os.environ.get("PADDLE_TPU_BENCH_PALLAS_RNN") == "1":
+            raise RuntimeError("Mosaic lowering failed: vmem exceeded")
+        return 42.0, {"mfu": 0.1}
+
+    monkeypatch.setenv("PADDLE_TPU_BENCH_PALLAS_RNN", "1")
+    v, extras = leg()
+    assert v == 42.0 and calls == ["1", "0"]
+    assert "FELL BACK" in extras["pallas_rnn"] and "Mosaic" in extras["pallas_rnn"]
+    assert os.environ["PADDLE_TPU_BENCH_PALLAS_RNN"] == "1"
+
+    # knob off: failures propagate (no silent downgrade)
+    monkeypatch.setenv("PADDLE_TPU_BENCH_PALLAS_RNN", "0")
+
+    @_pallas_fallback
+    def bad(**kw):
+        raise ValueError("real bug")
+
+    import pytest
+
+    with pytest.raises(ValueError):
+        bad()
+
+
+def test_pallas_fallback_double_failure(monkeypatch):
+    """When the scan-path rerun ALSO fails, the raised error must carry
+    the original pallas diagnosis, and the env flag must still be
+    restored for later legs."""
+    sys.path.insert(0, REPO)
+    import pytest
+
+    from bench import _pallas_fallback
+
+    @_pallas_fallback
+    def leg(**kw):
+        if os.environ.get("PADDLE_TPU_BENCH_PALLAS_RNN") == "1":
+            raise RuntimeError("Mosaic lowering failed")
+        raise ValueError("scan path oom")
+
+    monkeypatch.setenv("PADDLE_TPU_BENCH_PALLAS_RNN", "1")
+    with pytest.raises(RuntimeError) as ei:
+        leg()
+    msg = str(ei.value)
+    assert "scan path oom" in msg and "Mosaic lowering failed" in msg
+    assert os.environ["PADDLE_TPU_BENCH_PALLAS_RNN"] == "1"
