@@ -1,0 +1,245 @@
+"""Scaling-efficiency analysis: per-step collective volume from the
+GSPMD-partitioned HLO.
+
+BASELINE.md's north star includes "linear scaling 8 -> 64 chips". Real
+multi-chip hardware is not reachable from this environment, but the
+communication volume that DETERMINES scaling is: XLA inserts the
+collectives during SPMD partitioning, and the partitioned HLO (compiled
+against a virtual 8-device CPU mesh — same GSPMD pass as TPU) exposes
+every all-reduce/all-gather/reduce-scatter/collective-permute with its
+shape. This tool compiles the real sharded train step, sums collective
+bytes per step, and compares the ICI time they imply against the
+measured per-chip compute time — the scaling-book recipe for predicting
+parallel efficiency.
+
+Collective bytes are counted at the OUTPUT shape of each op (a ring
+all-reduce moves ~2x that over the slowest link; the report applies the
+ring factor). Async pairs (all-reduce-start/-done, TPU post-optimization
+form) are counted at the -start op only. Collectives living inside a
+while-loop BODY COMPUTATION (transitively, through fusions/calls)
+execute once per scan step — reported separately with a pessimistic
+T-fold bound, since XLA-TPU's while-loop all-reduce code motion is what
+normally hoists them and this tool may be reading a CPU compile.
+
+Gradient sizes are batch-independent, so small spatial configs give the
+same collective volume as the bench shapes.
+
+Usage: python benchmarks/collective_analysis.py  (CPU; forces the
+virtual 8-device mesh itself)
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+_DTYPE_BYTES = {"f64": 8, "f32": 4, "bf16": 2, "f16": 2, "s32": 4, "u32": 4,
+                "s64": 8, "u64": 8, "s16": 2, "u16": 2, "s8": 1, "u8": 1,
+                "pred": 1}
+
+_COLLECTIVES = ("all-reduce", "all-gather", "reduce-scatter",
+                "collective-permute", "all-to-all")
+
+# v5e ICI: 1600 Gbps per chip (Cloud TPU public spec)
+_ICI_BYTES_PER_S = 200e9
+
+
+def _shape_bytes(shape_text: str) -> int:
+    """Bytes of an HLO shape string: 'f32[512,128]{1,0}' or a tuple
+    '(f32[512,128]{1,0}, f32[512]{0}, ...)'."""
+    total = 0
+    for dtype, dims in re.findall(r"(\w+)\[([\d,]*)\]", shape_text):
+        elems = 1
+        for d in dims.split(","):
+            if d:
+                elems *= int(d)
+        total += elems * _DTYPE_BYTES.get(dtype, 4)
+    return total
+
+
+# computation headers look like `%region_0.123 (arg: (s32[], ...)) -> ... {`
+# — the parameter list may NEST parens (tuple params), so don't try to
+# match it; the name + "(" + trailing "->"/"{" is discriminating enough
+_COMP_HEADER = re.compile(r"^(?:ENTRY\s+)?%?([\w.\-]+)\s+\(")
+
+
+def _computations(hlo_text: str):
+    """{computation name: block text} from HLO module text."""
+    comps = {}
+    name, buf = None, []
+    for line in hlo_text.splitlines():
+        m = _COMP_HEADER.match(line)
+        if m and "->" in line and line.rstrip().endswith("{"):
+            name, buf = m.group(1), []
+            comps[name] = buf
+            continue
+        if name is not None:
+            if line.startswith("}"):
+                name = None
+            else:
+                buf.append(line)
+    return {k: "\n".join(v) for k, v in comps.items()}
+
+
+def _loop_computations(comps):
+    """Names of computations reachable from any while-loop BODY (through
+    calls/fusions/to_apply/conditionals) — their instructions execute
+    once per loop iteration."""
+    edges = {}
+    roots = set()
+    ref = re.compile(
+        r"(?:calls|to_apply|body|condition)=%?([\w.\-]+)"
+        r"|branch_computations=\{([^}]*)\}")
+    for cname, body in comps.items():
+        outs = set()
+        for m in ref.finditer(body):
+            if m.group(1):
+                outs.add(m.group(1))
+            else:
+                outs.update(x.strip().lstrip("%")
+                            for x in m.group(2).split(",") if x.strip())
+        edges[cname] = outs
+        for m in re.finditer(r"body=%?([\w.\-]+)", body):
+            roots.add(m.group(1))
+    seen = set()
+    stack = list(roots)
+    while stack:
+        c = stack.pop()
+        if c in seen:
+            continue
+        seen.add(c)
+        stack.extend(edges.get(c, ()))
+    return seen
+
+
+def collective_bytes(hlo_text: str):
+    """{op kind: (count, total output bytes, in-loop bytes)} from the
+    partitioned HLO text. Tuple-shaped collectives (XLA combines several
+    gradient buffers into one all-reduce) are summed over their members;
+    async -start/-done pairs count once at -start. in-loop = the op's
+    instruction lives in a computation reachable from a while body, so
+    it executes once PER iteration."""
+    comps = _computations(hlo_text)
+    if not comps:  # fragment without computation headers: one block
+        comps = {"<fragment>": hlo_text}
+    in_loop_comps = _loop_computations(comps)
+    out = {}
+    names = "|".join(_COLLECTIVES)
+    pat = re.compile(
+        rf"= (\([^)]*\)|\w+\[[\d,]*\]\S*) ({names})(-start)?\(")
+    for cname, body in comps.items():
+        looped = cname in in_loop_comps
+        for m in pat.finditer(body):
+            shape_text, op = m.group(1), m.group(2)
+            nbytes = _shape_bytes(shape_text)
+            c, b, lb = out.get(op, (0, 0, 0))
+            out[op] = (c + 1, b + nbytes, lb + (nbytes if looped else 0))
+    return out
+
+
+def _sharded_step_hlo(tc, batch, mesh_shape):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from __graft_entry__ import _train_step
+    from paddle_tpu.graph import GradientMachine
+    from paddle_tpu.graph.machine import compute_dtype_of
+    from paddle_tpu.optimizer import Updater
+    from paddle_tpu.parallel.mesh import make_mesh
+    from paddle_tpu.parallel.spmd import (
+        _opt_state_sharding,
+        _param_shardings,
+        batch_sharding,
+    )
+
+    gm = GradientMachine(tc.model_config,
+                         compute_dtype=compute_dtype_of(tc.opt_config))
+    updater = Updater(tc.opt_config, tc.model_config)
+    params = gm.init_params(seed=1)
+    opt_state = updater.init_state(params)
+    mesh = make_mesh(mesh_shape)
+    grad_fn = gm.grad_fn(remat=tc.opt_config.remat)
+    # the dryruns' shared one-train-step closure — the same step body the
+    # driver gate compiles, not a local replica
+    step = _train_step(grad_fn, updater)
+
+    # the same jit shard_train_step builds lazily (spmd.py:281-297),
+    # constructed eagerly so we can lower without executing
+    param_shards = _param_shardings(mesh, gm)
+    repl = NamedSharding(mesh, P())
+    bsh = batch_sharding(mesh)
+    p_spec = {k: param_shards.get(k, repl) for k in params}
+    o_spec = _opt_state_sharding(mesh, param_shards, opt_state)
+    b_spec = jax.tree_util.tree_map(lambda _: bsh, batch)
+    fn = jax.jit(step, in_shardings=(p_spec, o_spec, b_spec, repl, repl),
+                 out_shardings=(p_spec, o_spec, None, None))
+    B = next(iter(batch.values())).batch_size
+    lowered = fn.lower(params, opt_state, batch,
+                       jax.random.PRNGKey(0), jnp.asarray(float(B)))
+    return lowered.compile().as_text()
+
+
+def analyze(name, tc, batch, mesh_shape, per_chip_step_s=None, scan_steps=1):
+    hlo = _sharded_step_hlo(tc, batch, mesh_shape)
+    cols = collective_bytes(hlo)
+    total = sum(b for _, b, _lb in cols.values())
+    in_loop = sum(lb for _, _b, lb in cols.values())
+    n_params = sum(p.size for p in tc.model_config.parameters)
+    print(f"== {name} (mesh {mesh_shape})")
+    for op, (c, b, lb) in sorted(cols.items()):
+        loop_note = f" (in-loop {lb / 1e6:.2f} MB per iteration)" if lb else ""
+        print(f"  {op:20s} x{c:<3d} {b / 1e6:9.2f} MB{loop_note}")
+    print(f"  params: {n_params / 1e6:.2f}M; collective total "
+          f"{total / 1e6:.2f} MB/step (output-shape basis, in-loop "
+          f"counted once)")
+    if per_chip_step_s:
+        # ring all-reduce moves ~2x the buffer across the slowest link
+        def verdict(ratio):
+            return ("overlappable" if ratio < 0.2 else
+                    "partially hidden" if ratio < 1.0 else "comm-bound")
+
+        ici_s = 2.0 * total / _ICI_BYTES_PER_S
+        r = ici_s / per_chip_step_s
+        print(f"  measured per-chip step {per_chip_step_s * 1e3:.1f} ms vs "
+              f"ring-ICI {ici_s * 1e3:.2f} ms -> comm/compute = {r:.4f} "
+              f"({verdict(r)})")
+        if in_loop and scan_steps > 1:
+            worst = 2.0 * (total + in_loop * (scan_steps - 1)) / _ICI_BYTES_PER_S
+            rw = worst / per_chip_step_s
+            print(f"  pessimistic bound if in-loop collectives are NOT "
+                  f"hoisted (x{scan_steps} scan steps): ring-ICI "
+                  f"{worst * 1e3:.2f} ms -> comm/compute = {rw:.4f} "
+                  f"({verdict(rw)})")
+    return cols, total
+
+
+def main():
+    from paddle_tpu.utils.backend_guard import ensure_cpu_mesh
+
+    ensure_cpu_mesh(8)
+    from paddle_tpu.flagship import (example_batch, flagship_config,
+                                     make_image_batch, resnet_config)
+
+    # LSTM classifier at bench hidden size (grads batch-independent);
+    # scan_steps = the bench T so the unhoisted bound is honest
+    tc = flagship_config(dict_dim=10000, emb_dim=256, hidden=512, classes=2,
+                         mesh_shape="data=8")
+    tc.opt_config.dtype = "bfloat16"
+    analyze("lstm_classifier dp=8", tc, example_batch(dict_dim=10000, B=16, T=16),
+            "data=8", per_chip_step_s=16384 / 5549079.8, scan_steps=64)
+
+    # ResNet-50: small spatial config — identical parameter set (global
+    # pool), so identical gradient collectives as the 224px bench
+    tc = resnet_config(50, 64, 1000)
+    tc.opt_config.dtype = "bfloat16"
+    analyze("resnet50 dp=8", tc, make_image_batch(16, 64, 1000), "data=8",
+            per_chip_step_s=256 / 2215.1)
+
+
+if __name__ == "__main__":
+    main()

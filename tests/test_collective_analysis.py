@@ -1,0 +1,83 @@
+"""Collective-volume analysis (benchmarks/collective_analysis.py).
+
+The scaling story rests on these numbers being right: the HLO parser
+must handle tuple-shaped (combined) all-reduces, async -start/-done
+pairs (TPU post-optimization form), and in-while-body detection via the
+computation graph (metadata op_name survives hoisting, so it cannot be
+the signal); and the end-to-end dp=8 gradient all-reduce volume must
+equal the model's parameter bytes to within the scalar loss reduction.
+"""
+
+from benchmarks.collective_analysis import (
+    _shape_bytes,
+    collective_bytes,
+)
+
+
+def test_shape_bytes_tuple_and_scalar():
+    assert _shape_bytes("f32[512,128]{1,0}") == 512 * 128 * 4
+    assert _shape_bytes("bf16[8]{0}") == 16
+    assert _shape_bytes("(f32[4,4]{1,0}, bf16[2]{0}, f32[])") == 64 + 4 + 4
+    assert _shape_bytes("f32[]") == 4  # scalar
+
+
+_HLO = """HloModule jit_step
+
+%fused_inner.7 (p0: f32[16]) -> f32[16] {
+  %all-reduce.9 = f32[16]{0} all-reduce(%p0), channel_id=9
+}
+
+%region_body.1 (arg_tuple.1: (s32[], f32[512,128])) -> (s32[], f32[512,128]) {
+  %all-reduce.43 = (f32[512,128]{1,0}, f32[512]{0}) all-reduce(%dot.23), channel_id=1, metadata={op_name="jit(step)/while/body"}
+  %fusion.2 = f32[16]{0} fusion(%x), kind=kLoop, calls=%fused_inner.7
+}
+
+%region_cond.1 (arg: (s32[], f32[512,128])) -> pred[] {
+  %lt = pred[] compare(%a, %b), direction=LT
+}
+
+ENTRY %main.24_spmd (p0: f32[2]) -> f32[2] {
+  %while.1 = (s32[], f32[512,128]) while(%tuple.0), condition=%region_cond.1, body=%region_body.1
+  %all-reduce.44 = f32[1000,64]{1,0} all-reduce(%scatter), channel_id=2, metadata={op_name="jit(step)/while/body/leftover_metadata"}
+  %all-gather-start.1 = bf16[64,64]{1,0} all-gather-start(%p), channel_id=3
+  %all-gather-done.1 = bf16[64,64]{1,0} all-gather-done(%all-gather-start.1)
+  %dot.9 = f32[4,4]{1,0} dot(%a, %b)
+}
+"""
+
+
+def test_collective_bytes_tuples_async_and_loop_context():
+    cols = collective_bytes(_HLO)
+    ar_count, ar_bytes, ar_loop = cols["all-reduce"]
+    assert ar_count == 3
+    tuple_bytes = (512 * 128 + 512) * 4
+    assert ar_bytes == tuple_bytes + 1000 * 64 * 4 + 16 * 4
+    # in-loop = computation-graph membership, transitively through the
+    # fusion call; all-reduce.44 carries stale while/body METADATA but
+    # lives in ENTRY — it must NOT be flagged (hoisted-op false positive)
+    assert ar_loop == tuple_bytes + 16 * 4
+    # async pair counted once, at -start
+    ag_count, ag_bytes, ag_loop = cols["all-gather"]
+    assert (ag_count, ag_bytes, ag_loop) == (1, 64 * 64 * 2, 0)
+    assert "dot" not in cols
+
+
+def test_dp8_allreduce_volume_equals_param_bytes():
+    """End-to-end on the virtual 8-device mesh: pure data parallelism
+    all-reduces each gradient exactly once, so total collective bytes ==
+    sum of parameter sizes in f32 plus the scalar loss reduction."""
+    from benchmarks.collective_analysis import _sharded_step_hlo
+
+    from paddle_tpu.flagship import example_batch, flagship_config
+
+    tc = flagship_config(dict_dim=500, emb_dim=32, hidden=128, classes=2,
+                         mesh_shape="data=8")
+    hlo = _sharded_step_hlo(tc, example_batch(dict_dim=500, B=16, T=8),
+                            "data=8")
+    cols = collective_bytes(hlo)
+    total = sum(b for _, b, _lb in cols.values())
+    pbytes = sum(p.size for p in tc.model_config.parameters) * 4
+    assert pbytes <= total <= pbytes * 1.05 + 4096, (total, pbytes)
+    # the recurrent dW all-reduce is inside the backward scan on CPU HLO
+    # — the in-loop flag must catch it (this is the hoisting tripwire)
+    assert cols["all-reduce"][2] > 0
