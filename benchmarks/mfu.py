@@ -25,26 +25,9 @@ from __future__ import annotations
 
 from typing import Optional
 
-# substring (lowercased device_kind) -> peak bf16 TFLOP/s per jax device
-_PEAK_BF16_TFLOPS = [
-    ("v6e", 918.0),
-    ("v6 lite", 918.0),
-    ("v5p", 459.0),
-    ("v5e", 197.0),
-    ("v5 lite", 197.0),
-    ("v5litepod", 197.0),
-    ("v4", 275.0),
-    ("v3", 61.5),   # per core (a v3 jax device is one core)
-    ("v2", 23.0),
-]
-
-
-def peak_tflops(device_kind: str) -> Optional[float]:
-    dk = device_kind.lower()
-    for key, peak in _PEAK_BF16_TFLOPS:
-        if key in dk:
-            return peak
-    return None
+# the peak table lives with the FLOP accounting in the package (the
+# trainer's MFU logging uses it too); re-exported here for callers
+from paddle_tpu.ops.kernel_flops import peak_tflops  # noqa: F401
 
 
 def flops_of_compiled(compiled) -> Optional[float]:

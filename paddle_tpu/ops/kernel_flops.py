@@ -154,6 +154,33 @@ def train_step_flops(fn, *args, **kwargs) -> float:
     return jaxpr_flops(jax.make_jaxpr(fn, **kwargs)(*args))
 
 
+# ------------------------------------------------------------ chip peaks
+
+# substring (lowercased device_kind) -> peak bf16 TFLOP/s per jax device
+# (Google's published TPU specs; v3 entry is per core = one jax device)
+_PEAK_BF16_TFLOPS = [
+    ("v6e", 918.0),
+    ("v6 lite", 918.0),
+    ("v5p", 459.0),
+    ("v5e", 197.0),
+    ("v5 lite", 197.0),
+    ("v5litepod", 197.0),
+    ("v4", 275.0),
+    ("v3", 61.5),
+    ("v2", 23.0),
+]
+
+
+def peak_tflops(device_kind: str):
+    """Peak bf16 TFLOP/s for a jax device kind; None when unknown (MFU
+    is omitted, never guessed)."""
+    dk = device_kind.lower()
+    for key, peak in _PEAK_BF16_TFLOPS:
+        if key in dk:
+            return peak
+    return None
+
+
 # ------------------------------------------------------------- trace capture
 
 _LOG: Optional[List[float]] = None

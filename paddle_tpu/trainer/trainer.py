@@ -143,6 +143,12 @@ class Trainer:
         # — programmatic convergence-curve access (quality tracking tests,
         # plotcurve's structured counterpart)
         self.test_history: list = []
+        # model-FLOP accounting for the pass-end MFU log line: analytic
+        # matmul FLOPs per distinct batch-shape signature (one jaxpr
+        # trace each — ops/kernel_flops.py; XLA cost analysis undercounts
+        # scans so it cannot be the basis)
+        self._flops_cache: dict = {}
+        self._pass_flops = 0.0
         self._accum_fns = None
         self._acc = None
         self._acc_batches = 0
@@ -573,12 +579,51 @@ class Trainer:
         if self.save_dir and saved_pass != last_pass and last_pass >= self.start_pass:
             self.save(last_pass, final=True)
 
+    def _count_model_flops(self, key, fn, *args) -> float:
+        """Analytic model matmul FLOPs of one ``fn(*args)`` call, cached
+        by batch-shape signature (one jaxpr trace per distinct shape —
+        the same granularity jit compiles at). Never raises: accounting
+        must not be able to break training."""
+        f = self._flops_cache.get(key)
+        if f is None:
+            try:
+                from paddle_tpu.ops.kernel_flops import train_step_flops
+
+                f = train_step_flops(fn, *args)
+            except Exception:
+                f = 0.0
+            self._flops_cache[key] = f
+        return f
+
+    @staticmethod
+    def _shape_sig(tree):
+        return tuple(
+            (str(getattr(l, "shape", ())), str(getattr(l, "dtype", "")))
+            for l in jax.tree_util.tree_leaves(tree)
+        )
+
+    def _mfu_note(self, dt: float) -> str:
+        """', model X TFLOP/s, MFU Y' for the pass log when accounting
+        ran (empty on the accumulation path and when counting failed);
+        MFU only when the chip's peak is known — never guessed."""
+        if self._pass_flops <= 0 or dt <= 0:
+            return ""
+        from paddle_tpu.ops.kernel_flops import peak_tflops
+
+        tfps = self._pass_flops / dt / 1e12
+        note = f", model {tfps:.3g} TFLOP/s"
+        peak = peak_tflops(jax.devices()[0].device_kind)
+        if peak:
+            note += f", MFU {tfps / (peak * jax.device_count()):.3f}"
+        return note
+
     def train_one_pass(self, pass_id: int, provider: DataProvider, rng) -> None:
         stats = TrainerStats()
         evaluators = EvaluatorChain(self.config.model_config)
         evaluators.start()
         log_period = self.flags.log_period
         profiling = False
+        self._pass_flops = 0.0
         t0 = time.time()
         batch_id = 0
         step_times: list = []
@@ -598,7 +643,6 @@ class Trainer:
                 jax.profiler.start_trace(self.flags.profile_dir)
                 profiling = True
                 logger.info("profiler trace started → %s", self.flags.profile_dir)
-            t_step = time.perf_counter()
             if kind == "fused":
                 items = group
                 kf = len(items)
@@ -621,10 +665,19 @@ class Trainer:
                     rng, sr = jax.random.split(rng)
                     step_keys.append(sr)
                 rngs = jnp.stack(step_keys)
+                ns_arr = jnp.asarray([float(x) for x in ns])
+                # launch FLOPs counted exactly: the walker multiplies the
+                # fused scan body by its length k. Counted BEFORE t_step
+                # so a cache-miss jaxpr trace never inflates step timing
+                self._pass_flops += self._count_model_flops(
+                    ("fused", kf, self._shape_sig(stacked)),
+                    self.fused_step, self.params, self.opt_state, stacked,
+                    rngs, ns_arr,
+                )
+                t_step = time.perf_counter()
                 with stat_timer("train_step"):
                     self.params, self.opt_state, losses, keeps = self.fused_step(
-                        self.params, self.opt_state, stacked, rngs,
-                        jnp.asarray([float(x) for x in ns]),
+                        self.params, self.opt_state, stacked, rngs, ns_arr,
                     )
                 # ONE device→host transfer per launch (losses + kept
                 # outputs together); numpy slicing below adds no further
@@ -655,6 +708,13 @@ class Trainer:
             else:
                 rng, step_rng = jax.random.split(rng)
                 n, _host_batch, batch = group
+                if self._accum_n <= 1:
+                    self._pass_flops += self._count_model_flops(
+                        ("single", self._shape_sig(batch)),
+                        self.train_step, self.params, self.opt_state, batch,
+                        step_rng, jnp.asarray(float(n)),
+                    )
+                t_step = time.perf_counter()
                 with stat_timer("train_step"):
                     if self._accum_n > 1:
                         loss, outputs = self._accum_step(batch, step_rng, n)
@@ -737,11 +797,12 @@ class Trainer:
         dt = time.time() - t0
         rate = stats.total_samples / max(dt, 1e-9)
         logger.info(
-            "Pass %d done: %s  %s  (%.1f samples/s)",
+            "Pass %d done: %s  %s  (%.1f samples/s%s)",
             pass_id,
             stats.summary(),
             evaluators.summary(),
             rate,
+            self._mfu_note(dt),
         )
         from paddle_tpu.utils.barrier import step_time_skew_summary
 

@@ -1,0 +1,34 @@
+"""Pass-end model-TFLOP/s + MFU logging (Trainer._count_model_flops).
+
+The trainer accumulates analytic model matmul FLOPs per batch (jaxpr
+walk, cached by shape signature) and appends 'model X TFLOP/s[, MFU Y]'
+to the pass-done log line. MFU appears only when the device peak is
+known (never on CPU), so here we assert the FLOP accounting itself and
+the note formatting.
+"""
+
+from demo_utils import setup_demo, train_demo
+
+
+def test_pass_flops_accumulate_and_note(tmp_path):
+    setup_demo(tmp_path, "quick_start", ["train-seed-1"], ["test-seed-1"])
+    trainer, _ = train_demo(tmp_path, "trainer_config.lr.py", num_passes=1)
+    # one pass over 1000 samples, batch 64: flops counted for every batch
+    assert trainer._pass_flops > 0
+    # two cached signatures at most (full batches + the 40-sample tail)
+    assert 1 <= len(trainer._flops_cache) <= 2, trainer._flops_cache
+    per_batch = max(v for v in trainer._flops_cache.values())
+    # LR model ~ dims known loosely: fwd+bwd of [64,1000-ish bow] x fc;
+    # just require a sane magnitude and the full-batch > tail-batch order
+    assert per_batch > 1e4
+    # note formatting: TFLOP/s always, MFU absent on CPU (unknown peak)
+    note = trainer._mfu_note(2.0)
+    assert note.startswith(", model ") and "TFLOP/s" in note
+    assert "MFU" not in note  # CPU device kind has no published peak
+
+
+def test_mfu_note_empty_without_accounting(tmp_path):
+    setup_demo(tmp_path, "quick_start", ["train-seed-1"], ["test-seed-1"])
+    trainer, _ = train_demo(tmp_path, "trainer_config.lr.py", num_passes=1)
+    trainer._pass_flops = 0.0
+    assert trainer._mfu_note(2.0) == ""
