@@ -444,6 +444,11 @@ def main():
 
     backend = probe_backend(timeout_s=PROBE_TIMEOUT_S)
     on_tpu = backend not in ("", "cpu")
+    # early stderr marker: tells the supervisor this child never touches
+    # the accelerator, so a timeout may safely terminate it (a child on
+    # the TPU path must be abandoned instead — kill-wedge)
+    print(f"# backend-decision: {'tpu' if on_tpu else 'cpu'}",
+          file=sys.stderr, flush=True)
     if not on_tpu:
         ensure_cpu_mesh(1)
 
@@ -553,10 +558,13 @@ def _supervise():
     accelerator leg hangs or crashes (round-1 failure modes), retry on
     forced CPU. Guarantees exactly one JSON line and rc=0 no matter what.
 
-    Timed-out children are SIGTERMed with a grace period, never SIGKILLed
-    outright — a SIGKILLed holder of the TPU client wedges the tunnel for
-    every later claimant (including the CPU-retry's probe subprocess)."""
-    from paddle_tpu.utils.backend_guard import run_graceful
+    Timed-out children are ABANDONED, never signaled: even a SIGTERM to a
+    process hung mid-claim wedges the tunnel for every later claimant
+    (including the CPU-retry's probe subprocess). The abandoned child
+    finishes its own claim rejection (~25 min) as an orphan while the
+    retry proceeds; its partial stdout is salvaged from the incremental
+    pipe drain."""
+    from paddle_tpu.utils.backend_guard import run_abandoning
 
     budget = float(os.environ.get("PADDLE_TPU_BENCH_BUDGET", "1500"))
     deadline = time.monotonic() + budget
@@ -577,10 +585,13 @@ def _supervise():
         attempt_budget = remaining
         if i < len(attempts) - 1 and remaining - RETRY_RESERVE_S > 10:
             attempt_budget = remaining - RETRY_RESERVE_S
-        rc, stdout, stderr = run_graceful(
+        rc, stdout, stderr = run_abandoning(
             [sys.executable, os.path.abspath(__file__)] + sys.argv[1:],
             timeout_s=attempt_budget,
             env=env,
+            # a timed-out child that committed to the CPU path never held
+            # the accelerator: stop it so the retry gets uncontended cores
+            signal_if=lambda _o, e: "# backend-decision: cpu" in e,
         )
         sys.stderr.write((stderr or "")[-4000:])
         # salvage even on timeout: the child may have emitted the headline

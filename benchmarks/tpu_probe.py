@@ -5,7 +5,7 @@ Run this ONLY from the recovery watcher (benchmarks/tpu_watcher.sh) or by
 hand in a disposable shell — it claims the chip in-process, so a wedged
 tunnel makes it hang ~25 min before failing UNAVAILABLE. Everything else
 (bench.py, tests) must keep probing via
-paddle_tpu.utils.backend_guard.probe_backend (subprocess + SIGTERM-first
+paddle_tpu.utils.backend_guard.probe_backend (subprocess + abandon-on-timeout
 timeout).
 """
 import time

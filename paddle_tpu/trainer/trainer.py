@@ -149,6 +149,8 @@ class Trainer:
         # scans so it cannot be the basis)
         self._flops_cache: dict = {}
         self._pass_flops = 0.0
+        self._pass_train_s = 0.0
+        self._pass_flops_incomplete = False
         self._accum_fns = None
         self._acc = None
         self._acc_batches = 0
@@ -591,8 +593,13 @@ class Trainer:
 
                 f = train_step_flops(fn, *args)
             except Exception:
-                f = 0.0
+                f = None  # cached failure: don't re-trace every batch
             self._flops_cache[key] = f
+        if f is None:
+            # a partially-counted pass must not log a confident number
+            # ("omitted, never guessed")
+            self._pass_flops_incomplete = True
+            return 0.0
         return f
 
     @staticmethod
@@ -602,15 +609,18 @@ class Trainer:
             for l in jax.tree_util.tree_leaves(tree)
         )
 
-    def _mfu_note(self, dt: float) -> str:
+    def _mfu_note(self) -> str:
         """', model X TFLOP/s, MFU Y' for the pass log when accounting
-        ran (empty on the accumulation path and when counting failed);
-        MFU only when the chip's peak is known — never guessed."""
-        if self._pass_flops <= 0 or dt <= 0:
+        ran, over TRAINING time only (the summed step windows — in-pass
+        test/save/stats time would understate it). Empty on the
+        accumulation path and whenever any batch's counting failed; MFU
+        only when the chip's peak is known — never guessed."""
+        if (self._pass_flops <= 0 or self._pass_train_s <= 0
+                or self._pass_flops_incomplete):
             return ""
         from paddle_tpu.ops.kernel_flops import peak_tflops
 
-        tfps = self._pass_flops / dt / 1e12
+        tfps = self._pass_flops / self._pass_train_s / 1e12
         note = f", model {tfps:.3g} TFLOP/s"
         peak = peak_tflops(jax.devices()[0].device_kind)
         if peak:
@@ -624,6 +634,8 @@ class Trainer:
         log_period = self.flags.log_period
         profiling = False
         self._pass_flops = 0.0
+        self._pass_train_s = 0.0
+        self._pass_flops_incomplete = False
         t0 = time.time()
         batch_id = 0
         step_times: list = []
@@ -644,6 +656,7 @@ class Trainer:
                 profiling = True
                 logger.info("profiler trace started → %s", self.flags.profile_dir)
             if kind == "fused":
+                t_prep = time.perf_counter()
                 items = group
                 kf = len(items)
                 ns = [it[0] for it in items]
@@ -666,15 +679,18 @@ class Trainer:
                     step_keys.append(sr)
                 rngs = jnp.stack(step_keys)
                 ns_arr = jnp.asarray([float(x) for x in ns])
+                prep_s = time.perf_counter() - t_prep
                 # launch FLOPs counted exactly: the walker multiplies the
-                # fused scan body by its length k. Counted BEFORE t_step
-                # so a cache-miss jaxpr trace never inflates step timing
+                # fused scan body by its length k. Counted OUTSIDE the
+                # step window (a cache-miss jaxpr trace must not inflate
+                # step timing), while host-side stacking/rng prep stays
+                # INSIDE it, preserving the window's original semantics
                 self._pass_flops += self._count_model_flops(
                     ("fused", kf, self._shape_sig(stacked)),
                     self.fused_step, self.params, self.opt_state, stacked,
                     rngs, ns_arr,
                 )
-                t_step = time.perf_counter()
+                t_step = time.perf_counter() - prep_s
                 with stat_timer("train_step"):
                     self.params, self.opt_state, losses, keeps = self.fused_step(
                         self.params, self.opt_state, stacked, rngs, ns_arr,
@@ -696,6 +712,7 @@ class Trainer:
                         "— aborting. Try --job=checkgrad, a lower learning "
                         "rate, or gradient clipping to locate the cause."
                     )
+                self._pass_train_s += time.perf_counter() - t_step
                 step_dt = (time.perf_counter() - t_step) / kf
                 results = [
                     (
@@ -724,6 +741,7 @@ class Trainer:
                             jnp.asarray(float(n)),
                         )
                 loss_f = float(loss)
+                self._pass_train_s += time.perf_counter() - t_step
                 step_dt = time.perf_counter() - t_step
                 results = [(loss_f, outputs, n)]
             batch_id_start = batch_id
@@ -802,7 +820,7 @@ class Trainer:
             stats.summary(),
             evaluators.summary(),
             rate,
-            self._mfu_note(dt),
+            self._mfu_note(),
         )
         from paddle_tpu.utils.barrier import step_time_skew_summary
 

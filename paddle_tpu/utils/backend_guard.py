@@ -73,35 +73,57 @@ def _with_device_count(flags: str, n: int) -> str:
     return " ".join(parts)
 
 
-def run_graceful(cmd, timeout_s, grace_s: float = 15.0, env=None):
-    """subprocess.run(capture_output=True, text=True) with a SIGTERM-first
-    timeout. subprocess.run's own timeout SIGKILLs the child — and a
-    SIGKILLed holder of the accelerator client wedges the tunnel for every
-    later claimant. SIGTERM + a grace period lets the runtime's teardown
-    release the device; SIGKILL only if even that stalls.
+def run_abandoning(cmd, timeout_s, env=None, signal_if=None):
+    """Like run_graceful but NEVER signals a timed-out child: a hung
+    accelerator claimant that gets SIGTERM/SIGKILLed mid-claim wedges the
+    tunnel for every later claim (~25-minute rejections), which is worse
+    than letting it finish its own rejection as an orphan. On timeout the
+    child is abandoned — a daemon thread keeps draining its pipes so it
+    can't block, and it exits on its own once the claim resolves.
 
-    Total wall time is bounded by timeout_s: the grace period is carved
-    out of the budget, not added on top.
+    ``signal_if(stdout_so_far, stderr_so_far) -> bool`` carves out the
+    one case where signaling IS safe: a timed-out child that provably
+    never touched the accelerator (e.g. it printed its forced-CPU
+    backend decision) is merely slow, not hung in a claim — terminating
+    it frees the cores for the retry instead of running both
+    concurrently.
 
-    Returns (returncode|None, stdout, stderr); returncode None = timeout.
-    """
+    Returns (returncode|None, stdout, stderr); returncode None = timeout,
+    with whatever output had arrived by then (reader threads drain the
+    pipes incrementally, so partial results — e.g. a bench headline
+    emitted before a later leg hung — are still salvaged)."""
     import subprocess
+    import threading
 
     proc = subprocess.Popen(
         cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env
     )
-    grace = min(grace_s, timeout_s / 2)  # short timeouts keep real runtime
+    bufs = {"out": [], "err": []}
+
+    def _reader(stream, key):
+        for line in stream:
+            bufs[key].append(line)
+
+    threads = [
+        threading.Thread(target=_reader, args=(proc.stdout, "out"), daemon=True),
+        threading.Thread(target=_reader, args=(proc.stderr, "err"), daemon=True),
+    ]
+    for t in threads:
+        t.start()
+    rc: "int | None"
     try:
-        out, err = proc.communicate(timeout=timeout_s - grace)
-        return proc.returncode, out, err
+        rc = proc.wait(timeout=timeout_s)
+        for t in threads:  # streams hit EOF at exit; finish the drain
+            t.join(timeout=5)
     except subprocess.TimeoutExpired:
-        proc.terminate()
-        try:
-            out, err = proc.communicate(timeout=grace)
-        except subprocess.TimeoutExpired:
-            proc.kill()
-            out, err = proc.communicate()
-        return None, out or "", err or ""
+        rc = None  # abandoned: threads keep draining, child exits on its own
+        for t in threads:  # brief join so already-written output lands
+            t.join(timeout=0.5)
+        if signal_if and signal_if("".join(bufs["out"]), "".join(bufs["err"])):
+            proc.terminate()  # provably claim-free child: safe to stop
+            for t in threads:
+                t.join(timeout=5)
+    return rc, "".join(bufs["out"]), "".join(bufs["err"])
 
 
 def probe_backend(timeout_s: float = 180.0) -> str:
@@ -109,13 +131,14 @@ def probe_backend(timeout_s: float = 180.0) -> str:
 
     Runs the probe in a subprocess so a hanging accelerator plugin (the
     round-1 failure mode: axon tunnel up but chip unreachable) cannot
-    wedge the caller. Returns the backend platform name ('tpu', 'cpu',
-    ...) on success, or 'cpu' if init fails or exceeds timeout_s.
+    wedge the caller. A probe that exceeds timeout_s is ABANDONED, never
+    killed — see run_abandoning. Returns the backend platform name
+    ('tpu', 'cpu', ...) on success, or 'cpu' on failure/timeout.
     """
     import sys
 
     code = "import jax; print(jax.default_backend())"
-    rc, out, _ = run_graceful([sys.executable, "-c", code], timeout_s)
+    rc, out, _ = run_abandoning([sys.executable, "-c", code], timeout_s)
     if rc != 0:
         return "cpu"
     backend = out.strip().splitlines()[-1] if out.strip() else ""

@@ -395,3 +395,29 @@ def test_pallas_fallback_double_failure(monkeypatch):
     msg = str(ei.value)
     assert "scan path oom" in msg and "Mosaic lowering failed" in msg
     assert os.environ["PADDLE_TPU_BENCH_PALLAS_RNN"] == "1"
+
+
+def test_run_abandoning_salvages_without_signaling():
+    """run_abandoning: on timeout the child is left running (no signal —
+    a signaled mid-claim TPU client wedges the tunnel) but its output so
+    far is returned; on normal exit behaves like run()."""
+    import time as _time
+
+    from paddle_tpu.utils.backend_guard import run_abandoning
+
+    # normal exit
+    rc, out, err = run_abandoning(
+        [sys.executable, "-c", "print('fast'); import sys; sys.exit(3)"],
+        timeout_s=30)
+    assert rc == 3 and out.strip() == "fast"
+
+    # timeout: partial stdout salvaged, child NOT killed
+    code = ("import sys, time\n"
+            "print('headline', flush=True)\n"
+            "time.sleep(8)\n"
+            "print('late', flush=True)\n")
+    t0 = _time.monotonic()
+    rc, out, err = run_abandoning([sys.executable, "-c", code], timeout_s=2)
+    assert _time.monotonic() - t0 < 6  # returned at the timeout, not after
+    assert rc is None
+    assert out.strip() == "headline"  # salvage of pre-hang output

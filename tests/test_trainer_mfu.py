@@ -21,8 +21,10 @@ def test_pass_flops_accumulate_and_note(tmp_path):
     # LR model ~ dims known loosely: fwd+bwd of [64,1000-ish bow] x fc;
     # just require a sane magnitude and the full-batch > tail-batch order
     assert per_batch > 1e4
+    # training time accumulated from the step windows only
+    assert trainer._pass_train_s > 0
     # note formatting: TFLOP/s always, MFU absent on CPU (unknown peak)
-    note = trainer._mfu_note(2.0)
+    note = trainer._mfu_note()
     assert note.startswith(", model ") and "TFLOP/s" in note
     assert "MFU" not in note  # CPU device kind has no published peak
 
@@ -31,4 +33,9 @@ def test_mfu_note_empty_without_accounting(tmp_path):
     setup_demo(tmp_path, "quick_start", ["train-seed-1"], ["test-seed-1"])
     trainer, _ = train_demo(tmp_path, "trainer_config.lr.py", num_passes=1)
     trainer._pass_flops = 0.0
-    assert trainer._mfu_note(2.0) == ""
+    assert trainer._mfu_note() == ""
+    # a partially-failed accounting suppresses the note entirely
+    trainer._pass_flops = 1e9
+    trainer._pass_train_s = 1.0
+    trainer._pass_flops_incomplete = True
+    assert trainer._mfu_note() == ""
