@@ -387,6 +387,56 @@ def bench_nmt(B=None, T=32, vocab=30000, dim=512, steps=10, warmup=2, dtype=None
     return _try_ladder(ladder, run_one)
 
 
+def bench_nmt_gen(B=None, T=32, vocab=30000, dim=512, beam_size=3,
+                  max_length=32, steps=10, warmup=2, dtype=None):
+    """seqToseq beam-search generation throughput: generated (best-beam)
+    tokens/sec — the reference's gen.conf workload (SURVEY hard part #1's
+    beam search under XLA's static-shape regime). Forward-only; no MFU
+    (the decode while-loop is dispatch/latency-bound, not matmul-bound,
+    and its trip count is data-dependent)."""
+    import jax
+    import numpy as np
+
+    from paddle_tpu.flagship import nmt_gen_batch, nmt_gen_config
+    from paddle_tpu.graph import GradientMachine
+    from paddle_tpu.graph.machine import compute_dtype_of
+
+    def run_one(b):
+        tc = nmt_gen_config(vocab=vocab, dim=dim, beam_size=beam_size,
+                            max_length=max_length, dtype=dtype or BENCH_DTYPE,
+                            batch_size=b)
+        gm = GradientMachine(tc.model_config,
+                             compute_dtype=compute_dtype_of(tc.opt_config))
+        params = gm.init_params(seed=1)
+        batch = nmt_gen_batch(vocab=vocab, B=b, T=T)
+        group = next(s.name for s in tc.model_config.sub_models
+                     if s.generator is not None)
+
+        def fwd(params, batch):
+            outputs, _ = gm.forward(params, batch, pass_type="gen", rng=None)
+            best = outputs[group]
+            return best.ids, best.seq_lengths
+
+        fwd = jax.jit(fwd)
+        ids, lens = fwd(params, batch)
+        jax.block_until_ready((ids, lens))
+        for _ in range(warmup - 1):
+            ids, lens = fwd(params, batch)
+        jax.block_until_ready((ids, lens))
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            ids, lens = fwd(params, batch)
+        tokens = float(np.asarray(lens).sum())  # device sync via readback
+        dt = time.perf_counter() - t0
+        extras = _leg_extras(beam_size=beam_size, max_length=max_length,
+                             dtype=tc.opt_config.dtype, batch=b,
+                             tokens="best-beam generated")
+        return tokens * steps / dt, extras
+
+    ladder = [(B,)] if B else [(64,), (32,), (16,)]
+    return _try_ladder(ladder, run_one)
+
+
 def _load_last_measured():
     """Newest committed real-TPU rows (benchmarks/measured_tpu.json,
     refreshed by append_results.py after every measurement session).
@@ -425,9 +475,10 @@ def main():
             f"got {_SPL_RAW!r}"
         )
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
-    if which not in ("all", "resnet", "lstm", "nmt"):
+    if which not in ("all", "resnet", "lstm", "nmt", "gen"):
         print(
-            f"unknown benchmark {which!r}: expected 'all', 'resnet', 'lstm' or 'nmt'",
+            f"unknown benchmark {which!r}: expected 'all', 'resnet', 'lstm', "
+            "'nmt' or 'gen'",
             file=sys.stderr,
         )
         return 2
@@ -477,6 +528,16 @@ def main():
         # so the leg stays inside the supervisor budget
         value, extras = bench_nmt(dtype=leg_dtype, **({} if on_tpu else {"B": 64}))
         metric, unit, tkey = ("nmt_train_tokens_per_sec", "tokens/s", "nmt_tokens_per_sec")
+    elif which == "gen":
+        if on_tpu:
+            value, extras = bench_nmt_gen()
+            metric = "nmt_gen_tokens_per_sec"
+        else:
+            value, extras = bench_nmt_gen(
+                B=4, T=8, vocab=200, dim=32, max_length=8, steps=2, warmup=1,
+                dtype="float32")
+            metric = "nmt_gen_cpu_smoke_tokens_per_sec"
+        unit, tkey = "tokens/s", None
     elif on_tpu:
         # headline: bf16 ResNet-50; "all" additionally runs the two
         # sequence flagships (emitted incrementally below)

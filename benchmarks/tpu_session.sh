@@ -87,6 +87,10 @@ for u in 4 8; do
     PADDLE_TPU_BENCH_BUDGET=600 \
     timeout 700 python bench.py nmt >> $OUT 2>>$ERR
 done
+# 5b) generation throughput (beam search; lowest priority — quality
+#     parity workload, not a BASELINE headline)
+echo "--- nmt generation (beam search)" >> $OUT
+PADDLE_TPU_BENCH_BUDGET=900 timeout 1000 python bench.py gen >> $OUT 2>>$ERR
 # 6) trace summaries
 echo "--- trace summary (resnet)" >> $OUT
 python benchmarks/trace_summary.py benchmarks/traces 15 >> $OUT 2>>$ERR

@@ -62,10 +62,13 @@ def example_batch(dict_dim=1000, B=8, T=32, classes=2, seed=0):
     }
 
 
-def nmt_config(vocab=30000, dim=512, dtype="float32", batch_size=64):
-    """seqToseq NMT attention encoder-decoder (training graph), the
-    BASELINE.md north-star workload #2 — the same model the demo config
-    builds (reference demo/seqToseq/seqToseq_net.py:65-181)."""
+def nmt_config(vocab=30000, dim=512, dtype="float32", batch_size=64,
+               is_generating=False, **gen_kwargs):
+    """seqToseq NMT attention encoder-decoder, the BASELINE.md north-star
+    workload #2 — the same model the demo config builds (reference
+    demo/seqToseq/seqToseq_net.py:65-181). is_generating=True builds the
+    beam-search generation graph (gen.conf path); gen_kwargs (beam_size,
+    max_length, ...) pass through to gru_encoder_decoder."""
     import importlib.util
 
     from paddle_tpu.config.builder import fresh_context
@@ -89,12 +92,31 @@ def nmt_config(vocab=30000, dim=512, dtype="float32", batch_size=64):
         mod.gru_encoder_decoder(
             source_dict_dim=vocab,
             target_dict_dim=vocab,
-            is_generating=False,
+            is_generating=is_generating,
             word_vector_dim=dim,
             encoder_size=dim,
             decoder_size=dim,
+            **gen_kwargs,
         )
         return ctx.finalize()
+
+
+def nmt_gen_config(vocab=30000, dim=512, beam_size=3, max_length=32,
+                   dtype="float32", batch_size=64):
+    """The seqToseq generation graph at bench shapes (see nmt_config)."""
+    return nmt_config(vocab=vocab, dim=dim, dtype=dtype,
+                      batch_size=batch_size, is_generating=True,
+                      beam_size=beam_size, max_length=max_length)
+
+
+def nmt_gen_batch(vocab=30000, B=8, T=32, seed=0):
+    """Source-only batch for the generation graph."""
+    from paddle_tpu.graph import make_seq
+
+    rng = np.random.RandomState(seed)
+    ids = rng.randint(2, vocab, (B, T)).astype(np.int32)
+    lengths = rng.randint(max(T // 2, 1), T + 1, (B,)).astype(np.int32)
+    return {"source_language_word": make_seq(None, lengths, ids=ids)}
 
 
 def nmt_batch(vocab=30000, B=8, T=32, seed=0):

@@ -586,8 +586,9 @@ class Trainer:
         by batch-shape signature (one jaxpr trace per distinct shape —
         the same granularity jit compiles at). Never raises: accounting
         must not be able to break training."""
-        f = self._flops_cache.get(key)
-        if f is None:
+        if key in self._flops_cache:
+            f = self._flops_cache[key]
+        else:
             try:
                 from paddle_tpu.ops.kernel_flops import train_step_flops
 

@@ -121,6 +121,14 @@ def run_abandoning(cmd, timeout_s, env=None, signal_if=None):
             t.join(timeout=0.5)
         if signal_if and signal_if("".join(bufs["out"]), "".join(bufs["err"])):
             proc.terminate()  # provably claim-free child: safe to stop
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()  # e.g. stuck in an uninterruptible native call
+                try:
+                    proc.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    pass
             for t in threads:
                 t.join(timeout=5)
     return rc, "".join(bufs["out"]), "".join(bufs["err"])

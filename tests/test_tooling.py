@@ -421,3 +421,17 @@ def test_run_abandoning_salvages_without_signaling():
     assert _time.monotonic() - t0 < 6  # returned at the timeout, not after
     assert rc is None
     assert out.strip() == "headline"  # salvage of pre-hang output
+
+
+def test_bench_gen_leg_micro():
+    """bench.py's generation leg wiring: builds the beam-search graph,
+    runs it, and reports best-beam tokens/s with the beam knobs tagged."""
+    sys.path.insert(0, REPO)
+    import bench
+
+    v, extras = bench.bench_nmt_gen(B=2, T=4, vocab=60, dim=32, beam_size=2,
+                                    max_length=5, steps=2, warmup=1,
+                                    dtype="float32")
+    assert v > 0
+    assert extras["beam_size"] == 2 and extras["max_length"] == 5
+    assert extras["tokens"] == "best-beam generated"
