@@ -1,0 +1,32 @@
+"""Synthetic long-context corpus: a planted bigram language (each token
+deterministically constrains its successor to a small set), so the LM's
+next-token cross-entropy has real signal at any context length, with no
+dataset on disk. Swap for a reader of real tokenized lines to use a
+corpus."""
+
+import random
+
+from paddle.trainer.PyDataProvider2 import *
+
+
+def hook(settings, vocab=500, seq_len=256, **kwargs):
+    settings.vocab = vocab
+    settings.seq_len = seq_len
+    settings.input_types = {
+        "words": integer_value_sequence(vocab),
+        "next_words": integer_value_sequence(vocab),
+    }
+
+
+@provider(init_hook=hook, sort_by_length=False)
+def process(settings, file_name):
+    V, T = settings.vocab, settings.seq_len
+    rng = random.Random(file_name)
+    for _ in range(64):
+        toks = [rng.randrange(V)]
+        for _ in range(T):
+            # planted structure: successor lives in a 8-token window
+            # determined by the current token
+            base = (toks[-1] * 7) % V
+            toks.append((base + rng.randrange(8)) % V)
+        yield {"words": toks[:-1], "next_words": toks[1:]}

@@ -1,0 +1,16 @@
+#!/bin/bash
+# Single-device smoke:
+#   ./train.sh
+# Long-context sharded (2048 tokens over a data=2,seq=4 mesh — on real
+# hardware the mesh maps to chips; for a CPU dry run export
+# JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8):
+#   ./train.sh --config_args=mesh_data=2,mesh_seq=4,seq_len=2048
+set -e
+echo seed-1 > train.list
+echo seed-2 > test.list
+paddle train \
+  --config=trainer_config.py \
+  --save_dir=./output \
+  --num_passes=4 \
+  --log_period=4 \
+  "$@"

@@ -24,7 +24,7 @@ def setup_demo(tmp_path, demo, train_lines, test_lines=None):
 
 
 def train_demo(tmp_path, cfg_name, num_passes, dtype=None, log_period=0,
-               run_final_test=False, **flag_overrides):
+               run_final_test=False, config_arg_str="", **flag_overrides):
     """parse_config + Trainer.train() from inside tmp_path (the demos use
     relative module imports and list paths). Returns (trainer, final test
     results or None)."""
@@ -35,7 +35,7 @@ def train_demo(tmp_path, cfg_name, num_passes, dtype=None, log_period=0,
     cwd = os.getcwd()
     os.chdir(tmp_path)
     try:
-        cfg = parse_config(cfg_name)
+        cfg = parse_config(cfg_name, config_arg_str=config_arg_str)
         if dtype:
             cfg.opt_config.dtype = dtype
         flags = _Flags(config=cfg_name, num_passes=num_passes,
