@@ -64,6 +64,15 @@ class TrainerStats:
         return f"samples={self.total_samples} AvgCost={avg:.6g} CurrentCost={cur:.6g}"
 
 
+class PreemptionExit(Exception):
+    """Raised inside the pass loop after a preemption-triggered save."""
+
+    def __init__(self, pass_id: int, saved_path: str):
+        super().__init__(f"preempted at pass {pass_id}")
+        self.pass_id = pass_id
+        self.saved_path = saved_path
+
+
 class Trainer:
     def __init__(self, config: TrainerConfig, flags=FLAGS):
         self.config = config
@@ -151,6 +160,10 @@ class Trainer:
         self._pass_flops = 0.0
         self._pass_train_s = 0.0
         self._pass_flops_incomplete = False
+        # preemption-aware checkpointing: set by the SIGTERM handler that
+        # _preemption_guard installs around train(); checked at launch
+        # boundaries so the saved checkpoint is always consistent
+        self._preempt_requested = False
         self._accum_fns = None
         self._acc = None
         self._acc_batches = 0
@@ -422,6 +435,52 @@ class Trainer:
 
     # ------------------------------------------------------------- train
 
+    def _preemption_guard(self):
+        """Context manager active for the duration of train(): installs a
+        SIGTERM handler that requests a checkpoint-and-exit at the next
+        launch boundary (TPU preemption notices arrive as SIGTERM). Only
+        installable from the main thread — elsewhere (library embedding,
+        test runners) it degrades to a no-op. The previous handler is
+        restored on exit, and a SECOND SIGTERM falls through to it, so a
+        stuck save can still be killed the ordinary way. Gate:
+        flags.save_on_preempt (default on; the handler itself is cheap)."""
+        import contextlib
+        import signal
+        import threading
+
+        # Gates: flag off; non-main thread (signal API unavailable);
+        # multi-process (the flag would be per-host and unsynchronized —
+        # hosts at different launch boundaries would issue mismatched
+        # collectives and deadlock the save; multi-host preemption relies
+        # on the deterministic periodic saves instead, doc/divergences.md)
+        if (not getattr(self.flags, "save_on_preempt", True)
+                or self._multiproc
+                or threading.current_thread() is not threading.main_thread()):
+            return contextlib.nullcontext()
+
+        @contextlib.contextmanager
+        def guard():
+            prev = signal.getsignal(signal.SIGTERM)
+            # None = installed by non-Python code; fall through to default
+            fallback = prev if prev is not None else signal.SIG_DFL
+
+            def on_sigterm(signum, frame):
+                # flag-only: logging (or any IO) from a signal handler can
+                # re-enter a buffered stream mid-write and raise; the
+                # message is logged at the launch-boundary check instead
+                self._preempt_requested = True
+                signal.signal(signal.SIGTERM, fallback)  # 2nd signal: old path
+
+            signal.signal(signal.SIGTERM, on_sigterm)
+            try:
+                yield
+            finally:
+                self._preempt_requested = False
+                if signal.getsignal(signal.SIGTERM) is on_sigterm:
+                    signal.signal(signal.SIGTERM, fallback)
+
+        return guard()
+
     def train(self, num_passes: Optional[int] = None) -> None:
         num_passes = num_passes or self.flags.num_passes
         train_provider = self._provider(for_test=False)
@@ -430,17 +489,33 @@ class Trainer:
             return self._train_batch_mode(num_passes, train_provider)
         rng = jax.random.PRNGKey(self.flags.seed)
         saved_pass = -1
-        for pass_id in range(self.start_pass, num_passes):
-            rng, pass_rng = jax.random.split(rng)
-            self.train_one_pass(pass_id, train_provider, pass_rng)
-            with stat_timer("test"):
-                pass_results = self.test(pass_id=pass_id)
-            if pass_results:
-                self.test_history.append((pass_id, pass_results))
-            if self.save_dir and (pass_id + 1) % max(self.flags.saving_period, 1) == 0:
-                self.save(pass_id)
-                saved_pass = pass_id
-            logger.info(global_stats.summary())
+        with self._preemption_guard():
+            try:
+                for pass_id in range(self.start_pass, num_passes):
+                    rng, pass_rng = jax.random.split(rng)
+                    self.train_one_pass(pass_id, train_provider, pass_rng)
+                    with stat_timer("test"):
+                        pass_results = self.test(pass_id=pass_id)
+                    if pass_results:
+                        self.test_history.append((pass_id, pass_results))
+                    if self.save_dir and (pass_id + 1) % max(self.flags.saving_period, 1) == 0:
+                        self.save(pass_id)
+                        saved_pass = pass_id
+                    logger.info(global_stats.summary())
+            except PreemptionExit as e:
+                if e.saved_path:
+                    logger.info(
+                        "preemption: checkpoint saved at %s — exiting the "
+                        "train loop cleanly (resume with --init_model_path "
+                        "on that pass dir and --start_pass=%d)",
+                        e.saved_path, e.pass_id,
+                    )
+                else:
+                    logger.info(
+                        "preemption: exiting the train loop cleanly "
+                        "(no --save_dir configured, nothing was saved)"
+                    )
+                return
         if (
             self.save_dir
             and saved_pass != num_passes - 1
@@ -790,12 +865,30 @@ class Trainer:
                     evaluators.summary(),
                 )
                 stats.reset_window()
-            if crossed(self.flags.saving_period_by_batches) and self.save_dir:
+            # preemption (SIGTERM flag) saves through the SAME block as the
+            # periodic save — one flush, one save, even when both fire on
+            # this boundary (TPU pods preempt with a SIGTERM notice; the
+            # reference is restart-from-last-pass only — SURVEY §5 names
+            # this the recovery gap)
+            want_save = crossed(self.flags.saving_period_by_batches) or (
+                self._preempt_requested
+            )
+            if want_save and self.save_dir:
                 if self._accum_n > 1:
                     # apply pending gradients first or the checkpoint
                     # would silently drop up to N-1 batches' worth
                     self._accum_flush()
                 self.save(pass_id, batch_id=batch_id)
+            if self._preempt_requested:
+                self._end_dot_line()
+                logger.info("SIGTERM received — checkpointed at the launch "
+                            "boundary" if self.save_dir else
+                            "SIGTERM received — no save_dir, nothing saved")
+                saved_path = (
+                    os.path.join(self.save_dir, ckpt.PASS_FMT % pass_id)
+                    if self.save_dir else ""
+                )
+                raise PreemptionExit(pass_id, saved_path)
             if profiling and batch_id >= (
                 self.flags.profile_start_batch + self.flags.profile_num_batches
             ):

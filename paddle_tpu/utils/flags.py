@@ -35,6 +35,10 @@ class _Flags:
     dot_period: int = 1
     saving_period: int = 1               # passes between checkpoints
     saving_period_by_batches: int = 0
+    # preemption-aware checkpoint: SIGTERM during train() saves at the
+    # next launch boundary and exits cleanly (TPU pods preempt with a
+    # SIGTERM notice; resume via --init_model_path + --start_pass)
+    save_on_preempt: bool = True
     save_dir: str = ""
     init_model_path: str = ""
     load_missing_parameter_strategy: str = "fail"   # fail | rand | zero
