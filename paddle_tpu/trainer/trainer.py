@@ -869,21 +869,28 @@ class Trainer:
             # periodic save — one flush, one save, even when both fire on
             # this boundary (TPU pods preempt with a SIGTERM notice; the
             # reference is restart-from-last-pass only — SURVEY §5 names
-            # this the recovery gap)
-            want_save = crossed(self.flags.saving_period_by_batches) or (
-                self._preempt_requested
-            )
+            # this the recovery gap). Snapshot the flag ONCE: a signal
+            # landing between two reads must not make the raise claim a
+            # save that never ran.
+            preempted = self._preempt_requested
+            want_save = crossed(self.flags.saving_period_by_batches) or preempted
             if want_save and self.save_dir:
                 if self._accum_n > 1:
                     # apply pending gradients first or the checkpoint
                     # would silently drop up to N-1 batches' worth
                     self._accum_flush()
                 self.save(pass_id, batch_id=batch_id)
-            if self._preempt_requested:
+            if preempted:
                 self._end_dot_line()
                 logger.info("SIGTERM received — checkpointed at the launch "
                             "boundary" if self.save_dir else
                             "SIGTERM received — no save_dir, nothing saved")
+                if profiling:
+                    # the open trace would otherwise be abandoned mid-write
+                    jax.block_until_ready(self.params)
+                    jax.profiler.stop_trace()
+                    logger.info("profiler trace written to %s",
+                                self.flags.profile_dir)
                 saved_path = (
                     os.path.join(self.save_dir, ckpt.PASS_FMT % pass_id)
                     if self.save_dir else ""

@@ -60,16 +60,21 @@ def test_sigterm_saves_checkpoint_and_exits(tmp_path):
         cwd=tmp_path,
         env=dict(os.environ, PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu"),
     )
-    flag = tmp_path / "started.flag"
-    deadline = time.monotonic() + 120
-    while time.monotonic() < deadline and not flag.exists():
-        if proc.poll() is not None:
-            out, _ = proc.communicate()
-            raise AssertionError(f"child exited early:\n{out[-2500:]}")
-        time.sleep(0.25)
-    assert flag.exists(), "training loop never became live"
-    proc.send_signal(signal.SIGTERM)
-    out, _ = proc.communicate(timeout=120)
+    try:
+        flag = tmp_path / "started.flag"
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline and not flag.exists():
+            if proc.poll() is not None:
+                out, _ = proc.communicate()
+                raise AssertionError(f"child exited early:\n{out[-2500:]}")
+            time.sleep(0.25)
+        assert flag.exists(), "training loop never became live"
+        proc.send_signal(signal.SIGTERM)
+        out, _ = proc.communicate(timeout=120)
+    finally:
+        if proc.poll() is None:  # never leak the slow-provider child
+            proc.kill()
+            proc.wait()
     assert proc.returncode == 0, out[-2500:]
     assert "TRAIN_RETURNED_CLEANLY" in out, out[-2500:]
     assert "preemption: checkpoint saved" in out, out[-2500:]
