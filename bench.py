@@ -301,9 +301,13 @@ def bench_resnet50(B=None, img_size=224, classes=1000, steps=20, warmup=3, trace
         ladder = [(B, "none")]
     else:
         # 512 leads: bigger batches fill the MXU better and the ladder
-        # steps down safely on OOM (one wasted compile attempt); 256 is
-        # the measured round-4 configuration
-        ladder = [(b, r) for b in (512, 256, 128, 64) for r in ("none", "full")]
+        # steps down safely on OOM (one wasted compile attempt); 256/none
+        # is the measured round-4 configuration. ALL plain rungs come
+        # before ANY remat rung — if 512/none OOMs the known-good
+        # 256/none must win, not a 512/full whose +33% recompute would
+        # silently replace the mfu headline with hw_flops_util
+        sizes = (512, 256, 128, 64)
+        ladder = [(b, "none") for b in sizes] + [(b, "full") for b in sizes]
 
     def run_one(b, remat):
         tc = resnet_config(50, img_size, classes)
