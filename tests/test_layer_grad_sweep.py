@@ -229,3 +229,34 @@ def test_sampling_and_eos_forward(tmp_path):
     assert ids.shape == (B,) and (ids == 2).all(), ids
     assert np.asarray(outputs["hit"].value).ravel().tolist() == [1.0] * B
     assert np.asarray(outputs["miss"].value).ravel().tolist() == [0.0] * B
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_multi_head_attention_grad(causal, tmp_path):
+    """multi_head_attention (causal + plain) under the same
+    finite-difference methodology as every other layer type — the
+    seq-parallel parity tests check sharding, not the analytic grads."""
+    cfg_file = tmp_path / f"mha_{causal}.py"
+    cfg_file.write_text(HEAD + textwrap.dedent(f"""
+    seqin = data_layer('seqin', size=8)
+    att = multi_head_attention_layer(input=seqin, num_heads=2,
+                                     causal={causal}, name='att')
+    top = pooling_layer(input=att, pooling_type=MaxPooling())
+    """) + TAIL)
+    cfg = parse_config(str(cfg_file))
+    gm = GradientMachine(cfg.model_config)
+    params = gm.init_params(seed=9)
+    T = 5
+    rng = np.random.RandomState(3)
+    batch = {
+        "seqin": Argument(
+            value=jnp.asarray(rng.rand(B, T, 8).astype(np.float32) - 0.5),
+            seq_lengths=jnp.asarray([T, T - 1, T - 2, T], jnp.int32)),
+        "label": Argument(ids=_labels()),
+    }
+    outputs, _ = gm.forward(params, batch, pass_type="test")
+    assert np.isfinite(float(gm.total_cost(outputs)))
+    report = gm.check_gradient(params, batch, epsilon=1e-4, max_entries=6)
+    assert any(k.startswith("_att.") for k in report), report
+    for name, diff in report.items():
+        assert diff < 5e-2, f"causal={causal}: {name}: {diff}"
