@@ -79,3 +79,60 @@ def test_sigterm_saves_checkpoint_and_exits(tmp_path):
     assert "TRAIN_RETURNED_CLEANLY" in out, out[-2500:]
     assert "preemption: checkpoint saved" in out, out[-2500:]
     assert (tmp_path / "out" / "pass-00000").exists(), out[-1500:]
+
+
+RESUME_CHILD = """
+import sys
+sys.path.insert(0, {repo!r})
+import os
+os.chdir({ws!r})
+from paddle_tpu.utils.backend_guard import ensure_cpu_mesh
+ensure_cpu_mesh(1)
+from paddle_tpu.config import parse_config
+from paddle_tpu.trainer import Trainer
+from paddle_tpu.utils.flags import _Flags
+
+cfg = parse_config("cfg.py")
+flags = _Flags(config="cfg.py", num_passes=1, log_period=0,
+               init_model_path=os.path.join("out", "pass-00000"))
+t = Trainer(cfg, flags)
+# the preemption checkpoint carries the optimizer state: the step
+# counter must resume from where the SIGTERM landed, not zero
+step = int(t.opt_state.step)
+print(f"RESUMED_STEP={{step}}", flush=True)
+assert step > 0, step
+"""
+
+
+def test_resume_from_preemption_checkpoint(tmp_path):
+    """The documented resume path: --init_model_path on the preemption
+    checkpoint restores parameters AND optimizer state."""
+    # first leg: train, preempt, save (same flow as the test above)
+    proc = subprocess.Popen(
+        [sys.executable, "-c", CHILD.format(repo=REPO, ws=str(tmp_path))],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        cwd=tmp_path,
+        env=dict(os.environ, PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu"),
+    )
+    try:
+        flag = tmp_path / "started.flag"
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline and not flag.exists():
+            assert proc.poll() is None, proc.communicate()[0][-2000:]
+            time.sleep(0.25)
+        assert flag.exists()
+        proc.send_signal(signal.SIGTERM)
+        out, _ = proc.communicate(timeout=120)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+    assert proc.returncode == 0, out[-2000:]
+    # second leg: resume and verify the optimizer step counter carried over
+    r = subprocess.run(
+        [sys.executable, "-c", RESUME_CHILD.format(repo=REPO, ws=str(tmp_path))],
+        capture_output=True, text=True, timeout=180, cwd=tmp_path,
+        env=dict(os.environ, PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu"),
+    )
+    assert r.returncode == 0, (r.stdout + r.stderr)[-2000:]
+    assert "RESUMED_STEP=" in r.stdout
