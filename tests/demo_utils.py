@@ -10,15 +10,17 @@ import shutil
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def setup_demo(tmp_path, demo, train_lines, test_lines=None):
+def setup_demo(tmp_path, demo, train_lines=None, test_lines=None):
     """Copy demo/<demo>/*.py to tmp_path and write train/test lists.
     train_lines/test_lines: iterable of list-file entries (each entry
-    seeds the demo's deterministic synthetic generator)."""
+    seeds the demo's deterministic synthetic generator); None keeps the
+    demo's own committed list file (demos that ship one)."""
     demo_dir = os.path.join(REPO, "demo", demo)
     for f in os.listdir(demo_dir):
-        if f.endswith(".py"):
+        if f.endswith((".py", ".list")):
             shutil.copy(os.path.join(demo_dir, f), tmp_path)
-    (tmp_path / "train.list").write_text("".join(f"{s}\n" for s in train_lines))
+    if train_lines is not None:
+        (tmp_path / "train.list").write_text("".join(f"{s}\n" for s in train_lines))
     if test_lines is not None:
         (tmp_path / "test.list").write_text("".join(f"{s}\n" for s in test_lines))
 
