@@ -435,3 +435,25 @@ def test_bench_gen_leg_micro():
     assert v > 0
     assert extras["beam_size"] == 2 and extras["max_length"] == 5
     assert extras["tokens"] == "best-beam generated"
+
+
+def test_resnet_ladder_order_plain_before_remat(monkeypatch):
+    """All plain-batch rungs must precede any remat rung: if 512/none
+    OOMs, the known-good 256/none wins the headline — never a 512/full
+    whose +33% recompute would swap mfu for hw_flops_util."""
+    sys.path.insert(0, REPO)
+    import bench
+
+    seen = []
+
+    def fake_try_ladder(configs, run_one):
+        seen.extend(configs)
+        return 1.0, {}
+
+    monkeypatch.setattr(bench, "_try_ladder", fake_try_ladder)
+    monkeypatch.setattr(bench, "_jit_train_step",
+                        lambda *a, **k: (_ for _ in ()).throw(AssertionError))
+    bench.bench_resnet50()
+    kinds = [r for _, r in seen]
+    assert kinds == ["none"] * 4 + ["full"] * 4, seen
+    assert [b for b, _ in seen][:4] == [512, 256, 128, 64], seen
