@@ -457,3 +457,17 @@ def test_resnet_ladder_order_plain_before_remat(monkeypatch):
     kinds = [r for _, r in seen]
     assert kinds == ["none"] * 4 + ["full"] * 4, seen
     assert [b for b, _ in seen][:4] == [512, 256, 128, 64], seen
+
+
+def test_session_script_legs_are_valid_bench_args():
+    """Every `python bench.py <leg>` in tpu_session.sh must name a leg
+    main() accepts — a typo would silently burn that leg's slice of a
+    rare tunnel window on a usage error."""
+    import re
+
+    sh = open(os.path.join(REPO, "benchmarks", "tpu_session.sh")).read()
+    legs = re.findall(r"python bench\.py(?:\s+(\w+))?\s*>>", sh)
+    assert legs, "no bench invocations found in tpu_session.sh"
+    accepted = {"", "all", "resnet", "lstm", "nmt", "gen"}
+    bad = [l for l in legs if l not in accepted]
+    assert not bad, bad
