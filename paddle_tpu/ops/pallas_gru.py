@@ -62,7 +62,7 @@ def _fwd_kernel(x3_ref, m_ref, w_ref, y_ref, acts_ref, hprev_ref,
         h_scr[:] = jnp.zeros_like(h_scr)
 
     h_prev, h_new, u, r, c = _cell_fwd(x3_ref, w_ref, h_scr, act_in, act_gate)
-    m = m_ref[:, 0:1].astype(jnp.float32)               # [B, 1]
+    m = m_ref[0].astype(jnp.float32)                    # [B, 1]
 
     hprev_ref[0] = h_prev.astype(hprev_ref.dtype)       # residuals (pre-update)
     acts_ref[0] = jnp.concatenate([u, r, c], axis=1).astype(acts_ref.dtype)
@@ -79,7 +79,7 @@ def _fwd_kernel_light(x3_ref, m_ref, w_ref, y_ref, h_scr, *, act_in, act_gate):
         h_scr[:] = jnp.zeros_like(h_scr)
 
     h_prev, h_new, _u, _r, _c = _cell_fwd(x3_ref, w_ref, h_scr, act_in, act_gate)
-    m = m_ref[:, 0:1].astype(jnp.float32)
+    m = m_ref[0].astype(jnp.float32)
     y_ref[0] = (m * h_new).astype(y_ref.dtype)
     h_scr[:] = m * h_new + (1.0 - m) * h_prev
 
@@ -97,7 +97,7 @@ def _bwd_kernel(dy_ref, acts_ref, hprev_ref, m_ref, w_ref,
     acts = acts_ref[0].astype(jnp.float32)
     u, r, c = acts[:, :H], acts[:, H : 2 * H], acts[:, 2 * H :]
     h_prev = hprev_ref[0].astype(jnp.float32)
-    m = m_ref[:, 0:1].astype(jnp.float32)
+    m = m_ref[0].astype(jnp.float32)
     DH = dh_scr[:]
 
     dy = dy_ref[0].astype(jnp.float32)
@@ -135,12 +135,15 @@ def _bwd_kernel(dy_ref, acts_ref, hprev_ref, m_ref, w_ref,
     dw_ref[:] += jnp.concatenate([dwg, dwc], axis=1)     # [H, 3H]
 
 
-def _run_fwd(x3, mask_bt, w, acts, interpret, residuals=True):
+def _run_fwd(x3, mask_tb1, w, acts, interpret, residuals=True):
     T, B, H3 = x3.shape
     H = H3 // 3
     step3 = pl.BlockSpec((1, B, H3), lambda t: (t, 0, 0))
     step1 = pl.BlockSpec((1, B, H), lambda t: (t, 0, 0))
-    mask_spec = pl.BlockSpec((B, 1), lambda t: (0, t))
+    # mask rides time-major as [T, B, 1]: a (B, 1) block over [B, T] has
+    # a lane dim that is neither 128-divisible nor the full array dim,
+    # which Mosaic rejects (see pallas_lstm._run_fwd)
+    mask_spec = pl.BlockSpec((1, B, 1), lambda t: (t, 0, 0))
     wspec = pl.BlockSpec(w.shape, lambda t: (0, 0))
     kern = functools.partial(
         _fwd_kernel if residuals else _fwd_kernel_light,
@@ -163,15 +166,15 @@ def _run_fwd(x3, mask_bt, w, acts, interpret, residuals=True):
         scratch_shapes=[pltpu.VMEM((B, H), jnp.float32)] if pltpu is not None else [],
         interpret=interpret,
         compiler_params=_params(1),
-    )(x3, mask_bt, w)
+    )(x3, mask_tb1, w)
 
 
-def _run_bwd(dy, acts_seq, hprev, mask_bt, w, acts, interpret):
+def _run_bwd(dy, acts_seq, hprev, mask_tb1, w, acts, interpret):
     T, B, H3 = acts_seq.shape
     H = H3 // 3
     rev3 = pl.BlockSpec((1, B, H3), lambda i: (T - 1 - i, 0, 0))
     rev1 = pl.BlockSpec((1, B, H), lambda i: (T - 1 - i, 0, 0))
-    mask_spec = pl.BlockSpec((B, 1), lambda i: (0, T - 1 - i))
+    mask_spec = pl.BlockSpec((1, B, 1), lambda i: (T - 1 - i, 0, 0))
     wspec = pl.BlockSpec(w.shape, lambda i: (0, 0))
     kern = functools.partial(_bwd_kernel, act_in=acts[0], act_gate=acts[1])
     dx3, dw = pl.pallas_call(
@@ -186,7 +189,7 @@ def _run_bwd(dy, acts_seq, hprev, mask_bt, w, acts, interpret):
         scratch_shapes=[pltpu.VMEM((B, H), jnp.float32)] if pltpu is not None else [],
         interpret=interpret,
         compiler_params=_params(1),
-    )(dy, acts_seq, hprev, mask_bt, w)
+    )(dy, acts_seq, hprev, mask_tb1, w)
     return dx3, dw.astype(w.dtype)
 
 
@@ -200,7 +203,7 @@ def fused_gru(x3, mask, w, acts, interpret):
 
     T, B, H3 = x3.shape
     kernel_flops.record(kernel_flops.gru_fwd_flops(T, B, H3 // 3))
-    (ys,) = _run_fwd(x3, mask.T, w, acts, interpret, residuals=False)
+    (ys,) = _run_fwd(x3, mask[:, :, None], w, acts, interpret, residuals=False)
     return ys
 
 
@@ -209,7 +212,7 @@ def _fused_fwd(x3, mask, w, acts, interpret):
 
     T, B, H3 = x3.shape
     kernel_flops.record(kernel_flops.gru_fwd_flops(T, B, H3 // 3))
-    ys, acts_seq, hprev = _run_fwd(x3, mask.T, w, acts, interpret)
+    ys, acts_seq, hprev = _run_fwd(x3, mask[:, :, None], w, acts, interpret)
     return ys, (acts_seq, hprev, mask, w)
 
 
@@ -219,7 +222,7 @@ def _fused_bwd(acts, interpret, res, dy):
     acts_seq, hprev, mask, w = res
     T, B, H3 = acts_seq.shape
     kernel_flops.record(kernel_flops.gru_bwd_flops(T, B, H3 // 3))
-    dx3, dw = _run_bwd(dy, acts_seq, hprev, mask.T, w, acts, interpret)
+    dx3, dw = _run_bwd(dy, acts_seq, hprev, mask[:, :, None], w, acts, interpret)
     return dx3, jnp.zeros_like(mask), dw
 
 

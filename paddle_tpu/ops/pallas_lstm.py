@@ -145,7 +145,7 @@ def _fwd_kernel(x4_ref, m_ref, w_ref, peep_ref,
     h_prev, c_prev, h_new, c_new, a, i, f, o = _cell_fwd(
         x4_ref, w_ref, peep_ref, h_scr, c_scr, act_in, act_gate, act_state
     )
-    m = m_ref[:, 0:1].astype(jnp.float32)               # [B, 1]
+    m = m_ref[0].astype(jnp.float32)                    # [B, 1]
 
     hprev_ref[0] = h_prev.astype(hprev_ref.dtype)       # residuals (pre-update)
     cprev_ref[0] = c_prev
@@ -169,7 +169,7 @@ def _fwd_kernel_light(x4_ref, m_ref, w_ref, peep_ref, y_ref,
     h_prev, c_prev, h_new, c_new, _a, _i, _f, _o = _cell_fwd(
         x4_ref, w_ref, peep_ref, h_scr, c_scr, act_in, act_gate, act_state
     )
-    m = m_ref[:, 0:1].astype(jnp.float32)
+    m = m_ref[0].astype(jnp.float32)
     y_ref[0] = (m * h_new).astype(y_ref.dtype)
     h_scr[:] = m * h_new + (1.0 - m) * h_prev
     c_scr[:] = m * c_new + (1.0 - m) * c_prev
@@ -192,7 +192,7 @@ def _bwd_kernel(dy_ref, acts_ref, hprev_ref, cprev_ref, m_ref, w_ref, peep_ref,
     a, i, f, o = _split4(acts, H)
     c_prev = cprev_ref[0]
     h_prev = hprev_ref[0]
-    m = m_ref[:, 0:1].astype(jnp.float32)
+    m = m_ref[0].astype(jnp.float32)
     peep = peep_ref[:].astype(jnp.float32)
     pi, pf, po = peep[0:1], peep[1:2], peep[2:3]
     DH = dh_scr[:]
@@ -237,12 +237,16 @@ def _params(n):
     return pltpu.CompilerParams(dimension_semantics=("arbitrary",) * n)
 
 
-def _run_fwd(x4, mask_bt, w, peep, acts, interpret, residuals=True):
+def _run_fwd(x4, mask_tb1, w, peep, acts, interpret, residuals=True):
     T, B, H4 = x4.shape
     H = H4 // 4
     step_spec4 = pl.BlockSpec((1, B, H4), lambda t: (t, 0, 0))
     step_spec = pl.BlockSpec((1, B, H), lambda t: (t, 0, 0))
-    mask_spec = pl.BlockSpec((B, 1), lambda t: (0, t))
+    # mask rides time-major as [T, B, 1] so the block's last two dims are
+    # (B, 1) with the lane dim EQUAL to the overall array's — Mosaic
+    # rejects a (B, 1) block over a [B, T] array (lane dim 1 is neither
+    # 128-divisible nor the full T)
+    mask_spec = pl.BlockSpec((1, B, 1), lambda t: (t, 0, 0))
     const2 = lambda shape: pl.BlockSpec(shape, lambda t: (0, 0))
     kern = functools.partial(
         _fwd_kernel if residuals else _fwd_kernel_light,
@@ -269,16 +273,16 @@ def _run_fwd(x4, mask_bt, w, peep, acts, interpret, residuals=True):
         ] if pltpu is not None else [],
         interpret=interpret,
         compiler_params=_params(1),
-    )(x4, mask_bt, w, peep)
+    )(x4, mask_tb1, w, peep)
 
 
-def _run_bwd(dy, saved, mask_bt, w, peep, acts, interpret):
+def _run_bwd(dy, saved, mask_tb1, w, peep, acts, interpret):
     acts_seq, hprev, cprev = saved
     T, B, H4 = acts_seq.shape
     H = H4 // 4
     rev4 = pl.BlockSpec((1, B, H4), lambda i: (T - 1 - i, 0, 0))
     rev = pl.BlockSpec((1, B, H), lambda i: (T - 1 - i, 0, 0))
-    mask_spec = pl.BlockSpec((B, 1), lambda i: (0, T - 1 - i))
+    mask_spec = pl.BlockSpec((1, B, 1), lambda i: (T - 1 - i, 0, 0))
     const2 = lambda shape: pl.BlockSpec(shape, lambda i: (0, 0))
     kern = functools.partial(
         _bwd_kernel, act_in=acts[0], act_gate=acts[1], act_state=acts[2]
@@ -299,7 +303,7 @@ def _run_bwd(dy, saved, mask_bt, w, peep, acts, interpret):
         ] if pltpu is not None else [],
         interpret=interpret,
         compiler_params=_params(1),
-    )(dy, acts_seq, hprev, cprev, mask_bt, w, peep)
+    )(dy, acts_seq, hprev, cprev, mask_tb1, w, peep)
     return dx4, dw.astype(w.dtype), dpeep.astype(peep.dtype)
 
 
@@ -316,7 +320,7 @@ def fused_lstm(x4, mask, w, peep, acts, interpret):
 
     T, B, H4 = x4.shape
     kernel_flops.record(kernel_flops.lstm_fwd_flops(T, B, H4 // 4))
-    (ys,) = _run_fwd(x4, mask.T, w, peep, acts, interpret, residuals=False)
+    (ys,) = _run_fwd(x4, mask[:, :, None], w, peep, acts, interpret, residuals=False)
     return ys
 
 
@@ -325,7 +329,7 @@ def _fused_fwd(x4, mask, w, peep, acts, interpret):
 
     T, B, H4 = x4.shape
     kernel_flops.record(kernel_flops.lstm_fwd_flops(T, B, H4 // 4))
-    ys, acts_seq, hprev, cprev = _run_fwd(x4, mask.T, w, peep, acts, interpret)
+    ys, acts_seq, hprev, cprev = _run_fwd(x4, mask[:, :, None], w, peep, acts, interpret)
     return ys, (acts_seq, hprev, cprev, mask, w, peep)
 
 
@@ -336,7 +340,7 @@ def _fused_bwd(acts, interpret, res, dy):
     T, B, H4 = acts_seq.shape
     kernel_flops.record(kernel_flops.lstm_bwd_flops(T, B, H4 // 4))
     dx4, dw, dpeep = _run_bwd(
-        dy, (acts_seq, hprev, cprev), mask.T, w, peep, acts, interpret
+        dy, (acts_seq, hprev, cprev), mask[:, :, None], w, peep, acts, interpret
     )
     return dx4, jnp.zeros_like(mask), dw, dpeep
 
