@@ -143,6 +143,18 @@ def refresh_measured_json(session, when: str) -> int:
     for metric, (_, entry) in session_rows.items():
         rows[metric] = entry
     if session_rows:
+        # keep the provenance note in sync with the rows it describes —
+        # a hand-written session date here goes stale on the next refresh
+        doc["_comment"] = (
+            "Newest measured real-TPU rows, one per metric (per-row "
+            "when_utc/commit give each row's provenance; full raw log: "
+            "RESULTS_tpu_session_raw.txt, formatted: RESULTS.md). "
+            "bench.py embeds this under 'last_measured' whenever it "
+            "falls back to CPU smoke, so the driver's BENCH artifact "
+            "always carries the best available hardware evidence even "
+            "during a tunnel outage. Refreshed automatically by "
+            "append_results.py after each measurement session."
+        )
         with open(path, "w") as f:
             json.dump(doc, f, indent=2)
             f.write("\n")

@@ -1,0 +1,41 @@
+#!/bin/bash
+# Follow-up measurement session: the fused Pallas recurrent kernels ONLY.
+#
+# The 2026-08-01 03:10Z session was the kernels' first-ever hardware
+# compile and Mosaic rejected the mask block spec ((B, 1) over a [B, T]
+# array — lane dim neither 128-divisible nor the full array); every
+# pallas leg fell back to the scan path. This session re-runs exactly
+# those legs after the [T, B, 1] mask re-layout, plus a trace capture if
+# the kernel path wins. Run it only when the chip is known-free (the
+# main session exited).
+cd "$(dirname "$0")/.." || exit 1
+CUM=benchmarks/RESULTS_tpu_session_raw.txt
+OUT=benchmarks/RESULTS_tpu_session_partial.$$.txt
+ERR=/tmp/tpu_session_pallas_err.log
+: > $OUT
+echo "=== TPU pallas follow-up session $(date -u)" >> $OUT
+echo "--- pallas_rnn lstm (k=8 default)" >> $OUT
+PADDLE_TPU_BENCH_PALLAS_RNN=1 PADDLE_TPU_BENCH_BUDGET=600 \
+  timeout 700 python bench.py lstm >> $OUT 2>$ERR
+echo "--- pallas_rnn lstm (k=1 control)" >> $OUT
+PADDLE_TPU_BENCH_PALLAS_RNN=1 PADDLE_TPU_BENCH_STEPS_PER_LAUNCH=1 \
+  PADDLE_TPU_BENCH_BUDGET=600 timeout 700 python bench.py lstm >> $OUT 2>>$ERR
+echo "--- pallas_rnn nmt" >> $OUT
+PADDLE_TPU_BENCH_PALLAS_RNN=1 PADDLE_TPU_BENCH_BUDGET=900 \
+  timeout 1000 python bench.py nmt >> $OUT 2>>$ERR
+echo "--- pallas_rnn + steps_per_launch=8 nmt (combined)" >> $OUT
+PADDLE_TPU_BENCH_PALLAS_RNN=1 PADDLE_TPU_BENCH_STEPS_PER_LAUNCH=8 \
+  PADDLE_TPU_BENCH_BUDGET=900 timeout 1000 python bench.py nmt >> $OUT 2>>$ERR
+echo "--- traced pallas lstm" >> $OUT
+mkdir -p benchmarks/traces_pallas_lstm
+PADDLE_TPU_BENCH_PALLAS_RNN=1 PADDLE_TPU_BENCH_TRACE_LEG=lstm \
+  PADDLE_TPU_BENCH_TRACE_DIR=$PWD/benchmarks/traces_pallas_lstm \
+  PADDLE_TPU_BENCH_BUDGET=600 timeout 700 python bench.py lstm >> $OUT 2>>$ERR
+echo "--- trace summary (pallas lstm)" >> $OUT
+python benchmarks/trace_summary.py benchmarks/traces_pallas_lstm 15 >> $OUT 2>>$ERR
+echo "=== session done $(date -u)" >> $OUT
+python benchmarks/append_results.py $OUT >> $ERR 2>&1 || true
+grep -q '"backend": "[^c]' $OUT
+ok=$?
+cat $OUT >> $CUM && rm -f $OUT
+exit $ok
