@@ -70,11 +70,15 @@ def _leg_spl(default: int = 1) -> int:
     return STEPS_PER_LAUNCH if _SPL_ENV_SET else default
 
 
-def _leg_extras(spl=1, **kw):
-    """Per-leg JSON extras; tags the knobs that are active."""
+def _leg_extras(spl=1, rnn_leg=False, **kw):
+    """Per-leg JSON extras; tags the knobs that are active. The
+    pallas_rnn tag only goes on legs that HAVE recurrent layers —
+    default-on _pallas_on() would otherwise stamp conv-only legs
+    (resnet) with a knob that cannot affect them, polluting the
+    measured-row provenance in measured_tpu.json."""
     if spl > 1:
         kw["steps_per_launch"] = spl
-    if os.environ.get("PADDLE_TPU_BENCH_PALLAS_RNN") == "1":
+    if rnn_leg and _pallas_on():
         kw["pallas_rnn"] = True
     if os.environ.get("PADDLE_TPU_BENCH_S2D") == "1":
         kw["conv_s2d"] = True
@@ -93,7 +97,7 @@ def _jit_train_step(tc, spl=1):
     env_unroll = os.environ.get("PADDLE_TPU_BENCH_UNROLL")
     if env_unroll:
         tc.opt_config.scan_unroll = int(env_unroll)
-    if os.environ.get("PADDLE_TPU_BENCH_PALLAS_RNN") == "1":
+    if _pallas_on():
         tc.opt_config.pallas_rnn = True
     if os.environ.get("PADDLE_TPU_BENCH_S2D") == "1":
         tc.opt_config.conv_s2d = True
@@ -225,6 +229,20 @@ def _is_oom(e) -> bool:
     )
 
 
+def _pallas_on() -> bool:
+    """Tri-state PADDLE_TPU_BENCH_PALLAS_RNN: '1' forces the fused
+    kernels, '0' forces the scan path, unset defaults to ON for
+    accelerator runs and OFF for CPU smoke — measured default
+    (2026-08-01 03:27Z follow-up session): pallas lstm 10.57M vs 5.67M
+    tok/s at k=8 (1.86x, MFU 0.507), decision-table flip."""
+    v = os.environ.get("PADDLE_TPU_BENCH_PALLAS_RNN")
+    if v is not None:
+        return v == "1"
+    import jax
+
+    return jax.default_backend() != "cpu"
+
+
 def _pallas_fallback(leg_fn):
     """The fused Pallas kernels have never been compiled on real hardware
     (interpret-mode parity only): if a leg fails with the pallas knob on
@@ -234,7 +252,7 @@ def _pallas_fallback(leg_fn):
 
     @functools.wraps(leg_fn)
     def wrapped(*args, **kwargs):
-        if os.environ.get("PADDLE_TPU_BENCH_PALLAS_RNN") != "1":
+        if not _pallas_on():
             return leg_fn(*args, **kwargs)
         try:
             return leg_fn(*args, **kwargs)
@@ -242,6 +260,7 @@ def _pallas_fallback(leg_fn):
             err = f"{type(e).__name__}: {str(e)[:300]}"
             sys.stderr.write(f"pallas_rnn leg failed, retrying on the scan "
                              f"path: {err}\n")
+            orig = os.environ.get("PADDLE_TPU_BENCH_PALLAS_RNN")
             os.environ["PADDLE_TPU_BENCH_PALLAS_RNN"] = "0"
             try:
                 value, extras = leg_fn(*args, **kwargs)
@@ -253,7 +272,10 @@ def _pallas_fallback(leg_fn):
                     f"(scan-path rerun after pallas failure: {err})"
                 ) from e2
             finally:
-                os.environ["PADDLE_TPU_BENCH_PALLAS_RNN"] = "1"
+                if orig is None:
+                    del os.environ["PADDLE_TPU_BENCH_PALLAS_RNN"]
+                else:
+                    os.environ["PADDLE_TPU_BENCH_PALLAS_RNN"] = orig
             extras = dict(extras or {})
             extras["pallas_rnn"] = f"FELL BACK to scan path ({err})"
             return value, extras
@@ -265,14 +287,30 @@ def _try_ladder(configs, run_one):
     """Run the first ladder configuration that survives an OOM-class
     failure; any other error re-raises immediately. The successful rung's
     extras gain a "skipped_rungs" list recording each rung stepped past
-    and why, so the JSON never hides that a smaller configuration ran."""
+    and why, so the JSON never hides that a smaller configuration ran.
+
+    Rungs are (batch, remat) tuples; once a rung OOMs, later rungs with
+    the same remat mode and an equal-or-larger batch are skipped without
+    compiling — they strictly dominate the failed rung's memory, and the
+    ladder is no longer monotonically descending (256 leads on measured
+    throughput), so a guaranteed-OOM 512 could otherwise burn a full
+    compile after 256 already failed."""
     skipped = []
+    oomed = []  # (batch, ...) rungs that hit OOM
     for i, cfg in enumerate(configs):
+        # rung = (batch,) or (batch, remat, ...): dominate = same
+        # non-batch knobs with an equal-or-larger batch
+        dom = next((o for o in oomed if o[1:] == cfg[1:] and cfg[0] >= o[0]), None)
+        if dom is not None and i < len(configs) - 1:
+            skipped.append({"rung": list(cfg),
+                            "error": f"skipped: memory-dominates OOMed rung {list(dom)}"})
+            continue
         try:
             value, extras = run_one(*cfg)
         except Exception as e:
             if i == len(configs) - 1 or not _is_oom(e):
                 raise
+            oomed.append(cfg)
             skipped.append({"rung": list(cfg), "error": f"{type(e).__name__}: {str(e)[:200]}"})
             continue
         if skipped:
@@ -283,12 +321,13 @@ def _try_ladder(configs, run_one):
 
 def bench_resnet50(B=None, img_size=224, classes=1000, steps=20, warmup=3, trace=True,
                    dtype=None):
-    """Headline leg. Without an explicit B, tries a descending
-    (batch, remat) ladder — bigger batches fill the MXU better in bf16,
-    and rematerialization can rescue a batch that OOMs before giving up
-    on its size (the +33% recompute FLOPs often beats halving B) — and
-    keeps the first configuration that runs. PADDLE_TPU_BENCH_RESNET_B
-    pins a size."""
+    """Headline leg. Without an explicit B, tries a (batch, remat)
+    ladder led by the measured-fastest rung (B=256 — past it the BN-stat
+    and residual bandwidth grows faster than MXU fill; 2026-08-01 batch
+    A/B in benchmarks/RESULTS.md), stepping to other plain sizes on OOM
+    and only then to remat rungs (the +33% recompute FLOPs often beats
+    halving B), keeping the first configuration that runs.
+    PADDLE_TPU_BENCH_RESNET_B pins a size."""
     import jax.numpy as jnp
 
     from paddle_tpu.flagship import make_image_batch, resnet_config
@@ -300,13 +339,14 @@ def bench_resnet50(B=None, img_size=224, classes=1000, steps=20, warmup=3, trace
     elif B:
         ladder = [(B, "none")]
     else:
-        # 512 leads: bigger batches fill the MXU better and the ladder
-        # steps down safely on OOM (one wasted compile attempt); 256/none
-        # is the measured round-4 configuration. ALL plain rungs come
-        # before ANY remat rung — if 512/none OOMs the known-good
-        # 256/none must win, not a 512/full whose +33% recompute would
-        # silently replace the mfu headline with hw_flops_util
-        sizes = (512, 256, 128, 64)
+        # 256 leads — measured (2026-08-01 03:43Z batch A/B): 2201 imgs/s
+        # at B=256 vs 2082 at 512 and 1957 at 768; past 256 the BN-stat
+        # and residual bandwidth grows faster than MXU fill. ALL plain
+        # rungs come before ANY remat rung — if a plain rung OOMs a
+        # smaller plain rung must win, not a remat one whose +33%
+        # recompute would silently replace the mfu headline with
+        # hw_flops_util
+        sizes = (256, 512, 128, 64)
         ladder = [(b, "none") for b in sizes] + [(b, "full") for b in sizes]
 
     def run_one(b, remat):
@@ -358,7 +398,7 @@ def bench_lstm_classifier(B=256, T=64, steps=20, warmup=3, dtype=None):
         trace=TRACE_LEG == "lstm", spl=spl, count_fn=one_step,
     )
     m, _ = _mfu_of(flops, dt, steps)
-    extras = _leg_extras(spl=spl, mfu=m, dtype=tc.opt_config.dtype)
+    extras = _leg_extras(spl=spl, rnn_leg=True, mfu=m, dtype=tc.opt_config.dtype)
     return B * T * steps * spl / dt, extras
 
 
@@ -374,9 +414,13 @@ def bench_nmt(B=None, T=32, vocab=30000, dim=512, steps=10, warmup=2, dtype=None
     from paddle_tpu.flagship import nmt_batch, nmt_config
 
     def run_one(b):
+        import jax
+
         tc = nmt_config(vocab=vocab, dim=dim, dtype=dtype or BENCH_DTYPE)
         tc.opt_config.batch_size = b
-        spl = _leg_spl(1)  # k=8 unmeasured here (big-graph compile risk)
+        # measured default (2026-08-01 03:26Z session): k=8 419.9k tok/s
+        # vs k=1 373.3k = 1.125x — decision-table flip; CPU smoke stays k=1
+        spl = _leg_spl(8 if jax.default_backend() != "cpu" else 1)
         step, params, opt_state, one_step = _jit_train_step(tc, spl)
         batch = nmt_batch(vocab=vocab, B=b, T=T)
         dt, flops = _time_steps(
@@ -384,7 +428,7 @@ def bench_nmt(B=None, T=32, vocab=30000, dim=512, steps=10, warmup=2, dtype=None
             trace=TRACE_LEG == "nmt", spl=spl, count_fn=one_step,
         )
         m, _ = _mfu_of(flops, dt, steps)
-        extras = _leg_extras(spl=spl, mfu=m, dtype=tc.opt_config.dtype, tokens="target", batch=b)
+        extras = _leg_extras(spl=spl, rnn_leg=True, mfu=m, dtype=tc.opt_config.dtype, tokens="target", batch=b)
         return b * T * steps * spl / dt, extras
 
     ladder = [(B,)] if B else [(256,), (128,), (64,)]

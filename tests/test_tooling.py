@@ -456,7 +456,9 @@ def test_resnet_ladder_order_plain_before_remat(monkeypatch):
     bench.bench_resnet50()
     kinds = [r for _, r in seen]
     assert kinds == ["none"] * 4 + ["full"] * 4, seen
-    assert [b for b, _ in seen][:4] == [512, 256, 128, 64], seen
+    # 256 leads: measured 2026-08-01 batch A/B (2201 imgs/s at 256 vs
+    # 2082 at 512, 1957 at 768)
+    assert [b for b, _ in seen][:4] == [256, 512, 128, 64], seen
 
 
 def test_session_script_legs_are_valid_bench_args():
