@@ -201,6 +201,7 @@ def _apply_settings(opt: OptimizationConfig, s: Dict[str, Any]) -> None:
         "adam_epsilon",
         "num_batches_per_send_parameter",
         "num_batches_per_get_parameter",
+        "async_lagged_grad_discard_ratio",
         "gradient_clipping_threshold",
         "dtype",
         "mesh_shape",

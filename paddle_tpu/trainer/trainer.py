@@ -131,6 +131,35 @@ class Trainer:
         # gradient accumulation: N forward/backwards per optimizer update
         # (reference num_batches_per_send_parameter, TrainerInternal.cpp)
         self._accum_n = max(1, int(config.opt_config.num_batches_per_send_parameter))
+        # async SGD analog (settings(is_async=True) → algorithm='async_sgd'):
+        # per-replica local updates with periodic drift-gated parameter
+        # averaging (paddle_tpu/parallel/local_sgd.py). In this mode
+        # num_batches_per_send_parameter is the MERGE PERIOD (its
+        # reference meaning: batches between parameter sends), not a
+        # gradient-accumulation count — reinterpreted HERE, before the
+        # fuse/accumulation conflict check below, so an async config with
+        # a merge period is never rejected as "accumulation".
+        self._async = config.opt_config.algorithm == "async_sgd"
+        self._local_sgd = None
+        self._lsgd_state = None      # (params_r, opt_r) replica stacks
+        self._lsgd_dirty = False     # stacks hold updates self.params lacks
+        self._lsgd_batches = 0       # local batches since the last merge
+        self._lsgd_discarded = 0     # replicas drift-discarded this pass
+        self._sync_n = 1
+        if self._async:
+            self._sync_n = self._accum_n
+            self._accum_n = 1
+            if self._mesh is None or self._batch_divisor <= 1:
+                logger.warning(
+                    "async_sgd with a single data-parallel replica is "
+                    "exactly sync SGD — running the ordinary sync step "
+                    "(add --mesh_shape=data=N for local-SGD replicas)"
+                )
+                self._async = False
+            else:
+                from paddle_tpu.parallel.local_sgd import check_data_only
+
+                check_data_only(self._mesh)
         # fused launches: k consecutive same-shape batches per device
         # dispatch (lax.scan over stacked batches); each batch keeps its
         # own optimizer update, so numerics match the unfused loop
@@ -141,7 +170,7 @@ class Trainer:
                 "num_batches_per_send_parameter > 1 — fuse launches of "
                 "accumulation micro-batches are not supported; pick one"
             )
-        if self._fuse_k > 1 and self._mesh is not None:
+        if self._fuse_k > 1 and (self._mesh is not None or self._async):
             logger.warning(
                 "batches_per_launch > 1 is a single-chip dispatch-latency "
                 "optimization; ignored under a mesh"
@@ -712,6 +741,7 @@ class Trainer:
         self._pass_flops = 0.0
         self._pass_train_s = 0.0
         self._pass_flops_incomplete = False
+        self._lsgd_discarded = 0
         t0 = time.time()
         batch_id = 0
         step_times: list = []
@@ -801,7 +831,7 @@ class Trainer:
             else:
                 rng, step_rng = jax.random.split(rng)
                 n, _host_batch, batch = group
-                if self._accum_n <= 1:
+                if self._accum_n <= 1 and not self._async:
                     self._pass_flops += self._count_model_flops(
                         ("single", self._shape_sig(batch)),
                         self.train_step, self.params, self.opt_state, batch,
@@ -811,6 +841,8 @@ class Trainer:
                 with stat_timer("train_step"):
                     if self._accum_n > 1:
                         loss, outputs = self._accum_step(batch, step_rng, n)
+                    elif self._async:
+                        loss, outputs = self._async_step(batch, step_rng, n)
                     else:
                         self.params, self.opt_state, loss, outputs = self.train_step(
                             self.params, self.opt_state, batch, step_rng,
@@ -908,6 +940,14 @@ class Trainer:
             # end-of-pass remainder: apply whatever is accumulated so no
             # sample's gradient is dropped (reference flushes on finishPass)
             self._accum_flush()
+        self._async_flush(final=True)  # pass end: real merge + collapse
+        if self._lsgd_discarded:
+            logger.info(
+                "Pass %d: drift gate discarded %d replica update block(s) "
+                "(async_lagged_grad_discard_ratio=%g)",
+                pass_id, self._lsgd_discarded,
+                self.config.opt_config.async_lagged_grad_discard_ratio,
+            )
         if profiling:
             jax.block_until_ready(self.params)
             jax.profiler.stop_trace()
@@ -954,6 +994,67 @@ class Trainer:
         )
         self._acc_batches = 0
         self._acc_samples = 0
+
+    # ----------------------------------------------- async SGD (local SGD)
+
+    def _async_step(self, batch, step_rng, n: int):
+        """One local-SGD batch: every replica applies its own gradient to
+        its own parameter copy (no cross-replica collective); merges every
+        ``num_batches_per_send_parameter``-th call."""
+        if self._local_sgd is None:
+            from paddle_tpu.parallel.local_sgd import LocalSgd
+
+            # the SAME one-batch body the sync path jits (dense grads:
+            # sparse row sets vary per batch and cannot ride the stack)
+            self._local_sgd = LocalSgd(
+                self._one_batch_step(sparse=False),
+                self._mesh,
+                self.config.opt_config.async_lagged_grad_discard_ratio,
+            )
+        if self._lsgd_state is None:
+            self._lsgd_state = self._local_sgd.stack(self.params, self.opt_state)
+        pr, po = self._lsgd_state
+        pr, po, loss, outputs = self._local_sgd.step(
+            pr, po, batch, step_rng, jnp.asarray(float(n))
+        )
+        self._lsgd_state = (pr, po)
+        self._lsgd_dirty = True
+        self._lsgd_batches += 1
+        if self._lsgd_batches >= self._sync_n:
+            self._lsgd_merge()
+        return loss, outputs
+
+    def _lsgd_merge(self) -> None:
+        pr, po = self._lsgd_state
+        pr, po, discarded = self._local_sgd.merge(pr, po)
+        self._lsgd_state = (pr, po)
+        self._lsgd_batches = 0
+        self._lsgd_discarded += int(discarded)
+
+    def _async_flush(self, final: bool = False) -> None:
+        """Materialize canonical params/opt_state from the replica stacks
+        — called before any consumer of self.params (test/save/stats).
+
+        Mid-pass (``final=False``) this reads a PASSIVE merged snapshot
+        (`LocalSgd.merged_view`): the replica stacks and the merge
+        schedule are untouched, so observability flags (test_period,
+        show_parameter_stats_period, periodic saves) never perturb the
+        optimization trajectory — the reference's test path likewise
+        read the pserver's merged parameters without collapsing the
+        trainers' local progress. At pass end (``final=True``) a real
+        merge runs and the stacks collapse, so the pass boundary is a
+        true synchronization point (reference waitPassFinish)."""
+        if not self._async or not self._lsgd_dirty:
+            return
+        if not final:
+            self.params, self.opt_state = self._local_sgd.merged_view(
+                *self._lsgd_state
+            )
+            return  # stacks still ahead of params: stays dirty
+        if self._lsgd_batches:
+            self._lsgd_merge()
+        self.params, self.opt_state = self._local_sgd.collapse(*self._lsgd_state)
+        self._lsgd_dirty = False
 
     @property
     def _is_writer(self) -> bool:
@@ -1088,6 +1189,7 @@ class Trainer:
     def show_parameter_stats(self) -> None:
         """Per-parameter value stats (ref: TrainerInternal::showParameterStats,
         TrainerInternal.cpp:184-213)."""
+        self._async_flush()
         for name in sorted(self.params):
             v = np.asarray(self.params[name])
             logger.info(
@@ -1102,6 +1204,7 @@ class Trainer:
         provider = self._provider(for_test=True)
         if provider is None:
             return {}
+        self._async_flush()
         params = self.updater.averaged_params(self.params, self.opt_state)
         if not self.gm.has_cost():
             return self.predict(provider, params)
@@ -1300,6 +1403,7 @@ class Trainer:
         # owns (ckpt.save_checkpoint handles the barrier + index merge) —
         # a cross-host model-sharded parameter is never materialized on
         # one process
+        self._async_flush()
         extra = {"config_json": self.config.to_json()}
         if batch_id is not None:
             extra["batch_id"] = batch_id

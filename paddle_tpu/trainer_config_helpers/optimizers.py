@@ -199,6 +199,7 @@ def settings(
     learning_method: Optional[Optimizer] = None,
     regularization: Optional[BaseRegularization] = None,
     is_async: bool = False,
+    async_lagged_grad_discard_ratio: Optional[float] = None,
     model_average: Optional[ModelAverage] = None,
     gradient_clipping_threshold: Optional[float] = None,
     learning_rate_decay_a: float = 0.0,
@@ -223,6 +224,10 @@ def settings(
         learning_method = MomentumOptimizer()
     assert isinstance(learning_method, Optimizer)
     s["algorithm"] = "async_sgd" if is_async else "sgd"
+    if async_lagged_grad_discard_ratio is not None:
+        # async mode's staleness gate (here: replica drift gate at the
+        # merge — paddle_tpu/parallel/local_sgd.py)
+        s["async_lagged_grad_discard_ratio"] = async_lagged_grad_discard_ratio
     learning_method.to_settings(s, defaults)
     if regularization is not None:
         regs = regularization if isinstance(regularization, (list, tuple)) else [regularization]
