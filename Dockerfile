@@ -1,0 +1,24 @@
+# Container image (role of the reference's paddle/scripts/docker/Dockerfile:
+# a reproducible train/serve environment with the CLI on PATH).
+#
+#   docker build -t paddle-tpu .
+#   docker run --rm paddle-tpu paddle version
+#
+# On a TPU VM, install the TPU-enabled jax wheel instead of the CPU one:
+#   docker build --build-arg JAX_EXTRA=tpu -t paddle-tpu .
+FROM python:3.11-slim
+
+# g++ lets the wheel prebuild the native datapath library; the runtime
+# degrades gracefully without it, so slim deployments may drop this.
+RUN apt-get update && apt-get install -y --no-install-recommends g++ \
+    && rm -rf /var/lib/apt/lists/*
+
+ARG JAX_EXTRA=""
+WORKDIR /src
+COPY . .
+RUN pip install --no-cache-dir ${JAX_EXTRA:+"jax[${JAX_EXTRA}]"} . \
+    && rm -rf /src
+
+WORKDIR /workspace
+ENTRYPOINT ["paddle"]
+CMD ["version"]

@@ -83,6 +83,18 @@ def get_lib() -> Optional[ctypes.CDLL]:
         _tried = True
         if os.environ.get("PADDLE_TPU_NO_NATIVE"):
             return None
+        # a wheel-bundled prebuild (setup.py BuildPyWithDatapath) skips
+        # the toolchain requirement entirely — accept it if its ABI
+        # matches, else fall through to the hash-keyed cache build
+        bundled = os.path.join(os.path.dirname(_SRC), "_datapath.so")
+        if os.path.exists(bundled):
+            try:
+                lib = ctypes.CDLL(bundled)
+                if lib.pt_datapath_abi_version() == _ABI_VERSION:
+                    _lib = _declare(lib)
+                    return _lib
+            except Exception:  # noqa: BLE001 — stale/foreign-arch bundle
+                pass
         try:
             with open(_SRC, "rb") as f:
                 src_bytes = f.read()

@@ -1,0 +1,80 @@
+"""Package build (role of the reference's cmake root + paddle/scripts/
+docker + deb packaging, re-designed as one Python wheel).
+
+Static metadata lives in pyproject.toml. This file contributes what the
+declarative config cannot express:
+
+- the compat-shim package-dir mapping: ``compat/paddle`` and
+  ``compat/py_paddle`` install under their reference import names, so
+  `from paddle.trainer_config_helpers import *` and
+  `import py_paddle.swig_paddle` work unmodified after `pip install`;
+- a best-effort prebuild of the native datapath library
+  (paddle_tpu/native/datapath.cc → _datapath.so) into the wheel. The
+  runtime loader (paddle_tpu/native/__init__.py) prefers the bundled
+  library, falls back to build-on-first-import, then to the NumPy
+  paths — a missing toolchain at either build or run time never breaks
+  the install.
+"""
+
+import os
+import shutil
+import subprocess
+
+from setuptools import find_packages, setup
+from setuptools.command.build_py import build_py
+from setuptools.dist import Distribution
+
+
+def _have_cxx() -> bool:
+    return shutil.which(os.environ.get("CXX", "g++")) is not None
+
+
+class BuildPyWithDatapath(build_py):
+    def run(self):
+        super().run()
+        if not _have_cxx():
+            self.announce("no C++ compiler; datapath prebuild skipped — "
+                          "the runtime builds or falls back on first import",
+                          level=3)
+            return
+        src = os.path.join("paddle_tpu", "native", "datapath.cc")
+        out = os.path.join(self.build_lib, "paddle_tpu", "native", "_datapath.so")
+        try:
+            subprocess.run(
+                [os.environ.get("CXX", "g++"), "-O3", "-shared", "-fPIC",
+                 "-std=c++17", "-o", out, src],
+                check=True, capture_output=True, timeout=300,
+            )
+        except Exception as e:  # noqa: BLE001 — optional artifact
+            self.announce(f"datapath prebuild skipped ({e}); the runtime "
+                          "will build or fall back on first import", level=3)
+
+
+class DatapathDistribution(Distribution):
+    """A wheel that carries the arch-specific _datapath.so must not be
+    tagged py3-none-any — pip would install an x86-64 binary on arm64,
+    where CDLL fails and the prebuild benefit is silently lost. When a
+    compiler is present (so the prebuild will run) the wheel is declared
+    platform-specific; without one it stays pure and the runtime's
+    build-on-first-import / NumPy fallback chain applies."""
+
+    def has_ext_modules(self):
+        return _have_cxx()
+
+
+setup(
+    packages=find_packages(include=["paddle_tpu*"]) + [
+        "paddle",
+        "paddle.trainer",
+        "paddle.trainer_config_helpers",
+        "paddle.utils",
+        "py_paddle",
+    ],
+    package_dir={
+        "": ".",
+        "paddle": "compat/paddle",
+        "py_paddle": "compat/py_paddle",
+    },
+    cmdclass={"build_py": BuildPyWithDatapath},
+    distclass=DatapathDistribution,
+)
