@@ -1,0 +1,89 @@
+"""Shared harness for the 2-process loopback-cluster tests (the
+reference's loopback-pserver testing pattern, test_TrainerOnePass.cpp:
+120-296): one worker preamble + one process-pair runner, so the
+env/backend setup, the free-port helper, and the kill-on-timeout
+subprocess loop live in exactly one place.
+
+Usage (see test_multiprocess*.py):
+
+    WORKER = mp_harness.WORKER_PREAMBLE + '''
+    ... body using pid, ws, jax ...
+    print("WORKER_OK", pid, flush=True)
+    '''
+    outs = mp_harness.run_two_workers(
+        WORKER.format(repo=REPO, providers=PROVIDERS), ws)
+
+The preamble leaves ``pid`` (process index), ``ws`` (workspace dir,
+argv[3]) and an initialized 2-process jax runtime (8 devices, 4 local)
+in scope; bodies must end with the WORKER_OK print.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+WORKER_PREAMBLE = """
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "").replace("--xla_force_host_platform_device_count=8", "")
+    + " --xla_force_host_platform_device_count=4"
+).strip()
+sys.path.insert(0, {repo!r})
+sys.path.insert(0, {providers!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax._src.xla_bridge as _xb
+for _n in list(_xb._backend_factories):
+    if _n not in ("cpu", "tpu"):
+        del _xb._backend_factories[_n]
+
+pid = int(sys.argv[1])
+jax.distributed.initialize(coordinator_address="localhost:" + sys.argv[2],
+                           num_processes=2, process_id=pid)
+assert len(jax.devices()) == 8, jax.devices()
+assert len(jax.local_devices()) == 4
+ws = sys.argv[3]
+"""
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def run_two_workers(worker_src: str, ws: str, timeout: int = 300):
+    """Write ``worker_src`` to ws/worker.py, run it as processes 0 and 1
+    joined over a fresh localhost coordinator port, and assert both exit
+    0 after printing WORKER_OK. Returns [(rc, stdout, stderr), ...] for
+    test-specific assertions on the logs."""
+    port = free_port()
+    worker_py = os.path.join(ws, "worker.py")
+    with open(worker_py, "w") as f:
+        f.write(worker_src)
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker_py, str(i), str(port), ws],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append((p.returncode, out, err))
+    for rc, out, err in outs:
+        assert rc == 0, err[-3000:]
+        assert "WORKER_OK" in out, (out, err[-2000:])
+    return outs

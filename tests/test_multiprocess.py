@@ -11,10 +11,10 @@ step-time skew summary appears in the pass log.
 """
 
 import os
-import socket
-import subprocess
 import sys
 import textwrap
+
+import mp_harness
 
 import numpy as np
 import pytest
@@ -22,27 +22,7 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PROVIDERS = os.path.join(REPO, "tests", "providers")
 
-WORKER = """
-import os, sys
-os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "").replace("--xla_force_host_platform_device_count=8", "")
-    + " --xla_force_host_platform_device_count=4"
-).strip()
-sys.path.insert(0, {repo!r})
-sys.path.insert(0, {providers!r})
-import jax
-jax.config.update("jax_platforms", "cpu")
-import jax._src.xla_bridge as _xb
-for _n in list(_xb._backend_factories):
-    if _n not in ("cpu", "tpu"):
-        del _xb._backend_factories[_n]
-
-pid = int(sys.argv[1])
-jax.distributed.initialize(coordinator_address="localhost:" + sys.argv[2],
-                           num_processes=2, process_id=pid)
-assert len(jax.devices()) == 8, jax.devices()
-assert len(jax.local_devices()) == 4
+WORKER = mp_harness.WORKER_PREAMBLE + """
 
 from paddle_tpu.config import parse_config
 from paddle_tpu.trainer import Trainer
@@ -52,7 +32,6 @@ FLAGS.save_dir = ""
 FLAGS.mesh_shape = "data=8"
 FLAGS.log_period = 0
 FLAGS.seed = 7
-ws = sys.argv[3]
 trainer = Trainer(parse_config(os.path.join(ws, "cfg.py")))
 trainer.train(num_passes=1)
 
@@ -116,14 +95,6 @@ def _write_config(ws):
     return path
 
 
-def _free_port():
-    s = socket.socket()
-    s.bind(("localhost", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
-
-
 def test_two_process_training_matches_single(tmp_path):
     ws = str(tmp_path)
     cfg_path = _write_config(ws)
@@ -146,31 +117,8 @@ def test_two_process_training_matches_single(tmp_path):
         FLAGS.mesh_shape = ""
         sys.path.remove(PROVIDERS)
 
-    port = _free_port()
-    worker_py = os.path.join(ws, "worker.py")
-    with open(worker_py, "w") as f:
-        f.write(WORKER.format(repo=REPO, providers=PROVIDERS))
-    env = dict(os.environ)
-    env.pop("JAX_PLATFORMS", None)
-    procs = [
-        subprocess.Popen(
-            [sys.executable, worker_py, str(i), str(port), ws],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-        )
-        for i in range(2)
-    ]
-    outs = []
-    for p in procs:
-        try:
-            out, err = p.communicate(timeout=300)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            raise
-        outs.append((p.returncode, out, err))
-    for rc, out, err in outs:
-        assert rc == 0, err[-3000:]
-        assert "WORKER_OK" in out, (out, err[-2000:])
+    outs = mp_harness.run_two_workers(
+        WORKER.format(repo=REPO, providers=PROVIDERS), ws)
     # BarrierStat skew line logged at pass end on every host
     assert any("BarrierStat" in err for _, _, err in outs), outs[0][2][-2000:]
 

@@ -5,10 +5,10 @@ writer process), and the result file must equal the single-process run's.
 """
 
 import os
-import socket
-import subprocess
 import sys
 import textwrap
+
+import mp_harness
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -41,28 +41,8 @@ def gen_process(settings, file_name):
         yield {"src": [rng.randint(2, 10) for _ in range(n)]}
 """
 
-WORKER = """
-import os, sys
-os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "").replace("--xla_force_host_platform_device_count=8", "")
-    + " --xla_force_host_platform_device_count=4"
-).strip()
-sys.path.insert(0, {repo!r})
-ws = sys.argv[3]
-sys.path.insert(0, ws)
-import jax
-jax.config.update("jax_platforms", "cpu")
-import jax._src.xla_bridge as _xb
-for _n in list(_xb._backend_factories):
-    if _n not in ("cpu", "tpu"):
-        del _xb._backend_factories[_n]
-
-pid = int(sys.argv[1])
-jax.distributed.initialize(coordinator_address="localhost:" + sys.argv[2],
-                           num_processes=2, process_id=pid)
-assert len(jax.devices()) == 8
-
+# providers dir = the workspace itself (the gen provider is written there)
+WORKER = mp_harness.WORKER_PREAMBLE + """
 from paddle_tpu.config import parse_config
 from paddle_tpu.trainer import Trainer
 from paddle_tpu.utils.flags import FLAGS
@@ -76,14 +56,6 @@ FLAGS.gen_result = os.path.join(ws, "mp.txt")
 Trainer(parse_config(os.path.join(ws, "cfg.py"))).generate()
 print("WORKER_OK", pid, flush=True)
 """
-
-
-def _free_port():
-    s = socket.socket()
-    s.bind(("localhost", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
 
 
 def test_two_process_generation_matches_single(tmp_path):
@@ -112,28 +84,7 @@ def test_two_process_generation_matches_single(tmp_path):
         os.chdir(cwd)
         sys.path.remove(ws)
 
-    port = _free_port()
-    worker_py = os.path.join(ws, "worker.py")
-    with open(worker_py, "w") as f:
-        f.write(WORKER.format(repo=REPO))
-    env = dict(os.environ)
-    env.pop("JAX_PLATFORMS", None)
-    procs = [
-        subprocess.Popen(
-            [sys.executable, worker_py, str(i), str(port), ws],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-        )
-        for i in range(2)
-    ]
-    for p in procs:
-        try:
-            out, err = p.communicate(timeout=300)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            raise
-        assert p.returncode == 0, err[-3000:]
-        assert "WORKER_OK" in out, (out, err[-2000:])
+    mp_harness.run_two_workers(WORKER.format(repo=REPO, providers=ws), ws)
 
     plain = open(os.path.join(ws, "plain.txt")).read()
     mp = open(os.path.join(ws, "mp.txt")).read()
