@@ -2,22 +2,27 @@
 # a reproducible train/serve environment with the CLI on PATH).
 #
 #   docker build -t paddle-tpu .
-#   docker run --rm paddle-tpu paddle version
+#   docker run --rm paddle-tpu version
 #
 # On a TPU VM, install the TPU-enabled jax wheel instead of the CPU one:
 #   docker build --build-arg JAX_EXTRA=tpu -t paddle-tpu .
-FROM python:3.11-slim
-
-# g++ lets the wheel prebuild the native datapath library; the runtime
-# degrades gracefully without it, so slim deployments may drop this.
+#
+# Multi-stage: the wheel is built (with the native datapath prebuild) in
+# a throwaway stage, so the runtime image's layers never carry the
+# source tree — a COPY'd-then-rm'd tree would still ship in the copy
+# layer. .dockerignore keeps .git and trace dirs out of the context.
+FROM python:3.11-slim AS build
 RUN apt-get update && apt-get install -y --no-install-recommends g++ \
     && rm -rf /var/lib/apt/lists/*
-
-ARG JAX_EXTRA=""
 WORKDIR /src
 COPY . .
-RUN pip install --no-cache-dir ${JAX_EXTRA:+"jax[${JAX_EXTRA}]"} . \
-    && rm -rf /src
+RUN pip install --no-cache-dir build wheel setuptools \
+    && python -m build --wheel --no-isolation -o /dist
+
+FROM python:3.11-slim
+ARG JAX_EXTRA=""
+RUN --mount=type=bind,from=build,source=/dist,target=/dist \
+    pip install --no-cache-dir ${JAX_EXTRA:+"jax[${JAX_EXTRA}]"} /dist/*.whl
 
 WORKDIR /workspace
 ENTRYPOINT ["paddle"]

@@ -35,8 +35,11 @@ def _cache_dir() -> str:
     return d
 
 
-def _build(src: str, out: str) -> None:
-    cmd = [
+def build_command(src: str, out: str) -> list:
+    """The one datapath compile line — shared with setup.py's wheel
+    prebuild so a bundled library can never be compiled with different
+    flags than a first-import cache build."""
+    return [
         os.environ.get("CXX", "g++"),
         "-O3",
         "-shared",
@@ -46,7 +49,11 @@ def _build(src: str, out: str) -> None:
         out,
         src,
     ]
-    subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+
+
+def _build(src: str, out: str) -> None:
+    subprocess.run(build_command(src, out), check=True, capture_output=True,
+                   timeout=120)
 
 
 def _declare(lib: ctypes.CDLL) -> ctypes.CDLL:
@@ -84,15 +91,23 @@ def get_lib() -> Optional[ctypes.CDLL]:
         if os.environ.get("PADDLE_TPU_NO_NATIVE"):
             return None
         # a wheel-bundled prebuild (setup.py BuildPyWithDatapath) skips
-        # the toolchain requirement entirely — accept it if its ABI
-        # matches, else fall through to the hash-keyed cache build
+        # the toolchain requirement entirely — accepted only when BOTH
+        # the ABI version and the build-time source-hash stamp match the
+        # present datapath.cc, so a stale-but-ABI-compatible binary can
+        # never silently shadow an edited source (the same guarantee the
+        # hash-keyed cache path gives)
         bundled = os.path.join(os.path.dirname(_SRC), "_datapath.so")
         if os.path.exists(bundled):
             try:
-                lib = ctypes.CDLL(bundled)
-                if lib.pt_datapath_abi_version() == _ABI_VERSION:
-                    _lib = _declare(lib)
-                    return _lib
+                with open(_SRC, "rb") as f:
+                    src_digest = hashlib.sha256(f.read()).hexdigest()
+                with open(bundled.replace(".so", ".hash")) as f:
+                    stamp = f.read().strip()
+                if stamp == src_digest:
+                    lib = ctypes.CDLL(bundled)
+                    if lib.pt_datapath_abi_version() == _ABI_VERSION:
+                        _lib = _declare(lib)
+                        return _lib
             except Exception:  # noqa: BLE001 — stale/foreign-arch bundle
                 pass
         try:

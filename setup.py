@@ -16,13 +16,21 @@ declarative config cannot express:
   the install.
 """
 
+import hashlib
 import os
 import shutil
 import subprocess
+import sys
 
 from setuptools import find_packages, setup
 from setuptools.command.build_py import build_py
 from setuptools.dist import Distribution
+
+# single source of truth for the datapath compile line (the loader's
+# build-on-first-import path uses the same helper, so the wheel-bundled
+# library can never be compiled with different flags than a cache build)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from paddle_tpu.native import build_command  # noqa: E402
 
 
 def _have_cxx() -> bool:
@@ -40,11 +48,16 @@ class BuildPyWithDatapath(build_py):
         src = os.path.join("paddle_tpu", "native", "datapath.cc")
         out = os.path.join(self.build_lib, "paddle_tpu", "native", "_datapath.so")
         try:
-            subprocess.run(
-                [os.environ.get("CXX", "g++"), "-O3", "-shared", "-fPIC",
-                 "-std=c++17", "-o", out, src],
-                check=True, capture_output=True, timeout=300,
-            )
+            subprocess.run(build_command(src, out), check=True,
+                           capture_output=True, timeout=300)
+            # stamp the source hash so the runtime loader rejects a
+            # bundle that no longer matches datapath.cc (an ABI check
+            # alone would let a stale-but-compatible binary shadow an
+            # edited source)
+            with open(src, "rb") as f:
+                digest = hashlib.sha256(f.read()).hexdigest()
+            with open(out.replace(".so", ".hash"), "w") as f:
+                f.write(digest + "\n")
         except Exception as e:  # noqa: BLE001 — optional artifact
             self.announce(f"datapath prebuild skipped ({e}); the runtime "
                           "will build or fall back on first import", level=3)
@@ -56,10 +69,33 @@ class DatapathDistribution(Distribution):
     where CDLL fails and the prebuild benefit is silently lost. When a
     compiler is present (so the prebuild will run) the wheel is declared
     platform-specific; without one it stays pure and the runtime's
-    build-on-first-import / NumPy fallback chain applies."""
+    build-on-first-import / NumPy fallback chain applies. (If the
+    compile itself fails the wheel is tagged platform-specific without
+    the .so — over-restrictive but harmless; the runtime chain still
+    applies.)"""
 
     def has_ext_modules(self):
         return _have_cxx()
+
+
+try:
+    from wheel.bdist_wheel import bdist_wheel as _bdist_wheel
+
+    class BdistWheelCtypes(_bdist_wheel):
+        """The bundled library is ctypes-loaded — no CPython ABI — so the
+        wheel must stay py3-none-<plat>, not cp3X-cp3X-<plat>: an
+        interpreter-specific tag would lock out other supported Python
+        versions (requires-python >= 3.10) for no reason."""
+
+        def get_tag(self):
+            python, abi, plat = super().get_tag()
+            if self.root_is_pure:
+                return python, abi, plat
+            return "py3", "none", plat
+
+    _wheel_cmdclass = {"bdist_wheel": BdistWheelCtypes}
+except ImportError:  # pragma: no cover - wheel not installed
+    _wheel_cmdclass = {}
 
 
 setup(
@@ -75,6 +111,6 @@ setup(
         "paddle": "compat/paddle",
         "py_paddle": "compat/py_paddle",
     },
-    cmdclass={"build_py": BuildPyWithDatapath},
+    cmdclass={"build_py": BuildPyWithDatapath, **_wheel_cmdclass},
     distclass=DatapathDistribution,
 )

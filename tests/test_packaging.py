@@ -46,14 +46,11 @@ def test_compat_shim_mapping_matches_tree():
     a new shim subpackage that isn't listed would silently drop out of
     the wheel."""
     src = open(os.path.join(ROOT, "setup.py")).read()
-    tree = ast.parse(src)
     listed = {
-        s.value
-        for node in ast.walk(tree)
-        for s in ast.walk(node)
-        if isinstance(s, ast.Constant) and isinstance(s.value, str)
-        and (s.value == "py_paddle" or s.value.startswith("paddle."))
-        or (isinstance(s, ast.Constant) and s.value == "paddle")
+        n.value
+        for n in ast.walk(ast.parse(src))
+        if isinstance(n, ast.Constant) and isinstance(n.value, str)
+        and (n.value in ("paddle", "py_paddle") or n.value.startswith("paddle."))
     }
     on_disk = set()
     for base, import_name in (("compat/paddle", "paddle"),
