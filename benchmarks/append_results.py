@@ -50,9 +50,13 @@ def parse_session(raw_path: str):
 
 
 def _cell(text) -> str:
-    """Sanitize arbitrary text (XLA errors carry newlines and pipes) for
-    a markdown table cell."""
-    return str(text).replace("\n", " ").replace("|", "\\|")
+    """Sanitize arbitrary text (XLA errors carry newlines, pipes, and —
+    via the axon compile helper — raw ANSI escape sequences) for a
+    markdown table cell."""
+    import re
+
+    s = re.sub(r"\x1b\[[0-9;]*m", "", str(text))
+    return s.replace("\x1b", "").replace("\n", " ").replace("|", "\\|")
 
 
 def fmt_row(when: str, context: str, rec: dict) -> list:
