@@ -38,7 +38,8 @@ import jax
 import jax.numpy as jnp
 
 from paddle_tpu.graph.argument import Argument
-from paddle_tpu.layers.base import LayerContext, forward_layer, register_layer
+from paddle_tpu.layers.base import (LayerContext, TimeMajorLogits, forward_layer,
+                                   register_layer)
 from paddle_tpu.ops.activations import apply_activation
 from paddle_tpu.proto import LayerConfig, SubModelConfig
 
@@ -593,10 +594,12 @@ def _run_epilogue(network, ctx, epilogue, dyn_frontier, frs, statics,
             # re-publish the hoisted layer's pre-softmax logits under the
             # out-link name so the fused cross-entropy path survives the
             # hoist (the probabilities' transpose is then DCE-able when
-            # only the loss consumes this link)
-            ctx.logits[link.link_name] = jnp.swapaxes(
-                z.reshape((T, B) + z.shape[1:]), 0, 1
-            )
+            # only the loss consumes this link). Published FLAT in the
+            # projection's [T*B, V] layout: transposing the V-sized
+            # tensor to [B, T, V] here forced a full relayout copy on
+            # TPU (layers/base.py TimeMajorLogits) — the CE consumer
+            # transposes only the [T, B] per-step costs instead.
+            ctx.logits[link.link_name] = TimeMajorLogits(z, T, B)
 
 
 # ------------------------------------------------------------ generation

@@ -14,7 +14,7 @@ from __future__ import annotations
 import functools
 import zlib
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -29,6 +29,22 @@ Array = jax.Array
 
 LayerFn = Callable[[LayerConfig, List[Argument], "LayerContext"], Argument]
 layer_registry: Registry[LayerFn] = Registry("layer type")
+
+
+class TimeMajorLogits(NamedTuple):
+    """Pre-softmax logits of a HOISTED recurrent out-link, kept in the
+    vocab projection's native flat [T*B, V] form instead of the
+    transposed [B, T, V] view. The fused-CE consumer reduces over V
+    directly on this layout and transposes only the tiny [T, B]
+    per-step costs — transposing the V-sized tensor itself forced a
+    full-tensor relayout copy inside the train step (~0.5 GB/step on
+    the NMT flagship, 3.6% of device time in the 2026-08-01 TPU trace:
+    %copy.167 bf16[3750,8,32,B] between the projection's {0,1} layout
+    and the [B,T,V] consumers)."""
+
+    flat: jax.Array   # [T*B, V]
+    T: int
+    B: int
 
 
 def register_layer(*type_names: str):
@@ -89,14 +105,16 @@ class LayerContext:
     # consumer took the NHWC view). Recurrent groups build their own
     # context, so entries never cross a scan boundary.
     nhwc: Dict[str, Array] = field(default_factory=dict)
-    # pre-softmax logits side-table (layer name -> pre-activation array):
-    # finalize_output publishes here when the activation is a plain
-    # feature-axis softmax, so a downstream multi-class cross-entropy can
-    # compute fused log-softmax CE from the logits instead of
-    # re-upcasting the materialized probabilities ([B*T, V] f32 traffic
-    # at NMT vocab sizes). The softmax output stays authoritative for
-    # every other consumer and is DCE'd when only the loss reads it.
-    logits: Dict[str, Array] = field(default_factory=dict)
+    # pre-softmax logits side-table (layer name -> pre-activation array,
+    # OR a TimeMajorLogits wrapper for hoisted recurrent out-links —
+    # check isinstance before assuming an array): finalize_output
+    # publishes here when the activation is a plain feature-axis softmax,
+    # so a downstream multi-class cross-entropy can compute fused
+    # log-softmax CE from the logits instead of re-upcasting the
+    # materialized probabilities ([B*T, V] f32 traffic at NMT vocab
+    # sizes). The softmax output stays authoritative for every other
+    # consumer and is DCE'd when only the loss reads it.
+    logits: Dict[str, Any] = field(default_factory=dict)
     # sparse-embedding prefetch (GradientMachine::prefetch analog): rows
     # pre-gathered outside autodiff, keyed by (param_name, input_layer);
     # the table projection returns these instead of gathering, so

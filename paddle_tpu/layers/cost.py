@@ -19,7 +19,7 @@ import jax
 import jax.numpy as jnp
 
 from paddle_tpu.graph.argument import Argument
-from paddle_tpu.layers.base import LayerContext, register_layer
+from paddle_tpu.layers.base import LayerContext, TimeMajorLogits, register_layer
 from paddle_tpu.proto import LayerConfig
 
 from paddle_tpu.ops.precision import hp as _hp
@@ -76,12 +76,31 @@ def multi_class_cross_entropy(cfg: LayerConfig, inputs: List[Argument], ctx: Lay
         if _USE_FUSED_CE and not cfg.inputs[0].input_layer_argument
         else None
     )
-    if z is not None and z.shape == out.value.shape:
-        per_step = _fused_softmax_ce(z, ids)
-    else:
-        p = jnp.take_along_axis(_hp(out.value), ids[..., None], axis=-1)[..., 0]
-        per_step = -jnp.log(jnp.clip(p, _EPS, None))
+    per_step = _fused_or_plain_ce(z, out, ids)
     return _finish_cost(cfg, per_step, out, weight)
+
+
+def _fused_or_plain_ce(z, out: Argument, ids: Array) -> Array:
+    """Per-step CE: fused from logits when the published view matches,
+    else -log(p) from the probabilities. A hoisted recurrent out-link
+    publishes TimeMajorLogits (flat [T*B, V]); the CE then runs in that
+    native layout and only the [T, B] per-step costs transpose — never
+    the V-sized tensor (see layers/base.py TimeMajorLogits)."""
+    if isinstance(z, TimeMajorLogits):
+        B, T = out.value.shape[0], out.value.shape[1]
+        if (
+            out.value.ndim == 3
+            and (z.T, z.B) == (T, B)
+            and z.flat.shape == (T * B, out.value.shape[2])
+        ):
+            ids_flat = jnp.swapaxes(ids, 0, 1).reshape(-1)      # [T*B], tiny
+            per_flat = _fused_softmax_ce(z.flat, ids_flat)
+            return jnp.swapaxes(per_flat.reshape(T, B), 0, 1)   # [B, T], tiny
+        z = None
+    if z is not None and z.shape == out.value.shape:
+        return _fused_softmax_ce(z, ids)
+    p = jnp.take_along_axis(_hp(out.value), ids[..., None], axis=-1)[..., 0]
+    return -jnp.log(jnp.clip(p, _EPS, None))
 
 
 @register_layer("multi_class_cross_entropy_with_selfnorm")
