@@ -406,9 +406,9 @@ def bench_lstm_classifier(B=256, T=64, steps=20, warmup=3, dtype=None):
 def bench_nmt(B=None, T=32, vocab=30000, dim=512, steps=10, warmup=2, dtype=None):
     """seqToseq NMT attention encoder-decoder train step; tokens/sec counts
     target (decoder) tokens — BASELINE.md north-star workload #2. Without
-    an explicit B, walks a 384/256/128/64 batch ladder on OOM (384
-    measured fastest 2026-08-01: 471.4k tok/s MFU 0.3225 vs 444.4k at
-    256; 512 breaks the fused GRU kernel's hardware compile); an
+    an explicit B, walks a 448/384/256/128/64 batch ladder on OOM (448
+    measured fastest 2026-08-01: 599.6k tok/s MFU 0.4102; 512 breaks
+    the fused GRU kernel's hardware compile); an
     explicit B or PADDLE_TPU_BENCH_NMT_B pins a size, matching
     bench_resnet50's PADDLE_TPU_BENCH_RESNET_B."""
     import jax.numpy as jnp
@@ -437,10 +437,11 @@ def bench_nmt(B=None, T=32, vocab=30000, dim=512, steps=10, warmup=2, dtype=None
     if env_b:
         ladder = [(int(env_b),)]
     else:
-        # 384 leads — measured (2026-08-01 05:16Z batch A/B): 471.4k
-        # tok/s MFU 0.3225 vs 444.4k at 256; at 512 the fused GRU
-        # kernel's hardware compile fails (falls back to scan, 439.0k)
-        ladder = [(B,)] if B else [(384,), (256,), (128,), (64,)]
+        # 448 leads — measured (2026-08-01 06:08Z, post flat-logits):
+        # 599.6k tok/s MFU 0.4102 vs 587.4k at 384 and 554.6k at 256;
+        # at 512 the fused GRU kernel's hardware compile fails (falls
+        # back to scan), so 448 is the largest kernel-clean batch
+        ladder = [(B,)] if B else [(448,), (384,), (256,), (128,), (64,)]
     return _try_ladder(ladder, run_one)
 
 
