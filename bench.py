@@ -481,17 +481,30 @@ def bench_nmt_gen(B=None, T=32, vocab=30000, dim=512, beam_size=3,
         for _ in range(warmup - 1):
             ids, lens = fwd(params, batch)
         jax.block_until_ready((ids, lens))
+        tracing = TRACE_DIR and TRACE_LEG == "gen"
+        if tracing:
+            jax.profiler.start_trace(TRACE_DIR)
         t0 = time.perf_counter()
         for _ in range(steps):
             ids, lens = fwd(params, batch)
         tokens = float(np.asarray(lens).sum())  # device sync via readback
         dt = time.perf_counter() - t0
+        if tracing:
+            jax.profiler.stop_trace()
         extras = _leg_extras(beam_size=beam_size, max_length=max_length,
                              dtype=tc.opt_config.dtype, batch=b,
                              tokens="best-beam generated")
         return tokens * steps / dt, extras
 
-    ladder = [(B,)] if B else [(64,), (32,), (16,)]
+    env_b = os.environ.get("PADDLE_TPU_BENCH_GEN_B")
+    if env_b:
+        ladder = [(int(env_b),)]
+    else:
+        # 512 leads — measured (2026-08-01 07:08Z batch sweep): decode is
+        # dispatch-bound per step, so tokens/s scales with batch until
+        # the MXU fills: 800.6 (64) / 1557.6 (128) / 2450.3 (256) /
+        # 3114.4 (512) tok/s at beam=3
+        ladder = [(B,)] if B else [(512,), (256,), (128,), (64,)]
     return _try_ladder(ladder, run_one)
 
 
