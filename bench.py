@@ -384,6 +384,7 @@ def bench_lstm_classifier(B=256, T=64, steps=20, warmup=3, dtype=None):
 
     import jax
 
+    B = int(os.environ.get("PADDLE_TPU_BENCH_LSTM_B", 0)) or B
     tc = flagship_config(dict_dim=10000, emb_dim=256, hidden=512, classes=2)
     tc.opt_config.batch_size = B
     tc.opt_config.dtype = dtype or BENCH_DTYPE
