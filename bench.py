@@ -101,11 +101,14 @@ def _jit_train_step(tc, spl=1):
         tc.opt_config.pallas_rnn = True
     if os.environ.get("PADDLE_TPU_BENCH_S2D") == "1":
         tc.opt_config.conv_s2d = True
+    if _conv_stats_mode():
+        tc.opt_config.conv_stats_mode = _conv_stats_mode()
 
     gm = GradientMachine(tc.model_config, compute_dtype=compute_dtype_of(tc.opt_config),
                          scan_unroll=tc.opt_config.scan_unroll,
                          pallas_rnn=tc.opt_config.pallas_rnn,
-                         conv_s2d=tc.opt_config.conv_s2d)
+                         conv_s2d=tc.opt_config.conv_s2d,
+                         conv_stats_mode=tc.opt_config.conv_stats_mode)
     updater = Updater(tc.opt_config, tc.model_config)
     params = gm.init_params(seed=1)
     opt_state = updater.init_state(params)
@@ -243,44 +246,71 @@ def _pallas_on() -> bool:
     return jax.default_backend() != "cpu"
 
 
-def _pallas_fallback(leg_fn):
-    """The fused Pallas kernels have never been compiled on real hardware
-    (interpret-mode parity only): if a leg fails with the pallas knob on
-    — a Mosaic rejection, a VMEM miss in the real compiler, anything —
-    rerun it on the XLA scan path instead of forfeiting the A/B leg, and
-    tag the JSON so the fallback can never masquerade as a pallas win."""
+def _conv_stats_mode() -> str:
+    """PADDLE_TPU_BENCH_CONV_STATS: 'gram' computes BN statistics from
+    the 1x1 conv's input side (pure XLA — colsum + Gram algebra),
+    'pallas' uses the fused matmul kernel (measured end-to-end loser:
+    layout-boundary copies, see doc/performance.md), '1' aliases gram,
+    '0'/'' force off. Unset = off pending a measured A/B win."""
+    v = os.environ.get("PADDLE_TPU_BENCH_CONV_STATS", "")
+    if v == "1":
+        return "gram"
+    if v in ("gram", "pallas"):
+        return v
+    return ""
 
-    @functools.wraps(leg_fn)
-    def wrapped(*args, **kwargs):
-        if not _pallas_on():
-            return leg_fn(*args, **kwargs)
-        try:
-            return leg_fn(*args, **kwargs)
-        except Exception as e:
-            err = f"{type(e).__name__}: {str(e)[:300]}"
-            sys.stderr.write(f"pallas_rnn leg failed, retrying on the scan "
-                             f"path: {err}\n")
-            orig = os.environ.get("PADDLE_TPU_BENCH_PALLAS_RNN")
-            os.environ["PADDLE_TPU_BENCH_PALLAS_RNN"] = "0"
+
+def _knob_fallback(is_on, env_var, tag_key, fallback_label):
+    """Decorator factory for optional-kernel legs: if the leg fails with
+    the knob on — a Mosaic rejection, a VMEM miss in the real compiler,
+    anything — rerun it with the knob forced off instead of forfeiting
+    the A/B leg's budget, and tag the JSON so the fallback can never
+    masquerade as a win for the kernel."""
+
+    def deco(leg_fn):
+        @functools.wraps(leg_fn)
+        def wrapped(*args, **kwargs):
+            if not is_on():
+                return leg_fn(*args, **kwargs)
             try:
-                value, extras = leg_fn(*args, **kwargs)
-            except Exception as e2:
-                # keep the pallas diagnosis in the parseable record, not
-                # just stderr — the rerun's error alone would lose it
-                raise RuntimeError(
-                    f"{type(e2).__name__}: {str(e2)[:300]} "
-                    f"(scan-path rerun after pallas failure: {err})"
-                ) from e2
-            finally:
-                if orig is None:
-                    del os.environ["PADDLE_TPU_BENCH_PALLAS_RNN"]
-                else:
-                    os.environ["PADDLE_TPU_BENCH_PALLAS_RNN"] = orig
-            extras = dict(extras or {})
-            extras["pallas_rnn"] = f"FELL BACK to scan path ({err})"
-            return value, extras
+                return leg_fn(*args, **kwargs)
+            except Exception as e:
+                err = f"{type(e).__name__}: {str(e)[:300]}"
+                sys.stderr.write(f"{tag_key} leg failed, retrying on "
+                                 f"{fallback_label}: {err}\n")
+                orig = os.environ.get(env_var)
+                os.environ[env_var] = "0"
+                try:
+                    value, extras = leg_fn(*args, **kwargs)
+                except Exception as e2:
+                    # keep the original diagnosis in the parseable record,
+                    # not just stderr — the rerun's error alone would
+                    # lose it
+                    raise RuntimeError(
+                        f"{type(e2).__name__}: {str(e2)[:300]} "
+                        f"(rerun on {fallback_label} after {tag_key} "
+                        f"failure: {err})"
+                    ) from e2
+                finally:
+                    if orig is None:
+                        del os.environ[env_var]
+                    else:
+                        os.environ[env_var] = orig
+                extras = dict(extras or {})
+                extras[tag_key] = f"FELL BACK to {fallback_label} ({err})"
+                return value, extras
 
-    return wrapped
+        return wrapped
+
+    return deco
+
+
+_pallas_fallback = _knob_fallback(
+    lambda: _pallas_on(), "PADDLE_TPU_BENCH_PALLAS_RNN",
+    "pallas_rnn", "the scan path")
+_conv_stats_fallback = _knob_fallback(
+    lambda: bool(_conv_stats_mode()), "PADDLE_TPU_BENCH_CONV_STATS",
+    "conv_stats", "the XLA path")
 
 
 def _try_ladder(configs, run_one):
@@ -319,6 +349,7 @@ def _try_ladder(configs, run_one):
     raise AssertionError("empty ladder")
 
 
+@_conv_stats_fallback
 def bench_resnet50(B=None, img_size=224, classes=1000, steps=20, warmup=3, trace=True,
                    dtype=None):
     """Headline leg. Without an explicit B, tries a (batch, remat)
@@ -363,6 +394,8 @@ def bench_resnet50(B=None, img_size=224, classes=1000, steps=20, warmup=3, trace
         )
         m, kind = _mfu_of(flops, dt, steps)
         extras = _leg_extras(spl=spl, device_kind=kind, dtype=tc.opt_config.dtype, batch=b)
+        if _conv_stats_mode():
+            extras["conv_stats"] = _conv_stats_mode()
         if remat == "none":
             extras["mfu"] = m
         else:

@@ -37,6 +37,15 @@ mkdir -p benchmarks/traces
 #    B=512 now; MFU on the round-5 analytic model-FLOPs basis)
 PADDLE_TPU_BENCH_TRACE_DIR=$PWD/benchmarks/traces PADDLE_TPU_BENCH_BUDGET=1400 \
   timeout 1500 python bench.py >> $OUT 2>$ERR
+# 1b) gram conv-stats A/B (input-side BN statistics for 1x1 expand
+#     convs, pure XLA — layers/vision.py _publish_gram_stats): the
+#     round-5 rung at the resnet reduce bottleneck. Runs EARLY: it is
+#     the round's open decision and needs only one leg. (The "pallas"
+#     mode of the same knob is a measured end-to-end loser — layout
+#     copies — and is not re-run here.)
+echo "--- resnet conv-stats A/B (gram input-side BN stats)" >> $OUT
+PADDLE_TPU_BENCH_CONV_STATS=gram PADDLE_TPU_BENCH_RESNET_B=256 \
+  PADDLE_TPU_BENCH_BUDGET=900 timeout 1000 python bench.py resnet >> $OUT 2>>$ERR
 # 2) the round-4 unmeasured queue: fused Pallas recurrent kernels
 #    (whole scan in one kernel launch; first-ever hardware compile —
 #    bench falls back gracefully if Mosaic rejects them) and fused

@@ -210,6 +210,7 @@ def _apply_settings(opt: OptimizationConfig, s: Dict[str, Any]) -> None:
         "batches_per_launch",
         "pallas_rnn",
         "conv_s2d",
+        "conv_stats_mode",
         "c1",
         "backoff",
         "owlqn_steps",

@@ -41,7 +41,7 @@ def compute_dtype_of(opt_config) -> Optional[Any]:
 class GradientMachine:
     def __init__(self, model: ModelConfig, dtype=jnp.float32, compute_dtype=None,
                  scan_unroll: int = 1, pallas_rnn: bool = False,
-                 conv_s2d: bool = False):
+                 conv_s2d: bool = False, conv_stats_mode: str = ""):
         self.model = model
         self.network = Network(model)
         self.dtype = dtype
@@ -56,6 +56,15 @@ class GradientMachine:
         self.pallas_rnn = bool(pallas_rnn)
         # stem conv space-to-depth rewrite (layers/vision.py)
         self.conv_s2d = bool(conv_s2d)
+        # fused 1x1-conv + BN-statistics mode ("gram" | "pallas" | "")
+        self.conv_stats_mode = str(conv_stats_mode or "")
+        if self.conv_stats_mode not in ("", "gram", "pallas"):
+            # an unknown value would silently disable the feature and
+            # poison the very A/B measurement the knob exists for
+            raise ValueError(
+                f"conv_stats_mode must be '', 'gram' or 'pallas', "
+                f"got {conv_stats_mode!r}"
+            )
         self.mesh = None  # set by the trainer when running on a mesh
         self.param_configs: Dict[str, ParameterConfig] = {p.name: p for p in model.parameters}
         # data layers whose every consumer is a cost layer carry targets/
@@ -100,7 +109,7 @@ class GradientMachine:
             dtype=self.dtype, mesh=self.mesh, table_overrides=table_overrides,
             compute_dtype=self.compute_dtype, no_cast_inputs=self.no_cast_inputs,
             scan_unroll=self.scan_unroll, pallas_rnn=self.pallas_rnn,
-            conv_s2d=self.conv_s2d,
+            conv_s2d=self.conv_s2d, conv_stats_mode=self.conv_stats_mode,
         )
         self.network.forward(ctx, in_args)
         return ctx.outputs, ctx.state_updates

@@ -92,6 +92,11 @@ class LayerContext:
     # OptimizationConfig.conv_s2d: few-channel 7x7/s2 stem convs rewrite
     # to a space-to-depth 4x4/s1 conv (layers/vision.py _stem_s2d_conv)
     conv_s2d: bool = False
+    # OptimizationConfig.conv_stats_mode: 1x1/s1 convs publish their
+    # output's per-channel (sum, sumsq, rows) into `conv_stats` — via
+    # input-side Gram algebra ("gram", pure XLA) or the fused Pallas
+    # matmul kernel ("pallas", ops/pallas_conv1x1_bn); "" = off
+    conv_stats_mode: str = ""
     # recurrent-group prologue hoisting (graph/recurrent_group.py
     # _plan_prologue): mixed layer name -> (skip_input_indices,
     # precomputed [B, out] slice) for scan-input projections computed
@@ -115,6 +120,15 @@ class LayerContext:
     # sizes). The softmax output stays authoritative for every other
     # consumer and is DCE'd when only the loss reads it.
     logits: Dict[str, Any] = field(default_factory=dict)
+    # fused conv+BN statistics side-table (producer layer name ->
+    # (sum [C] f32, sumsq [C] f32, rows)): a 1x1 conv that ran the
+    # pallas_conv_stats kernel publishes its output's per-channel
+    # statistics here; a downstream batch_norm consuming that layer in
+    # training mode uses them instead of re-reading the activation from
+    # HBM. The conv output Argument stays authoritative for every other
+    # consumer; both come from one custom_vjp call, so gradients through
+    # output and statistics compose in its backward.
+    conv_stats: Dict[str, Any] = field(default_factory=dict)
     # sparse-embedding prefetch (GradientMachine::prefetch analog): rows
     # pre-gathered outside autodiff, keyed by (param_name, input_layer);
     # the table projection returns these instead of gathering, so
@@ -221,6 +235,7 @@ def forward_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -
         # drop them so every consumer goes through the clipped value
         ctx.nhwc.pop(cfg.name, None)
         ctx.logits.pop(cfg.name, None)
+        ctx.conv_stats.pop(cfg.name, None)
     ctx.outputs[cfg.name] = out
     return out
 

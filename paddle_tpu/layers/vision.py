@@ -132,7 +132,131 @@ def _stem_s2d_applies(ctx, cc, fy, sy, py, h, w) -> bool:
     )
 
 
+def _fused_stats_gates(cfg: LayerConfig, ctx: LayerContext):
+    """Shared eligibility gate for BOTH fused conv+BN-statistics modes:
+    single-input 1x1/s1/p0 ungrouped conv whose output is exactly what a
+    downstream batch_norm would reduce — identity activation, no
+    dropout, shared (or no) bias — in a training pass. Returns the conv
+    input config, or None."""
+    if not ctx.is_training or len(cfg.inputs) != 1:
+        return None
+    in_cfg = cfg.inputs[0]
+    cc = in_cfg.conv_conf
+    fy = cc.filter_size_y or cc.filter_size
+    sy = cc.stride_y or cc.stride
+    py = cc.padding_y if cc.padding_y >= 0 else cc.padding
+    if not (fy == 1 and cc.filter_size == 1 and sy == 1 and cc.stride == 1
+            and py == 0 and cc.padding == 0 and cc.groups == 1):
+        return None
+    if cfg.active_type not in ("", "linear") or cfg.drop_rate > 0.0:
+        return None
+    if cfg.bias_parameter_name and not cfg.shared_biases:
+        return None
+    return in_cfg
+
+
+def _conv1x1_stats_forward(cfg: LayerConfig, inputs: List[Argument],
+                           ctx: LayerContext):
+    """1x1/s1 conv through the fused matmul + BN-statistics Pallas kernel
+    (ops/pallas_conv1x1_bn): publishes per-channel (sum, sumsq, rows) into
+    ctx.conv_stats so a downstream batch_norm skips its statistics pass's
+    full HBM re-read of this output. Returns None whenever any gate fails
+    — the caller falls through to the XLA conv, identical semantics.
+
+    Measured end-to-end LOSER on v5e (doc/performance.md round-5
+    conv-stats A/B: layout-boundary copies); kept as the
+    conv_stats_mode="pallas" A/B knob. Gates beyond the shared ones
+    mirror the fused-RNN path (layers/recurrent.py): single-device only
+    (no GSPMD partitioning rule for the custom call), TPU backend or
+    forced interpret mode, and kernel shape/VMEM support.
+    """
+    import os
+
+    if ctx.mesh is not None:
+        return None
+    in_cfg = _fused_stats_gates(cfg, ctx)
+    if in_cfg is None:
+        return None
+    cc = in_cfg.conv_conf
+    on_tpu = jax.default_backend() == "tpu"
+    force_interpret = os.environ.get("PADDLE_TPU_PALLAS_INTERPRET") == "1"
+    if not (on_tpu or force_interpret):
+        return None
+    from paddle_tpu.ops import pallas_conv1x1_bn as pcb
+
+    h = w = cc.img_size
+    x = _take_nhwc(ctx, in_cfg.input_layer_name, inputs[0], cc.channels, h, w)
+    B = x.shape[0]
+    M, K, N = B * h * w, cc.channels, cfg.num_filters
+    if not pcb.supported(M, K, N, x.dtype.itemsize):
+        return None
+    wf = ctx.param(in_cfg.input_parameter_name).reshape(N, K)
+    if cfg.bias_parameter_name:
+        b = ctx.param(cfg.bias_parameter_name).reshape(N).astype(x.dtype)
+    else:
+        b = jnp.zeros((N,), x.dtype)
+    y2, s, q = pcb.conv1x1_stats(x.reshape(M, K), wf.T, b, force_interpret)
+    ctx.conv_stats[cfg.name] = (s, q, M)
+    return _publish_nhwc(ctx, cfg, y2.reshape(B, h, w, N))
+
+
+def _gram_stats_gates(cfg: LayerConfig, ctx: LayerContext):
+    """Gate for input-side Gram statistics: the shared fused-stats gate
+    plus N >= 2K. Unlike the pallas path this is pure XLA (any backend,
+    works under a mesh — the reduces shard like BN's own), and is only
+    worthwhile when the output is wider than the input: the colsum +
+    Gram passes read x twice vs the saved stats pass's one read of y,
+    so the gate is N >= 2K (resnet expand convs are N = 4K)."""
+    in_cfg = _fused_stats_gates(cfg, ctx)
+    if in_cfg is None or cfg.num_filters < 2 * in_cfg.conv_conf.channels:
+        return None
+    return in_cfg
+
+
+def _publish_gram_stats(cfg: LayerConfig, ctx: LayerContext, x_nhwc: Array,
+                        w2: Array, bias) -> None:
+    """Per-channel sum/sumsq of y = x@w + b computed from the INPUT side:
+
+        sum_m(y)   = colsum(x) @ w + M*b
+        sum_m(y^2) = diag(w^T (x^T x) w) + 2*b*(colsum(x) @ w) + M*b^2
+
+    exact algebra (associativity aside), so the BN stats pass never has
+    to re-read y from HBM — it reads x twice (colsum + Gram) instead,
+    a win when N >= 2K and FREE when no batch_norm consumes the entry
+    (XLA dead-code-eliminates the unused reduces). All plain jnp ops:
+    autodiff composes the stats' gradient with the conv's naturally, and
+    XLA keeps its own conv layouts — the measured failure mode of the
+    pallas variant (doc/performance.md round-5 conv-stats A/B).
+
+    Semantics note: these are statistics of the UNROUNDED x@w (the
+    activation-dtype path reduces the bf16-rounded y) — a ~1e-3-relative
+    difference on the mean, inside BN's own eps regime; the parity test
+    pins it (tests/test_conv_stats.py).
+    """
+    f32 = jnp.float32
+    M = x_nhwc.shape[0] * x_nhwc.shape[1] * x_nhwc.shape[2]
+    cs = jnp.sum(x_nhwc, axis=(0, 1, 2), dtype=f32)          # [K]
+    gram = jnp.einsum("bhwk,bhwl->kl", x_nhwc, x_nhwc,
+                      preferred_element_type=f32)            # [K, K]
+    w32 = w2.astype(f32)
+    csw = cs @ w32                                           # [N]
+    s = csw
+    q = jnp.einsum("kn,kl,ln->n", w32, gram, w32)
+    if bias is not None:
+        b32 = bias.astype(f32)
+        s = s + M * b32
+        q = q + 2.0 * b32 * csw + M * jnp.square(b32)
+    ctx.conv_stats[cfg.name] = (s, q, M)
+
+
 def _conv_forward(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -> Argument:
+    if ctx.conv_stats_mode == "pallas":
+        out = _conv1x1_stats_forward(cfg, inputs, ctx)
+        if out is not None:
+            return out
+    gram_in = (
+        _gram_stats_gates(cfg, ctx) if ctx.conv_stats_mode == "gram" else None
+    )
     acc = None
     for in_cfg, arg in zip(cfg.inputs, inputs):
         cc = in_cfg.conv_conf
@@ -144,11 +268,21 @@ def _conv_forward(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -
         wf = ctx.param(in_cfg.input_parameter_name)
         wf = wf.reshape(cfg.num_filters, cc.filter_channels, fy, cc.filter_size)
         w_hwio = wf.transpose(2, 3, 1, 0)  # OIHW → HWIO
+        if gram_in is not None:
+            gram_operands = (x, w_hwio.reshape(cc.channels, cfg.num_filters))
         if _stem_s2d_applies(ctx, cc, fy, sy, py, h, w):
             y = _stem_s2d_conv(x, w_hwio)
         else:
             y = _conv2d(x, w_hwio, (sy, cc.stride), [(py, py), (cc.padding, cc.padding)], cc.groups)
         acc = y if acc is None else acc + y
+    if gram_in is not None:
+        bias = (
+            ctx.param(cfg.bias_parameter_name)
+            if cfg.bias_parameter_name
+            else None
+        )
+        _publish_gram_stats(cfg, ctx, *gram_operands,
+                            bias.reshape(cfg.num_filters) if bias is not None else None)
     if cfg.bias_parameter_name:
         b = ctx.param(cfg.bias_parameter_name)
         if cfg.shared_biases:
@@ -297,15 +431,33 @@ def batch_norm_layer(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext
     else:
         # at-least-f32 accumulation (f64 under the x64 gradient check)
         acc_dt = jnp.promote_types(xr.dtype, jnp.float32)
-        # one-pass statistics: mean and E[x^2] are independent reductions
-        # over the same input, so XLA fuses them into a single traversal
-        # (a two-pass centered variance would read the activation twice —
-        # the var reduce depends on the mean). The squares are exact
-        # (bf16->f32 widening then f32 multiply inside the fusion);
-        # the E[x^2]-mean^2 cancellation at f32 only bites for channels
-        # with |mean|/std >~ 1e3, far beyond post-conv activations.
-        mean = jnp.mean(xr, axis=0, dtype=acc_dt)
-        msq = jnp.mean(jnp.square(hp(xr)), axis=0, dtype=acc_dt)
+        # fused statistics (conv_stats_mode): a 1x1 conv feeding this BN
+        # already published sum/sumsq — from its matmul epilogue
+        # ("pallas", ops/pallas_conv1x1_bn) or from input-side Gram
+        # algebra ("gram", _publish_gram_stats) — and consuming them
+        # skips this pass's full HBM re-read of the activation. Gated on
+        # exact row-count match and f32 accumulation (the x64 gradient
+        # check wants f64 stats, which the producers do not make).
+        pub = (
+            ctx.conv_stats.get(cfg.inputs[0].input_layer_name)
+            if (x_nhwc is not None and not a.is_seq and acc_dt == jnp.float32)
+            else None
+        )
+        if pub is not None and pub[2] == xr.shape[0] and pub[0].shape == (C,):
+            s_pub, q_pub, rows = pub
+            mean = s_pub / rows
+            msq = q_pub / rows
+        else:
+            # one-pass statistics: mean and E[x^2] are independent
+            # reductions over the same input, so XLA fuses them into a
+            # single traversal (a two-pass centered variance would read
+            # the activation twice — the var reduce depends on the mean).
+            # The squares are exact (bf16->f32 widening then f32 multiply
+            # inside the fusion); the E[x^2]-mean^2 cancellation at f32
+            # only bites for channels with |mean|/std >~ 1e3, far beyond
+            # post-conv activations.
+            mean = jnp.mean(xr, axis=0, dtype=acc_dt)
+            msq = jnp.mean(jnp.square(hp(xr)), axis=0, dtype=acc_dt)
         var = jnp.maximum(msq - jnp.square(mean), 0.0)
         # center against the EXACT f32 mean (a bf16-rounded mean would
         # bias every centered value); the convert-sub-convert chain

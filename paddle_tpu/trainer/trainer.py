@@ -92,6 +92,7 @@ class Trainer:
             scan_unroll=config.opt_config.scan_unroll,
             pallas_rnn=config.opt_config.pallas_rnn,
             conv_s2d=config.opt_config.conv_s2d,
+            conv_stats_mode=config.opt_config.conv_stats_mode,
         )
         self.updater = Updater(
             config.opt_config, config.model_config,

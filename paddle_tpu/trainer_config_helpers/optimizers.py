@@ -215,6 +215,7 @@ def settings(
     batches_per_launch: Optional[int] = None,
     pallas_rnn: Optional[bool] = None,
     conv_s2d: Optional[bool] = None,
+    conv_stats_mode: Optional[str] = None,
 ):
     ctx = current_context()
     s, defaults = ctx.settings, ctx.defaults
@@ -255,6 +256,9 @@ def settings(
         s["pallas_rnn"] = pallas_rnn
     if conv_s2d is not None:
         s["conv_s2d"] = conv_s2d
+    if conv_stats_mode is not None:
+        # fused 1x1-conv + BN statistics: "gram" | "pallas" | ""
+        s["conv_stats_mode"] = conv_stats_mode
     if num_batches_per_send_parameter is not None:
         # gradient accumulation: N batches per optimizer update
         s["num_batches_per_send_parameter"] = num_batches_per_send_parameter
