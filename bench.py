@@ -82,6 +82,8 @@ def _leg_extras(spl=1, rnn_leg=False, **kw):
         kw["pallas_rnn"] = True
     if os.environ.get("PADDLE_TPU_BENCH_S2D") == "1":
         kw["conv_s2d"] = True
+    if rnn_leg and _pallas_decoder_on():
+        kw["pallas_decoder"] = True
     return kw
 
 
@@ -103,12 +105,15 @@ def _jit_train_step(tc, spl=1):
         tc.opt_config.conv_s2d = True
     if _conv_stats_mode():
         tc.opt_config.conv_stats_mode = _conv_stats_mode()
+    if _pallas_decoder_on():
+        tc.opt_config.pallas_decoder = True
 
     gm = GradientMachine(tc.model_config, compute_dtype=compute_dtype_of(tc.opt_config),
                          scan_unroll=tc.opt_config.scan_unroll,
                          pallas_rnn=tc.opt_config.pallas_rnn,
                          conv_s2d=tc.opt_config.conv_s2d,
-                         conv_stats_mode=tc.opt_config.conv_stats_mode)
+                         conv_stats_mode=tc.opt_config.conv_stats_mode,
+                         pallas_decoder=tc.opt_config.pallas_decoder)
     updater = Updater(tc.opt_config, tc.model_config)
     params = gm.init_params(seed=1)
     opt_state = updater.init_state(params)
@@ -246,6 +251,14 @@ def _pallas_on() -> bool:
     return jax.default_backend() != "cpu"
 
 
+def _pallas_decoder_on() -> bool:
+    """Tri-state PADDLE_TPU_BENCH_PALLAS_DECODER: '1' runs matching
+    attention-GRU decoder groups as one fused Pallas launch
+    (ops/pallas_attention_gru), '0'/unset keeps the lax.scan — off
+    pending a measured A/B win on hardware (first compile ever)."""
+    return os.environ.get("PADDLE_TPU_BENCH_PALLAS_DECODER") == "1"
+
+
 def _conv_stats_mode() -> str:
     """PADDLE_TPU_BENCH_CONV_STATS: 'gram' computes BN statistics from
     the 1x1 conv's input side (pure XLA — colsum + Gram algebra),
@@ -311,6 +324,9 @@ _pallas_fallback = _knob_fallback(
 _conv_stats_fallback = _knob_fallback(
     lambda: bool(_conv_stats_mode()), "PADDLE_TPU_BENCH_CONV_STATS",
     "conv_stats", "the XLA path")
+_pallas_decoder_fallback = _knob_fallback(
+    _pallas_decoder_on, "PADDLE_TPU_BENCH_PALLAS_DECODER",
+    "pallas_decoder", "the scan path")
 
 
 def _try_ladder(configs, run_one):
@@ -437,6 +453,7 @@ def bench_lstm_classifier(B=256, T=64, steps=20, warmup=3, dtype=None):
 
 
 @_pallas_fallback
+@_pallas_decoder_fallback
 def bench_nmt(B=None, T=32, vocab=30000, dim=512, steps=10, warmup=2, dtype=None):
     """seqToseq NMT attention encoder-decoder train step; tokens/sec counts
     target (decoder) tokens — BASELINE.md north-star workload #2. Without

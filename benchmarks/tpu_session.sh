@@ -46,6 +46,14 @@ PADDLE_TPU_BENCH_TRACE_DIR=$PWD/benchmarks/traces PADDLE_TPU_BENCH_BUDGET=1400 \
 echo "--- resnet conv-stats A/B (gram input-side BN stats)" >> $OUT
 PADDLE_TPU_BENCH_CONV_STATS=gram PADDLE_TPU_BENCH_RESNET_B=256 \
   PADDLE_TPU_BENCH_BUDGET=900 timeout 1000 python bench.py resnet >> $OUT 2>>$ERR
+# 1c) fused attention-GRU decoder A/B (ops/pallas_attention_gru): the
+#     whole decoder time loop in one pallas launch — the round-5 NMT
+#     rung (decoder scan/while is 56.6% of the traced step). First-ever
+#     hardware compile; bench falls back to the scan on a Mosaic
+#     rejection, so the leg budget is safe either way.
+echo "--- nmt fused-decoder A/B (pallas attention-GRU)" >> $OUT
+PADDLE_TPU_BENCH_PALLAS_DECODER=1 PADDLE_TPU_BENCH_BUDGET=900 \
+  timeout 1000 python bench.py nmt >> $OUT 2>>$ERR
 # 2) the round-4 unmeasured queue: fused Pallas recurrent kernels
 #    (whole scan in one kernel launch; first-ever hardware compile —
 #    bench falls back gracefully if Mosaic rejects them) and fused

@@ -211,6 +211,7 @@ def _apply_settings(opt: OptimizationConfig, s: Dict[str, Any]) -> None:
         "pallas_rnn",
         "conv_s2d",
         "conv_stats_mode",
+        "pallas_decoder",
         "c1",
         "backoff",
         "owlqn_steps",

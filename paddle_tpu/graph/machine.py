@@ -41,7 +41,8 @@ def compute_dtype_of(opt_config) -> Optional[Any]:
 class GradientMachine:
     def __init__(self, model: ModelConfig, dtype=jnp.float32, compute_dtype=None,
                  scan_unroll: int = 1, pallas_rnn: bool = False,
-                 conv_s2d: bool = False, conv_stats_mode: str = ""):
+                 conv_s2d: bool = False, conv_stats_mode: str = "",
+                 pallas_decoder: bool = False):
         self.model = model
         self.network = Network(model)
         self.dtype = dtype
@@ -56,6 +57,8 @@ class GradientMachine:
         self.pallas_rnn = bool(pallas_rnn)
         # stem conv space-to-depth rewrite (layers/vision.py)
         self.conv_s2d = bool(conv_s2d)
+        # fused attention-GRU decoder groups (ops/pallas_attention_gru)
+        self.pallas_decoder = bool(pallas_decoder)
         # fused 1x1-conv + BN-statistics mode ("gram" | "pallas" | "")
         self.conv_stats_mode = str(conv_stats_mode or "")
         if self.conv_stats_mode not in ("", "gram", "pallas"):
@@ -110,6 +113,7 @@ class GradientMachine:
             compute_dtype=self.compute_dtype, no_cast_inputs=self.no_cast_inputs,
             scan_unroll=self.scan_unroll, pallas_rnn=self.pallas_rnn,
             conv_s2d=self.conv_s2d, conv_stats_mode=self.conv_stats_mode,
+            pallas_decoder=self.pallas_decoder,
         )
         self.network.forward(ctx, in_args)
         return ctx.outputs, ctx.state_updates

@@ -447,6 +447,27 @@ def _forward_scan(network, cfg: LayerConfig, sub: SubModelConfig, ctx: LayerCont
                 acc = y if acc is None else acc + y
             pro_feeds[lname] = acc
 
+    # fused attention-GRU decoder (OptimizationConfig.pallas_decoder):
+    # when the step graph is exactly the simple_attention + gru_step
+    # template, the whole time loop runs as ONE pallas launch with the
+    # encoder states VMEM-resident per batch block (ops/
+    # pallas_attention_gru); the hoisted epilogue then consumes the
+    # same raw frontier stream the scan would have produced
+    fused_ys = None
+    if not nested and ctx.pallas_decoder:
+        from paddle_tpu.graph.fused_decoder import match_decoder, run_fused_decoder
+
+        fplan = match_decoder(network, sub, ctx, statics, skip, pro_plan)
+        if fplan is not None:
+            gname = fplan["gru"].name
+            frontier_ok = all(f == gname for f in dyn_frontier)
+            links_ok = all(l.layer_name == gname for l in inside_out_links)
+            if frontier_ok and links_ok:
+                fused_ys = run_fused_decoder(
+                    network, sub, ctx, statics, fplan, pro_feeds,
+                    init_carries[0], mask_bt,
+                )
+
     def step(carries, inp):
         x_v, x_i, x_sl, m_t, t_idx, x_pro = inp
         fed: Dict[str, Argument] = {}
@@ -512,9 +533,16 @@ def _forward_scan(network, cfg: LayerConfig, sub: SubModelConfig, ctx: LayerCont
         jnp.arange(T, dtype=jnp.int32),
         pro_feeds,
     )
-    _, (ys, frs) = jax.lax.scan(
-        step, init_carries, xs, reverse=bool(sub.reversed), unroll=ctx.scan_unroll
-    )
+    if fused_ys is not None:
+        # same (ys, frs) pytree the scan would produce: masked out-link
+        # streams + raw frontier values for the hoisted epilogue
+        m3 = jnp.swapaxes(mask_bt, 0, 1)[:, :, None].astype(fused_ys.dtype)
+        ys = [(fused_ys * m3, None) for _ in inside_out_links]
+        frs = tuple((fused_ys, None) for _ in dyn_frontier)
+    else:
+        _, (ys, frs) = jax.lax.scan(
+            step, init_carries, xs, reverse=bool(sub.reversed), unroll=ctx.scan_unroll
+        )
     for link, (y, y_lens) in zip(inside_out_links, ys):
         if y_lens is not None:
             # [S, B, T, D] → nested [B, S, T, D] with per-subseq lengths

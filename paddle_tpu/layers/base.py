@@ -97,6 +97,9 @@ class LayerContext:
     # input-side Gram algebra ("gram", pure XLA) or the fused Pallas
     # matmul kernel ("pallas", ops/pallas_conv1x1_bn); "" = off
     conv_stats_mode: str = ""
+    # OptimizationConfig.pallas_decoder: attention-GRU decoder groups
+    # run as one fused Pallas launch (graph/fused_decoder.py)
+    pallas_decoder: bool = False
     # recurrent-group prologue hoisting (graph/recurrent_group.py
     # _plan_prologue): mixed layer name -> (skip_input_indices,
     # precomputed [B, out] slice) for scan-input projections computed

@@ -132,12 +132,17 @@ def _stem_s2d_applies(ctx, cc, fy, sy, py, h, w) -> bool:
     )
 
 
-def _fused_stats_gates(cfg: LayerConfig, ctx: LayerContext):
+def _fused_stats_gates(cfg: LayerConfig, ctx: LayerContext,
+                       allow_stride: bool = False):
     """Shared eligibility gate for BOTH fused conv+BN-statistics modes:
-    single-input 1x1/s1/p0 ungrouped conv whose output is exactly what a
+    single-input 1x1/p0 ungrouped conv whose output is exactly what a
     downstream batch_norm would reduce — identity activation, no
     dropout, shared (or no) bias — in a training pass. Returns the conv
-    input config, or None."""
+    input config, or None. ``allow_stride`` admits strided 1x1 convs
+    (resnet downsample projections): a stride-s 1x1/p0 conv is a matmul
+    over the ::s-sliced input, so input-side statistics stay exact —
+    but only under caffe-mode output sizing, where the conv output rows
+    are exactly the ceil(img/s) slice positions."""
     if not ctx.is_training or len(cfg.inputs) != 1:
         return None
     in_cfg = cfg.inputs[0]
@@ -145,7 +150,11 @@ def _fused_stats_gates(cfg: LayerConfig, ctx: LayerContext):
     fy = cc.filter_size_y or cc.filter_size
     sy = cc.stride_y or cc.stride
     py = cc.padding_y if cc.padding_y >= 0 else cc.padding
-    if not (fy == 1 and cc.filter_size == 1 and sy == 1 and cc.stride == 1
+    stride_ok = (
+        sy == 1 and cc.stride == 1
+        or (allow_stride and cc.caffe_mode and sy >= 1 and cc.stride >= 1)
+    )
+    if not (fy == 1 and cc.filter_size == 1 and stride_ok
             and py == 0 and cc.padding == 0 and cc.groups == 1):
         return None
     if cfg.active_type not in ("", "linear") or cfg.drop_rate > 0.0:
@@ -207,7 +216,7 @@ def _gram_stats_gates(cfg: LayerConfig, ctx: LayerContext):
     worthwhile when the output is wider than the input: the colsum +
     Gram passes read x twice vs the saved stats pass's one read of y,
     so the gate is N >= 2K (resnet expand convs are N = 4K)."""
-    in_cfg = _fused_stats_gates(cfg, ctx)
+    in_cfg = _fused_stats_gates(cfg, ctx, allow_stride=True)
     if in_cfg is None or cfg.num_filters < 2 * in_cfg.conv_conf.channels:
         return None
     return in_cfg
@@ -269,7 +278,11 @@ def _conv_forward(cfg: LayerConfig, inputs: List[Argument], ctx: LayerContext) -
         wf = wf.reshape(cfg.num_filters, cc.filter_channels, fy, cc.filter_size)
         w_hwio = wf.transpose(2, 3, 1, 0)  # OIHW → HWIO
         if gram_in is not None:
-            gram_operands = (x, w_hwio.reshape(cc.channels, cfg.num_filters))
+            # a strided 1x1/p0 conv only ever reads the ::s positions —
+            # statistics of the sliced view are exact (the slice fuses
+            # into the stats reduces; nothing materializes)
+            x_stats = x[:, ::sy, ::cc.stride, :] if (sy > 1 or cc.stride > 1) else x
+            gram_operands = (x_stats, w_hwio.reshape(cc.channels, cfg.num_filters))
         if _stem_s2d_applies(ctx, cc, fy, sy, py, h, w):
             y = _stem_s2d_conv(x, w_hwio)
         else:

@@ -406,6 +406,12 @@ class OptimizationConfig(Message):
     #    forces ~33% of the step into relayout copies. Kept for A/B.
     #  - "": off (default until a measured win).
     conv_stats_mode: str = ""
+    # run a matching attention-GRU decoder recurrent group (the seqToseq
+    # template) as ONE fused Pallas launch per train step, encoder
+    # states VMEM-resident per batch block (ops/pallas_attention_gru,
+    # graph/fused_decoder.py). Off by default until measured faster on
+    # the target chip; non-matching groups take the lax.scan either way.
+    pallas_decoder: bool = False
     # fuse k consecutive same-shape batches into ONE device launch
     # (lax.scan over stacked batches): amortizes per-dispatch host latency
     # when single steps are short — each batch still gets its own optimizer

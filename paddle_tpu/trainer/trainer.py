@@ -93,6 +93,7 @@ class Trainer:
             pallas_rnn=config.opt_config.pallas_rnn,
             conv_s2d=config.opt_config.conv_s2d,
             conv_stats_mode=config.opt_config.conv_stats_mode,
+            pallas_decoder=config.opt_config.pallas_decoder,
         )
         self.updater = Updater(
             config.opt_config, config.model_config,

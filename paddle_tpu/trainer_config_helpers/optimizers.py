@@ -216,6 +216,7 @@ def settings(
     pallas_rnn: Optional[bool] = None,
     conv_s2d: Optional[bool] = None,
     conv_stats_mode: Optional[str] = None,
+    pallas_decoder: Optional[bool] = None,
 ):
     ctx = current_context()
     s, defaults = ctx.settings, ctx.defaults
@@ -259,6 +260,8 @@ def settings(
     if conv_stats_mode is not None:
         # fused 1x1-conv + BN statistics: "gram" | "pallas" | ""
         s["conv_stats_mode"] = conv_stats_mode
+    if pallas_decoder is not None:
+        s["pallas_decoder"] = pallas_decoder
     if num_batches_per_send_parameter is not None:
         # gradient accumulation: N batches per optimizer update
         s["num_batches_per_send_parameter"] = num_batches_per_send_parameter
