@@ -204,3 +204,33 @@ def test_non_matching_group_falls_back(monkeypatch):
     loss, grads, _, _ = gm.grad_fn()(params, batch, jax.random.PRNGKey(0))
     assert calls["n"] == 0
     assert np.isfinite(float(loss))
+
+
+def test_machine_parity_seqtoseq_bf16(monkeypatch):
+    """The bench configuration (bf16 compute) — looser tolerance, but
+    the kernel must track the scan within bf16 noise."""
+    monkeypatch.setenv("PADDLE_TPU_PALLAS_INTERPRET", "1")
+    import jax.numpy as jnp
+
+    from paddle_tpu.graph import GradientMachine
+    from paddle_tpu.graph.machine import compute_dtype_of
+
+    tc = _nmt_tc(dim=16)
+    tc.opt_config.dtype = "bfloat16"
+    cd = compute_dtype_of(tc.opt_config)
+    batch = _nmt_batch()
+    rng = jax.random.PRNGKey(0)
+    gm_off = GradientMachine(tc.model_config, compute_dtype=cd)
+    gm_on = GradientMachine(tc.model_config, compute_dtype=cd,
+                            pallas_decoder=True)
+    params = gm_off.init_params(seed=11)
+    loss_off, grads_off, _, _ = gm_off.grad_fn()(params, batch, rng)
+    loss_on, grads_on, _, _ = gm_on.grad_fn()(params, batch, rng)
+    np.testing.assert_allclose(float(loss_on), float(loss_off),
+                               rtol=5e-3, atol=1e-3)
+    for name in sorted(grads_off):
+        a = np.asarray(grads_on[name], np.float32)
+        b = np.asarray(grads_off[name], np.float32)
+        scale = max(1e-3, float(np.max(np.abs(b))))
+        np.testing.assert_allclose(a / scale, b / scale, rtol=0.0,
+                                   atol=0.05, err_msg=name)
