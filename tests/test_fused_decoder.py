@@ -234,3 +234,109 @@ def test_machine_parity_seqtoseq_bf16(monkeypatch):
         scale = max(1e-3, float(np.max(np.abs(b))))
         np.testing.assert_allclose(a / scale, b / scale, rtol=0.0,
                                    atol=0.05, err_msg=name)
+
+
+def test_machine_parity_biased_template(monkeypatch):
+    """A hand-built decoder step with biases on the attention transform,
+    combine, and din mixed layers (the template allows them; the runner
+    folds them into b_att / xw) — parity proves the folds are right."""
+    monkeypatch.setenv("PADDLE_TPU_PALLAS_INTERPRET", "1")
+    import textwrap
+
+    from paddle_tpu.config import parse_config
+    from paddle_tpu.graph import GradientMachine, make_seq
+
+    src = textwrap.dedent("""
+    from paddle_tpu.trainer_config_helpers import *
+
+    settings(batch_size=4, learning_rate=1e-3)
+    src_w = data_layer(name="src_word", size=40)
+    src_emb = embedding_layer(input=src_w, size=12,
+                              param_attr=ParamAttr(name="_semb"))
+    enc = simple_gru(input=src_emb, size=12)
+    enc_rev = simple_gru(input=src_emb, size=12, reverse=True)
+    enc_vec = concat_layer(input=[enc, enc_rev])
+    with mixed_layer(size=12) as enc_proj:
+        enc_proj += full_matrix_projection(enc_vec)
+    boot_first = first_seq(input=enc_rev)
+    with mixed_layer(size=12, act=TanhActivation()) as boot:
+        boot += full_matrix_projection(boot_first)
+
+    def step(enc_v, enc_p, cur):
+        mem = memory(name="dec", size=12, boot_layer=boot)
+        with mixed_layer(size=12, bias_attr=True,
+                         name="att_transform") as m:
+            m += full_matrix_projection(mem)
+        ex = expand_layer(input=m, expand_as=enc_v, name="att_expand")
+        with mixed_layer(size=12, act=TanhActivation(), bias_attr=True,
+                         name="att_combine") as comb:
+            comb += identity_projection(ex)
+            comb += identity_projection(enc_p)
+        att = fc_layer(input=comb, size=1, act=SequenceSoftmaxActivation(),
+                       bias_attr=False, name="att_softmax")
+        sc = scaling_layer(weight=att, input=enc_v, name="att_scaling")
+        ctxt = pooling_layer(input=sc, pooling_type=SumPooling(),
+                             name="att_pool")
+        with mixed_layer(size=12 * 3, bias_attr=True, name="din") as din:
+            din += full_matrix_projection(ctxt)
+            din += full_matrix_projection(cur)
+        g = gru_step_layer(name="dec", input=din, output_mem=mem, size=12)
+        with mixed_layer(size=40, bias_attr=True,
+                         act=SoftmaxActivation()) as out:
+            out += full_matrix_projection(input=g)
+        return out
+
+    trg = embedding_layer(input=data_layer(name="trg_word", size=40),
+                          size=12, param_attr=ParamAttr(name="_temb"))
+    dec = recurrent_group(name="dgrp", step=step,
+                          input=[StaticInput(input=enc_vec, is_seq=True),
+                                 StaticInput(input=enc_proj, is_seq=True),
+                                 trg])
+    lbl = data_layer(name="trg_next", size=40)
+    outputs(classification_cost(name="cost", input=dec, label=lbl))
+    """)
+    import tempfile, os as _os
+
+    with tempfile.TemporaryDirectory() as td:
+        p = _os.path.join(td, "cfg.py")
+        with open(p, "w") as f:
+            f.write(src)
+        tc = parse_config(p)
+
+    rng_np = np.random.RandomState(4)
+    B, Ts, Tt = 4, 6, 5
+    src_ids = rng_np.randint(0, 40, (B, Ts)).astype(np.int32)
+    trg_ids = rng_np.randint(0, 40, (B, Tt)).astype(np.int32)
+    nxt_ids = rng_np.randint(0, 40, (B, Tt)).astype(np.int32)
+    sl = np.array([6, 5, 4, 6], np.int32)
+    tl = np.array([5, 5, 3, 4], np.int32)
+    batch = {
+        "src_word": make_seq(None, sl, ids=src_ids),
+        "trg_word": make_seq(None, tl, ids=trg_ids),
+        "trg_next": make_seq(None, tl, ids=nxt_ids),
+    }
+    rng = jax.random.PRNGKey(0)
+    gm_off = GradientMachine(tc.model_config)
+    gm_on = GradientMachine(tc.model_config, pallas_decoder=True)
+    params = gm_off.init_params(seed=21)
+
+    calls = {}
+    orig = fd.run_fused_decoder
+
+    def spy(*a, **kw):
+        out = orig(*a, **kw)
+        calls["ys"] = out
+        return out
+
+    monkeypatch.setattr(fd, "run_fused_decoder", spy)
+    loss_on, grads_on, _, _ = gm_on.grad_fn()(params, batch, rng)
+    assert calls.get("ys") is not None, "biased template did not engage"
+    loss_off, grads_off, _, _ = gm_off.grad_fn()(params, batch, rng)
+    np.testing.assert_allclose(float(loss_on), float(loss_off),
+                               rtol=1e-5, atol=1e-6)
+    for name in sorted(grads_off):
+        np.testing.assert_allclose(
+            np.asarray(grads_on[name], np.float32),
+            np.asarray(grads_off[name], np.float32),
+            rtol=2e-4, atol=2e-5, err_msg=name,
+        )
