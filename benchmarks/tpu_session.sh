@@ -61,6 +61,17 @@ PADDLE_TPU_BENCH_PALLAS_DECODER=1 PADDLE_TPU_BENCH_BUDGET=900 \
 echo "--- headline" >> $OUT
 PADDLE_TPU_BENCH_TRACE_DIR=$PWD/benchmarks/traces PADDLE_TPU_BENCH_BUDGET=1400 \
   timeout 1500 python bench.py >> $OUT 2>>$ERR
+# 1d) transpose-free ("flat") recurrent-kernel interface A/B: the
+#     kernels read the x-projection through a free [B, T*width] reshape
+#     instead of the materialized time-major swap (the x-projection
+#     backward transpose was 16.9% of the pallas-leg step). Both
+#     recurrent legs; scan-fallback-safe like every pallas leg.
+echo "--- pallas flat-interface lstm (k=8)" >> $OUT
+PADDLE_TPU_PALLAS_FLAT=1 PADDLE_TPU_BENCH_PALLAS_RNN=1 \
+  PADDLE_TPU_BENCH_BUDGET=600 timeout 700 python bench.py lstm >> $OUT 2>>$ERR
+echo "--- pallas flat-interface nmt (k=8)" >> $OUT
+PADDLE_TPU_PALLAS_FLAT=1 PADDLE_TPU_BENCH_PALLAS_RNN=1 \
+  PADDLE_TPU_BENCH_BUDGET=900 timeout 1000 python bench.py nmt >> $OUT 2>>$ERR
 # 2) the round-4 unmeasured queue: fused Pallas recurrent kernels
 #    (whole scan in one kernel launch; first-ever hardware compile —
 #    bench falls back gracefully if Mosaic rejects them) and fused

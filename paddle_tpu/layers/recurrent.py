@@ -122,11 +122,17 @@ def _pallas_rnn_path(ctx, cfg, a, x, mask, w, bias, usable_fn, fwd_fn):
     force_interpret = os.environ.get("PADDLE_TPU_PALLAS_INTERPRET") == "1"
     if not (on_tpu or force_interpret) or not usable_fn(cfg, x):
         return None
+    # PADDLE_TPU_PALLAS_FLAT=1: the transpose-free interface — the
+    # kernel reads the projection output's batch-major value through a
+    # free [B, T*width] reshape instead of a materialized time-major
+    # swap (A/B knob; flip the default only on a measured win)
+    x_bt = a.value if os.environ.get("PADDLE_TPU_PALLAS_FLAT") == "1" else None
     # the env flag wins even on TPU so a compiled-kernel discrepancy can
     # be A/B'd in interpret mode on the device where it manifests (off
     # TPU the guard above already required the flag)
-    ys = fwd_fn(cfg, x, mask, w, bias, interpret=force_interpret)
-    return Argument(value=jnp.swapaxes(ys, 0, 1), seq_lengths=a.seq_lengths)
+    ys = fwd_fn(cfg, x, mask, w, bias, interpret=force_interpret, x_bt=x_bt)
+    value = ys if x_bt is not None else jnp.swapaxes(ys, 0, 1)
+    return Argument(value=value, seq_lengths=a.seq_lengths)
 
 
 @register_layer("lstmemory")

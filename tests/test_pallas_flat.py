@@ -1,0 +1,187 @@
+"""Transpose-free ("flat") interface of the fused recurrent kernels
+(PADDLE_TPU_PALLAS_FLAT=1): the kernel reads the x-projection's
+batch-major value through a free [B, T*width] reshape and writes ys the
+same way, so the time-major boundary transposes (a measured 16.9% of
+the pallas-leg step) never exist. Parity: kernel-level flat-vs-time-
+major on both kernels, and machine-level losses/gradients through the
+LSTM flagship and the NMT encoder.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu.graph  # noqa: F401
+from paddle_tpu.ops.pallas_gru import fused_gru
+from paddle_tpu.ops.pallas_lstm import fused_lstm
+
+
+def test_lstm_flat_parity():
+    T, B, H = 6, 8, 16
+    ks = jax.random.split(jax.random.PRNGKey(0), 4)
+    x4_tm = jax.random.normal(ks[0], (T, B, 4 * H)) * 0.5
+    mask = (jax.random.uniform(ks[1], (T, B)) > 0.3).astype(jnp.float32)
+    w = jax.random.normal(ks[2], (H, 4 * H)) * 0.2
+    peep = jnp.zeros((3, H))
+    acts = ("tanh", "sigmoid", "tanh")
+    x4_flat = jnp.swapaxes(x4_tm, 0, 1).reshape(B, T * 4 * H)
+    ys_tm = fused_lstm(x4_tm, mask, w, peep, acts, True, False)
+    ys_fl = fused_lstm(x4_flat, mask, w, peep, acts, True, True)
+    np.testing.assert_allclose(
+        np.asarray(jnp.swapaxes(ys_tm, 0, 1)),
+        np.asarray(ys_fl.reshape(B, T, H)),
+        rtol=1e-6, atol=1e-6,
+    )
+    cot = jax.random.normal(ks[3], (T, B, H))
+    cot_fl = jnp.swapaxes(cot, 0, 1).reshape(B, T * H)
+    g_tm = jax.grad(
+        lambda x, w: jnp.sum(fused_lstm(x, mask, w, peep, acts, True, False) * cot),
+        (0, 1),
+    )(x4_tm, w)
+    g_fl = jax.grad(
+        lambda x, w: jnp.sum(fused_lstm(x, mask, w, peep, acts, True, True) * cot_fl),
+        (0, 1),
+    )(x4_flat, w)
+    np.testing.assert_allclose(
+        np.asarray(jnp.swapaxes(g_tm[0], 0, 1)),
+        np.asarray(g_fl[0].reshape(B, T, 4 * H)),
+        rtol=1e-5, atol=1e-6, err_msg="dx4",
+    )
+    np.testing.assert_allclose(
+        np.asarray(g_tm[1]), np.asarray(g_fl[1]),
+        rtol=1e-5, atol=1e-6, err_msg="dw",
+    )
+
+
+def test_gru_flat_parity():
+    T, B, H = 5, 8, 16
+    ks = jax.random.split(jax.random.PRNGKey(7), 4)
+    x3_tm = jax.random.normal(ks[0], (T, B, 3 * H)) * 0.5
+    mask = (jax.random.uniform(ks[1], (T, B)) > 0.2).astype(jnp.float32)
+    w = jax.random.normal(ks[2], (H, 3 * H)) * 0.2
+    acts = ("tanh", "sigmoid")
+    x3_flat = jnp.swapaxes(x3_tm, 0, 1).reshape(B, T * 3 * H)
+    ys_tm = fused_gru(x3_tm, mask, w, acts, True, False)
+    ys_fl = fused_gru(x3_flat, mask, w, acts, True, True)
+    np.testing.assert_allclose(
+        np.asarray(jnp.swapaxes(ys_tm, 0, 1)),
+        np.asarray(ys_fl.reshape(B, T, H)),
+        rtol=1e-6, atol=1e-6,
+    )
+    cot = jax.random.normal(ks[3], (T, B, H))
+    cot_fl = jnp.swapaxes(cot, 0, 1).reshape(B, T * H)
+    g_tm = jax.grad(
+        lambda x, w: jnp.sum(fused_gru(x, mask, w, acts, True, False) * cot),
+        (0, 1),
+    )(x3_tm, w)
+    g_fl = jax.grad(
+        lambda x, w: jnp.sum(fused_gru(x, mask, w, acts, True, True) * cot_fl),
+        (0, 1),
+    )(x3_flat, w)
+    np.testing.assert_allclose(
+        np.asarray(jnp.swapaxes(g_tm[0], 0, 1)),
+        np.asarray(g_fl[0].reshape(B, T, 3 * H)),
+        rtol=1e-5, atol=1e-6, err_msg="dx3",
+    )
+    np.testing.assert_allclose(
+        np.asarray(g_tm[1]), np.asarray(g_fl[1]),
+        rtol=1e-5, atol=1e-6, err_msg="dw",
+    )
+
+
+def test_machine_flat_parity(monkeypatch):
+    """The env knob end-to-end: flagship LSTM train grads identical flat
+    vs time-major (incl. the reversed-GRU NMT encoder in the sibling
+    session A/B)."""
+    monkeypatch.setenv("PADDLE_TPU_PALLAS_INTERPRET", "1")
+    from paddle_tpu.flagship import example_batch, flagship_config
+    from paddle_tpu.graph import GradientMachine
+
+    tc = flagship_config(dict_dim=128, emb_dim=32, hidden=128)
+    gm = GradientMachine(tc.model_config, pallas_rnn=True)
+    params = gm.init_params(seed=5)
+    batch = example_batch(dict_dim=128, B=8, T=8, seed=3)
+    rng = jax.random.PRNGKey(0)
+    monkeypatch.delenv("PADDLE_TPU_PALLAS_FLAT", raising=False)
+    loss_tm, grads_tm, _, _ = gm.grad_fn()(params, batch, rng)
+    monkeypatch.setenv("PADDLE_TPU_PALLAS_FLAT", "1")
+    loss_fl, grads_fl, _, _ = gm.grad_fn()(params, batch, rng)
+    np.testing.assert_allclose(float(loss_fl), float(loss_tm),
+                               rtol=1e-6, atol=1e-7)
+    for k in grads_tm:
+        np.testing.assert_allclose(
+            np.asarray(grads_fl[k], np.float32),
+            np.asarray(grads_tm[k], np.float32),
+            rtol=1e-5, atol=1e-6, err_msg=k,
+        )
+
+
+def test_reversed_gru_flat_parity(monkeypatch):
+    """cfg.reversed flips axis 1 in flat mode — pin against time-major."""
+    monkeypatch.setenv("PADDLE_TPU_PALLAS_INTERPRET", "1")
+    import textwrap
+
+    from paddle_tpu.config import parse_config
+    from paddle_tpu.graph import GradientMachine, make_seq
+
+    # shapes must PASS the kernel gate (H % 128 == 0, B % 8 == 0) or
+    # both runs silently take the scan fallback and the test is vacuous
+    src = textwrap.dedent("""
+    from paddle_tpu.trainer_config_helpers import *
+
+    settings(batch_size=8, learning_rate=1e-3, pallas_rnn=True)
+    x = data_layer(name="x", size=384)
+    g = simple_gru(input=x, size=128, reverse=True)
+    last = first_seq(input=g)
+    lbl = data_layer(name="y", size=2)
+    fc = fc_layer(input=last, size=2, act=SoftmaxActivation())
+    outputs(classification_cost(name="cost", input=fc, label=lbl))
+    """)
+    import tempfile, os as _os
+
+    with tempfile.TemporaryDirectory() as td:
+        pth = _os.path.join(td, "cfg.py")
+        with open(pth, "w") as f:
+            f.write(src)
+        tc = parse_config(pth)
+    gm = GradientMachine(tc.model_config, pallas_rnn=True)
+    params = gm.init_params(seed=3)
+    rng_np = np.random.RandomState(1)
+    B = 8
+    onehot = np.zeros((B, 2), np.float32)
+    onehot[np.arange(B), rng_np.randint(0, 2, B)] = 1.0
+    lengths = np.array([6, 4, 5, 6, 6, 3, 6, 2], np.int32)
+    from paddle_tpu.graph import make_dense
+
+    batch = {
+        "x": make_seq(rng_np.randn(B, 6, 384).astype(np.float32), lengths),
+        "y": make_dense(onehot),
+    }
+    rng = jax.random.PRNGKey(0)
+    # engagement: the fused path must actually run (monkeypatch-spy the
+    # layer wrapper, same pattern as tests/test_pallas_gru.py)
+    from paddle_tpu.ops import pallas_gru as pg
+
+    calls = {"n": 0, "flat": 0}
+    orig = pg.gru_layer_forward
+
+    def spy(cfg, x, mask, w, bias, interpret, x_bt=None):
+        calls["n"] += 1
+        calls["flat"] += int(x_bt is not None)
+        return orig(cfg, x, mask, w, bias, interpret, x_bt=x_bt)
+
+    monkeypatch.setattr(pg, "gru_layer_forward", spy)
+    monkeypatch.delenv("PADDLE_TPU_PALLAS_FLAT", raising=False)
+    loss_tm, grads_tm, _, _ = gm.grad_fn()(params, batch, rng)
+    assert calls["n"] > 0, "fused GRU path did not engage"
+    monkeypatch.setenv("PADDLE_TPU_PALLAS_FLAT", "1")
+    loss_fl, grads_fl, _, _ = gm.grad_fn()(params, batch, rng)
+    assert calls["flat"] > 0, "flat interface did not engage"
+    np.testing.assert_allclose(float(loss_fl), float(loss_tm),
+                               rtol=1e-6, atol=1e-7)
+    for k in grads_tm:
+        np.testing.assert_allclose(
+            np.asarray(grads_fl[k], np.float32),
+            np.asarray(grads_tm[k], np.float32),
+            rtol=1e-5, atol=1e-6, err_msg=k,
+        )
