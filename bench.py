@@ -84,6 +84,8 @@ def _leg_extras(spl=1, rnn_leg=False, **kw):
         kw["conv_s2d"] = True
     if rnn_leg and _pallas_decoder_on():
         kw["pallas_decoder"] = True
+    if rnn_leg and os.environ.get("PADDLE_TPU_PALLAS_FLAT") == "1":
+        kw["pallas_flat"] = True
     return kw
 
 
