@@ -107,31 +107,79 @@ def lstm_cell_step(
 
 def _pallas_rnn_path(ctx, cfg, a, x, mask, w, bias, usable_fn, fwd_fn):
     """The fused Pallas kernel path shared by lstmemory/gated_recurrent,
-    or None to take the scan. Gating: single-device TPU only (inside a
-    GSPMD-sharded jit the pallas custom call has no partitioning rule;
-    non-TPU backends would run the Python interpreter — tests force it
-    via PADDLE_TPU_PALLAS_INTERPRET=1, production falls back to the
-    scan); shapes/activations/VMEM checked by the kernel's usable().
+    or None to take the scan. Gating: TPU backend (non-TPU would run the
+    Python interpreter — tests force it via PADDLE_TPU_PALLAS_INTERPRET=1,
+    production falls back to the scan); shapes/activations/VMEM checked
+    by the kernel's usable(). Meshes: single-device, or a purely
+    data-parallel mesh — there the kernel runs per-shard under shard_map
+    (each shard's batch rows are independent sequences); any non-trivial
+    model/seq axis falls back to the scan, whose ops GSPMD can partition.
     Callers guard on ctx.pallas_rnn BEFORE importing the kernel module,
     keeping the ops import lazy on the default path."""
-    if ctx.mesh is not None:
-        return None
     import os
 
+    data_extent = None
+    T, B = mask.shape
+    if ctx.mesh is not None:
+        from paddle_tpu.parallel.mesh import data_only_extent
+
+        data_extent = data_only_extent(ctx.mesh)
+        if data_extent is None or B % data_extent:
+            return None
     on_tpu = jax.default_backend() == "tpu"
     force_interpret = os.environ.get("PADDLE_TPU_PALLAS_INTERPRET") == "1"
-    if not (on_tpu or force_interpret) or not usable_fn(cfg, x):
+    if not (on_tpu or force_interpret):
+        return None
+    if data_extent is not None:
+        # gate on the PER-SHARD batch the kernel will actually see
+        local = jax.ShapeDtypeStruct((T, B // data_extent, x.shape[2]), x.dtype)
+        if not usable_fn(cfg, local):
+            return None
+    elif not usable_fn(cfg, x):
         return None
     # PADDLE_TPU_PALLAS_FLAT=1: the transpose-free interface — the
     # kernel reads the projection output's batch-major value through a
     # free [B, T*width] reshape instead of a materialized time-major
     # swap (A/B knob; flip the default only on a measured win)
-    x_bt = a.value if os.environ.get("PADDLE_TPU_PALLAS_FLAT") == "1" else None
+    flat = os.environ.get("PADDLE_TPU_PALLAS_FLAT") == "1"
+    x_bt = a.value if flat else None
     # the env flag wins even on TPU so a compiled-kernel discrepancy can
     # be A/B'd in interpret mode on the device where it manifests (off
     # TPU the guard above already required the flag)
-    ys = fwd_fn(cfg, x, mask, w, bias, interpret=force_interpret, x_bt=x_bt)
-    value = ys if x_bt is not None else jnp.swapaxes(ys, 0, 1)
+    if data_extent is None:
+        ys = fwd_fn(cfg, x, mask, w, bias, interpret=force_interpret, x_bt=x_bt)
+    else:
+        from jax.sharding import PartitionSpec as P
+
+        try:
+            from jax import shard_map
+        except ImportError:  # older jax
+            from jax.experimental.shard_map import shard_map
+
+        def shard_fn(xin, mask_l, *wb):
+            w_l = wb[0]
+            bias_l = wb[1] if len(wb) > 1 else None
+            return fwd_fn(cfg, xin, mask_l, w_l, bias_l,
+                          interpret=force_interpret,
+                          x_bt=xin if flat else None)
+
+        x_spec = P("data") if flat else P(None, "data")
+        y_spec = P("data") if flat else P(None, "data")
+        wb_args = (w,) if bias is None else (w, bias)
+        wb_specs = tuple(P(*(None,) * v.ndim) for v in wb_args)
+        # check_vma=False: pallas_call out_shapes carry no varying-mesh-
+        # axes annotation, which the new shard_map type system would
+        # otherwise reject; the specs above state the sharding exactly.
+        # Older jax (experimental.shard_map) spells the kwarg check_rep.
+        args = (x_bt if flat else x, mask) + wb_args
+        specs = dict(mesh=ctx.mesh,
+                     in_specs=(x_spec, P(None, "data")) + wb_specs,
+                     out_specs=y_spec)
+        try:
+            ys = shard_map(shard_fn, check_vma=False, **specs)(*args)
+        except TypeError:
+            ys = shard_map(shard_fn, check_rep=False, **specs)(*args)
+    value = ys if flat else jnp.swapaxes(ys, 0, 1)
     return Argument(value=value, seq_lengths=a.seq_lengths)
 
 

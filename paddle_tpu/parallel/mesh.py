@@ -77,6 +77,20 @@ def make_mesh(spec: str = "", devices: Optional[list] = None) -> Mesh:
     return Mesh(dev, ms.names)
 
 
+def data_only_extent(mesh: Mesh):
+    """The data-parallel extent if every OTHER mesh axis is trivial
+    (extent 1), else None. Used to gate per-shard shard_map execution of
+    the pallas kernels (layers/recurrent.py) — the same purely-data
+    question local_sgd.check_data_only asks."""
+    d = 1
+    for n, e in mesh.shape.items():
+        if n == "data":
+            d = e
+        elif e > 1:
+            return None
+    return d if d > 1 else None
+
+
 def data_axis_names(mesh: Mesh) -> Tuple[str, ...]:
     """Axes that shard the batch dimension (data and expert act as data
     parallel for the dense path)."""
