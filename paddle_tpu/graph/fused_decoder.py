@@ -51,8 +51,16 @@ def match_decoder(network, sub, ctx, statics, skip, pro_plan) -> Optional[Dict[s
     """Returns the extraction plan, or None when the group is not the
     attention-GRU decoder template (every bail is silent — the scan path
     is always a correct fallback)."""
-    if not ctx.is_training or ctx.mesh is not None or sub.reversed:
+    if not ctx.is_training or sub.reversed:
         return None
+    if ctx.mesh is not None:
+        from paddle_tpu.parallel.mesh import data_only_extent
+
+        # a pallas custom call has no GSPMD partitioning rule; under a
+        # purely data-parallel mesh the decoder runs per-shard via
+        # shard_map (run_fused_decoder) — anything else takes the scan
+        if data_only_extent(ctx.mesh) is None:
+            return None
     on_tpu = jax.default_backend() == "tpu"
     force_interpret = os.environ.get("PADDLE_TPU_PALLAS_INTERPRET") == "1"
     if not (on_tpu or force_interpret):
@@ -207,9 +215,18 @@ def run_fused_decoder(network, sub, ctx, statics, plan, pro_feeds,
     if dtype not in (jnp.float32, jnp.bfloat16):
         return None
     interpret = os.environ.get("PADDLE_TPU_PALLAS_INTERPRET") == "1"
+    data_extent = None
+    if ctx.mesh is not None:
+        from paddle_tpu.parallel.mesh import data_only_extent
+
+        data_extent = data_only_extent(ctx.mesh)
+        if data_extent is None or B % data_extent:
+            return None
+    B_local = B // (data_extent or 1)
     # the lane-alignment/VMEM gate is a Mosaic-compile constraint; the
     # interpreter (CPU parity tests) takes any shape
-    if not interpret and not pag.supported(B, Te, D, E, jnp.dtype(dtype).itemsize):
+    if not interpret and not pag.supported(B_local, Te, D, E,
+                                           jnp.dtype(dtype).itemsize):
         return None
 
     wa = ctx.param(plan["tr_ic"].input_parameter_name).reshape(D, D)
@@ -234,8 +251,24 @@ def run_fused_decoder(network, sub, ctx, statics, plan, pro_feeds,
     dmask = jnp.swapaxes(mask_bt, 0, 1)[:, :, None].astype(dtype)
     h0 = boot_carry.astype(dtype)
 
-    return pag.fused_attention_gru(
-        ep, ev, em, xw.astype(dtype), dmask, h0,
-        wa, ba.astype(wa.dtype), v.reshape(1, D), wctx, wg,
-        ("tanh", "sigmoid"), interpret,
-    )
+    operands = (ep, ev, em, xw.astype(dtype), dmask, h0,
+                wa, ba.astype(wa.dtype), v.reshape(1, D), wctx, wg)
+    if data_extent is None:
+        return pag.fused_attention_gru(*operands, ("tanh", "sigmoid"),
+                                       interpret)
+    # purely data-parallel mesh: per-shard execution (each shard's batch
+    # rows are independent decodes); weights replicated, batch dims
+    # sharded (the version-compat lives in parallel/mesh.py).
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu.parallel.mesh import replicated_specs, shard_map_compat
+
+    def shard_fn(ep_l, ev_l, em_l, xw_l, dm_l, h0_l, *ws):
+        return pag.fused_attention_gru(ep_l, ev_l, em_l, xw_l, dm_l, h0_l,
+                                       *ws, ("tanh", "sigmoid"), interpret)
+
+    seq_spec = P(None, "data")
+    in_specs = (seq_spec,) * 5 + (P("data"),) + replicated_specs(*operands[6:])
+    return shard_map_compat(
+        shard_fn, ctx.mesh, in_specs=in_specs, out_specs=seq_spec
+    )(*operands)

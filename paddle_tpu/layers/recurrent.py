@@ -151,10 +151,7 @@ def _pallas_rnn_path(ctx, cfg, a, x, mask, w, bias, usable_fn, fwd_fn):
     else:
         from jax.sharding import PartitionSpec as P
 
-        try:
-            from jax import shard_map
-        except ImportError:  # older jax
-            from jax.experimental.shard_map import shard_map
+        from paddle_tpu.parallel.mesh import replicated_specs, shard_map_compat
 
         def shard_fn(xin, mask_l, *wb):
             w_l = wb[0]
@@ -164,21 +161,12 @@ def _pallas_rnn_path(ctx, cfg, a, x, mask, w, bias, usable_fn, fwd_fn):
                           x_bt=xin if flat else None)
 
         x_spec = P("data") if flat else P(None, "data")
-        y_spec = P("data") if flat else P(None, "data")
         wb_args = (w,) if bias is None else (w, bias)
-        wb_specs = tuple(P(*(None,) * v.ndim) for v in wb_args)
-        # check_vma=False: pallas_call out_shapes carry no varying-mesh-
-        # axes annotation, which the new shard_map type system would
-        # otherwise reject; the specs above state the sharding exactly.
-        # Older jax (experimental.shard_map) spells the kwarg check_rep.
-        args = (x_bt if flat else x, mask) + wb_args
-        specs = dict(mesh=ctx.mesh,
-                     in_specs=(x_spec, P(None, "data")) + wb_specs,
-                     out_specs=y_spec)
-        try:
-            ys = shard_map(shard_fn, check_vma=False, **specs)(*args)
-        except TypeError:
-            ys = shard_map(shard_fn, check_rep=False, **specs)(*args)
+        ys = shard_map_compat(
+            shard_fn, ctx.mesh,
+            in_specs=(x_spec, P(None, "data")) + replicated_specs(*wb_args),
+            out_specs=x_spec,  # ys shards on batch exactly like x
+        )(x_bt if flat else x, mask, *wb_args)
     value = ys if flat else jnp.swapaxes(ys, 0, 1)
     return Argument(value=value, seq_lengths=a.seq_lengths)
 

@@ -10,6 +10,7 @@ LSTM flagship and the NMT encoder.
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 import paddle_tpu.graph  # noqa: F401
 from paddle_tpu.ops.pallas_gru import fused_gru
@@ -185,9 +186,6 @@ def test_reversed_gru_flat_parity(monkeypatch):
             np.asarray(grads_tm[k], np.float32),
             rtol=1e-5, atol=1e-6, err_msg=k,
         )
-
-
-import pytest
 
 
 @pytest.mark.parametrize("flat", [False, True])
