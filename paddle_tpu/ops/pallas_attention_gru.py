@@ -31,12 +31,14 @@ layers/sequence.py sequence pooling, layers/recurrent.py gru_cell_step
 
 The frontier output stream is the RAW h_new (matching the scan path,
 which masks only the carry and the out-link; the hoisted epilogue masks
-at the end). Backward is a reverse-grid kernel: dW_gru/dW_att/dv/db_att
-and d_enc_proj accumulate in VMEM f32; d_enc_vec and dW_ctx are
-reconstructed OUTSIDE from the streamed (alpha, d_ctx) and
-(ctx, d_din) pairs as large time-parallel matmuls — keeping the
-backward kernel inside the 14MB VMEM budget (the measured ceiling
-discipline from ops/pallas_lstm.py).
+at the end). Backward is a reverse-grid kernel: dW_att/dv/db_att and
+d_enc_proj accumulate in VMEM f32; dW_gru, dW_ctx and d_enc_vec are
+reconstructed OUTSIDE from the streamed (h_prev, r, d_din),
+(ctx, d_din) and (alpha, d_ctx) pairs as large time-parallel matmuls —
+keeping the backward kernel inside the 14MB VMEM budget (the measured
+ceiling discipline from ops/pallas_lstm.py) and the sequential critical
+path free of weight-gradient dots. Forward and backward size their
+batch blocks independently (fwd bb=64 / bwd bb=32 at flagship shapes).
 
 Correctness: interpret-mode parity vs the unfused recurrent-group scan
 in tests/test_fused_decoder.py. Enabled via
@@ -62,29 +64,46 @@ _VMEM_BUDGET_BYTES = (
 )
 
 
-def _pick_bb(B: int, Te: int = 0, D: int = 0, E: int = 0,
-             itemsize: int = 2) -> int | None:
-    """Largest batch block that divides B AND keeps the backward kernel
-    under the VMEM budget (when shape arguments are given)."""
+def _pick_bb(B: int, vmem_fn=None) -> int | None:
+    """Largest batch block that divides B AND keeps the calling kernel
+    under the VMEM budget (``vmem_fn(bb) -> bytes``). Forward and
+    backward pick INDEPENDENTLY — they communicate only through
+    [Td,B,*]/[Te,B,*] HBM streams, and the forward is ~2x lighter (no
+    dW/d_enc accumulators), so it gets larger, better-MXU-filling row
+    blocks (bb=64 vs the backward's 32 at flagship shapes)."""
     for bb in (64, 32, 16, 8):
         if B % bb != 0:
             continue
-        if D and _vmem_bytes(bb, Te, D, E, itemsize) >= _VMEM_BUDGET_BYTES:
+        if vmem_fn is not None and vmem_fn(bb) >= _VMEM_BUDGET_BYTES:
             continue
         return bb
-    if B < 8 and (not D or _vmem_bytes(B, Te, D, E, itemsize) < _VMEM_BUDGET_BYTES):
+    if B < 8 and (vmem_fn is None or vmem_fn(B) < _VMEM_BUDGET_BYTES):
         return B
     return None
 
 
-def _vmem_bytes(bb: int, Te: int, D: int, E: int, itemsize: int) -> int:
-    """Backward kernel residency (the binding case)."""
-    enc_in = Te * bb * (D + E) * itemsize          # ep + ev blocks
-    w_in = (D * D + E * 3 * D + D * 3 * D) * itemsize
-    dw_acc = (D * D + D * 3 * D) * 4               # dW_att + dW_gru f32
+def _vmem_fwd(bb: int, Te: int, D: int, E: int, itemsize: int,
+              residuals: bool = True) -> int:
+    enc_in = Te * bb * (D + E + 1) * itemsize      # ep + ev + emask blocks
+    w_in = (D * D + E * 3 * D + D * 3 * D + 2 * D) * itemsize
+    step_widths = 3 * D + 1 + D                    # xw + dmask + ys
+    if residuals:
+        step_widths += D + 3 * D + Te + E          # h_prev, acts, alpha, ctx
+    steps = 2 * bb * step_widths * itemsize
+    scr = bb * D * 4
+    return enc_in + w_in + steps + scr
+
+
+def _vmem_bwd(bb: int, Te: int, D: int, E: int, itemsize: int) -> int:
+    """dW_gru/dW_ctx/d_enc_vec live OUTSIDE the kernel (rebuilt from the
+    streamed pairs); in-kernel f32 accumulators are dW_att, db_att, dv
+    and the d_enc_proj block."""
+    enc_in = Te * bb * (D + E + 1) * itemsize
+    w_in = (D * D + E * 3 * D + D * 3 * D + 2 * D) * itemsize
+    dw_acc = (D * D + 2 * D) * 4                   # dW_att + db_att + dv f32
     dep_acc = Te * bb * D * 4                      # d_enc_proj f32
-    steps = 2 * bb * (3 * D + 3 * D + E + D + D + Te) * itemsize  # dbl-buffered streams
-    scr = bb * D * 4 + 2 * D * 4
+    steps = 2 * bb * (D + 1 + D + 3 * D + Te + 3 * D + E) * itemsize
+    scr = bb * D * 4
     return enc_in + w_in + dw_acc + dep_acc + steps + scr
 
 
@@ -93,7 +112,8 @@ def supported(B: int, Te: int, D: int, E: int, itemsize: int = 2) -> bool:
         return False
     if D % 128 != 0 or E % 128 != 0:
         return False
-    return _pick_bb(B, Te, D, E, itemsize) is not None
+    bwd = lambda bb: _vmem_bwd(bb, Te, D, E, itemsize)
+    return _pick_bb(B, bwd) is not None
 
 
 # --------------------------------------------------------------- forward
@@ -170,7 +190,9 @@ def _run_fwd(ep, ev, em, xw, dmask, h0, wa, ba, v, wctx, wg,
     Td = xw.shape[0]
     # interpret mode (CPU parity tests) takes any shape: fall back to a
     # single whole-batch block when no hardware block fits
-    bb = _pick_bb(B, Te, D, E, ep.dtype.itemsize) or (B if interpret else None)
+    bb = _pick_bb(
+        B, lambda n: _vmem_fwd(n, Te, D, E, ep.dtype.itemsize, residuals)
+    ) or (B if interpret else None)
     assert bb is not None, (B, Te, D, E)  # callers gate on supported()
     enc3 = lambda width: pl.BlockSpec((Te, bb, width), lambda b, t: (0, b, 0))
     step = lambda width: pl.BlockSpec((1, bb, width), lambda b, t: (t, b, 0))
@@ -229,7 +251,7 @@ def _bwd_kernel(dy_ref, ep_ref, ev_ref, em_ref, dm_ref,
                 hprev_ref, acts_ref, alpha_ref,
                 wa_ref, ba_ref, v_ref, wctx_ref, wg_ref,
                 dxw_ref, dctx_ref, dh0_ref, dep_ref,
-                dwa_ref, dba_ref, dv_ref, dwg_ref,
+                dwa_ref, dba_ref, dv_ref,
                 dh_scr, *, act_in, act_gate, Te, D):
     b = pl.program_id(0)
     idx = pl.program_id(1)            # walks t = Td-1 .. 0 via index maps
@@ -247,7 +269,6 @@ def _bwd_kernel(dy_ref, ep_ref, ev_ref, em_ref, dm_ref,
         dwa_ref[...] = jnp.zeros_like(dwa_ref)
         dba_ref[...] = jnp.zeros_like(dba_ref)
         dv_ref[...] = jnp.zeros_like(dv_ref)
-        dwg_ref[...] = jnp.zeros_like(dwg_ref)
 
     h_prev = hprev_ref[0].astype(f32)                    # [bB, D]
     acts = acts_ref[0].astype(f32)
@@ -272,17 +293,10 @@ def _bwd_kernel(dy_ref, ep_ref, ev_ref, em_ref, dm_ref,
     dg = jnp.concatenate([dgu, dgr], axis=1)             # [bB, 2D]
     d_din = jnp.concatenate([dg, dcand], axis=1)         # [bB, 3D]
     dxw_ref[0] = d_din.astype(dxw_ref.dtype)
-
-    # GRU weight grads (VMEM accumulators)
-    dwg_ref[...] += jnp.concatenate(
-        [
-            jax.lax.dot_general(h_prev, dg, (((0,), (0,)), ((), ())),
-                                preferred_element_type=f32),
-            jax.lax.dot_general(r * h_prev, dcand, (((0,), (0,)), ((), ())),
-                                preferred_element_type=f32),
-        ],
-        axis=1,
-    )
+    # dW_gru is NOT accumulated here: it is rebuilt outside the kernel
+    # from the streamed (h_prev, r, d_din) as two time-parallel matmuls
+    # — saves 3MB of f32 VMEM (bb 16 -> 32 at flagship shapes) and two
+    # MXU dots from the sequential critical path
 
     # context projection: d_ctx in-kernel (needed for the attention
     # chain); dW_ctx reconstructed OUTSIDE from the (ctx, d_din) streams
@@ -338,7 +352,9 @@ def _run_bwd(dy, ep, ev, em, dmask, hprev, acts3, alphas,
     Te, B, D = ep.shape
     E = ev.shape[2]
     Td = dy.shape[0]
-    bb = _pick_bb(B, Te, D, E, ep.dtype.itemsize) or (B if interpret else None)
+    bb = _pick_bb(
+        B, lambda n: _vmem_bwd(n, Te, D, E, ep.dtype.itemsize)
+    ) or (B if interpret else None)
     assert bb is not None, (B, Te, D, E)  # callers gate on supported()
     enc3 = lambda width: pl.BlockSpec((Te, bb, width), lambda b, i: (0, b, 0))
     rev = lambda width: pl.BlockSpec((1, bb, width), lambda b, i: (Td - 1 - i, b, 0))
@@ -348,7 +364,7 @@ def _run_bwd(dy, ep, ev, em, dmask, hprev, acts3, alphas,
         _bwd_kernel, act_in=acts[0], act_gate=acts[1], Te=Te, D=D
     )
     f32 = jnp.float32
-    dxw, dctxs, dh0, dep, dwa, dba, dv, dwg = pl.pallas_call(
+    dxw, dctxs, dh0, dep, dwa, dba, dv = pl.pallas_call(
         kern,
         grid=(B // bb, Td),
         in_specs=[
@@ -364,7 +380,7 @@ def _run_bwd(dy, ep, ev, em, dmask, hprev, acts3, alphas,
             rev(E),                       # d_ctx stream
             bspec,                        # dh0
             enc3(D),                      # d_enc_proj (per b-block)
-            wspec(wa.shape), wspec(ba.shape), wspec(v.shape), wspec(wg.shape),
+            wspec(wa.shape), wspec(ba.shape), wspec(v.shape),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((Td, B, 3 * D), dy.dtype),
@@ -374,7 +390,6 @@ def _run_bwd(dy, ep, ev, em, dmask, hprev, acts3, alphas,
             jax.ShapeDtypeStruct(wa.shape, f32),
             jax.ShapeDtypeStruct(ba.shape, f32),
             jax.ShapeDtypeStruct(v.shape, f32),
-            jax.ShapeDtypeStruct(wg.shape, f32),
         ],
         scratch_shapes=[pltpu.VMEM((bb, D), jnp.float32)]
         if pltpu is not None
@@ -382,7 +397,7 @@ def _run_bwd(dy, ep, ev, em, dmask, hprev, acts3, alphas,
         interpret=interpret,
         compiler_params=_params(2),
     )(dy, ep, ev, em, dmask, hprev, acts3, alphas, wa, ba, v, wctx, wg)
-    return dxw, dctxs, dh0, dep, dwa, dba, dv, dwg
+    return dxw, dctxs, dh0, dep, dwa, dba, dv
 
 
 # ------------------------------------------------------------ public API
@@ -438,17 +453,32 @@ def _fused_bwd(acts, interpret, res, dy):
     Td, B = dy.shape[0], dy.shape[1]
     Te, D, E = ep.shape[0], ep.shape[2], ev.shape[2]
     kernel_flops.record(_flops(Td, B, Te, D, E, bwd=True))
-    dxw, dctxs, dh0, dep, dwa, dba, dv, dwg = _run_bwd(
+    dxw, dctxs, dh0, dep, dwa, dba, dv = _run_bwd(
         dy, ep, ev, em, dmask, hprev, acts3, alphas,
         wa, ba, v, wctx, wg, acts, interpret,
     )
     f32 = jnp.float32
-    # dW_ctx and d_enc_vec as large time-parallel contractions OUTSIDE
-    # the kernel (VMEM budget — see module docstring)
+    # dW_ctx, dW_gru and d_enc_vec as large time-parallel contractions
+    # OUTSIDE the kernel (VMEM budget — see module docstring)
     dwctx = jax.lax.dot_general(
         ctxs.reshape(-1, E), dxw.reshape(-1, 3 * D),
         (((0,), (0,)), ((), ())), preferred_element_type=f32,
     ).astype(wctx.dtype)
+    hp2 = hprev.reshape(-1, D)
+    dxw2 = dxw.reshape(-1, 3 * D)
+    r2 = acts3.reshape(-1, 3 * D)[:, D : 2 * D]
+    dwg = jnp.concatenate(
+        [
+            jax.lax.dot_general(hp2, dxw2[:, : 2 * D],
+                                (((0,), (0,)), ((), ())),
+                                preferred_element_type=f32),
+            jax.lax.dot_general((r2.astype(f32) * hp2.astype(f32)).astype(hp2.dtype),
+                                dxw2[:, 2 * D :],
+                                (((0,), (0,)), ((), ())),
+                                preferred_element_type=f32),
+        ],
+        axis=1,
+    )
     # d_ev[te, b, :] = sum_td alpha[td, b, te] * d_ctx[td, b, :]
     dev = jnp.einsum(
         "tbe,tbd->ebd", alphas.astype(f32), dctxs.astype(f32),
