@@ -53,6 +53,11 @@ PADDLE_TPU_BENCH_CONV_STATS=gram PADDLE_TPU_BENCH_RESNET_B=256 \
 echo "--- nmt fused-decoder A/B (pallas attention-GRU)" >> $OUT
 PADDLE_TPU_BENCH_PALLAS_DECODER=1 PADDLE_TPU_BENCH_BUDGET=900 \
   timeout 1000 python bench.py nmt >> $OUT 2>>$ERR
+# 1b2) composed candidate: decoder kernel + flat interface together
+#      (the default config if 1b and 1d individually win)
+echo "--- nmt fused-decoder + flat (composed)" >> $OUT
+PADDLE_TPU_BENCH_PALLAS_DECODER=1 PADDLE_TPU_PALLAS_FLAT=1 \
+  PADDLE_TPU_BENCH_BUDGET=900 timeout 1000 python bench.py nmt >> $OUT 2>>$ERR
 # 1c) headline: all three legs, bf16, trace captured (same-window
 #     control for the A/Bs above + the driver-facing composed numbers).
 #     The literal "headline" marker matters: append_results.py treats

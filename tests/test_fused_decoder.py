@@ -404,3 +404,51 @@ def test_fused_decoder_under_data_mesh(monkeypatch):
             np.asarray(p_sh[k], np.float32), np.asarray(p_ref[k], np.float32),
             rtol=2e-4, atol=2e-5, err_msg=k,
         )
+
+
+def test_all_pallas_knobs_composed(monkeypatch):
+    """pallas_rnn (encoder GRUs) + pallas_decoder + the flat interface
+    all on at once — the composed-defaults candidate the session
+    measures if the individual A/Bs win — must match the plain scan.
+    Shapes pass the GRU kernel gate (H%128, B%8) and BOTH kernel paths
+    assert engagement, so neither knob can vacuously scan-fall-back."""
+    monkeypatch.setenv("PADDLE_TPU_PALLAS_INTERPRET", "1")
+    monkeypatch.setenv("PADDLE_TPU_PALLAS_FLAT", "1")
+    from paddle_tpu.graph import GradientMachine
+    from paddle_tpu.ops import pallas_gru as pg
+
+    tc = _nmt_tc(dim=128, B=8)
+    batch = _nmt_batch(B=8)
+    rng = jax.random.PRNGKey(0)
+    gm0 = GradientMachine(tc.model_config)
+    params = gm0.init_params(seed=11)
+    loss0, grads0, _, _ = gm0.grad_fn()(params, batch, rng)
+
+    calls = {"dec": 0, "gru_flat": 0}
+    orig_dec = fd.run_fused_decoder
+    orig_gru = pg.gru_layer_forward
+
+    def spy_dec(*a, **kw):
+        out = orig_dec(*a, **kw)
+        calls["dec"] += int(out is not None)
+        return out
+
+    def spy_gru(cfg, x, mask, w, bias, interpret, x_bt=None):
+        calls["gru_flat"] += int(x_bt is not None)
+        return orig_gru(cfg, x, mask, w, bias, interpret, x_bt=x_bt)
+
+    monkeypatch.setattr(fd, "run_fused_decoder", spy_dec)
+    monkeypatch.setattr(pg, "gru_layer_forward", spy_gru)
+    gm1 = GradientMachine(tc.model_config, pallas_rnn=True,
+                          pallas_decoder=True)
+    loss1, grads1, _, _ = gm1.grad_fn()(params, batch, rng)
+    assert calls["dec"] > 0, "decoder kernel did not engage"
+    assert calls["gru_flat"] > 0, "flat GRU kernel did not engage"
+    np.testing.assert_allclose(float(loss1), float(loss0),
+                               rtol=1e-5, atol=1e-6)
+    for k in sorted(grads0):
+        np.testing.assert_allclose(
+            np.asarray(grads1[k], np.float32),
+            np.asarray(grads0[k], np.float32),
+            rtol=2e-4, atol=2e-5, err_msg=k,
+        )
