@@ -211,13 +211,26 @@ def _conv1x1_stats_forward(cfg: LayerConfig, inputs: List[Argument],
 
 def _gram_stats_gates(cfg: LayerConfig, ctx: LayerContext):
     """Gate for input-side Gram statistics: the shared fused-stats gate
-    plus N >= 2K. Unlike the pallas path this is pure XLA (any backend,
-    works under a mesh — the reduces shard like BN's own), and is only
-    worthwhile when the output is wider than the input: the colsum +
-    Gram passes read x twice vs the saved stats pass's one read of y,
-    so the gate is N >= 2K (resnet expand convs are N = 4K)."""
+    plus a stride-dependent width ratio (N >= 2K at stride 1, N >= 4K
+    strided — derivation at the check below). Unlike the pallas path
+    this is pure XLA (any backend, works under a mesh — the reduces
+    shard like BN's own), and only worthwhile when the output is
+    sufficiently wider than the input (resnet expand convs are N = 4K;
+    its stride-2 downsample projections are N = 2K and stay on the
+    direct path)."""
     in_cfg = _fused_stats_gates(cfg, ctx, allow_stride=True)
-    if in_cfg is None or cfg.num_filters < 2 * in_cfg.conv_conf.channels:
+    if in_cfg is None:
+        return None
+    cc = in_cfg.conv_conf
+    strided = cc.stride_y > 1 or cc.stride > 1
+    # break-even math: the stats-side reads x (or its ::s slice) TWICE
+    # vs the saved single read of y. Stride 1: 2*M*K vs M*N -> N >= 2K.
+    # Stride s: the slice has the SAME row count as y, so 2*M_out*K vs
+    # M_out*N breaks even at N = 2K exactly (resnet downsample
+    # projections are all N = 2K, plus strided reads waste cache lines)
+    # -> require N >= 4K so strided convs only engage at a clear win.
+    need = 4 if strided else 2
+    if cfg.num_filters < need * cc.channels:
         return None
     return in_cfg
 
@@ -231,7 +244,8 @@ def _publish_gram_stats(cfg: LayerConfig, ctx: LayerContext, x_nhwc: Array,
 
     exact algebra (associativity aside), so the BN stats pass never has
     to re-read y from HBM — it reads x twice (colsum + Gram) instead,
-    a win when N >= 2K and FREE when no batch_norm consumes the entry
+    a win at the _gram_stats_gates width ratios and FREE when no
+    batch_norm consumes the entry
     (XLA dead-code-eliminates the unused reduces). All plain jnp ops:
     autodiff composes the stats' gradient with the conv's naturally, and
     XLA keeps its own conv layouts — the measured failure mode of the
