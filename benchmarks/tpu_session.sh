@@ -43,7 +43,9 @@ mkdir -p benchmarks/traces
 #     resnet reduce bottleneck. (The "pallas" mode of the same knob is
 #     a measured end-to-end loser — layout copies — not re-run here.)
 echo "--- resnet conv-stats A/B (gram input-side BN stats)" >> $OUT
+mkdir -p benchmarks/traces_gram
 PADDLE_TPU_BENCH_CONV_STATS=gram PADDLE_TPU_BENCH_RESNET_B=256 \
+  PADDLE_TPU_BENCH_TRACE_DIR=$PWD/benchmarks/traces_gram \
   PADDLE_TPU_BENCH_BUDGET=900 timeout 1000 python bench.py resnet >> $OUT 2>$ERR
 # 1b) fused attention-GRU decoder A/B (ops/pallas_attention_gru): the
 #     whole decoder time loop in one pallas launch — the round-5 NMT
@@ -51,8 +53,10 @@ PADDLE_TPU_BENCH_CONV_STATS=gram PADDLE_TPU_BENCH_RESNET_B=256 \
 #     hardware compile; bench falls back to the scan on a Mosaic
 #     rejection, so the leg budget is safe either way.
 echo "--- nmt fused-decoder A/B (pallas attention-GRU)" >> $OUT
-PADDLE_TPU_BENCH_PALLAS_DECODER=1 PADDLE_TPU_BENCH_BUDGET=900 \
-  timeout 1000 python bench.py nmt >> $OUT 2>>$ERR
+mkdir -p benchmarks/traces_decoder
+PADDLE_TPU_BENCH_PALLAS_DECODER=1 PADDLE_TPU_BENCH_TRACE_LEG=nmt \
+  PADDLE_TPU_BENCH_TRACE_DIR=$PWD/benchmarks/traces_decoder \
+  PADDLE_TPU_BENCH_BUDGET=900 timeout 1000 python bench.py nmt >> $OUT 2>>$ERR
 # 1b2) composed candidate: decoder kernel + flat interface together
 #      (the default config if 1b and 1d individually win)
 echo "--- nmt fused-decoder + flat (composed)" >> $OUT
@@ -134,6 +138,10 @@ PADDLE_TPU_BENCH_BUDGET=900 timeout 1000 python bench.py gen >> $OUT 2>>$ERR
 # 6) trace summaries
 echo "--- trace summary (resnet)" >> $OUT
 python benchmarks/trace_summary.py benchmarks/traces 15 >> $OUT 2>>$ERR
+echo "--- trace summary (gram resnet)" >> $OUT
+python benchmarks/trace_summary.py benchmarks/traces_gram 15 >> $OUT 2>>$ERR
+echo "--- trace summary (fused-decoder nmt)" >> $OUT
+python benchmarks/trace_summary.py benchmarks/traces_decoder 15 >> $OUT 2>>$ERR
 for leg in lstm nmt; do
   echo "--- trace summary ($leg)" >> $OUT
   python benchmarks/trace_summary.py benchmarks/traces_$leg 15 >> $OUT 2>>$ERR
