@@ -111,8 +111,11 @@ class GradientMachine:
                 latest = ckpt.latest_pass(path)
                 assert latest is not None, f"no checkpoint under {path}"
                 path = os.path.join(path, ckpt.PASS_FMT % latest)
+            # fallback=False: an inference embedding asked for THIS
+            # checkpoint — never quarantine it or silently substitute an
+            # older pass (verification still fails loudly on corruption)
             self.params, _, _ = ckpt.load_checkpoint(
-                path, None, expected_params=self.params
+                path, None, expected_params=self.params, fallback=False
             )
         self._fwd_test = None
 

@@ -23,7 +23,8 @@ def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help", "help"):
         print(__doc__)
-        print("usage: paddle <train|test|gen|checkgrad|dump_config|merge_model|version> [--flags]")
+        print("usage: paddle <train|test|gen|checkgrad|dump_config|merge_model|"
+              "check-checkpoint|version> [--flags]")
         return 0
     cmd, rest = argv[0], argv[1:]
     if cmd == "version":
@@ -39,6 +40,8 @@ def main(argv=None) -> int:
         return _dump_config(rest)
     if cmd == "merge_model":
         return _merge_model(rest)
+    if cmd in ("check-checkpoint", "check_checkpoint"):
+        return _check_checkpoint(rest)
     print(f"unknown command {cmd!r}", file=sys.stderr)
     return 2
 
@@ -49,6 +52,11 @@ def _setup(rest):
     leftover = FLAGS.parse(rest)
     if leftover:
         print(f"warning: unrecognized flags {leftover}", file=sys.stderr)
+    if FLAGS.fault_spec:
+        # chaos drills: deterministic fault injection at the named sites
+        from paddle_tpu.resilience import faultinject
+
+        faultinject.configure(FLAGS.fault_spec, FLAGS.fault_seed)
     if not FLAGS.use_tpu:
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
     if FLAGS.coordinator_address:
@@ -122,9 +130,12 @@ def _test_saved_passes(trainer, flags) -> None:
                 time.sleep(5)
                 continue
             break
+        # fallback=False: this is a READ-side job, possibly polling a live
+        # trainer's save_dir — it must never quarantine (mutate) that dir
+        # or silently report pass-N metrics computed from pass-(N-1) params
         trainer.params, opt_state, _ = ckpt.load_checkpoint(
             path, trainer.opt_state, expected_params=trainer.params,
-            sharding_for=trainer.ckpt_sharding_for(),
+            sharding_for=trainer.ckpt_sharding_for(), fallback=False,
         )
         if opt_state is not None:
             trainer.opt_state = opt_state
@@ -136,6 +147,56 @@ def _dump_config(rest) -> int:
     flags, config = _setup(rest)
     print(config.to_json(indent=2))
     return 0
+
+
+def _check_checkpoint(rest) -> int:
+    """`paddle check-checkpoint <dir>` — offline manifest verification.
+
+    <dir> is one pass directory, or a save_dir whose pass-NNNNN children
+    are each verified. Exit 0 = everything restorable, 1 = problems.
+    Never mutates anything (quarantine is load_checkpoint's job)."""
+    from paddle_tpu.resilience.manifest import read_manifest
+    from paddle_tpu.trainer import checkpoint as ckpt
+
+    targets = [a for a in rest if not a.startswith("-")]
+    if len(targets) != 1:
+        print("usage: paddle check-checkpoint <pass-dir | save-dir>", file=sys.stderr)
+        return 2
+    root = targets[0]
+    if not os.path.isdir(root):
+        print(f"error: {root!r} is not a directory", file=sys.stderr)
+        return 2
+    if ckpt.has_params_tree(root):
+        dirs = [root]
+    else:
+        dirs = sorted(
+            os.path.join(root, d)
+            for d in os.listdir(root)
+            if ckpt._is_pass_dir_name(d)
+        )
+        if not dirs:
+            print(f"error: no pass dirs (or params tree) under {root!r}", file=sys.stderr)
+            return 2
+    bad = 0
+    for d in dirs:
+        problems = ckpt.verify_checkpoint(d)
+        manifest = read_manifest(d)
+        if problems:
+            bad += 1
+            print(f"CORRUPT  {d}")
+            for p in problems:
+                print(f"         - {p}")
+        elif manifest is None:
+            print(f"OK?      {d} (no MANIFEST.json — pre-resilience save, contents unverified)")
+        else:
+            print(f"OK       {d} ({len(manifest.get('files', {}))} files verified)")
+    quarantined = [
+        d for d in os.listdir(root)
+        if ckpt.CORRUPT_SUFFIX in d
+    ] if not ckpt.has_params_tree(root) else []
+    for q in sorted(quarantined):
+        print(f"QUARANTINED  {os.path.join(root, q)} (previously failed restore)")
+    return 1 if bad else 0
 
 
 def _merge_model(rest) -> int:

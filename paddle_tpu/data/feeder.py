@@ -20,6 +20,7 @@ import json
 import queue
 import random
 import threading
+import time
 from typing import Any, Dict, Iterator, List, Optional, Sequence
 
 import numpy as np
@@ -28,7 +29,10 @@ from paddle_tpu.graph.argument import Argument
 from paddle_tpu.data.provider import DataType, SequenceType
 from paddle_tpu.native import ptr
 from paddle_tpu.proto import DataConfig
+from paddle_tpu.resilience import BadSampleError, DataStallError
+from paddle_tpu.resilience.faultinject import fault_point
 from paddle_tpu.utils.logging import logger
+from paddle_tpu.utils.retry import RetryPolicy
 
 
 def bucket_length(n: int, multiple: int = 8) -> int:
@@ -318,10 +322,28 @@ class DataProvider:
         seed: int = 1,
         drop_last: bool = False,
         for_test: bool = False,
+        stall_timeout: Optional[float] = None,
+        max_bad_samples: Optional[int] = None,
+        retry: Optional[RetryPolicy] = None,
     ):
+        from paddle_tpu.utils.flags import FLAGS
+
         self.provider = provider_obj
         self.file_list = file_list
         self.batch_size = batch_size
+        # resilience knobs: explicit argument > global flag
+        self.stall_timeout = (
+            float(FLAGS.data_stall_timeout) if stall_timeout is None else float(stall_timeout)
+        )
+        self.max_bad_samples = (
+            int(FLAGS.max_bad_samples) if max_bad_samples is None else int(max_bad_samples)
+        )
+        self.retry = retry if retry is not None else RetryPolicy.from_flags(FLAGS)
+        self._bad_samples = 0
+        # sample-granular watchdog heartbeat (see _double_buffered): a
+        # provider legitimately spending minutes filling a big shuffle
+        # pool IS making progress and must not trip the stall timeout
+        self._progress = time.monotonic()
         init_kwargs = dict(provider_kwargs or {})
         # runtime-injected hook kwargs (reference PyDataProvider2 contract):
         # user args from the config take precedence if they collide
@@ -353,14 +375,80 @@ class DataProvider:
             return
         collect = [] if self._use_cache else None
         for fname in self.file_list:
-            for sample in self.provider.generator_fn(self.settings, fname):
+            for sample in self._iter_file(fname):
                 if not isinstance(sample, (list, tuple, dict)):
                     sample = [sample]
+                if self.max_bad_samples > 0 and not self._sample_ok(sample, fname):
+                    continue
                 if collect is not None:
                     collect.append(sample)
                 yield sample
         if collect is not None:
             self._cache = collect
+
+    def _iter_file(self, fname: str) -> Iterator[Any]:
+        """One file's samples through the shared RetryPolicy: a transient
+        error from the user generator (flaky shared FS, a remote source
+        hiccup) re-opens the generator and fast-forwards past the samples
+        already yielded. Exactly-once delivery holds for generators that
+        yield the same sequence on every open of the same file (true of
+        every provider in this repo — shuffling happens downstream in the
+        pool); a generator with INTERNAL nondeterministic order may
+        duplicate or drop samples across a retry. Fast-forwarding also
+        re-runs the generator's side effects from the start of the
+        file."""
+        yielded = 0
+        state = None
+        failed_at = -1
+        while True:
+            it = self.provider.generator_fn(self.settings, fname)
+            try:
+                skip = yielded
+                for sample in it:
+                    if skip > 0:
+                        skip -= 1
+                        # fast-forward IS progress: without a heartbeat a
+                        # long replay after a late-file retry would trip
+                        # the stall watchdog mid-recovery
+                        self._progress = time.monotonic()
+                        continue
+                    fault_point("provider.yield", info=fname)
+                    yield sample
+                    yielded += 1
+                return
+            except self.retry.retry_on as e:
+                # the attempt/deadline budget covers one failure BURST:
+                # successful progress since the last failure earns a fresh
+                # budget, so two isolated hiccups minutes apart on a huge
+                # file don't add up to "exhausted"
+                if state is None or yielded > failed_at:
+                    state = self.retry.begin(f"provider {self.provider.name}({fname})")
+                failed_at = yielded
+                state.retry(e)  # sleeps, or re-raises when exhausted
+
+    def _sample_ok(self, sample, fname: str) -> bool:
+        """Bounded bad-sample budget (``--max_bad_samples``): a sample
+        that cannot be assembled is skipped and logged instead of
+        poisoning its whole batch, up to the budget — then fail loudly.
+        Validation (a one-sample assembly) only runs when the budget is
+        enabled, so the default path pays nothing."""
+        try:
+            self.assembler.assemble([sample])
+            return True
+        except Exception as e:
+            self._bad_samples += 1
+            if self._bad_samples > self.max_bad_samples:
+                raise BadSampleError(
+                    f"provider {self.provider.name}: {self._bad_samples} malformed "
+                    f"samples exceeds --max_bad_samples={self.max_bad_samples} "
+                    f"(last, from {fname!r}: {e})"
+                ) from e
+            if self._bad_samples <= 5 or self._bad_samples % 100 == 0:
+                logger.warning(
+                    "skipping malformed sample %d/%d from %s: %s",
+                    self._bad_samples, self.max_bad_samples, fname, e,
+                )
+            return False
 
     def batches(self) -> Iterator[Dict[str, Argument]]:
         """One pass of batches (shuffled within the pool)."""
@@ -378,6 +466,7 @@ class DataProvider:
             pool_size = 10000 * max(1, self.batch_size // 128 + 1)
         pool: List = []
         for sample in samples:
+            self._progress = time.monotonic()  # heartbeat: per SAMPLE
             pool.append(sample)
             if len(pool) >= pool_size:
                 yield from self._drain(pool, final=False)
@@ -431,14 +520,27 @@ class DataProvider:
             pool.clear()
 
     def _double_buffered(self, it: Iterator) -> Iterator:
-        """Background-thread prefetch (DoubleBuffer analog)."""
+        """Background-thread prefetch (DoubleBuffer analog) with a
+        heartbeat watchdog.
+
+        A provider that blocks forever (dead NFS mount, a generator stuck
+        on a socket) used to hang the training loop inside ``q.get()`` —
+        which also blocked SIGTERM preemption handling, the worst possible
+        failure on a pod. Now the consumer polls with a timeout: when it
+        has waited ``stall_timeout`` seconds AND the worker produced no
+        item in that window, it raises a diagnosable DataStallError
+        (worker liveness, queue depth, stall age) instead of hanging.
+        0 disables the watchdog."""
         q: "queue.Queue" = queue.Queue(maxsize=4)
         sentinel = object()
         err: List[BaseException] = []
+        beat = [time.monotonic()]  # last time the worker pulled an item
 
         def worker():
             try:
                 for item in it:
+                    fault_point("provider.stall")
+                    beat[0] = time.monotonic()
                     q.put(item)
             except BaseException as e:  # propagate into the consumer
                 err.append(e)
@@ -447,8 +549,35 @@ class DataProvider:
 
         t = threading.Thread(target=worker, daemon=True, name="pt-data-prefetch")
         t.start()
+        timeout = self.stall_timeout
         while True:
-            item = q.get()
+            if timeout and timeout > 0:
+                wait_start = time.monotonic()
+                while True:
+                    try:
+                        item = q.get(timeout=min(timeout / 4.0, 1.0))
+                        break
+                    except queue.Empty:
+                        now = time.monotonic()
+                        # progress = a batch handed over (beat) OR a raw
+                        # sample pulled (self._progress): pool-filling
+                        # counts as progress, only true dead air trips
+                        last = max(beat[0], self._progress)
+                        if (now - wait_start >= timeout
+                                and now - last >= timeout):
+                            raise DataStallError(
+                                f"data pipeline stalled: no batch for "
+                                f"{now - wait_start:.1f}s (stall timeout "
+                                f"{timeout:g}s; provider "
+                                f"{getattr(self.provider, 'name', '?')}; "
+                                f"prefetch worker "
+                                f"{'alive' if t.is_alive() else 'dead'}, "
+                                f"last progress {now - last:.1f}s ago, "
+                                f"queue depth {q.qsize()}). Raise "
+                                f"--data_stall_timeout or fix the provider."
+                            )
+            else:
+                item = q.get()
             if item is sentinel:
                 break
             yield item
@@ -463,17 +592,28 @@ def create_data_provider(
     async_prefetch: bool = True,
     seed: int = 1,
     for_test: bool = False,
+    stall_timeout: Optional[float] = None,
+    max_bad_samples: Optional[int] = None,
+    retry: Optional[RetryPolicy] = None,
 ) -> DataProvider:
-    """Instantiate from a DataConfig (define_py_data_sources2 output)."""
+    """Instantiate from a DataConfig (define_py_data_sources2 output).
+
+    ``stall_timeout`` / ``max_bad_samples`` / ``retry`` override the
+    global flags (--data_stall_timeout / --max_bad_samples /
+    --io_retry_*) for this provider; None inherits them."""
     import importlib
     import os
     import sys
 
+    resilience_kw = dict(
+        stall_timeout=stall_timeout, max_bad_samples=max_bad_samples, retry=retry,
+    )
     if data_config.type == "multi":
         subs = [
             create_data_provider(
                 sub, batch_size, slot_names,
                 async_prefetch=False, seed=seed + i, for_test=for_test,
+                **resilience_kw,
             )
             for i, sub in enumerate(data_config.sub_data_configs)
         ]
@@ -497,6 +637,7 @@ def create_data_provider(
             async_prefetch=async_prefetch,
             seed=seed,
             for_test=for_test,
+            **resilience_kw,
         )
     assert data_config.type in ("py2", "py"), f"unsupported data type {data_config.type!r}"
     # the provider module conventionally sits next to the config / file
@@ -525,4 +666,5 @@ def create_data_provider(
         async_prefetch=async_prefetch,
         seed=seed,
         for_test=for_test,
+        **resilience_kw,
     )

@@ -19,6 +19,10 @@ Hyperparameters follow the reference settings (config_parser.py:2941-2947):
 ``max_backoff`` (line-search trials), ``owlqn_steps`` (history size),
 ``l1weight``/``l2weight`` (OWL-QN regularization; l1 drives the
 pseudo-gradient/orthant machinery, l2 folds into cost+gradient).
+
+Note: ``backoff`` here is the line search's NUMERICAL step-shrink
+factor, unrelated to failure handling — transient-I/O retry backoff
+lives in ``paddle_tpu.utils.retry.RetryPolicy`` (doc/resilience.md).
 """
 
 from __future__ import annotations

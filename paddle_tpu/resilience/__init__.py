@@ -1,0 +1,53 @@
+"""Fault-tolerance layer.
+
+The reference PaddlePaddle v0 is fail-fast only — its recovery story is
+"restart from the last pass directory and hope the files are intact"
+(SURVEY §5). At pod scale preemptions, transient shared-filesystem
+errors, and hung data providers are routine, so this package supplies
+the missing half: *surviving* the failure, not just noticing it.
+
+Pieces (see doc/resilience.md for the failure model):
+
+- ``manifest``  — per-file CRC32/size manifests (``MANIFEST.json``) that
+  make a checkpoint directory self-verifying; used by the atomic
+  write-rename protocol in ``trainer/checkpoint.py`` and the offline
+  ``paddle check-checkpoint`` subcommand.
+- ``faultinject`` — deterministic, seeded, site-named fault injection
+  (``checkpoint.write``, ``checkpoint.rename``, ``provider.yield``,
+  ``provider.stall``) so chaos tests exercise mid-write crashes, torn
+  renames, flaky providers, and stalls reproducibly.
+- errors below — typed failures the trainer and tools can act on.
+
+The shared backoff machinery lives in ``paddle_tpu.utils.retry``
+(checkpoint I/O and data-provider iteration both use it). The
+L-BFGS/OWL-QN line-search "backoff" in ``optimizer/batch_methods.py`` is
+a *numerical* step-shrink factor, not an I/O retry, and intentionally
+stays separate.
+"""
+
+from __future__ import annotations
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint directory failed manifest/completeness verification
+    and no fallback pass directory could be restored either."""
+
+    def __init__(self, message: str, problems=None):
+        super().__init__(message)
+        self.problems = list(problems or [])
+
+
+class DataStallError(RuntimeError):
+    """The data-pipeline watchdog saw no provider progress within the
+    configured stall timeout (``--data_stall_timeout``)."""
+
+
+class BadSampleError(RuntimeError):
+    """More malformed samples than ``--max_bad_samples`` allows."""
+
+
+__all__ = [
+    "CheckpointCorruptError",
+    "DataStallError",
+    "BadSampleError",
+]

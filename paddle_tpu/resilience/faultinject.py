@@ -1,0 +1,220 @@
+"""Deterministic, site-named fault injection.
+
+Production failure modes — a crash between checkpoint write and rename,
+a torn shard, a provider that throws EIO once, a prefetch worker that
+hangs — are impossible to reproduce on demand without help. This module
+plants named injection points at the few places those failures occur and
+fires them from a declarative spec, so chaos tests (and operators
+rehearsing recovery) get the exact same failure every run.
+
+Spec grammar (``--fault_spec`` / ``PADDLE_TPU_FAULTS``)::
+
+    spec    := entry (';' entry)*
+    entry   := site '=' action [':' arg] ['@' trigger]
+    action  := raise | oserror | exit | sleep
+    trigger := N      fire on the Nth hit of the site only (1-based)
+             | N+     fire on every hit >= N
+             | pP     fire with probability P per hit (seeded, so the
+                      decision sequence is a pure function of
+                      (seed, site) — reruns fail identically)
+
+Actions: ``raise`` raises FaultInjected (simulated crash the test can
+observe in-process); ``oserror`` raises OSError(EIO) (a *retryable*
+transient, exercises RetryPolicy); ``exit[:code]`` calls os._exit
+(a real mid-write kill — no atexit, no finally blocks, default code 3);
+``sleep[:secs]`` blocks the calling thread (stalls, default 3600).
+
+Examples::
+
+    checkpoint.rename=exit@1          # die between write and rename
+    provider.yield=oserror@3          # 3rd sample read throws EIO once
+    provider.stall=sleep:120@5        # prefetch worker hangs at item 5
+    checkpoint.write=oserror@p0.2     # 20% of file writes flake
+
+Instrumented sites: ``checkpoint.write`` (before each checkpoint file
+write), ``checkpoint.rename`` (before the tmp→final commit rename),
+``provider.yield`` (before each sample leaves a data provider),
+``provider.stall`` (inside the prefetch worker loop).
+
+Inactive cost is one global ``is None`` check per site hit.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import re
+import time
+import zlib
+from typing import Dict, List, Optional
+
+ENV_SPEC = "PADDLE_TPU_FAULTS"
+ENV_SEED = "PADDLE_TPU_FAULT_SEED"
+
+KNOWN_SITES = (
+    "checkpoint.write",
+    "checkpoint.rename",
+    "provider.yield",
+    "provider.stall",
+)
+
+
+class FaultInjected(RuntimeError):
+    """Raised by the ``raise`` action at an injection site."""
+
+    def __init__(self, site: str, hit: int, info: str = ""):
+        detail = f" ({info})" if info else ""
+        super().__init__(f"injected fault at {site!r} hit #{hit}{detail}")
+        self.site = site
+        self.hit = hit
+
+
+_ENTRY_RE = re.compile(
+    r"^(?P<site>[\w.]+)=(?P<action>raise|oserror|exit|sleep)"
+    r"(?::(?P<arg>[^@]+))?(?:@(?P<trigger>.+))?$"
+)
+
+
+class _Rule:
+    def __init__(self, site: str, action: str, arg: Optional[str], trigger: Optional[str]):
+        self.site = site
+        self.action = action
+        self.arg = arg
+        # trigger: ("nth", n) | ("from", n) | ("prob", p) | ("always",)
+        if trigger is None:
+            self.trigger = ("always",)
+        elif trigger.startswith("p"):
+            p = float(trigger[1:])
+            if not 0.0 <= p <= 1.0:
+                raise ValueError(f"probability {p} out of [0, 1]")
+            self.trigger = ("prob", p)
+        elif trigger.endswith("+"):
+            self.trigger = ("from", int(trigger[:-1]))
+        else:
+            self.trigger = ("nth", int(trigger))
+
+    def should_fire(self, hit: int, rng: random.Random) -> bool:
+        kind = self.trigger[0]
+        if kind == "always":
+            return True
+        if kind == "nth":
+            return hit == self.trigger[1]
+        if kind == "from":
+            return hit >= self.trigger[1]
+        # "prob": one seeded draw per hit — deterministic in (seed, site)
+        return rng.random() < self.trigger[1]
+
+    def fire(self, site: str, hit: int, info: str) -> None:
+        if self.action == "raise":
+            raise FaultInjected(site, hit, info)
+        if self.action == "oserror":
+            import errno
+
+            raise OSError(
+                errno.EIO, f"injected transient I/O error at {site!r} hit #{hit}"
+            )
+        if self.action == "exit":
+            code = int(self.arg) if self.arg else 3
+            # os._exit: no atexit, no finally — the honest simulation of
+            # a preemption landing mid-write
+            os._exit(code)
+        if self.action == "sleep":
+            time.sleep(float(self.arg) if self.arg else 3600.0)
+
+
+class FaultInjector:
+    """Parsed plan + per-site hit counters + seeded per-site rngs."""
+
+    def __init__(self, spec: str, seed: int = 0):
+        self.spec = spec
+        self.seed = seed
+        self.rules: Dict[str, List[_Rule]] = {}
+        self._hits: Dict[str, int] = {}
+        self._rngs: Dict[str, random.Random] = {}
+        for raw in spec.replace(",", ";").split(";"):
+            raw = raw.strip()
+            if not raw:
+                continue
+            m = _ENTRY_RE.match(raw)
+            if m is None:
+                raise ValueError(
+                    f"bad fault spec entry {raw!r} "
+                    "(want site=action[:arg][@trigger])"
+                )
+            rule = _Rule(m["site"], m["action"], m["arg"], m["trigger"])
+            if rule.site not in KNOWN_SITES:
+                # a typo'd site would otherwise parse fine and never fire,
+                # making a chaos drill "pass" without testing anything.
+                # Warn, don't raise: tests and future call sites may plant
+                # their own fault points.
+                import logging
+
+                logging.getLogger("paddle_tpu").warning(
+                    "fault spec names unknown site %r (known: %s) — it will "
+                    "only fire if something calls fault_point(%r)",
+                    rule.site, ", ".join(KNOWN_SITES), rule.site,
+                )
+            self.rules.setdefault(rule.site, []).append(rule)
+
+    def _rng(self, site: str) -> random.Random:
+        rng = self._rngs.get(site)
+        if rng is None:
+            rng = self._rngs[site] = random.Random(
+                (self.seed * 1000003) ^ zlib.crc32(site.encode())
+            )
+        return rng
+
+    def fire(self, site: str, info: str = "") -> None:
+        rules = self.rules.get(site)
+        if not rules:
+            return
+        hit = self._hits.get(site, 0) + 1
+        self._hits[site] = hit
+        rng = self._rng(site)
+        for rule in rules:
+            if rule.should_fire(hit, rng):
+                rule.fire(site, hit, info)
+
+    def hits(self, site: str) -> int:
+        return self._hits.get(site, 0)
+
+
+_injector: Optional[FaultInjector] = None
+_env_checked = False
+
+
+def configure(spec: str, seed: int = 0) -> Optional[FaultInjector]:
+    """Install (or with an empty spec, clear) the process-global plan."""
+    global _injector, _env_checked
+    _env_checked = True  # explicit configuration wins over the env var
+    _injector = FaultInjector(spec, seed) if spec else None
+    return _injector
+
+
+def _maybe_configure_from_env() -> None:
+    global _env_checked
+    _env_checked = True
+    spec = os.environ.get(ENV_SPEC, "")
+    if spec:
+        configure(spec, int(os.environ.get(ENV_SEED, "0") or 0))
+
+
+def fault_point(site: str, info: str = "") -> None:
+    """The hook planted at instrumented sites. No-op unless a plan
+    names this site."""
+    if not _env_checked:
+        _maybe_configure_from_env()
+    if _injector is not None:
+        _injector.fire(site, info)
+
+
+def is_active() -> bool:
+    if not _env_checked:
+        _maybe_configure_from_env()
+    return _injector is not None
+
+
+def current() -> Optional[FaultInjector]:
+    if not _env_checked:
+        _maybe_configure_from_env()
+    return _injector

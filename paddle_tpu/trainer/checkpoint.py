@@ -19,6 +19,19 @@ assumption orbax/GCS makes). Restore assembles each parameter from its
 shard records and re-shards onto the CURRENT mesh via
 ``jax.make_array_from_callback`` — a checkpoint written on one mesh
 layout loads onto any other, including single-host ↔ multi-host moves.
+
+DURABILITY (doc/resilience.md): a save writes into ``pass-%05d.tmp``,
+fsyncs every file, records a per-file CRC32/size manifest
+(``MANIFEST.json``), and only then renames the directory into place —
+the previous checkpoint (including an earlier save of the SAME pass) is
+never removed until the new one is durable, so a crash at any point
+leaves at least one restorable checkpoint. ``load_checkpoint`` verifies
+the manifest first and, on corruption or incompleteness, quarantines the
+bad directory (``*.corrupt``) and falls back to the newest earlier pass.
+File I/O retries transient OSErrors through the shared RetryPolicy
+(``--io_retry_*``). The reference's ParamUtil rewrote pass dirs in
+place, destroying the previous checkpoint on a mid-save crash — the
+exact gap SURVEY §5 flags.
 """
 
 from __future__ import annotations
@@ -26,16 +39,84 @@ from __future__ import annotations
 import json
 import os
 import shutil
-from typing import Any, Callable, Dict, Optional, Tuple
+import zipfile
+import zlib
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from paddle_tpu.optimizer.updater import UpdaterState
+from paddle_tpu.resilience import CheckpointCorruptError
+from paddle_tpu.resilience import manifest as ckpt_manifest
+from paddle_tpu.resilience.faultinject import fault_point
+from paddle_tpu.utils.flags import FLAGS
 from paddle_tpu.utils.logging import logger
+from paddle_tpu.utils.retry import RetryPolicy
 
 PASS_FMT = "pass-%05d"
+TMP_SUFFIX = ".tmp"
+CORRUPT_SUFFIX = ".corrupt"
+
+
+def _is_pass_dir_name(d: str) -> bool:
+    return d.startswith("pass-") and d[5:].isdigit()
+
+
+def _io_policy() -> RetryPolicy:
+    """Shared-FS writes/reads see transient errors at pod scale; all
+    checkpoint file I/O funnels through this one policy.
+
+    Deliberately built from the process-global FLAGS (not a trainer's
+    _Flags instance): this module also serves flag-less tools
+    (check-checkpoint, merge_model, torch2paddle) and deep helpers that
+    have no trainer in scope. Per-trainer ``--io_retry_*`` overrides DO
+    reach the data-provider retry (trainer._provider); a trainer wanting
+    different checkpoint-I/O retries sets the global FLAGS."""
+    return RetryPolicy.from_flags(FLAGS, name="checkpoint-io")
+
+
+def _fsync_dir(path: str) -> None:
+    """Make a directory entry durable (rename atomicity needs the parent
+    synced). Best-effort: not every filesystem supports dir fsync."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _write_file(path: str, writer: Callable, mode: str = "wb") -> None:
+    """One durable checkpoint file: fault site → write → flush → fsync,
+    the whole unit retried on transient OSError (a retry reopens the
+    file, so a partial first attempt is truncated away)."""
+
+    def once():
+        fault_point("checkpoint.write", info=os.path.basename(path))
+        with open(path, mode) as f:
+            writer(f)
+            f.flush()
+            os.fsync(f.fileno())
+
+    _io_policy().call(once, name=f"write {os.path.basename(path)}")
+
+
+def _durable_manifest(fn, *args, label: str):
+    """Manifest writes get the same treatment as every other checkpoint
+    file: the checkpoint.write fault site + the shared retry policy
+    (the fsync discipline lives inside manifest.py itself)."""
+
+    def once():
+        fault_point("checkpoint.write", info=label)
+        return fn(*args)
+
+    return _io_policy().call(once, name=f"write {label}")
 
 
 def _flatten(tree: Dict, prefix: str = "") -> Dict[str, Any]:
@@ -63,9 +144,10 @@ def _unflatten(flat: Dict[str, np.ndarray]) -> Dict:
     return out
 
 
-def _save_tree_sharded(path: str, base: str, flat: Dict[str, jax.Array]) -> None:
+def _save_tree_sharded(path: str, base: str, flat: Dict[str, jax.Array]) -> str:
     """Write this process's uniquely-owned shards of one tree + a partial
-    index. Called by EVERY process."""
+    index. Called by EVERY process. Returns the shard filename (the
+    caller manifests the files it wrote)."""
     pid = jax.process_index()
     shard_file = f"{base}.shard{pid:05d}.npz"
     pieces: Dict[str, np.ndarray] = {}
@@ -91,9 +173,15 @@ def _save_tree_sharded(path: str, base: str, flat: Dict[str, jax.Array]) -> None
             )
         if entry["shards"]:
             partial[name] = entry
-    np.savez(os.path.join(path, shard_file), **pieces)
-    with open(os.path.join(path, f"{base}.index.{pid:05d}.json"), "w") as f:
-        json.dump(partial, f)
+    _write_file(os.path.join(path, shard_file), lambda f: np.savez(f, **pieces))
+    # the partial index is transient (merged then deleted): durable write,
+    # but never manifested
+    _write_file(
+        os.path.join(path, f"{base}.index.{pid:05d}.json"),
+        lambda f: json.dump(partial, f),
+        mode="w",
+    )
+    return shard_file
 
 
 def _merge_tree_indexes(path: str, base: str) -> None:
@@ -114,8 +202,11 @@ def _merge_tree_indexes(path: str, base: str) -> None:
             else:
                 merged[name] = entry
         os.remove(os.path.join(path, fn))
-    with open(os.path.join(path, f"{base}.index.json"), "w") as f:
-        json.dump(merged, f)
+    _write_file(
+        os.path.join(path, f"{base}.index.json"),
+        lambda f: json.dump(merged, f),
+        mode="w",
+    )
 
 
 def _optimizer_trees(opt_state: UpdaterState) -> Dict[str, Dict]:
@@ -134,18 +225,30 @@ def save_checkpoint(
     opt_state: Optional[UpdaterState] = None,
     extra_meta: Optional[Dict[str, Any]] = None,
     keep: int = 3,
+    protect_pass: Optional[int] = None,
 ) -> str:
-    """Save one pass directory. In multi-process runs every process must
-    call this (collective); shards are written where they live instead of
-    materializing cross-host arrays on process 0."""
-    path = os.path.join(save_dir, PASS_FMT % pass_id)
+    """Save one pass directory, atomically. In multi-process runs every
+    process must call this (collective); shards are written where they
+    live instead of materializing cross-host arrays on process 0.
+
+    Protocol: everything is written into ``pass-%05d.tmp`` (fsynced),
+    a CRC32/size ``MANIFEST.json`` is recorded, then the tmp dir is
+    renamed into place. A pre-existing final dir for the same pass (a
+    periodic save followed by the pass-end save) is moved aside and
+    removed only AFTER the rename — at every instant at least one
+    complete checkpoint of this pass exists on disk. ``protect_pass``
+    exempts one pass (the one this run restored from) from rolling
+    deletion."""
+    final = os.path.join(save_dir, PASS_FMT % pass_id)
+    tmp = final + TMP_SUFFIX
     multihost = jax.process_count() > 1
     if jax.process_index() == 0:
-        # clear any previous contents: a re-save in the OTHER format would
-        # otherwise leave a stale <tree>.index.json that the loader prefers
-        # over the fresh .npz
-        shutil.rmtree(path, ignore_errors=True)
-        os.makedirs(path, exist_ok=True)
+        os.makedirs(save_dir, exist_ok=True)
+        # a stale .tmp here is a crashed previous attempt at this pass —
+        # garbage by definition (it never renamed); the FINAL dir stays
+        # untouched until the fresh write is durable
+        shutil.rmtree(tmp, ignore_errors=True)
+        os.makedirs(tmp)
     trees: Dict[str, Dict] = {"params": _flatten(params)}
     meta: Dict[str, Any] = {"pass_id": pass_id, "format_version": 2 if multihost else 1}
     if opt_state is not None:
@@ -165,38 +268,99 @@ def save_checkpoint(
     if multihost:
         from jax.experimental import multihost_utils
 
-        # everyone waits for mkdir, writes its shards, then process 0
-        # merges the partial indexes and finalizes meta
-        multihost_utils.sync_global_devices("ckpt_dir:" + path)
-        for base, flat in trees.items():
-            _save_tree_sharded(path, base, flat)
-        multihost_utils.sync_global_devices("ckpt_shards:" + path)
+        # everyone waits for mkdir, writes its shards + its slice of the
+        # manifest, then process 0 merges partial indexes and manifests,
+        # finalizes meta, and commits the rename
+        multihost_utils.sync_global_devices("ckpt_dir:" + tmp)
+        own_files = [_save_tree_sharded(tmp, base, flat) for base, flat in trees.items()]
+        pid = jax.process_index()
+        _durable_manifest(
+            ckpt_manifest.write_partial_manifest, tmp, pid, own_files,
+            label=f"MANIFEST.partial.{pid:05d}.json",
+        )
+        multihost_utils.sync_global_devices("ckpt_shards:" + tmp)
         if jax.process_index() == 0:
             for base in trees:
-                _merge_tree_indexes(path, base)
-            with open(os.path.join(path, "meta.json"), "w") as f:
-                json.dump(meta, f, indent=2)
-            _rotate(save_dir, keep)
-        multihost_utils.sync_global_devices("ckpt_done:" + path)
+                _merge_tree_indexes(tmp, base)
+            _write_file(
+                os.path.join(tmp, "meta.json"),
+                lambda f: json.dump(meta, f, indent=2),
+                mode="w",
+            )
+            _durable_manifest(
+                ckpt_manifest.merge_partial_manifests, tmp, label="MANIFEST.json"
+            )
+            _commit(tmp, final)
+            _rotate(save_dir, keep, protect=protect_pass)
+        multihost_utils.sync_global_devices("ckpt_done:" + final)
     else:
         for base, flat in trees.items():
-            np.savez(os.path.join(path, f"{base}.npz"), **flat)
-        with open(os.path.join(path, "meta.json"), "w") as f:
-            json.dump(meta, f, indent=2)
-        _rotate(save_dir, keep)
-    logger.info("saved checkpoint %s", path)
-    return path
+            _write_file(
+                os.path.join(tmp, f"{base}.npz"),
+                lambda f, _flat=flat: np.savez(f, **_flat),
+            )
+        _write_file(
+            os.path.join(tmp, "meta.json"),
+            lambda f: json.dump(meta, f, indent=2),
+            mode="w",
+        )
+        _durable_manifest(ckpt_manifest.write_manifest, tmp, label="MANIFEST.json")
+        _commit(tmp, final)
+        _rotate(save_dir, keep, protect=protect_pass)
+    logger.info("saved checkpoint %s", final)
+    return final
 
 
-def _rotate(save_dir: str, keep: int) -> None:
-    """Rolling deletion of old pass dirs (ParamUtil::deleteOldestPass)."""
+def _commit(tmp: str, final: str) -> None:
+    """Atomically publish a complete tmp dir as the final pass dir. A
+    crash before the rename leaves the old checkpoint untouched (plus a
+    stale .tmp that the next save's rotation sweeps); a crash after it
+    leaves the new checkpoint complete — there is no window in which
+    neither is restorable."""
+    _fsync_dir(tmp)
+    fault_point("checkpoint.rename", info=os.path.basename(final))
+    old = None
+    if os.path.lexists(final):
+        # re-save of the same pass id: POSIX cannot rename onto a
+        # non-empty dir, so move the old one aside and drop it only
+        # after the new dir is in place
+        old = final + ".old"
+        shutil.rmtree(old, ignore_errors=True)
+        os.rename(final, old)
+    os.rename(tmp, final)
+    _fsync_dir(os.path.dirname(final) or ".")
+    if old is not None:
+        shutil.rmtree(old, ignore_errors=True)
+
+
+def _rotate(save_dir: str, keep: int, protect: Optional[int] = None) -> None:
+    """Rolling deletion of old pass dirs (ParamUtil::deleteOldestPass).
+
+    Only completed ``pass-NNNNN`` dirs count toward the keep budget:
+    ``*.tmp`` and ``*.corrupt`` dirs are not restorable state, and
+    counting them would silently shrink the number of real checkpoints
+    retained. Stale ``*.tmp`` dirs (crashed writes — ours already
+    renamed) are swept outright; quarantined ``*.corrupt`` dirs are kept
+    for post-mortem. ``protect`` (the pass this run restored from) is
+    never rolled away: until a newer checkpoint proves itself loadable,
+    it is the only state known-good."""
+    names = os.listdir(save_dir)
+    for d in names:
+        # .tmp = crashed write; .old = crash inside _commit's two-rename
+        # window — both are litter once a newer save completed. The one
+        # exception: the .old of the protected pass, which may be the
+        # very dir this run restored from (torn-commit recovery).
+        if d.startswith("pass-") and (d.endswith(TMP_SUFFIX) or d.endswith(".old")):
+            if protect is not None and d == (PASS_FMT % protect) + ".old":
+                continue
+            shutil.rmtree(os.path.join(save_dir, d), ignore_errors=True)
     if keep <= 0:
         return
-    passes = sorted(
-        d for d in os.listdir(save_dir) if d.startswith("pass-") and d[5:].isdigit()
-    )
-    for d in passes[:-keep]:
-        shutil.rmtree(os.path.join(save_dir, d), ignore_errors=True)
+    passes = sorted(int(d[5:]) for d in names if _is_pass_dir_name(d))
+    for p in passes[:-keep]:
+        if protect is not None and p == protect:
+            continue
+        shutil.rmtree(os.path.join(save_dir, PASS_FMT % p), ignore_errors=True)
 
 
 def has_params_tree(path: str) -> bool:
@@ -210,9 +374,115 @@ def latest_pass(save_dir: str) -> Optional[int]:
     if not os.path.isdir(save_dir):
         return None
     passes = [
-        int(d[5:]) for d in os.listdir(save_dir) if d.startswith("pass-") and d[5:].isdigit()
+        int(d[5:]) for d in os.listdir(save_dir) if _is_pass_dir_name(d)
     ]
     return max(passes) if passes else None
+
+
+def verify_checkpoint(path: str) -> List[str]:
+    """Problems with one pass directory; empty list = restorable.
+
+    Checks completeness (a params tree is present — meta.json stays
+    optional, as in the loader) and, when a ``MANIFEST.json`` exists,
+    every manifested file's size and CRC32. Pre-manifest checkpoints
+    verify on completeness alone — old checkpoints must keep loading."""
+    if not os.path.isdir(path):
+        return [f"{path}: not a directory"]
+    problems: List[str] = []
+    if not has_params_tree(path):
+        problems.append("no params tree (params.npz / params.index.json)")
+    # the CRC pass reads every manifested byte — transient shared-FS read
+    # errors retry through the shared policy rather than condemning a
+    # good checkpoint
+    problems.extend(
+        _io_policy().call(ckpt_manifest.verify_dir, path, name=f"verify {path}")
+    )
+    return problems
+
+
+def find_restorable_checkpoint(save_dir: str) -> Optional[str]:
+    """Newest pass dir under ``save_dir`` that verifies clean, or None.
+
+    Read-only (corrupt candidates are logged and skipped, never
+    quarantined here — that is load_checkpoint's job); backs
+    ``--init_model_path=auto``."""
+    if not os.path.isdir(save_dir):
+        return None
+    passes = sorted(
+        (int(d[5:]) for d in os.listdir(save_dir) if _is_pass_dir_name(d)),
+        reverse=True,
+    )
+    for p in passes:
+        path = os.path.join(save_dir, PASS_FMT % p)
+        problems = verify_checkpoint(path)
+        if not problems:
+            return path
+        logger.warning(
+            "find_restorable_checkpoint: skipping %s: %s", path, "; ".join(problems)
+        )
+    # last resort: a crash exactly between _commit's two renames leaves
+    # the previous (fully durable, once-published) checkpoint as
+    # pass-NNNNN.old — restorable even though unpublished. Never .tmp:
+    # a tmp dir was never known complete+published as a whole.
+    olds = sorted(
+        (
+            d for d in os.listdir(save_dir)
+            if d.endswith(".old") and _is_pass_dir_name(d[: -len(".old")])
+        ),
+        reverse=True,
+    )
+    for d in olds:
+        path = os.path.join(save_dir, d)
+        if not verify_checkpoint(path):
+            logger.warning(
+                "find_restorable_checkpoint: recovering from torn commit "
+                "leftover %s", path,
+            )
+            return path
+    return None
+
+
+def _quarantine(path: str) -> Optional[str]:
+    """Rename a corrupt pass dir to ``*.corrupt`` (kept for post-mortem,
+    excluded from rotation budgets and restore scans). Returns the new
+    path, or None when quarantine was skipped (not a pass dir, already
+    gone, or a non-0 process in a multi-host run — one renamer only)."""
+    if not _is_pass_dir_name(os.path.basename(path)):
+        return None
+    if jax.process_count() > 1 and jax.process_index() != 0:
+        return None
+    dest = path + CORRUPT_SUFFIX
+    n = 1
+    while os.path.lexists(dest):
+        dest = f"{path}{CORRUPT_SUFFIX}{n}"
+        n += 1
+    try:
+        os.rename(path, dest)
+    except OSError as e:
+        logger.warning("could not quarantine %s: %s", path, e)
+        return None
+    logger.warning("quarantined corrupt checkpoint %s -> %s", path, dest)
+    return dest
+
+
+def _fallback_candidate(path: str) -> Optional[str]:
+    """The newest pass dir older than ``path`` in the same save_dir, or
+    None when ``path`` is not a pass dir / nothing older exists."""
+    base = os.path.basename(path)
+    if not _is_pass_dir_name(base):
+        return None
+    save_dir = os.path.dirname(path) or "."
+    bad_id = int(base[5:])
+    if not os.path.isdir(save_dir):
+        return None
+    older = [
+        int(d[5:])
+        for d in os.listdir(save_dir)
+        if _is_pass_dir_name(d) and int(d[5:]) < bad_id
+    ]
+    if not older:
+        return None
+    return os.path.join(save_dir, PASS_FMT % max(older))
 
 
 class _ShardedTreeReader:
@@ -315,8 +585,101 @@ def load_checkpoint(
     expected_params: Optional[Dict[str, jax.Array]] = None,
     sharding_for: Optional[Callable[[str, str, Any], Any]] = None,
     io_stats: Optional[Dict[str, int]] = None,
+    verify: bool = True,
+    fallback: bool = True,
 ) -> Tuple[Dict[str, jax.Array], Optional[UpdaterState], Dict[str, Any]]:
-    """Load params (+ optimizer state rebuilt onto ``opt_template``).
+    """Load params (+ optimizer state rebuilt onto ``opt_template``),
+    with verification and a fallback restore chain.
+
+    ``verify``: check completeness + the CRC32/size manifest before
+    deserializing anything. ``fallback``: when ``path`` is a
+    ``pass-NNNNN`` dir that fails verification, quarantine it
+    (``*.corrupt``) and retry with the newest earlier pass dir in the
+    same save_dir, logging exactly what was skipped and why; raises
+    CheckpointCorruptError only when no candidate survives. A mismatched
+    model (``missing='fail'`` KeyError) is a config error, not
+    corruption — it never triggers fallback.
+
+    A path that does not exist at all is a caller error (wrong
+    ``--start_pass``, a typo'd ``--init_model_path``) and raises
+    FileNotFoundError up front — fallback is for checkpoints that went
+    bad, never a license to silently substitute state the caller did
+    not ask for.
+
+    Multi-host: every process verifies the FULL manifest (an
+    N_hosts × checkpoint-size read amplification on restore — the known
+    cost of keeping verification collective-free; the optimization path
+    is verify-on-process-0 + broadcast) and walks the fallback chain
+    independently; only process 0 quarantines. Verification outcomes
+    depend on per-process I/O, so under concurrent corruption hosts CAN
+    diverge on the candidate — corrupt-restore on a pod is best-effort;
+    when a pod-wide restore reports corruption, run
+    ``paddle check-checkpoint`` and restart cleanly rather than relying
+    on per-host fallback. See the remaining parameters on
+    ``_load_checkpoint_once``."""
+    tried: List[str] = []
+    cur = os.path.normpath(path)
+    if not os.path.isdir(cur):
+        raise FileNotFoundError(f"checkpoint {cur} does not exist")
+    first = True
+    while True:
+        # verify=False covers only the FIRST candidate (the caller just
+        # CRC'd it, e.g. find_restorable_checkpoint); anything the
+        # fallback chain reaches is unvetted and must be verified here
+        problems = [] if (not verify and first) else verify_checkpoint(cur)
+        first = False
+        if not problems:
+            try:
+                return _load_checkpoint_once(
+                    cur, opt_template, missing, expected_params, sharding_for,
+                    io_stats,
+                )
+            except (
+                FileNotFoundError,
+                EOFError,
+                ValueError,
+                zipfile.BadZipFile,
+                zlib.error,
+            ) as e:
+                # corruption-shaped deserialization failures: no params
+                # tree, a file vanished between verify and read, or a
+                # torn/truncated archive in a PRE-MANIFEST checkpoint
+                # (np.load raises BadZipFile on truncation, zlib.error on
+                # corrupt members, ValueError/EOFError on garbage). But a
+                # checkpoint whose manifest just CRC-verified clean cannot
+                # be torn on disk — a ValueError there is a model/config
+                # mismatch (wrong shapes for this net), and quarantining
+                # good checkpoints over it would walk the whole chain into
+                # *.corrupt. Config errors propagate; only manifest-less
+                # dirs (and vanished files) enter the fallback chain here.
+                if not isinstance(e, FileNotFoundError) and (
+                    ckpt_manifest.read_manifest(cur) is not None
+                ):
+                    raise
+                problems = [f"load failed: {e}"]
+        detail = f"{cur}: {'; '.join(problems)}"
+        tried.append(detail)
+        logger.error("checkpoint failed verification: %s", detail)
+        nxt = _fallback_candidate(cur) if fallback else None
+        if fallback:
+            _quarantine(cur)
+        if nxt is None:
+            raise CheckpointCorruptError(
+                "no restorable checkpoint: " + " | ".join(tried), problems=tried
+            )
+        logger.warning("falling back to earlier checkpoint %s", nxt)
+        cur = nxt
+
+
+def _load_checkpoint_once(
+    path: str,
+    opt_template: Optional[UpdaterState] = None,
+    missing: str = "fail",
+    expected_params: Optional[Dict[str, jax.Array]] = None,
+    sharding_for: Optional[Callable[[str, str, Any], Any]] = None,
+    io_stats: Optional[Dict[str, int]] = None,
+) -> Tuple[Dict[str, jax.Array], Optional[UpdaterState], Dict[str, Any]]:
+    """Deserialize one (pre-verified) pass directory.
 
     ``missing``: fail | rand | zero — the reference's
     --load_missing_parameter_strategy; ``expected_params`` supplies shapes

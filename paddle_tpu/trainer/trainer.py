@@ -261,27 +261,93 @@ class Trainer:
         return checkpoint_sharding_fn(self._mesh, self.gm)
 
     def _maybe_restore(self) -> None:
+        self._restored_pass: Optional[int] = None
         init_path = self.flags.init_model_path or self.config.init_model_path
         sharding_for = self.ckpt_sharding_for()
+        pre_verified = False
+        if init_path == "auto":
+            # newest checkpoint under save_dir that passes manifest
+            # verification; a fresh run (nothing restorable) starts clean
+            init_path = (
+                ckpt.find_restorable_checkpoint(self.save_dir)
+                if self.save_dir else None
+            )
+            if init_path is None:
+                logger.info(
+                    "--init_model_path=auto: no restorable checkpoint under "
+                    "%r — starting fresh", self.save_dir,
+                )
+                return
+            pre_verified = True  # find_restorable just CRC'd this dir
         if init_path:
-            self.params, opt_state, _ = ckpt.load_checkpoint(
+            # fallback (quarantine + walk to an earlier pass) only within
+            # OUR OWN save_dir: an explicit init_model_path pointing at a
+            # foreign/pretrained model dir must fail loudly, never rename
+            # a shared directory or substitute weights the user did not
+            # ask for (same contract as api.py loadParameters)
+            own = bool(self.save_dir) and os.path.abspath(
+                os.path.dirname(os.path.normpath(init_path))
+            ) == os.path.abspath(self.save_dir)
+            self.params, opt_state, meta = ckpt.load_checkpoint(
                 init_path,
                 self.opt_state,
                 missing=self.flags.load_missing_parameter_strategy,
                 expected_params=self.params,
                 sharding_for=sharding_for,
+                # don't re-CRC a multi-GB checkpoint the auto scan just
+                # verified moments ago (fallback candidates, if the load
+                # has to walk to one, are still verified)
+                verify=not pre_verified,
+                fallback=pre_verified or own,
             )
             if opt_state is not None:
                 self.opt_state = opt_state
+            restored = self._note_restored(init_path, meta)
+            if pre_verified and restored is not None and self.start_pass == 0:
+                # auto-resume: continue pass numbering past the pass the
+                # load ACTUALLY restored (meta pass_id — the chain may
+                # have fallen back below the scanned candidate), the
+                # reference's restart-from-last-pass minus the "hope the
+                # files are intact" part
+                self.start_pass = restored + 1
+                logger.info(
+                    "--init_model_path=auto: resumed pass %d from %s "
+                    "(start_pass=%d)", restored, init_path, self.start_pass,
+                )
             return
         if self.start_pass > 0:
             path = os.path.join(self.save_dir, ckpt.PASS_FMT % (self.start_pass - 1))
-            self.params, opt_state, _ = ckpt.load_checkpoint(
+            self.params, opt_state, meta = ckpt.load_checkpoint(
                 path, self.opt_state, expected_params=self.params,
                 sharding_for=sharding_for,
             )
             if opt_state is not None:
                 self.opt_state = opt_state
+            self._note_restored(path, meta)
+
+    def _note_restored(self, path: str, meta: Optional[Dict] = None) -> Optional[int]:
+        """Record which pass in OUR save_dir this run restored from, so
+        rolling deletion never removes the only known-good state (the
+        load may also have FALLEN BACK to an earlier pass than the path
+        asked for — trust meta['pass_id'] when present)."""
+        if meta is not None and isinstance(meta.get("pass_id"), int):
+            pass_id = meta["pass_id"]
+        else:
+            base = os.path.basename(os.path.normpath(path))
+            if base.endswith(".old"):
+                # torn-commit leftover (see checkpoint._commit): the pass
+                # id still applies, so resume numbering stays correct
+                base = base[: -len(".old")]
+            if not (base.startswith("pass-") and base[5:].isdigit()):
+                return None
+            pass_id = int(base[5:])
+        # abspath both sides: a relative --save_dir must still match an
+        # absolute init path to the same directory (and vice versa)
+        if self.save_dir and os.path.abspath(
+            os.path.dirname(os.path.normpath(path))
+        ) == os.path.abspath(self.save_dir):
+            self._restored_pass = pass_id
+        return pass_id
 
     # ------------------------------------------------------------- steps
 
@@ -456,12 +522,20 @@ class Trainer:
         if dc is None:
             return None
         slot_names = self.config.model_config.input_layer_names
+        from paddle_tpu.utils.retry import RetryPolicy
+
         return create_data_provider(
             dc,
             self.config.opt_config.batch_size,
             slot_names,
             seed=self.flags.seed,
             for_test=for_test if ordered is None else ordered,
+            # resilience knobs come from THIS trainer's flags object, not
+            # the process-global FLAGS (programmatic embeddings pass
+            # their own _Flags instance)
+            stall_timeout=self.flags.data_stall_timeout,
+            max_bad_samples=self.flags.max_bad_samples,
+            retry=RetryPolicy.from_flags(self.flags, name="data-provider"),
         )
 
     # ------------------------------------------------------------- train
@@ -699,8 +773,15 @@ class Trainer:
                 from paddle_tpu.ops.kernel_flops import train_step_flops
 
                 f = train_step_flops(fn, *args)
-            except Exception:
-                f = None  # cached failure: don't re-trace every batch
+            except Exception as e:
+                # cached failure: don't re-trace every batch — but leave a
+                # trace, once per shape, so broken FLOPs accounting is
+                # diagnosable instead of silently zeroing the MFU line
+                logger.debug(
+                    "FLOPs accounting disabled for batch signature %r: %s",
+                    key, e, exc_info=True,
+                )
+                f = None
             self._flops_cache[key] = f
         if f is None:
             # a partially-counted pass must not log a confident number
@@ -1416,7 +1497,16 @@ class Trainer:
             self.opt_state,
             extra_meta=extra,
             keep=0 if final else 3,
+            # rolling deletion must never remove the checkpoint this run
+            # restored from — until a newer save proves restorable it is
+            # the only known-good state
+            protect_pass=self._restored_pass,
         )
+        if self._restored_pass is not None and pass_id != self._restored_pass:
+            # a NEWER checkpoint just landed durably (manifested + renamed):
+            # the restored-from pass rejoins the normal rotation budget
+            # instead of being retained for the run's lifetime
+            self._restored_pass = None
 
     # ---------------------------------------------------------- checkgrad
 

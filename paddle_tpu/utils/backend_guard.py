@@ -13,6 +13,12 @@ env vars alone to work).
 Role analog in the reference: the CPU-only stub build
 (/root/reference/paddle/cuda/include/stub/) that lets everything run
 without accelerators.
+
+This module deliberately does NOT retry a hung accelerator claim
+through ``paddle_tpu.utils.retry.RetryPolicy``: a claimant must be
+abandoned, never re-driven (see run_abandoning) — retrying the claim
+is exactly what wedges the tunnel. RetryPolicy is for transient
+*completing* failures (shared-FS I/O, flaky providers).
 """
 
 from __future__ import annotations

@@ -40,6 +40,8 @@ class _Flags:
     # SIGTERM notice; resume via --init_model_path + --start_pass)
     save_on_preempt: bool = True
     save_dir: str = ""
+    # a pass dir, or "auto": restore the newest checkpoint under
+    # save_dir that passes manifest verification (fresh start when none)
     init_model_path: str = ""
     load_missing_parameter_strategy: str = "fail"   # fail | rand | zero
     show_parameter_stats_period: int = 0
@@ -52,6 +54,25 @@ class _Flags:
     profile_dir: str = ""                # write a profiler trace here
     profile_start_batch: int = 5
     profile_num_batches: int = 10
+    # resilience (doc/resilience.md)
+    # fault injection: site=action[:arg][@trigger];... (see
+    # paddle_tpu/resilience/faultinject.py; PADDLE_TPU_FAULTS env also works)
+    fault_spec: str = ""
+    fault_seed: int = 0
+    # data-pipeline watchdog: no provider progress (not even one SAMPLE
+    # pulled) for this many seconds raises DataStallError instead of
+    # hanging (0 disables). Generous default: 30 min of true dead air is
+    # indistinguishable from a hang
+    data_stall_timeout: float = 1800.0
+    # skip-and-log up to N malformed samples per provider, then fail
+    # (0 = fail on the first one, the old behavior)
+    max_bad_samples: int = 0
+    # shared transient-I/O retry policy (checkpoint I/O, provider reads):
+    # exponential backoff from io_retry_base_delay, capped attempts and
+    # total elapsed seconds
+    io_retry_attempts: int = 4
+    io_retry_base_delay: float = 0.25
+    io_retry_deadline: float = 120.0
     # rng
     seed: int = 1
     # distributed (multi-host jax)
