@@ -1,11 +1,13 @@
-"""`paddle` CLI — train / test / checkgrad / dump_config / merge_model /
-version.
+"""`paddle` CLI — train / supervise / test / checkgrad / dump_config /
+merge_model / version.
 
 Role of the reference's TrainerMain + `paddle` shell dispatcher
 (/root/reference/paddle/trainer/TrainerMain.cpp:35-110,
 paddle/scripts/submit_local.sh.in:46-69). The pserver subcommand has no TPU
 meaning (SPMD replaces it); multi-host launch is `paddle train
 --coordinator_address=... --num_processes=N --process_id=k` per host.
+`paddle supervise` wraps `paddle train` in the crash-loop-aware
+auto-restart supervisor (doc/resilience.md).
 """
 
 from __future__ import annotations
@@ -23,8 +25,8 @@ def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help", "help"):
         print(__doc__)
-        print("usage: paddle <train|test|gen|checkgrad|dump_config|merge_model|"
-              "check-checkpoint|version> [--flags]")
+        print("usage: paddle <train|supervise|test|gen|checkgrad|dump_config|"
+              "merge_model|check-checkpoint|version> [--flags]")
         return 0
     cmd, rest = argv[0], argv[1:]
     if cmd == "version":
@@ -36,6 +38,8 @@ def main(argv=None) -> int:
         return 0
     if cmd in ("train", "test", "checkgrad", "gen"):
         return _run_trainer_job(cmd, rest)
+    if cmd == "supervise":
+        return _supervise(rest)
     if cmd == "dump_config":
         return _dump_config(rest)
     if cmd == "merge_model":
@@ -98,6 +102,27 @@ def _run_trainer_job(cmd, rest) -> int:
         return 0
     ok = trainer.check_gradient()
     return 0 if ok else 1
+
+
+def _supervise(rest) -> int:
+    """`paddle supervise <train flags>` — run `paddle train` as a
+    supervised child: restart with backoff + `--init_model_path=auto` on
+    nonzero exit (bounded by --restart_budget), stop with a JSON crash
+    report on a crash loop, forward SIGTERM so preemption still
+    checkpoints. `--dry_run` prints the child command and policy.
+
+    The supervisor itself never initializes jax (a dead child must be
+    restartable even when the accelerator runtime is what killed it), so
+    this parses flags without `_setup` and forwards `rest` verbatim —
+    the child re-parses the same flags and validates --config."""
+    from paddle_tpu.utils.flags import FLAGS
+
+    leftover = FLAGS.parse(list(rest))
+    if leftover:
+        print(f"warning: unrecognized flags {leftover}", file=sys.stderr)
+    from paddle_tpu.resilience.supervisor import Supervisor
+
+    return Supervisor(rest, FLAGS).run()
 
 
 def _test_saved_passes(trainer, flags) -> None:

@@ -269,10 +269,12 @@ def globalize_batch(batch, mesh: Mesh):
     return jax.tree_util.tree_map(put, batch)
 
 
-def shard_train_step(step, mesh: Mesh, gm):
+def shard_train_step(step, mesh: Mesh, gm, donate: bool = True):
     """Wrap a (params, opt_state, batch, rng, batch_size) step with mesh
     shardings. Shardings for the batch depend on its treedef, so the jit is
-    built lazily per batch structure and cached."""
+    built lazily per batch structure and cached. ``donate=False`` keeps the
+    input buffers valid after the call (the trainer's skip/rollback
+    divergence policies must be able to discard a poisoned update)."""
     param_shards = _param_shardings(mesh, gm)
     repl = NamedSharding(mesh, P())
     bs = batch_sharding(mesh)
@@ -291,7 +293,7 @@ def shard_train_step(step, mesh: Mesh, gm):
                 step,
                 in_shardings=(p_spec, o_spec, b_spec, repl, repl),
                 out_shardings=(p_spec, o_spec, None, None),
-                donate_argnums=(0, 1),
+                donate_argnums=(0, 1) if donate else (),
             )
             cache[treedef] = fn
         return fn(params, opt_state, batch, rng, batch_size)
@@ -299,13 +301,13 @@ def shard_train_step(step, mesh: Mesh, gm):
     return call
 
 
-def shard_accum_steps(astep, ustep, mesh: Mesh, gm):
+def shard_accum_steps(astep, ustep, mesh: Mesh, gm, donate: bool = True):
     """Mesh-shard the gradient-accumulation pair
     (num_batches_per_send_parameter > 1): ``astep(params, acc, batch,
     rng, n)`` accumulates one batch's gradients; ``ustep(params,
     opt_state, acc, total_n)`` applies one optimizer update. The
     accumulator tree mirrors the parameter tree, so it takes the
-    parameter shardings."""
+    parameter shardings. ``donate=False``: see shard_train_step."""
     param_shards = _param_shardings(mesh, gm)
     repl = NamedSharding(mesh, P())
     bs = batch_sharding(mesh)
@@ -325,7 +327,7 @@ def shard_accum_steps(astep, ustep, mesh: Mesh, gm):
                 astep,
                 in_shardings=(ps, ps, b_spec, repl, repl),
                 out_shardings=(ps, ps, None, None),
-                donate_argnums=(0, 1),
+                donate_argnums=(0, 1) if donate else (),
             )
             a_cache[treedef] = fn
         return fn(params, acc, batch, rng, n)
@@ -340,7 +342,7 @@ def shard_accum_steps(astep, ustep, mesh: Mesh, gm):
                 ustep,
                 in_shardings=(ps, o_spec, ps, repl),
                 out_shardings=(ps, o_spec, ps),
-                donate_argnums=(0, 1, 2),
+                donate_argnums=(0, 1, 2) if donate else (),
             )
         return u_fn(params, opt_state, acc, total_n)
 

@@ -14,8 +14,13 @@ Pieces (see doc/resilience.md for the failure model):
   ``paddle check-checkpoint`` subcommand.
 - ``faultinject`` — deterministic, seeded, site-named fault injection
   (``checkpoint.write``, ``checkpoint.rename``, ``provider.yield``,
-  ``provider.stall``) so chaos tests exercise mid-write crashes, torn
-  renames, flaky providers, and stalls reproducibly.
+  ``provider.stall``, ``trainer.crash``, ``trainer.nonfinite``) so chaos
+  tests exercise mid-write crashes, torn renames, flaky providers,
+  stalls, mid-run process deaths, and diverging losses reproducibly.
+- ``supervisor`` — `paddle supervise`: run `paddle train` as a child
+  process, restart it with backoff and ``--init_model_path=auto`` on
+  nonzero exit, detect crash loops (repeated death at the same restored
+  checkpoint), and emit a JSON crash report when recovery is hopeless.
 - errors below — typed failures the trainer and tools can act on.
 
 The shared backoff machinery lives in ``paddle_tpu.utils.retry``
@@ -46,8 +51,27 @@ class BadSampleError(RuntimeError):
     """More malformed samples than ``--max_bad_samples`` allows."""
 
 
+class NonFiniteLossError(FloatingPointError):
+    """A training loss (or whole-data cost) came back NaN/Inf and the
+    configured ``--nonfinite_policy`` could not (or may not) recover:
+    ``abort`` raises immediately, ``skip``/``rollback`` raise once the
+    ``--max_nonfinite_steps`` budget is exhausted or no restorable
+    checkpoint exists to roll back to.
+
+    Subclasses ``FloatingPointError`` so pre-existing fail-fast callers
+    keep working; supervisors and tests should catch THIS type to
+    classify divergence separately from an ordinary crash."""
+
+    def __init__(self, message: str, value=None, pass_id=None, batch_id=None):
+        super().__init__(message)
+        self.value = value
+        self.pass_id = pass_id
+        self.batch_id = batch_id
+
+
 __all__ = [
     "CheckpointCorruptError",
     "DataStallError",
     "BadSampleError",
+    "NonFiniteLossError",
 ]

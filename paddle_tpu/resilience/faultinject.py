@@ -34,7 +34,11 @@ Examples::
 Instrumented sites: ``checkpoint.write`` (before each checkpoint file
 write), ``checkpoint.rename`` (before the tmp→final commit rename),
 ``provider.yield`` (before each sample leaves a data provider),
-``provider.stall`` (inside the prefetch worker loop).
+``provider.stall`` (inside the prefetch worker loop), ``trainer.crash``
+(before each trained launch — ``exit`` here is a mid-run process death
+for `paddle supervise` drills), ``trainer.nonfinite`` (at the per-batch
+loss check — a firing ``raise`` turns that batch's loss into NaN, the
+deterministic divergence for ``--nonfinite_policy`` drills).
 
 Inactive cost is one global ``is None`` check per site hit.
 """
@@ -56,6 +60,8 @@ KNOWN_SITES = (
     "checkpoint.rename",
     "provider.yield",
     "provider.stall",
+    "trainer.crash",
+    "trainer.nonfinite",
 )
 
 

@@ -29,6 +29,7 @@ import numpy as np
 
 from paddle_tpu.data.feeder import DataProvider, create_data_provider
 from paddle_tpu.graph.argument import Argument
+from paddle_tpu.resilience import NonFiniteLossError, faultinject
 from paddle_tpu.graph.machine import GradientMachine
 from paddle_tpu.optimizer import Updater
 from paddle_tpu.proto import TrainerConfig
@@ -71,6 +72,16 @@ class PreemptionExit(Exception):
         super().__init__(f"preempted at pass {pass_id}")
         self.pass_id = pass_id
         self.saved_path = saved_path
+
+
+class _RollbackRequest(Exception):
+    """Internal control flow: train_one_pass asks train() to restore the
+    newest verified checkpoint (``--nonfinite_policy=rollback``)."""
+
+    def __init__(self, pass_id: int, batch_id: int):
+        super().__init__(f"rollback requested at pass {pass_id} batch {batch_id}")
+        self.pass_id = pass_id
+        self.batch_id = batch_id
 
 
 class Trainer:
@@ -244,6 +255,41 @@ class Trainer:
                 l2weight=oc.l2weight,
                 learning_rate=oc.learning_rate,
             )
+        # divergence policy (--nonfinite_policy, doc/resilience.md): what
+        # a NaN/Inf loss does. abort keeps the reference's FP-trap role;
+        # skip discards the poisoned update (pre-step buffers stay valid
+        # because donation is disabled below); rollback restores the
+        # newest verified checkpoint, scales the lr, and fast-forwards
+        # past the poison region. Both are bounded by max_nonfinite_steps.
+        self._nf_policy = str(getattr(flags, "nonfinite_policy", "abort") or "abort")
+        if self._nf_policy not in ("abort", "skip", "rollback"):
+            raise ValueError(
+                f"--nonfinite_policy={self._nf_policy!r} "
+                "(want abort, skip, or rollback)"
+            )
+        self._nf_budget = max(0, int(getattr(flags, "max_nonfinite_steps", 3)))
+        self._nf_count = 0
+        self.rollbacks = 0
+        # (pass_id, first clean batch): re-run of the rolled-back pass
+        # skips batches before this index — the poison region
+        self._ff_target: Optional[Tuple[int, int]] = None
+        if self._nf_policy != "abort" and (
+            self._async or self._batch_method is not None
+        ):
+            logger.warning(
+                "--nonfinite_policy=%s is not supported under %s — a "
+                "non-finite loss still aborts (with NonFiniteLossError)",
+                self._nf_policy,
+                "async_sgd (replica stacks hold no single pre-step state)"
+                if self._async else "whole-data batch methods",
+            )
+            self._nf_policy = "abort"
+        if self._nf_policy == "rollback" and not self.save_dir:
+            logger.warning(
+                "--nonfinite_policy=rollback without --save_dir: there "
+                "will be no checkpoint to roll back to — the first "
+                "non-finite loss raises NonFiniteLossError"
+            )
         self._maybe_restore()
         # StaticPruningHook init semantics: mask values once at startup
         self.params = self.updater.apply_init_hooks(self.params)
@@ -379,14 +425,26 @@ class Trainer:
 
         return step
 
+    @property
+    def _donate_steps(self) -> bool:
+        """skip/rollback must be able to hand back the pre-step state of
+        a poisoned update, so the train steps may not donate their input
+        buffers (the documented ~2x parameter-memory cost of those
+        policies); abort keeps the donating fast path."""
+        return self._nf_policy == "abort"
+
     def _build_train_step(self):
         step = self._one_batch_step()
 
         if self._mesh is not None:
             from paddle_tpu.parallel.spmd import shard_train_step
 
-            return shard_train_step(step, self._mesh, self.gm)
-        return jax.jit(step, donate_argnums=(0, 1))
+            return shard_train_step(
+                step, self._mesh, self.gm, donate=self._donate_steps
+            )
+        return jax.jit(
+            step, donate_argnums=(0, 1) if self._donate_steps else ()
+        )
 
     def _build_accum_steps(self):
         """Gradient accumulation (num_batches_per_send_parameter = N > 1,
@@ -417,7 +475,11 @@ class Trainer:
         if self._mesh is not None:
             from paddle_tpu.parallel.spmd import shard_accum_steps
 
-            return shard_accum_steps(astep, ustep, self._mesh, self.gm)
+            return shard_accum_steps(
+                astep, ustep, self._mesh, self.gm, donate=self._donate_steps
+            )
+        if not self._donate_steps:
+            return jax.jit(astep), jax.jit(ustep)
         return (
             jax.jit(astep, donate_argnums=(0, 1)),
             jax.jit(ustep, donate_argnums=(0, 1, 2)),
@@ -444,7 +506,9 @@ class Trainer:
             )
             return p, o, losses, keeps
 
-        return jax.jit(fstep, donate_argnums=(0, 1))
+        return jax.jit(
+            fstep, donate_argnums=(0, 1) if self._donate_steps else ()
+        )
 
     @property
     def fused_step(self):
@@ -596,9 +660,18 @@ class Trainer:
         saved_pass = -1
         with self._preemption_guard():
             try:
-                for pass_id in range(self.start_pass, num_passes):
-                    rng, pass_rng = jax.random.split(rng)
-                    self.train_one_pass(pass_id, train_provider, pass_rng)
+                # while-loop (not range): a rollback rewinds pass_id to
+                # just after the restored checkpoint. Per-pass keys are
+                # folded from the base key, so a re-run pass replays the
+                # same rng stream it saw the first time.
+                pass_id = self.start_pass
+                while pass_id < num_passes:
+                    pass_rng = jax.random.fold_in(rng, pass_id)
+                    try:
+                        self.train_one_pass(pass_id, train_provider, pass_rng)
+                    except _RollbackRequest as rb:
+                        pass_id = self._apply_rollback(rb)
+                        continue
                     with stat_timer("test"):
                         pass_results = self.test(pass_id=pass_id)
                     if pass_results:
@@ -607,6 +680,7 @@ class Trainer:
                         self.save(pass_id)
                         saved_pass = pass_id
                     logger.info(global_stats.summary())
+                    pass_id += 1
             except PreemptionExit as e:
                 if e.saved_path:
                     logger.info(
@@ -709,8 +783,12 @@ class Trainer:
                         self.params, provider, want_grad=True
                     )
                 if not np.isfinite(cost):
-                    raise FloatingPointError(
-                        f"non-finite whole-data cost ({cost}) at pass {pass_id}"
+                    # same typed failure as the per-step trap so
+                    # supervisors/tests classify divergence vs. crash
+                    # uniformly (subclasses FloatingPointError)
+                    raise NonFiniteLossError(
+                        f"non-finite whole-data cost ({cost}) at pass {pass_id}",
+                        value=float(cost), pass_id=pass_id,
                     )
                 bm.record_grad(grads)  # completes the previous pass's (s, y)
                 xt = {
@@ -829,9 +907,32 @@ class Trainer:
         batch_id = 0
         step_times: list = []
         profiled = False
+        # rollback fast-forward: when re-running the pass that diverged,
+        # consume (without training) the batches up to and past the
+        # poison region, so the same poisoned update is not re-applied
+        ff_until = 0
+        if self._ff_target is not None:
+            tgt_pass, tgt_batch = self._ff_target
+            if pass_id == tgt_pass:
+                ff_until = tgt_batch
+                logger.info(
+                    "Pass %d: fast-forwarding past the poison region "
+                    "(skipping batches < %d)", pass_id, tgt_batch,
+                )
+            if pass_id >= tgt_pass:
+                self._ff_target = None
         for kind, group in self._launch_groups(
             self._device_prefetch(self._global_batches(provider))
         ):
+            if ff_until and batch_id < ff_until:
+                batch_id += len(group) if kind == "fused" else 1
+                continue
+            # chaos site: `trainer.crash=exit@N` is a deterministic
+            # mid-run process death (one hit per trained launch) —
+            # what `paddle supervise` drills recover from
+            faultinject.fault_point(
+                "trainer.crash", info=f"pass={pass_id} batch={batch_id}"
+            )
             if (
                 self.flags.profile_dir
                 and pass_id == self.start_pass
@@ -880,6 +981,7 @@ class Trainer:
                     rngs, ns_arr,
                 )
                 t_step = time.perf_counter() - prep_s
+                snap = self._nf_snapshot()
                 with stat_timer("train_step"):
                     self.params, self.opt_state, losses, keeps = self.fused_step(
                         self.params, self.opt_state, stacked, rngs, ns_arr,
@@ -889,18 +991,25 @@ class Trainer:
                 # device dispatches
                 losses_host, keeps_host = jax.device_get((losses, keeps))
                 losses_host = np.asarray(losses_host)
+                if faultinject.is_active():
+                    losses_host = np.asarray([
+                        self._poisoned_loss(float(l), pass_id, batch_id + i)
+                        for i, l in enumerate(losses_host)
+                    ])
                 if not np.isfinite(losses_host).all():
                     # gate BEFORE any per-batch housekeeping: params already
                     # contain all k updates, so a periodic save fired for an
                     # earlier batch of this launch would checkpoint
                     # NaN-poisoned weights as if they were pre-NaN
                     bad = int(np.flatnonzero(~np.isfinite(losses_host))[0])
-                    raise FloatingPointError(
-                        f"non-finite loss ({losses_host[bad]}) at pass "
-                        f"{pass_id} batch {batch_id + bad} (launch of {kf}) "
-                        "— aborting. Try --job=checkgrad, a lower learning "
-                        "rate, or gradient clipping to locate the cause."
-                    )
+                    if self._handle_nonfinite(
+                        pass_id, batch_id + bad, float(losses_host[bad]),
+                        snap, f"(launch of {kf}) ",
+                    ):
+                        # poisoned launch discarded whole (skip policy):
+                        # pre-launch params/opt_state are back in place
+                        batch_id += kf
+                        continue
                 self._pass_train_s += time.perf_counter() - t_step
                 step_dt = (time.perf_counter() - t_step) / kf
                 results = [
@@ -921,6 +1030,7 @@ class Trainer:
                         step_rng, jnp.asarray(float(n)),
                     )
                 t_step = time.perf_counter()
+                snap = self._nf_snapshot()
                 with stat_timer("train_step"):
                     if self._accum_n > 1:
                         loss, outputs = self._accum_step(batch, step_rng, n)
@@ -931,7 +1041,7 @@ class Trainer:
                             self.params, self.opt_state, batch, step_rng,
                             jnp.asarray(float(n)),
                         )
-                loss_f = float(loss)
+                loss_f = self._poisoned_loss(float(loss), pass_id, batch_id)
                 self._pass_train_s += time.perf_counter() - t_step
                 step_dt = time.perf_counter() - t_step
                 results = [(loss_f, outputs, n)]
@@ -940,14 +1050,14 @@ class Trainer:
                 step_times.append(step_dt)
                 if not np.isfinite(loss_f):
                     # FP trap role (ref: feenableexcept(FE_INVALID|FE_DIVBYZERO|
-                    # FE_OVERFLOW), TrainerMain.cpp:96): a NaN/Inf must abort the
-                    # run, not train on silently. loss is already read back to the
-                    # host each batch, so this check costs nothing extra.
-                    raise FloatingPointError(
-                        f"non-finite loss ({loss_f}) at pass {pass_id} batch "
-                        f"{batch_id} — aborting. Try --job=checkgrad, a lower "
-                        "learning rate, or gradient clipping to locate the cause."
-                    )
+                    # FE_OVERFLOW), TrainerMain.cpp:96), now policy-driven:
+                    # abort raises, skip discards the update, rollback
+                    # restores a checkpoint. Fused launches were gated
+                    # above; reaching here is the single-batch path. loss
+                    # is already read back each batch, so the check is free.
+                    if self._handle_nonfinite(pass_id, batch_id, loss_f, snap):
+                        batch_id += 1
+                        continue
                 stats.add(loss_f * n, n)
                 self._eval_outputs(evaluators, outputs)
                 batch_id += 1
@@ -1049,6 +1159,120 @@ class Trainer:
         from paddle_tpu.utils.barrier import step_time_skew_summary
 
         step_time_skew_summary(step_times)
+
+    # --------------------------------------------- divergence recovery
+
+    def _nf_snapshot(self):
+        """Pre-step state the skip policy can hand back: plain references
+        — valid after the step because _donate_steps disabled buffer
+        donation for every non-abort policy. None under abort (the
+        handler will raise, nothing to restore)."""
+        if self._nf_policy == "abort":
+            return None
+        return (
+            self.params, self.opt_state,
+            self._acc, self._acc_batches, self._acc_samples,
+        )
+
+    def _poisoned_loss(self, loss_f: float, pass_id: int, batch_id: int) -> float:
+        """`trainer.nonfinite` injection site — one hit per batch; a
+        firing `raise` rule turns this batch's loss into NaN, the
+        deterministic divergence the chaos tests drive policies with."""
+        if faultinject.is_active():
+            try:
+                faultinject.fault_point(
+                    "trainer.nonfinite", info=f"pass={pass_id} batch={batch_id}"
+                )
+            except faultinject.FaultInjected:
+                logger.warning(
+                    "injected non-finite loss at pass %d batch %d",
+                    pass_id, batch_id,
+                )
+                return float("nan")
+        return loss_f
+
+    def _handle_nonfinite(self, pass_id, batch_id, value, snap, launch_note=""):
+        """Apply --nonfinite_policy to one non-finite loss. Returns True
+        when the poisoned update was discarded (skip) and the caller
+        should move on; raises NonFiniteLossError (abort / exhausted
+        budget) or _RollbackRequest (rollback) otherwise."""
+        base = (
+            f"non-finite loss ({value}) at pass {pass_id} "
+            f"batch {batch_id} {launch_note}"
+        )
+        if self._nf_policy == "abort" or snap is None:
+            raise NonFiniteLossError(
+                base + "— aborting. Try --job=checkgrad, a lower learning "
+                "rate, or gradient clipping to locate the cause "
+                "(or --nonfinite_policy=skip/rollback to recover).",
+                value=value, pass_id=pass_id, batch_id=batch_id,
+            )
+        self._nf_count += 1
+        if self._nf_count > self._nf_budget:
+            raise NonFiniteLossError(
+                base + f"— non-finite budget exhausted "
+                f"(--max_nonfinite_steps={self._nf_budget}, "
+                f"{self._nf_count - 1} poisoned event(s) already recovered)",
+                value=value, pass_id=pass_id, batch_id=batch_id,
+            )
+        (self.params, self.opt_state, self._acc,
+         self._acc_batches, self._acc_samples) = snap
+        if self._nf_policy == "skip":
+            logger.warning(
+                "%s— update discarded (%d/%d non-finite budget used)",
+                base, self._nf_count, self._nf_budget,
+            )
+            return True
+        raise _RollbackRequest(pass_id, batch_id)
+
+    def _apply_rollback(self, rb: _RollbackRequest) -> int:
+        """--nonfinite_policy=rollback: restore the newest verified
+        checkpoint, temper the learning rate, and arrange to fast-forward
+        past the poison region. Returns the pass id to resume from."""
+        path = (
+            ckpt.find_restorable_checkpoint(self.save_dir)
+            if self.save_dir else None
+        )
+        if path is None:
+            raise NonFiniteLossError(
+                f"non-finite loss at pass {rb.pass_id} batch {rb.batch_id} "
+                "— --nonfinite_policy=rollback found no restorable "
+                "checkpoint under --save_dir to roll back to",
+                pass_id=rb.pass_id, batch_id=rb.batch_id,
+            )
+        # find_restorable just CRC'd the candidate (verify=False mirrors
+        # the auto-restore path); fallback may still walk earlier passes
+        self.params, opt_state, meta = ckpt.load_checkpoint(
+            path, self.opt_state, expected_params=self.params,
+            sharding_for=self.ckpt_sharding_for(),
+            verify=False, fallback=True,
+        )
+        if opt_state is not None:
+            self.opt_state = opt_state
+        restored = self._note_restored(path, meta)
+        scale = float(getattr(self.flags, "rollback_lr_scale", 0.5) or 1.0)
+        oc = self.config.opt_config
+        old_lr = oc.learning_rate
+        oc.learning_rate = old_lr * scale
+        # the jitted steps baked the old schedule constants at trace
+        # time — drop them so the tempered lr actually takes effect
+        self._train_step_fn = None
+        self._fused_step_fn = None
+        self._accum_fns = None
+        self._acc = None
+        self._acc_batches = 0
+        self._acc_samples = 0
+        self.rollbacks += 1
+        self._ff_target = (rb.pass_id, rb.batch_id + 1)
+        resume = (restored + 1) if restored is not None else rb.pass_id
+        logger.warning(
+            "rollback: non-finite loss at pass %d batch %d — restored %s, "
+            "learning_rate %g -> %g (x%g), resuming at pass %d "
+            "(will fast-forward past batch %d of pass %d)",
+            rb.pass_id, rb.batch_id, path, old_lr, oc.learning_rate, scale,
+            resume, rb.batch_id, rb.pass_id,
+        )
+        return resume
 
     def _accum_step(self, batch, step_rng, n: int):
         """One gradient-accumulation batch; applies the optimizer update

@@ -23,23 +23,51 @@ def step_time_skew_summary(step_times_s: List[float]) -> Optional[str]:
     running multi-process."""
     import jax
 
-    if not step_times_s:
-        return None
-    local = np.asarray(
-        [np.mean(step_times_s), np.percentile(step_times_s, 99)], np.float32
-    )
     if jax.process_count() == 1:
         return None
+    # every process MUST reach the allgather: a host whose pass trained
+    # zero launches (all-remainder pass, fast-forward after rollback)
+    # joins with NaN sentinels instead of returning early — the old
+    # early return desynced the collective and hung the pod, and a
+    # zero-filled row would have skewed the min/argmax attribution
+    if step_times_s:
+        local = np.asarray(
+            [np.mean(step_times_s), np.percentile(step_times_s, 99)], np.float32
+        )
+    else:
+        local = np.asarray([np.nan, np.nan], np.float32)
     from jax.experimental import multihost_utils
 
     all_stats = np.asarray(multihost_utils.process_allgather(local))  # [P, 2]
+    line = summarize_host_stats(all_stats)
+    if line is not None:
+        logger.info(line)
+    return line
+
+
+def summarize_host_stats(all_stats: np.ndarray) -> Optional[str]:
+    """Format the gathered [P, 2] (mean, p99) table into the BarrierStat
+    line. NaN rows (hosts that recorded no steps) are excluded from the
+    skew/slowest attribution but called out, so a dead-idle host can
+    neither fake being the fastest nor hide. None when no host has data.
+
+    Split out from the collective so the sentinel handling is unit
+    testable without a multi-process run; the supervisor's crash report
+    greps the resulting line for slowest-host attribution."""
+    all_stats = np.asarray(all_stats, np.float64)
     means = all_stats[:, 0]
-    slowest = int(np.argmax(means))
-    skew = float(means.max() - means.min())
+    valid = np.isfinite(means)
+    if not valid.any():
+        return None
+    slowest = int(np.nanargmax(means))
+    skew = float(np.nanmax(means) - np.nanmin(means))
+    fmt = ["%.1fms" % (m * 1e3) if np.isfinite(m) else "n/a" for m in means]
     line = (
-        f"BarrierStat: step mean/host={['%.1fms' % (m * 1e3) for m in means]} "
+        f"BarrierStat: step mean/host={fmt} "
         f"skew={skew * 1e3:.1f}ms slowest=host{slowest} "
         f"p99[slowest]={all_stats[slowest, 1] * 1e3:.1f}ms"
     )
-    logger.info(line)
+    idle = [str(i) for i in np.flatnonzero(~valid)]
+    if idle:
+        line += f" (no steps recorded on host(s) {','.join(idle)})"
     return line

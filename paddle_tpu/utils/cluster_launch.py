@@ -7,19 +7,33 @@ launches the same `paddle train` command on every host with the jax
 distributed-runtime flags filled in (process 0's host becomes the
 coordinator). Assumes a shared or rsynced workdir, as the reference did.
 
+Failure handling (doc/resilience.md): children are POLLED, not serially
+waited — when any host's process dies, the remaining hosts are torn down
+immediately (SIGTERM, then SIGKILL after --grace seconds) instead of
+hanging forever inside collectives waiting for the dead rank, and the
+failing rank is named in the exit message. With --max_restarts=N the
+whole job is relaunched up to N times with `--init_model_path=auto`
+appended, so a relaunch resumes from the newest manifest-verified
+checkpoint. SIGTERM to the launcher is forwarded to every host (pod
+preemption: each trainer checkpoints via --save_on_preempt).
+
 Usage:
     python -m paddle_tpu.utils.cluster_launch --conf=conf.py \
-        --workdir=/path/on/hosts -- --config=train.conf --mesh_shape=data=16 ...
+        --workdir=/path/on/hosts [--max_restarts=N] \
+        -- --config=train.conf --mesh_shape=data=16 ...
 """
 
 from __future__ import annotations
 
 import argparse
 import importlib.util
+import os
 import shlex
+import signal
 import subprocess
 import sys
-from typing import List
+import time
+from typing import List, Optional, Tuple
 
 
 def load_hosts(conf_path: str) -> List[str]:
@@ -29,6 +43,82 @@ def load_hosts(conf_path: str) -> List[str]:
     hosts = getattr(mod, "HOSTS", None)
     assert hosts, f"{conf_path} must define HOSTS = ['user@host', ...]"
     return list(hosts)
+
+
+def _launch(args, hosts: List[str], train_args: List[str],
+            attempt: int) -> List[subprocess.Popen]:
+    coordinator = f"{hosts[0].split('@')[-1]}:{args.port}"
+    extra = []
+    if attempt > 0:
+        # relaunch after a failure: resume every host from the newest
+        # verified checkpoint instead of its original init
+        from paddle_tpu.utils.flags import strip_flag
+
+        train_args = strip_flag(train_args, "init_model_path")
+        extra = ["--init_model_path=auto"]
+    procs = []
+    for rank, host in enumerate(hosts):
+        cmd = [
+            args.paddle, "train", *train_args, *extra,
+            f"--coordinator_address={coordinator}",
+            f"--num_processes={len(hosts)}",
+            f"--process_id={rank}",
+        ]
+        remote = f"cd {shlex.quote(args.workdir)} && {' '.join(shlex.quote(c) for c in cmd)}"
+        ssh = ["ssh", "-o", "BatchMode=yes", host, remote]
+        print(f"[{rank}] {host}: {remote}")
+        if not args.dry_run:
+            # each ssh gets its own process group so teardown can signal
+            # the whole group — a bare terminate() of the ssh process
+            # would orphan anything it spawned, leaving it holding the
+            # job's pipes/ports
+            procs.append(subprocess.Popen(ssh, start_new_session=True))
+    return procs
+
+
+def _signal_group(proc: subprocess.Popen, sig: int) -> None:
+    try:
+        os.killpg(proc.pid, sig)  # pid == pgid (start_new_session)
+    except (ProcessLookupError, PermissionError, OSError):
+        try:
+            proc.send_signal(sig)
+        except OSError:
+            pass
+
+
+def _wait_first_failure(procs: List[subprocess.Popen],
+                        poll_s: float) -> Optional[Tuple[int, int]]:
+    """Poll all children; None when every one exited 0, else
+    (rank, exit code) of the FIRST failure observed — the launcher must
+    never sit in a serial wait() on rank 0 while rank 3 is already dead
+    and the survivors hang in collectives."""
+    pending = dict(enumerate(procs))
+    while pending:
+        for rank, proc in list(pending.items()):
+            rc = proc.poll()
+            if rc is None:
+                continue
+            del pending[rank]
+            if rc != 0:
+                return rank, rc
+        if pending:
+            time.sleep(poll_s)
+    return None
+
+
+def _teardown(procs: List[subprocess.Popen], grace_s: float) -> None:
+    """SIGTERM every still-running host (their trainers checkpoint via
+    --save_on_preempt), escalate to SIGKILL after the grace window."""
+    live = [p for p in procs if p.poll() is None]
+    for p in live:
+        _signal_group(p, signal.SIGTERM)
+    deadline = time.monotonic() + grace_s
+    for p in live:
+        try:
+            p.wait(timeout=max(deadline - time.monotonic(), 0.1))
+        except subprocess.TimeoutExpired:
+            _signal_group(p, signal.SIGKILL)
+            p.wait()
 
 
 def main(argv=None) -> int:
@@ -44,27 +134,83 @@ def main(argv=None) -> int:
     p.add_argument("--port", type=int, default=8476, help="coordinator port")
     p.add_argument("--paddle", default="paddle", help="paddle executable on hosts")
     p.add_argument("--dry_run", action="store_true")
+    p.add_argument("--max_restarts", type=int, default=0,
+                   help="relaunch the whole job (with --init_model_path=auto) "
+                        "up to N times after a host failure; 0 = fail fast")
+    p.add_argument("--restart_delay", type=float, default=5.0,
+                   help="seconds between teardown and relaunch")
+    p.add_argument("--poll_interval", type=float, default=0.5,
+                   help="child liveness poll period, seconds")
+    p.add_argument("--grace", type=float, default=10.0,
+                   help="seconds between SIGTERM and SIGKILL at teardown")
     args = p.parse_args(own)
 
     hosts = load_hosts(args.conf)
-    coordinator = f"{hosts[0].split('@')[-1]}:{args.port}"
-    procs = []
-    for rank, host in enumerate(hosts):
-        cmd = [
-            args.paddle, "train", *train_args,
-            f"--coordinator_address={coordinator}",
-            f"--num_processes={len(hosts)}",
-            f"--process_id={rank}",
-        ]
-        remote = f"cd {shlex.quote(args.workdir)} && {' '.join(shlex.quote(c) for c in cmd)}"
-        ssh = ["ssh", "-o", "BatchMode=yes", host, remote]
-        print(f"[{rank}] {host}: {remote}")
-        if not args.dry_run:
-            procs.append(subprocess.Popen(ssh))
-    rc = 0
-    for rank, proc in enumerate(procs):
-        rc |= proc.wait()
-    return rc
+    current: List[subprocess.Popen] = []
+    terminating = False
+
+    def on_sigterm(signum, frame):
+        # preemption of the launcher itself: forward to every host and
+        # stop relaunching — each trainer checkpoints on its own SIGTERM
+        nonlocal terminating
+        terminating = True
+        for proc in current:
+            if proc.poll() is None:
+                _signal_group(proc, signal.SIGTERM)
+
+    prev_handler = signal.getsignal(signal.SIGTERM)
+    try:
+        signal.signal(signal.SIGTERM, on_sigterm)
+    except ValueError:  # non-main thread (tests): degrade to no handler
+        prev_handler = None
+
+    attempt = 0
+    try:
+        while True:
+            current[:] = _launch(args, hosts, train_args, attempt)
+            if args.dry_run:
+                return 0
+            failure = _wait_first_failure(current, args.poll_interval)
+            if failure is None:
+                return 0
+            rank, rc = failure
+            _teardown(current, args.grace)
+            if terminating:
+                print("cluster_launch: SIGTERM — job torn down, not "
+                      "relaunching", file=sys.stderr)
+                return rc or 143
+            print(
+                f"cluster_launch: host rank {rank} ({hosts[rank]}) exited "
+                f"rc={rc}; tore down the remaining {len(hosts) - 1} host(s) "
+                "to avoid hung collectives",
+                file=sys.stderr,
+            )
+            if attempt >= args.max_restarts:
+                if args.max_restarts:
+                    print(
+                        f"cluster_launch: restart budget "
+                        f"({args.max_restarts}) exhausted — giving up",
+                        file=sys.stderr,
+                    )
+                return rc or 1
+            attempt += 1
+            print(
+                f"cluster_launch: relaunching whole job with "
+                f"--init_model_path=auto (restart {attempt}/"
+                f"{args.max_restarts}) in {args.restart_delay:g}s",
+                file=sys.stderr,
+            )
+            time.sleep(args.restart_delay)
+            if terminating:
+                # SIGTERM landed while no hosts were running (teardown
+                # already done, restart_delay sleep): honor it here
+                # instead of relaunching a job the scheduler is ending
+                print("cluster_launch: SIGTERM during restart delay — "
+                      "not relaunching", file=sys.stderr)
+                return rc or 143
+    finally:
+        if prev_handler is not None:
+            signal.signal(signal.SIGTERM, prev_handler)
 
 
 if __name__ == "__main__":

@@ -73,6 +73,29 @@ class _Flags:
     io_retry_attempts: int = 4
     io_retry_base_delay: float = 0.25
     io_retry_deadline: float = 120.0
+    # divergence policy: what a non-finite (NaN/Inf) training loss does.
+    # abort = raise NonFiniteLossError immediately (the reference's FP
+    # trap); skip = discard the poisoned update and continue; rollback =
+    # restore the newest verified checkpoint, scale the learning rate by
+    # rollback_lr_scale, and fast-forward past the poison region. skip
+    # and rollback disable step-buffer donation (~2x parameter memory)
+    # and are bounded by max_nonfinite_steps total events per run.
+    nonfinite_policy: str = "abort"      # abort | skip | rollback
+    max_nonfinite_steps: int = 3
+    rollback_lr_scale: float = 0.5
+    # run supervision (`paddle supervise`, resilience/supervisor.py):
+    # restart a dead `paddle train` child with exponential backoff and
+    # --init_model_path=auto, at most restart_budget times; repeated
+    # death at the same restored checkpoint for crash_loop_threshold
+    # consecutive attempts is classified as poison (stop + JSON crash
+    # report under supervise_dir, default <save_dir>/supervise)
+    restart_budget: int = 5
+    restart_base_delay: float = 1.0
+    crash_loop_threshold: int = 3
+    supervise_dir: str = ""
+    # print the child command + restart policy without launching
+    # (`paddle supervise --dry_run`)
+    dry_run: bool = False
     # rng
     seed: int = 1
     # distributed (multi-host jax)
@@ -98,6 +121,26 @@ class _Flags:
 
 def _parse_bool(v: str) -> bool:
     return str(v).lower() in ("1", "true", "yes", "on")
+
+
+def strip_flag(argv: List[str], name: str) -> List[str]:
+    """Remove every occurrence of ``--name=value`` / ``--name value``
+    from an argv list. Shared by the restart paths (supervisor, cluster
+    launcher) that replace a user's flag with their own — e.g. swapping
+    ``--init_model_path`` for ``auto`` on relaunch."""
+    out: List[str] = []
+    skip_next = False
+    for a in argv:
+        if skip_next:
+            skip_next = False
+            continue
+        if a == f"--{name}":
+            skip_next = True  # the space-separated value form
+            continue
+        if a.startswith(f"--{name}="):
+            continue
+        out.append(a)
+    return out
 
 
 FLAGS = _Flags()

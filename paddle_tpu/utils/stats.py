@@ -17,8 +17,6 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, Iterator
 
-import jax
-
 
 @dataclass
 class Stat:
@@ -81,6 +79,11 @@ def stat_timer(name: str, block_on=None) -> Iterator[None]:
     ``block_on``: optional pytree whose leaves are block_until_ready'd before
     stopping the clock, so device time is included.
     """
+    # lazy: importing this module must not pull in jax — the supervisor
+    # CLI (`paddle supervise`) imports the utils package and has to stay
+    # usable when the accelerator runtime is exactly what keeps crashing
+    import jax
+
     t0 = time.perf_counter()
     with jax.profiler.TraceAnnotation(name):
         yield

@@ -56,6 +56,117 @@ def test_cluster_launch_dry_run(tmp_path):
     assert "u@h1" in out.stdout
 
 
+def _write_fake_ssh(bin_dir, body):
+    """A stub `ssh` on PATH: argv is [-o, BatchMode=yes, host, remote] —
+    $3 is the host, $4 the remote command (cluster_launch's call shape)."""
+    ssh = bin_dir / "ssh"
+    ssh.write_text("#!/bin/sh\nhost=$3\nremote=$4\n" + body)
+    ssh.chmod(0o755)
+    return {**os.environ, "PATH": f"{bin_dir}:{os.environ['PATH']}",
+            "PYTHONPATH": f"{REPO}:{REPO}/compat"}
+
+
+def test_cluster_launch_tears_down_on_first_host_failure(tmp_path):
+    """One dead host must fail the whole launch promptly (and kill the
+    surviving hosts) instead of leaving the launcher blocked in a serial
+    wait while the others hang in collectives."""
+    import time
+
+    conf = tmp_path / "conf.py"
+    conf.write_text("HOSTS = ['u@h_fail', 'u@h_hang']\n")
+    env = _write_fake_ssh(tmp_path, (
+        "case \"$host\" in\n"
+        "  *fail*) sleep 0.3; exit 3;;\n"
+        "  *) sleep 120;;\n"
+        "esac\n"
+    ))
+    t0 = time.monotonic()
+    out = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.utils.cluster_launch",
+         "--conf", str(conf), "--workdir", "/job",
+         "--poll_interval", "0.1", "--grace", "2",
+         "--", "--config=train.conf"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=60,
+    )
+    elapsed = time.monotonic() - t0
+    assert out.returncode == 3, (out.returncode, out.stderr)
+    assert elapsed < 30, elapsed  # did not wait out the 120s survivor
+    # the failing rank is named in the exit message
+    assert "rank 0" in out.stderr and "u@h_fail" in out.stderr
+
+
+def test_cluster_launch_relaunches_with_auto_resume(tmp_path):
+    """--max_restarts: after a host failure the whole job relaunches
+    with --init_model_path=auto appended (resume from the newest
+    verified checkpoint), and a clean second round exits 0."""
+    conf = tmp_path / "conf.py"
+    conf.write_text("HOSTS = ['u@h_once', 'u@h_ok']\n")
+    calls = tmp_path / "calls.log"
+    marker = tmp_path / "round2"
+    env = _write_fake_ssh(tmp_path, (
+        f"echo \"$remote\" >> {calls}\n"
+        "case \"$host\" in\n"
+        f"  *once*) if [ ! -f {marker} ]; then touch {marker}; exit 2; fi;"
+        " exit 0;;\n"
+        "  *) exit 0;;\n"
+        "esac\n"
+    ))
+    out = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.utils.cluster_launch",
+         "--conf", str(conf), "--workdir", "/job",
+         "--poll_interval", "0.1", "--grace", "2",
+         "--max_restarts", "1", "--restart_delay", "0.1",
+         "--", "--config=train.conf"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=60,
+    )
+    assert out.returncode == 0, (out.returncode, out.stderr)
+    assert "relaunching" in out.stderr
+    lines = calls.read_text().splitlines()
+    assert len(lines) == 4  # 2 hosts x 2 rounds
+    assert all("--init_model_path=auto" not in l for l in lines[:2])
+    assert all("--init_model_path=auto" in l for l in lines[2:])
+
+
+def test_cmd_arguments_doc_flags_exist():
+    """Every `--flag` referenced in a doc/cmd_arguments.md table row must
+    exist in utils/flags.py, so the flag reference can't silently rot."""
+    import dataclasses
+    import re
+
+    from paddle_tpu.utils.flags import _Flags
+
+    known = {f.name for f in dataclasses.fields(_Flags)}
+    doc = open(os.path.join(REPO, "doc", "cmd_arguments.md")).read()
+    referenced = set()
+    for line in doc.splitlines():
+        if line.lstrip().startswith("|"):
+            referenced.update(re.findall(r"`--([A-Za-z0-9_]+)", line))
+    assert len(referenced) > 20, "doc table parsing broke"
+    missing = referenced - known
+    assert not missing, (
+        f"doc/cmd_arguments.md references flags missing from "
+        f"utils/flags.py: {sorted(missing)}"
+    )
+
+
+def test_supervise_dry_run_prints_plan_without_launching(tmp_path):
+    """`paddle supervise --dry_run` prints the child command and restart
+    policy, launches nothing, and needs no jax/accelerator."""
+    out = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.cli", "supervise",
+         "--dry_run=1", "--config=cfg.py", "--restart_budget=2",
+         f"--supervise_dir={tmp_path / 'sup'}"],
+        capture_output=True, text=True, cwd=REPO, timeout=60,
+        env={**os.environ, "PYTHONPATH": f"{REPO}:{REPO}/compat",
+             "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 0, out.stderr
+    assert "--config=cfg.py" in out.stdout
+    assert "--init_model_path=auto" in out.stdout
+    assert "restart_budget=2" in out.stdout
+    assert not (tmp_path / "sup").exists()
+
+
 def test_trace_summary_reads_cpu_trace(tmp_path):
     """benchmarks/trace_summary.py parses a jax.profiler xplane trace and
     surfaces the dominant op (dot_general for a matmul-heavy step)."""
