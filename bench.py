@@ -590,6 +590,26 @@ def _emit(metric, value, unit, vs_baseline, **extra):
     }
     line.update({k: v for k, v in extra.items() if v is not None})
     print(json.dumps(line))
+    _emit_metrics_record(line)
+
+
+def _emit_metrics_record(line):
+    """Mirror each result line into a run-telemetry stream
+    (PADDLE_TPU_BENCH_METRICS_DIR): the BENCH_*.json payload and live
+    run telemetry then share ONE schema — `paddle metrics --tail` and
+    any jsonl tooling read bench sessions unchanged
+    (doc/observability.md, kind="bench")."""
+    path = os.environ.get("PADDLE_TPU_BENCH_METRICS_DIR", "")
+    if not path:
+        return
+    try:
+        from paddle_tpu.observability import metrics as obs
+
+        obs.configure(path)
+        obs.emit("bench", **line)
+        obs.flush()
+    except Exception as e:  # telemetry must never fail the bench
+        print(f"# bench metrics record failed: {e}", file=sys.stderr)
 
 
 def main():

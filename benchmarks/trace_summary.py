@@ -16,7 +16,19 @@ from __future__ import annotations
 import collections
 import glob
 import os
+import re
 import sys
+
+# HLO SSA suffixes on per-op event names ("dot.4", "tanh.5.clone",
+# "fusion.26.remat") — newer profilers emit the bare HLO instruction
+# name on the thread-pool lines, so summing requires folding the
+# numbered instances back onto their opcode
+_SSA_SUFFIX_RE = re.compile(r"(\.\d+)+(\.clone\d*|\.remat\d*)*$")
+
+
+def _canonical_op(name: str) -> str:
+    """Fold one HLO instruction name to its opcode ("dot.4" -> "dot")."""
+    return _SSA_SUFFIX_RE.sub("", name.split(" = ", 1)[0])
 
 
 def _find_xplanes(trace_dir: str):
@@ -72,9 +84,17 @@ def summarize(xplane_path: str):
         # wrapper methods (Foo::Bar), python dispatch frames.
         op_lines = [l for l in plane.lines if l.name == "XLA Ops"]
         event_filter = None
+        normalize = None
         if op_lines:
             lines = op_lines
         else:
+            # host-CPU fallback. Two generations of layout: older jax put
+            # op events on one anonymous line; current jax scatters them
+            # over the runtime's thread-pool lines ("tf_XLAEigen/...",
+            # "tf_XLATfrtCpuClient/...") interleaved with python frames
+            # and C++ wrapper spans, and names events by HLO instruction
+            # ("dot.4") instead of framework op — so filtering happens
+            # per EVENT and instances fold onto their opcode.
             lines = [
                 l
                 for l in plane.lines
@@ -89,6 +109,8 @@ def summarize(xplane_path: str):
                     or n.startswith(("PjitFunction", "profiler", "Pjit", "jit("))
                 )
 
+            normalize = _canonical_op
+
         durs: collections.Counter = collections.Counter()
         count: collections.Counter = collections.Counter()
         for line in lines:
@@ -96,6 +118,8 @@ def summarize(xplane_path: str):
                 n = ev_names.get(ev.metadata_id, "?")
                 if event_filter is not None and not event_filter(n):
                     continue
+                if normalize is not None:
+                    n = normalize(n)
                 durs[n] += ev.duration_ps
                 count[n] += 1
         if durs:

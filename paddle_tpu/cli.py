@@ -1,5 +1,5 @@
 """`paddle` CLI — train / supervise / test / checkgrad / dump_config /
-merge_model / version.
+merge_model / metrics / version.
 
 Role of the reference's TrainerMain + `paddle` shell dispatcher
 (/root/reference/paddle/trainer/TrainerMain.cpp:35-110,
@@ -26,7 +26,7 @@ def main(argv=None) -> int:
     if not argv or argv[0] in ("-h", "--help", "help"):
         print(__doc__)
         print("usage: paddle <train|supervise|test|gen|checkgrad|dump_config|"
-              "merge_model|check-checkpoint|version> [--flags]")
+              "merge_model|check-checkpoint|metrics|version> [--flags]")
         return 0
     cmd, rest = argv[0], argv[1:]
     if cmd == "version":
@@ -46,6 +46,12 @@ def main(argv=None) -> int:
         return _merge_model(rest)
     if cmd in ("check-checkpoint", "check_checkpoint"):
         return _check_checkpoint(rest)
+    if cmd == "metrics":
+        # telemetry analyzer (doc/observability.md) — jax-free like
+        # `supervise`: it must summarize a run dir copied off a pod
+        from paddle_tpu.observability.analyze import main as metrics_main
+
+        return metrics_main(rest)
     print(f"unknown command {cmd!r}", file=sys.stderr)
     return 2
 

@@ -28,6 +28,8 @@ import numpy as np
 from paddle_tpu.graph.argument import Argument
 from paddle_tpu.data.provider import DataType, SequenceType
 from paddle_tpu.native import ptr
+from paddle_tpu.observability import metrics as obs
+from paddle_tpu.observability import spans as obs_spans
 from paddle_tpu.proto import DataConfig
 from paddle_tpu.resilience import BadSampleError, DataStallError
 from paddle_tpu.resilience.faultinject import fault_point
@@ -437,6 +439,7 @@ class DataProvider:
             return True
         except Exception as e:
             self._bad_samples += 1
+            obs.registry().counter("data.bad_samples").inc()
             if self._bad_samples > self.max_bad_samples:
                 raise BadSampleError(
                     f"provider {self.provider.name}: {self._bad_samples} malformed "
@@ -550,7 +553,13 @@ class DataProvider:
         t = threading.Thread(target=worker, daemon=True, name="pt-data-prefetch")
         t.start()
         timeout = self.stall_timeout
+        # telemetry: summed consumer wait (the share of run time the step
+        # loop spent starved — `paddle metrics` reports it per pass) and
+        # the watchdog's view of heartbeat age
+        wait_counter = obs.registry().counter("data.prefetch_wait_s")
+        age_gauge = obs.registry().gauge("data.heartbeat_age_s")
         while True:
+            wait_t0 = time.perf_counter()
             if timeout and timeout > 0:
                 wait_start = time.monotonic()
                 while True:
@@ -563,6 +572,7 @@ class DataProvider:
                         # sample pulled (self._progress): pool-filling
                         # counts as progress, only true dead air trips
                         last = max(beat[0], self._progress)
+                        age_gauge.set(now - last)
                         if (now - wait_start >= timeout
                                 and now - last >= timeout):
                             raise DataStallError(
@@ -578,6 +588,11 @@ class DataProvider:
                             )
             else:
                 item = q.get()
+            waited = time.perf_counter() - wait_t0
+            wait_counter.inc(waited)
+            age_gauge.set(0.0)
+            if waited > 1e-3:  # only waits worth seeing in a trace
+                obs_spans.record_perf("data/prefetch_wait", wait_t0, waited)
             if item is sentinel:
                 break
             yield item

@@ -1,0 +1,34 @@
+"""Unified run telemetry (doc/observability.md).
+
+The reference framework's only observability was ``REGISTER_TIMER`` /
+``StatSet`` log dumps and the BarrierStat straggler line — metrics lived
+as unstructured log text, scraped back out with regexes. This package is
+the structured replacement: every subsystem (trainer step loop, data
+pipeline, checkpoint I/O, retry layer, fault injection, barrier skew)
+emits into one per-host, schema-versioned ``metrics.jsonl`` stream, and
+``spans.py`` upgrades ``stat_timer`` scopes into Chrome trace-event
+spans. ``paddle metrics <run_dir>`` (analyze.py) reads it all back.
+
+Deliberately jax-free at import time: the supervisor and the analyzer
+must work when the accelerator runtime is exactly what keeps crashing.
+"""
+
+from paddle_tpu.observability.metrics import (  # noqa: F401
+    SCHEMA_VERSION,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    MetricsWriter,
+    configure,
+    configure_from_flags,
+    emit,
+    enabled,
+    flush,
+    metrics_files,
+    read_records,
+    read_tail,
+    registry,
+    validate_record,
+)
+from paddle_tpu.observability import spans  # noqa: F401

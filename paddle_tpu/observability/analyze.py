@@ -1,0 +1,251 @@
+"""``paddle metrics <run_dir>`` — read the telemetry back.
+
+Merges the per-host ``metrics*.jsonl`` streams of one run dir, prints a
+per-pass aggregate table (step-time p50/p99, data-wait share, checkpoint
+durations, nonfinite/retry/fault counters), flags stragglers across
+hosts (reusing ``utils/barrier.summarize_host_stats`` — the BarrierStat
+attribution, now fed from structured records instead of log lines) and
+stalls, and emits the whole analysis as JSON with ``--json`` for
+tooling. jax-free: it must run on a dev box against a run dir copied
+off a pod.
+
+Usage::
+
+    paddle metrics <run_dir | metrics.jsonl> [--json] [--tail N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional
+
+from paddle_tpu.observability import metrics as obs
+
+# counters whose per-pass DELTA the table surfaces (snapshot keys from
+# MetricsRegistry — cumulative in the records, differenced here)
+_COUNTER_COLS = (
+    ("data.prefetch_wait_s", "data_wait_s"),
+    ("data.bad_samples", "bad_samples"),
+    ("retry.attempts", "retries"),
+    ("faults.fired", "faults"),
+    ("nonfinite.events", "nonfinite"),
+)
+
+
+def load_run(run_dir: str) -> Dict[int, List[Dict[str, Any]]]:
+    """{host: [records in stream order]} for one run dir."""
+    streams: Dict[int, List[Dict[str, Any]]] = {}
+    for path in obs.metrics_files(run_dir):
+        for rec in obs.read_records(path):
+            streams.setdefault(int(rec.get("host", 0)), []).append(rec)
+    return streams
+
+
+def _counter(rec: Dict[str, Any], name: str) -> float:
+    v = (rec.get("counters") or {}).get(name, 0.0)
+    if isinstance(v, dict):  # histogram snapshot: the count is the tally
+        return float(v.get("count", 0.0))
+    return float(v or 0.0)
+
+
+def analyze(streams: Dict[int, List[Dict[str, Any]]]) -> Dict[str, Any]:
+    """Aggregate merged streams into the analysis document.
+
+    Re-run passes are first-class input: a supervised restart or a
+    rollback re-run appends a SECOND ``pass_end`` for the same (host,
+    pass) to the same stream, so records are deduplicated latest-wins
+    (stream order) per host before aggregation — otherwise samples
+    double-count and the hosts divisor inflates."""
+    hosts = sorted(streams)
+    checkpoints: List[Dict[str, Any]] = []
+    invalid = 0
+    # {host: {pass: latest pass_end record}} — latest-wins dedupe
+    per_host_pass: Dict[int, Dict[int, Dict[str, Any]]] = {}
+    last_skew: Optional[Dict[str, Any]] = None
+    run_ended = False
+
+    for host in hosts:
+        for rec in streams[host]:
+            if obs.validate_record(rec):
+                invalid += 1
+                continue
+            kind = rec.get("kind")
+            if kind == "run_end":
+                run_ended = True
+            elif kind == "checkpoint":
+                checkpoints.append(rec)
+            elif kind == "barrier_skew":
+                last_skew = rec
+            elif kind == "pass_end":
+                p = int(rec.get("pass", -1))
+                per_host_pass.setdefault(host, {})[p] = rec
+
+    passes: Dict[int, Dict[str, Any]] = {}
+    per_host_prev: Dict[int, Dict[str, float]] = {}
+    # per-pass per-host (mean, p99) step times for straggler attribution
+    host_steps: Dict[int, Dict[int, tuple]] = {}
+    for host in hosts:
+        prev_counters: Dict[str, float] = {}
+        for p in sorted(per_host_pass.get(host, {})):
+            rec = per_host_pass[host][p]
+            row = passes.setdefault(p, {"pass": p, "samples": 0, "hosts": 0})
+            row["hosts"] += 1
+            row["samples"] += int(rec.get("samples", 0))
+            if row["hosts"] == 1:
+                # representative scalars come from the LOWEST host with
+                # this pass (host 0 normally) — samples_per_sec/mfu
+                # genuinely differ per host, and last-host-wins would
+                # label the pass with an arbitrary host's number
+                for src in ("AvgCost", "CurrentCost", "samples_per_sec",
+                            "model_tflops_per_sec", "mfu"):
+                    if src in rec:
+                        row[src] = rec[src]
+            for k in ("step_time_p50_s", "step_time_p99_s"):
+                if k in rec:
+                    row[k] = max(float(row.get(k, 0.0)), float(rec[k]))
+            pass_time = float(rec.get("pass_time_s", 0.0))
+            row["pass_time_s"] = max(
+                float(row.get("pass_time_s", 0.0)), pass_time
+            )
+            cur = {name: _counter(rec, name) for name, _ in _COUNTER_COLS}
+            for name, col in _COUNTER_COLS:
+                d = cur[name] - prev_counters.get(name, 0.0)
+                row[col] = row.get(col, 0.0) + max(d, 0.0)
+            prev_counters = cur
+            if row.get("pass_time_s", 0.0) > 0:
+                share = row.get("data_wait_s", 0.0) / (
+                    row["pass_time_s"] * max(row["hosts"], 1)
+                )
+                row["data_wait_share"] = round(min(share, 1.0), 4)
+            if "step_time_mean_s" in rec:
+                host_steps.setdefault(p, {})[host] = (
+                    float(rec["step_time_mean_s"]),
+                    float(rec.get("step_time_p99_s", rec["step_time_mean_s"])),
+                )
+        per_host_prev[host] = prev_counters
+
+    # straggler attribution: feed the gathered per-host step stats of the
+    # LAST pass with full coverage through the BarrierStat formatter
+    straggler = None
+    if len(hosts) > 1 and host_steps:
+        import numpy as np
+
+        from paddle_tpu.utils.barrier import summarize_host_stats
+
+        for p in sorted(host_steps, reverse=True):
+            per_host = host_steps[p]
+            if len(per_host) == len(hosts):
+                table = np.asarray(
+                    [per_host.get(h, (float("nan"),) * 2) for h in hosts]
+                )
+                straggler = {"pass": p, "line": summarize_host_stats(table)}
+                break
+
+    warnings: List[str] = []
+    for p in sorted(passes):
+        row = passes[p]
+        if row.get("data_wait_share", 0.0) > 0.5:
+            warnings.append(
+                f"pass {p}: data-bound — the step loop spent "
+                f"{row['data_wait_share'] * 100:.0f}% of the pass waiting "
+                "on the provider (grow pool_size / check input storage)"
+            )
+        for col, label in (("nonfinite", "non-finite loss event(s)"),
+                           ("faults", "injected fault firing(s)"),
+                           ("bad_samples", "malformed sample(s) skipped")):
+            if row.get(col, 0) > 0:
+                warnings.append(f"pass {p}: {int(row[col])} {label}")
+    if last_skew is not None and last_skew.get("line"):
+        warnings.append(f"barrier skew: {last_skew['line']}")
+    if passes and not run_ended:
+        warnings.append(
+            "stream ends without a run_end record — the run crashed, was "
+            "killed, or is still going"
+        )
+    if invalid:
+        warnings.append(f"{invalid} record(s) failed schema validation")
+
+    return {
+        "hosts": hosts,
+        "passes": [passes[p] for p in sorted(passes)],
+        "checkpoints": checkpoints,
+        "counters": {h: per_host_prev.get(h, {}) for h in hosts},
+        "straggler": straggler,
+        "barrier_skew": last_skew,
+        "run_ended": run_ended,
+        "invalid_records": invalid,
+        "warnings": warnings,
+    }
+
+
+def _fmt_table(doc: Dict[str, Any]) -> str:
+    lines = [
+        f"{'pass':>5} {'samples':>9} {'AvgCost':>10} {'p50 ms':>8} "
+        f"{'p99 ms':>8} {'data-wait':>9} {'nf':>4} {'retry':>5} {'fault':>5}"
+    ]
+    for row in doc["passes"]:
+        lines.append(
+            f"{row['pass']:>5} {row.get('samples', 0):>9} "
+            f"{row.get('AvgCost', float('nan')):>10.5g} "
+            f"{row.get('step_time_p50_s', 0.0) * 1e3:>8.2f} "
+            f"{row.get('step_time_p99_s', 0.0) * 1e3:>8.2f} "
+            f"{row.get('data_wait_share', 0.0) * 100:>8.1f}% "
+            f"{int(row.get('nonfinite', 0)):>4} "
+            f"{int(row.get('retries', 0)):>5} "
+            f"{int(row.get('faults', 0)):>5}"
+        )
+    if doc["checkpoints"]:
+        lines.append("")
+        lines.append(f"{'checkpoint':<10} {'pass':>5} {'secs':>8} {'MB':>9}")
+        for c in doc["checkpoints"]:
+            lines.append(
+                f"{c.get('op', '?'):<10} {c.get('pass', -1):>5} "
+                f"{c.get('duration_s', 0.0):>8.3f} "
+                f"{c.get('bytes', 0) / 1e6:>9.2f}"
+            )
+    if doc["straggler"] and doc["straggler"].get("line"):
+        lines.append("")
+        lines.append(doc["straggler"]["line"])
+    if doc["warnings"]:
+        lines.append("")
+        for w in doc["warnings"]:
+            lines.append(f"! {w}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="paddle metrics",
+        description="summarize a run's metrics.jsonl telemetry",
+    )
+    p.add_argument("run_dir", help="run dir (or one metrics*.jsonl file)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit the full analysis as JSON")
+    p.add_argument("--tail", type=int, default=0, metavar="N",
+                   help="also print the last N raw records per host")
+    args = p.parse_args(argv)
+
+    files = obs.metrics_files(args.run_dir)
+    if not files:
+        print(f"no metrics*.jsonl under {args.run_dir!r} "
+              "(was the run started with --metrics_path / --save_dir?)",
+              file=sys.stderr)
+        return 1
+    doc = analyze(load_run(args.run_dir))
+    if args.as_json:
+        print(json.dumps(doc, indent=2, default=str))
+    else:
+        print(f"# metrics: {', '.join(files)}")
+        print(_fmt_table(doc))
+        if args.tail:
+            for host, recs in sorted(obs.read_tail(args.run_dir, args.tail).items()):
+                print(f"\n-- host {host}: last {len(recs)} records --")
+                for rec in recs:
+                    print(json.dumps(rec, default=str))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -29,6 +29,8 @@ try:  # unavailable when jax has no TPU platform registered (CPU test env)
 except Exception:  # noqa: BLE001
     pltpu = None
 
+from paddle_tpu.ops.pallas_compat import compiler_params as _compiler_params
+
 Array = jax.Array
 
 _NEG = -1e30
@@ -178,7 +180,7 @@ def _run_fwd(q, k, v, lengths, causal, bq, bk, interpret):
             jax.ShapeDtypeStruct((B, H, T), jnp.float32),
         ],
         interpret=interpret,
-        compiler_params=None if pltpu is None else pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
     )(lengths, q, k, v)
@@ -204,7 +206,7 @@ def _run_bwd(q, k, v, do, out, lse, lengths, causal, bq, bk, interpret):
         out_specs=qspec,
         out_shape=jax.ShapeDtypeStruct((B, H, T, D), q.dtype),
         interpret=interpret,
-        compiler_params=None if pltpu is None else pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
     )(lengths, q, k, v, do, lse, delta)
@@ -220,7 +222,7 @@ def _run_bwd(q, k, v, do, out, lse, lengths, causal, bq, bk, interpret):
             jax.ShapeDtypeStruct((B, H, T, D), v.dtype),
         ],
         interpret=interpret,
-        compiler_params=None if pltpu is None else pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
     )(lengths, q, k, v, do, lse, delta)

@@ -1,0 +1,30 @@
+"""Shims over jax.experimental.pallas.tpu API drift.
+
+The TPU compiler-params class was renamed across jax releases
+(``TPUCompilerParams`` -> ``CompilerParams``); kernels call
+:func:`compiler_params` instead of naming either class, so one wheel of
+this package runs on both sides of the rename (and degrades to None —
+"no params" — when pallas TPU support is absent entirely, e.g. CPU-only
+installs running kernels in interpret mode).
+"""
+
+from __future__ import annotations
+
+try:  # unavailable when jax has no TPU platform registered (CPU test env)
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # noqa: BLE001
+    pltpu = None
+
+_PARAMS_CLS = None
+if pltpu is not None:
+    _PARAMS_CLS = getattr(pltpu, "CompilerParams", None) or getattr(
+        pltpu, "TPUCompilerParams", None
+    )
+
+
+def compiler_params(**kwargs):
+    """TPU compiler params under whichever name this jax exposes, or
+    None when pallas TPU support (or the class) is unavailable."""
+    if _PARAMS_CLS is None:
+        return None
+    return _PARAMS_CLS(**kwargs)

@@ -153,9 +153,9 @@ def _run(x: Array, w: Array, b: Array, interpret: bool):
     bm, bn, bk = blocks
     nm, nn, nk = M // bm, N // bn, K // bk
     kernel = functools.partial(_kernel, bn=bn, nk=nk)
-    compiler_params = pltpu.CompilerParams(
-        dimension_semantics=("arbitrary",) * 3
-    )
+    from paddle_tpu.ops.pallas_compat import compiler_params as _cp
+
+    compiler_params = _cp(dimension_semantics=("arbitrary",) * 3)
     y, s, q = pl.pallas_call(
         kernel,
         grid=(nm, nn, nk),

@@ -255,9 +255,9 @@ def _bwd_kernel(dy_ref, acts_ref, hprev_ref, cprev_ref, m_ref, w_ref, peep_ref,
 
 
 def _params(n):
-    if pltpu is None:
-        return None
-    return pltpu.CompilerParams(dimension_semantics=("arbitrary",) * n)
+    from paddle_tpu.ops.pallas_compat import compiler_params
+
+    return compiler_params(dimension_semantics=("arbitrary",) * n)
 
 
 def _run_fwd(x4, mask_tb1, w, peep, acts, interpret, residuals=True,

@@ -179,6 +179,16 @@ class FaultInjector:
         rng = self._rng(site)
         for rule in rules:
             if rule.should_fire(hit, rng):
+                # count (and record) the firing BEFORE the action runs —
+                # exit/raise must not lose the telemetry of their own
+                # firing. Lazy import: this module stays stdlib-only
+                # when injection is inactive.
+                from paddle_tpu.observability import metrics as obs
+
+                obs.registry().counter("faults.fired").inc()
+                obs.emit("fault", site=site, hit=hit,
+                         action=rule.action, info=info)
+                obs.flush()  # an exit-action fault never reaches atexit
                 rule.fire(site, hit, info)
 
     def hits(self, site: str) -> int:

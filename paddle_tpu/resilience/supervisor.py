@@ -15,8 +15,10 @@ as a child process and
   with NO checkpoint progress between them (same restorable pass every
   launch) classifies the failure as deterministic poison — restarting
   would replay it — so the supervisor stops and writes a JSON crash
-  report (exit code, restore history, child-log tail, and the last
-  BarrierStat skew line for slowest-host attribution);
+  report (exit code, restore history, child-log tail, the last N
+  structured metrics records per host from the child's metrics.jsonl
+  telemetry, and the last barrier-skew record for slowest-host
+  attribution — log-line grepping only as the telemetry-less fallback);
 - forwards SIGTERM to the child, so a preempted supervised run still
   checkpoints at the next launch boundary (``--save_on_preempt``) and is
   NOT restarted — the preemption is the scheduler's decision.
@@ -50,6 +52,7 @@ from paddle_tpu.utils.retry import RetryPolicy
 
 CRASH_REPORT = "crash_report.json"
 LOG_TAIL_BYTES = 8192
+METRICS_TAIL_RECORDS = 25  # last N metrics records per host in the report
 # distinct from any child code the trainer produces, so wrappers can
 # tell "supervisor classified this as poison" from "child died again"
 EXIT_CRASH_LOOP = 17
@@ -101,6 +104,12 @@ class Supervisor:
         self.flags = flags
         self._child_cmd_override = child_cmd
         self.save_dir = getattr(flags, "save_dir", "") or ""
+        # where the child's telemetry lands (observability/metrics.py
+        # resolves the same way: --metrics_path wins, save_dir doubles
+        # as the run dir) — the crash report reads its tail from here
+        self.metrics_dir = (
+            getattr(flags, "metrics_path", "") or self.save_dir
+        )
         self.dir = getattr(flags, "supervise_dir", "") or (
             os.path.join(self.save_dir, "supervise")
             if self.save_dir else "supervise"
@@ -300,12 +309,41 @@ class Supervisor:
         except OSError:
             return ""
 
+    def _metrics_tail(self):
+        """Last N structured telemetry records per host from the child's
+        metrics.jsonl streams (observability/metrics.py) — the primary
+        post-mortem evidence, replacing log-grepping. Returns ({host:
+        [records]}, last barrier_skew record or None)."""
+        if not self.metrics_dir:
+            return {}, None
+        from paddle_tpu.observability import metrics as obs
+
+        tails = obs.read_tail(self.metrics_dir, n=METRICS_TAIL_RECORDS)
+        # newest skew record: LAST in stream order per host (the 't'
+        # offset resets to ~0 in every restarted child appending to the
+        # same stream, so it cannot order records across attempts), then
+        # the highest pass across hosts — all hosts emit the same
+        # allgathered table, so any host's newest is authoritative
+        skew = None
+        for recs in tails.values():
+            last = next(
+                (r for r in reversed(recs) if r.get("kind") == "barrier_skew"),
+                None,
+            )
+            if last is not None and (
+                skew is None or last.get("pass", -1) >= skew.get("pass", -1)
+            ):
+                skew = last
+        return {str(h): r for h, r in tails.items()}, skew
+
     def _crash_report(self, reason: str, log_path: str, detail: str) -> str:
         tail = self._log_tail(log_path)
-        # slowest-host attribution for multi-host deaths: the trainer
-        # logs a BarrierStat skew line at each pass end (utils/barrier);
-        # the last one before death names the straggler
-        skew = next(
+        # slowest-host attribution for multi-host deaths: primary source
+        # is the structured barrier_skew metrics record; a telemetry-less
+        # child (no save_dir/--metrics_path) falls back to grepping the
+        # BarrierStat log line the trainer still prints at pass end
+        metrics_tail, skew_rec = self._metrics_tail()
+        skew = skew_rec if skew_rec is not None else next(
             (l for l in reversed(tail.splitlines()) if "BarrierStat:" in l),
             None,
         )
@@ -317,6 +355,7 @@ class Supervisor:
             "train_args": self.train_args,
             "attempts": self.attempts,
             "log_tail": tail,
+            "metrics_tail": metrics_tail,
             "step_time_skew": skew,
             "written_at": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
         }

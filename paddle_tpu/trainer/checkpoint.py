@@ -39,6 +39,7 @@ from __future__ import annotations
 import json
 import os
 import shutil
+import time
 import zipfile
 import zlib
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -47,6 +48,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from paddle_tpu.observability import metrics as obs
+from paddle_tpu.observability import spans as obs_spans
 from paddle_tpu.optimizer.updater import UpdaterState
 from paddle_tpu.resilience import CheckpointCorruptError
 from paddle_tpu.resilience import manifest as ckpt_manifest
@@ -62,6 +65,44 @@ CORRUPT_SUFFIX = ".corrupt"
 
 def _is_pass_dir_name(d: str) -> bool:
     return d.startswith("pass-") and d[5:].isdigit()
+
+
+def _dir_bytes(path: str) -> int:
+    """On-disk size of one checkpoint dir (telemetry only: best-effort)."""
+    total = 0
+    try:
+        for root, _dirs, files in os.walk(path):
+            for f in files:
+                try:
+                    total += os.path.getsize(os.path.join(root, f))
+                except OSError:
+                    pass
+    except OSError:
+        pass
+    return total
+
+
+def _ckpt_record(op: str, path: str, t0: float, pass_id: Optional[int] = None,
+                 measure_bytes: bool = False, **fields) -> None:
+    """One structured ``checkpoint`` record + matching span (save/load/
+    verify durations and bytes — doc/observability.md). The dir walk for
+    ``measure_bytes`` only runs when telemetry is actually on — a
+    telemetry-less tool (merge_model, tests) must not pay thousands of
+    stat() calls for a field a no-op emit would discard. Multi-host
+    saves/loads are collective: only process 0 records (and walks), so a
+    pod save costs ONE shared-FS directory walk, not N, and `paddle
+    metrics` shows one checkpoint row per operation. Spans stay per-host
+    (host-side timing is cheap and genuinely per process)."""
+    dur = time.perf_counter() - t0
+    obs_spans.record_perf(f"checkpoint/{op}", t0, dur)
+    if not obs.enabled():
+        return
+    if jax.process_count() > 1 and jax.process_index() != 0:
+        return
+    if measure_bytes:
+        fields["bytes"] = _dir_bytes(path)
+    obs.emit("checkpoint", op=op, path=path, pass_id=pass_id,
+             duration_s=round(dur, 6), **fields)
 
 
 def _io_policy() -> RetryPolicy:
@@ -241,6 +282,7 @@ def save_checkpoint(
     deletion."""
     final = os.path.join(save_dir, PASS_FMT % pass_id)
     tmp = final + TMP_SUFFIX
+    t0 = time.perf_counter()
     multihost = jax.process_count() > 1
     if jax.process_index() == 0:
         os.makedirs(save_dir, exist_ok=True)
@@ -308,6 +350,7 @@ def save_checkpoint(
         _commit(tmp, final)
         _rotate(save_dir, keep, protect=protect_pass)
     logger.info("saved checkpoint %s", final)
+    _ckpt_record("save", final, t0, pass_id=pass_id, measure_bytes=True)
     return final
 
 
@@ -388,6 +431,7 @@ def verify_checkpoint(path: str) -> List[str]:
     verify on completeness alone — old checkpoints must keep loading."""
     if not os.path.isdir(path):
         return [f"{path}: not a directory"]
+    t0 = time.perf_counter()
     problems: List[str] = []
     if not has_params_tree(path):
         problems.append("no params tree (params.npz / params.index.json)")
@@ -397,6 +441,7 @@ def verify_checkpoint(path: str) -> List[str]:
     problems.extend(
         _io_policy().call(ckpt_manifest.verify_dir, path, name=f"verify {path}")
     )
+    _ckpt_record("verify", path, t0, ok=not problems)
     return problems
 
 
@@ -621,6 +666,7 @@ def load_checkpoint(
     cur = os.path.normpath(path)
     if not os.path.isdir(cur):
         raise FileNotFoundError(f"checkpoint {cur} does not exist")
+    t0 = time.perf_counter()
     first = True
     while True:
         # verify=False covers only the FIRST candidate (the caller just
@@ -630,10 +676,18 @@ def load_checkpoint(
         first = False
         if not problems:
             try:
-                return _load_checkpoint_once(
+                result = _load_checkpoint_once(
                     cur, opt_template, missing, expected_params, sharding_for,
                     io_stats,
                 )
+                _ckpt_record(
+                    "load", cur, t0,
+                    pass_id=result[2].get("pass_id")
+                    if isinstance(result[2].get("pass_id"), int) else None,
+                    measure_bytes=True,
+                    fallbacks=len(tried),
+                )
+                return result
             except (
                 FileNotFoundError,
                 EOFError,

@@ -35,6 +35,8 @@ from paddle_tpu.optimizer import Updater
 from paddle_tpu.proto import TrainerConfig
 from paddle_tpu.trainer import checkpoint as ckpt
 from paddle_tpu.trainer.evaluators import EvaluatorChain
+from paddle_tpu.observability import metrics as obs
+from paddle_tpu.observability import spans as obs_spans
 from paddle_tpu.utils.flags import FLAGS
 from paddle_tpu.utils.logging import logger
 from paddle_tpu.utils.stats import global_stats, stat_timer
@@ -59,10 +61,21 @@ class TrainerStats:
         self.window_cost = 0.0
         self.window_samples = 0
 
+    def summary_dict(self) -> Dict[str, Any]:
+        """The pass/window stats as one dict — the SINGLE source both the
+        human log line (``summary()``) and the metrics.jsonl record are
+        rendered from, so log text and telemetry can never drift."""
+        return {
+            "samples": self.total_samples,
+            "AvgCost": self.total_cost / max(self.total_samples, 1),
+            "CurrentCost": self.window_cost / max(self.window_samples, 1),
+        }
+
     def summary(self) -> str:
-        avg = self.total_cost / max(self.total_samples, 1)
-        cur = self.window_cost / max(self.window_samples, 1)
-        return f"samples={self.total_samples} AvgCost={avg:.6g} CurrentCost={cur:.6g}"
+        return " ".join(
+            f"{k}={v:d}" if isinstance(v, int) else f"{k}={v:.6g}"
+            for k, v in self.summary_dict().items()
+        )
 
 
 class PreemptionExit(Exception):
@@ -290,6 +303,11 @@ class Trainer:
                 "will be no checkpoint to roll back to — the first "
                 "non-finite loss raises NonFiniteLossError"
             )
+        # telemetry (doc/observability.md): per-host metrics.jsonl stream
+        # (--metrics_path, defaulting to save_dir) + Chrome trace-event
+        # spans (--trace_events_path). No-ops when neither is configured.
+        obs.configure_from_flags(flags, host=jax.process_index())
+        obs_spans.configure_from_flags(flags, host=jax.process_index())
         self._maybe_restore()
         # StaticPruningHook init semantics: mask values once at startup
         self.params = self.updater.apply_init_hooks(self.params)
@@ -694,6 +712,8 @@ class Trainer:
                         "preemption: exiting the train loop cleanly "
                         "(no --save_dir configured, nothing was saved)"
                     )
+                obs.emit("run_end", status="preempted")
+                obs.flush()
                 return
         if (
             self.save_dir
@@ -701,6 +721,12 @@ class Trainer:
             and num_passes > self.start_pass  # at least one pass actually ran
         ):
             self.save(num_passes - 1, final=True)
+        # the on-purpose end of the run: a stream WITHOUT this record
+        # ended in a crash/kill (what `paddle metrics` flags and the
+        # supervisor's crash report captures)
+        obs.emit("run_end", status="completed")
+        obs.flush()
+        obs_spans.export()
 
     # --------------------------------------------- whole-data batch mode
 
@@ -875,22 +901,35 @@ class Trainer:
             for l in jax.tree_util.tree_leaves(tree)
         )
 
-    def _mfu_note(self) -> str:
-        """', model X TFLOP/s, MFU Y' for the pass log when accounting
-        ran, over TRAINING time only (the summed step windows — in-pass
-        test/save/stats time would understate it). Empty on the
+    def _mfu_fields(self) -> Dict[str, float]:
+        """Model-FLOP throughput of the finished pass as structured
+        fields, over TRAINING time only (the summed step windows —
+        in-pass test/save/stats time would understate it). Empty on the
         accumulation path and whenever any batch's counting failed; MFU
-        only when the chip's peak is known — never guessed."""
+        only when the chip's peak is known — never guessed. Both the
+        human log note (``_mfu_note``) and the pass_end metrics record
+        render from THIS dict."""
         if (self._pass_flops <= 0 or self._pass_train_s <= 0
                 or self._pass_flops_incomplete):
-            return ""
+            return {}
         from paddle_tpu.ops.kernel_flops import peak_tflops
 
         tfps = self._pass_flops / self._pass_train_s / 1e12
-        note = f", model {tfps:.3g} TFLOP/s"
+        fields = {"model_tflops_per_sec": tfps}
         peak = peak_tflops(jax.devices()[0].device_kind)
         if peak:
-            note += f", MFU {tfps / (peak * jax.device_count()):.3f}"
+            fields["mfu"] = tfps / (peak * jax.device_count())
+        return fields
+
+    def _mfu_note(self, fields: Optional[Dict[str, float]] = None) -> str:
+        """', model X TFLOP/s, MFU Y' rendered from ``_mfu_fields``."""
+        if fields is None:
+            fields = self._mfu_fields()
+        if not fields:
+            return ""
+        note = f", model {fields['model_tflops_per_sec']:.3g} TFLOP/s"
+        if "mfu" in fields:
+            note += f", MFU {fields['mfu']:.3f}"
         return note
 
     def train_one_pass(self, pass_id: int, provider: DataProvider, rng) -> None:
@@ -904,8 +943,10 @@ class Trainer:
         self._pass_flops_incomplete = False
         self._lsgd_discarded = 0
         t0 = time.time()
+        pass_t0 = time.perf_counter()  # span + pass_time_s clock
         batch_id = 0
         step_times: list = []
+        launch_counts = {"single": 0, "fused": 0}
         profiled = False
         # rollback fast-forward: when re-running the pass that diverged,
         # consume (without training) the batches up to and past the
@@ -933,6 +974,7 @@ class Trainer:
             faultinject.fault_point(
                 "trainer.crash", info=f"pass={pass_id} batch={batch_id}"
             )
+            launch_counts[kind] += 1
             if (
                 self.flags.profile_dir
                 and pass_id == self.start_pass
@@ -1089,6 +1131,11 @@ class Trainer:
                     stats.summary(),
                     evaluators.summary(),
                 )
+                # the window record carries the SAME key=value pairs the
+                # log line just printed (one shared dict, satellite of
+                # doc/observability.md)
+                obs.emit("train_window", pass_id=pass_id, step=batch_id,
+                         **stats.summary_dict())
                 stats.reset_window()
             # preemption (SIGTERM flag) saves through the SAME block as the
             # periodic save — one flush, one save, even when both fire on
@@ -1120,6 +1167,12 @@ class Trainer:
                     os.path.join(self.save_dir, ckpt.PASS_FMT % pass_id)
                     if self.save_dir else ""
                 )
+                # SIGTERM-driven flush: the preemption window must not
+                # cost the buffered telemetry of this partial pass
+                obs.emit("preempt", pass_id=pass_id, step=batch_id,
+                         saved_path=saved_path)
+                obs.flush()
+                obs_spans.export()
                 raise PreemptionExit(pass_id, saved_path)
             if profiling and batch_id >= (
                 self.flags.profile_start_batch + self.flags.profile_num_batches
@@ -1148,17 +1201,39 @@ class Trainer:
         self._end_dot_line()
         dt = time.time() - t0
         rate = stats.total_samples / max(dt, 1e-9)
+        mfu_fields = self._mfu_fields()
         logger.info(
             "Pass %d done: %s  %s  (%.1f samples/s%s)",
             pass_id,
             stats.summary(),
             evaluators.summary(),
             rate,
-            self._mfu_note(),
+            self._mfu_note(mfu_fields),
+        )
+        # the structured twin of the "Pass N done" line: same shared
+        # dict (summary_dict / mfu_fields) plus step-time quantiles,
+        # launch-group counts, and the cumulative counters snapshot —
+        # flushed here, so a crash loses at most one pass window
+        record: Dict[str, Any] = dict(stats.summary_dict())
+        record.update(evaluators.results())
+        record.update(mfu_fields)
+        record["samples_per_sec"] = rate
+        record["pass_time_s"] = time.perf_counter() - pass_t0
+        if step_times:
+            record["step_time_mean_s"] = float(np.mean(step_times))
+            record["step_time_p50_s"] = float(np.percentile(step_times, 50))
+            record["step_time_p99_s"] = float(np.percentile(step_times, 99))
+        record["launches_single"] = launch_counts["single"]
+        record["launches_fused"] = launch_counts["fused"]
+        if obs.enabled():
+            record["counters"] = obs.registry().snapshot()
+        obs.emit("pass_end", pass_id=pass_id, step=batch_id, **record)
+        obs_spans.record_perf(
+            "trainer/pass", pass_t0, time.perf_counter() - pass_t0
         )
         from paddle_tpu.utils.barrier import step_time_skew_summary
 
-        step_time_skew_summary(step_times)
+        step_time_skew_summary(step_times, pass_id=pass_id)
 
     # --------------------------------------------- divergence recovery
 
@@ -1200,6 +1275,9 @@ class Trainer:
             f"non-finite loss ({value}) at pass {pass_id} "
             f"batch {batch_id} {launch_note}"
         )
+        obs.registry().counter("nonfinite.events").inc()
+        obs.emit("nonfinite", pass_id=pass_id, step=batch_id,
+                 value=value, policy=self._nf_policy)
         if self._nf_policy == "abort" or snap is None:
             raise NonFiniteLossError(
                 base + "— aborting. Try --job=checkgrad, a lower learning "
@@ -1537,6 +1615,7 @@ class Trainer:
         results.update(evaluators.results())
         logger.info("Test (pass %d): %s  %s", pass_id, stats.summary(),
                     evaluators.summary())
+        obs.emit("test", pass_id=pass_id, **results)
         return results
 
     def predict(self, provider: DataProvider, params=None) -> Dict[str, float]:

@@ -17,10 +17,15 @@ import numpy as np
 from paddle_tpu.utils.logging import logger
 
 
-def step_time_skew_summary(step_times_s: List[float]) -> Optional[str]:
+def step_time_skew_summary(
+    step_times_s: List[float], pass_id: Optional[int] = None
+) -> Optional[str]:
     """All-gather this host's mean/p99 step time and summarize cross-host
     skew. Returns the log line (also logged here), or None when not
-    running multi-process."""
+    running multi-process. Also emits the gathered table as a structured
+    ``barrier_skew`` metrics record (doc/observability.md), so the
+    supervisor's crash report and `paddle metrics` read attribution from
+    telemetry instead of grepping this log line."""
     import jax
 
     if jax.process_count() == 1:
@@ -42,6 +47,22 @@ def step_time_skew_summary(step_times_s: List[float]) -> Optional[str]:
     line = summarize_host_stats(all_stats)
     if line is not None:
         logger.info(line)
+        from paddle_tpu.observability import metrics as obs
+
+        means = all_stats[:, 0].astype(float)
+        valid = np.isfinite(means)
+        obs.emit(
+            "barrier_skew",
+            pass_id=pass_id,
+            mean_s=[float(m) if np.isfinite(m) else None for m in means],
+            p99_s=[
+                float(p) if np.isfinite(p) else None for p in all_stats[:, 1]
+            ],
+            skew_s=float(np.nanmax(means) - np.nanmin(means)),
+            slowest_host=int(np.nanargmax(means)),
+            idle_hosts=[int(i) for i in np.flatnonzero(~valid)],
+            line=line,
+        )
     return line
 
 

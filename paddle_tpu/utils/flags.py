@@ -54,6 +54,14 @@ class _Flags:
     profile_dir: str = ""                # write a profiler trace here
     profile_start_batch: int = 5
     profile_num_batches: int = 10
+    # observability (doc/observability.md): per-host structured telemetry.
+    # metrics_path: run dir for the append-only metrics.jsonl stream
+    # (empty = use --save_dir when set, else telemetry off);
+    # trace_events_path: export stat_timer scopes as Chrome trace-event
+    # JSON here (host-side spans; composes with --profile_dir's device
+    # xplanes via the shared scope names)
+    metrics_path: str = ""
+    trace_events_path: str = ""
     # resilience (doc/resilience.md)
     # fault injection: site=action[:arg][@trigger];... (see
     # paddle_tpu/resilience/faultinject.py; PADDLE_TPU_FAULTS env also works)

@@ -1,17 +1,25 @@
-"""Plot cost curves from training logs
+"""Plot cost curves from run telemetry or training logs
 (ref: python/paddle/utils/plotcurve.py — reads trainer log lines and
 plots AvgCost and any named evaluator over passes).
 
 Usage:
     python -m paddle_tpu.utils.plotcurve [-o out.png] [key ...] < train.log
-Keys default to AvgCost; any `name=value` token in "Pass N done" lines
-can be named (e.g. classification_error). Without matplotlib, prints an
-ASCII curve instead.
+    python -m paddle_tpu.utils.plotcurve -i <run_dir> AvgCost
+
+When the input is a run dir (or a metrics*.jsonl file), the structured
+``pass_end`` records are the source — no regex scraping (see
+doc/observability.md). The legacy "Pass N done" log-scraping path stays
+as the fallback for plain log files and stdin, so curves from
+pre-telemetry runs keep plotting. Keys default to AvgCost; any numeric
+field of the pass_end record (or `name=value` log token) can be named
+(e.g. classification_error, step_time_p99_s). Without matplotlib,
+prints an ASCII curve instead.
 """
 
 from __future__ import annotations
 
 import argparse
+import os
 import re
 import sys
 from typing import Dict, List
@@ -35,13 +43,53 @@ def parse_log(lines) -> Dict[str, List[float]]:
     return series
 
 
+def parse_metrics(run_dir: str) -> Dict[str, List[float]]:
+    """pass-indexed series from metrics.jsonl ``pass_end`` records (host
+    0's stream when several exist — costs are identical across hosts)."""
+    from paddle_tpu.observability import metrics as obs
+
+    by_pass: Dict[int, Dict[str, float]] = {}
+    for path in obs.metrics_files(run_dir):
+        for rec in obs.read_records(path):
+            if rec.get("kind") != "pass_end" or rec.get("host", 0) != 0:
+                continue
+            fields = {
+                k: float(v) for k, v in rec.items()
+                if isinstance(v, (int, float)) and not isinstance(v, bool)
+                and k not in ("v", "host", "pass", "t")
+            }
+            by_pass[int(rec.get("pass", len(by_pass)))] = fields
+    # every series spans the SAME pass axis: a field absent from some
+    # pass (mfu when FLOP accounting failed, an evaluator that didn't
+    # run) holds a NaN gap there instead of silently shifting later
+    # points left onto the wrong pass
+    passes = sorted(by_pass)
+    keys = {k for fields in by_pass.values() for k in fields}
+    return {
+        k: [by_pass[p].get(k, float("nan")) for p in passes] for k in keys
+    }
+
+
+def _is_metrics_input(path: str) -> bool:
+    from paddle_tpu.observability import metrics as obs
+
+    if os.path.isdir(path):
+        return bool(obs.metrics_files(path))
+    # must actually exist: a typo'd .jsonl path falls through to the log
+    # path, whose open() raises the honest FileNotFoundError
+    return path.endswith(".jsonl") and os.path.isfile(path)
+
+
 def ascii_plot(ys: List[float], width: int = 60, height: int = 12) -> str:
-    if not ys:
+    finite = [y for y in ys if y == y]  # NaN gaps (see parse_metrics)
+    if not finite:
         return "(no data)"
-    lo, hi = min(ys), max(ys)
+    lo, hi = min(finite), max(finite)
     span = (hi - lo) or 1.0
     rows = [[" "] * width for _ in range(height)]
     for i, y in enumerate(ys):
+        if y != y:
+            continue  # gap: leave the column empty
         x = int(i * (width - 1) / max(len(ys) - 1, 1))
         r = int((hi - y) * (height - 1) / span)
         rows[r][x] = "*"
@@ -58,8 +106,18 @@ def main(argv=None) -> int:
     p.add_argument("-o", "--output", default="", help="png path (matplotlib)")
     args = p.parse_args(argv)
 
-    lines = sys.stdin if args.input == "-" else open(args.input)
-    series = parse_log(lines)
+    if args.input != "-" and _is_metrics_input(args.input):
+        # structured telemetry preferred; the regex path below stays for
+        # plain logs (old runs scrape exactly as before)
+        series = parse_metrics(args.input)
+    elif args.input != "-" and os.path.isdir(args.input):
+        print(f"{args.input} is a directory with no metrics*.jsonl "
+              "(pass a log file, or rerun training with --metrics_path)",
+              file=sys.stderr)
+        return 1
+    else:
+        lines = sys.stdin if args.input == "-" else open(args.input)
+        series = parse_log(lines)
     keys = args.keys or (["AvgCost"] if "AvgCost" in series else sorted(series)[:1])
     missing = [k for k in keys if k not in series]
     if missing:

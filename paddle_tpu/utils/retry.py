@@ -93,6 +93,12 @@ class _RetryState:
     def retry(self, exc: BaseException) -> None:
         """Record a failed attempt. Sleeps and returns when another
         attempt is allowed; re-raises ``exc`` when exhausted."""
+        # telemetry: every failed attempt and every give-up is counted
+        # (registry snapshot rides the pass_end record; `paddle metrics`
+        # surfaces the per-pass delta)
+        from paddle_tpu.observability import metrics as obs
+
+        obs.registry().counter("retry.attempts").inc()
         self.attempt += 1
         p = self.policy
         elapsed = time.monotonic() - self.started
@@ -101,16 +107,19 @@ class _RetryState:
                 "%s: attempt %d/%d failed (%s) — giving up",
                 self.name, self.attempt, p.max_attempts, exc,
             )
+            obs.registry().counter("retry.exhausted").inc()
             raise exc
         if p.deadline and elapsed >= p.deadline:
             logger.warning(
                 "%s: retry deadline (%.1fs) exhausted after attempt %d (%s) "
                 "— giving up", self.name, p.deadline, self.attempt, exc,
             )
+            obs.registry().counter("retry.exhausted").inc()
             raise exc
         d = p.delay_for(self.attempt, self._rng)
         if p.deadline:
             d = min(d, max(p.deadline - elapsed, 0.0))
+        obs.registry().counter("retry.backoff_s").inc(d)
         logger.warning(
             "%s: attempt %d/%d failed (%s) — retrying in %.2gs",
             self.name, self.attempt, p.max_attempts, exc, d,

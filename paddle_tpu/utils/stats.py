@@ -74,7 +74,10 @@ global_stats = StatSet()
 
 @contextlib.contextmanager
 def stat_timer(name: str, block_on=None) -> Iterator[None]:
-    """Time a scope into ``global_stats`` and the jax profiler trace.
+    """Time a scope into ``global_stats``, the jax profiler trace, and —
+    when ``--trace_events_path`` configured a collector — the span layer
+    (observability/spans.py), where the same named scopes export as
+    nested Chrome trace events.
 
     ``block_on``: optional pytree whose leaves are block_until_ready'd before
     stopping the clock, so device time is included.
@@ -84,9 +87,13 @@ def stat_timer(name: str, block_on=None) -> Iterator[None]:
     # usable when the accelerator runtime is exactly what keeps crashing
     import jax
 
+    from paddle_tpu.observability import spans
+
     t0 = time.perf_counter()
     with jax.profiler.TraceAnnotation(name):
         yield
     if block_on is not None:
         jax.block_until_ready(block_on)
-    global_stats.get(name).add(time.perf_counter() - t0)
+    dt = time.perf_counter() - t0
+    global_stats.get(name).add(dt)
+    spans.record_perf(name, t0, dt)

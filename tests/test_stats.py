@@ -1,0 +1,132 @@
+"""utils/stats.py direct coverage (Stat, StatSet, stat_timer nesting and
+threading) and the streaming-histogram quantile math pinned against
+numpy percentiles."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.observability.metrics import Histogram
+from paddle_tpu.utils.stats import Stat, StatSet, global_stats, stat_timer
+
+pytestmark = pytest.mark.obs
+
+
+# ------------------------------------------------------------- Stat(Set)
+
+
+def test_stat_accumulates_total_count_max_avg():
+    s = Stat("x")
+    for dt in (0.1, 0.3, 0.2):
+        s.add(dt)
+    assert s.count == 3
+    assert s.total_s == pytest.approx(0.6)
+    assert s.max_s == pytest.approx(0.3)
+    assert s.avg_s == pytest.approx(0.2)
+    # empty stat: avg must not divide by zero
+    assert Stat("y").avg_s == 0.0
+
+
+def test_statset_get_is_stable_and_summary_sorts_by_total():
+    ss = StatSet("t")
+    assert ss.get("a") is ss.get("a")
+    ss.get("small").add(0.001)
+    ss.get("big").add(1.0)
+    text = ss.summary()
+    assert text.index("big") < text.index("small")
+    assert "n=1" in text
+    ss.reset()
+    assert "empty" in ss.summary()
+
+
+def test_statset_threaded_adds_lose_nothing():
+    ss = StatSet("threads")
+    N, T = 200, 8
+
+    def work():
+        for _ in range(N):
+            ss.get("shared").add(0.001)
+
+    threads = [threading.Thread(target=work) for _ in range(T)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    st = ss.get("shared")
+    assert st.count == N * T
+    assert st.total_s == pytest.approx(0.001 * N * T)
+
+
+# ------------------------------------------------------------ stat_timer
+
+
+def test_stat_timer_records_scope_and_nests():
+    global_stats.reset()
+    with stat_timer("outer_scope"):
+        time.sleep(0.01)
+        with stat_timer("inner_scope"):
+            time.sleep(0.01)
+    outer = global_stats.get("outer_scope")
+    inner = global_stats.get("inner_scope")
+    assert outer.count == 1 and inner.count == 1
+    # the outer scope contains the inner one
+    assert outer.total_s >= inner.total_s
+    assert inner.total_s >= 0.005
+
+
+def test_stat_timer_concurrent_threads_each_count():
+    global_stats.reset()
+    T = 4
+
+    def work(i):
+        with stat_timer("thread_scope"):
+            time.sleep(0.005)
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(T)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert global_stats.get("thread_scope").count == T
+
+
+# ------------------------------------------------------------- Histogram
+
+
+@pytest.mark.parametrize("dist", ["uniform", "lognormal", "constant"])
+def test_histogram_quantiles_match_numpy(dist):
+    rng = np.random.RandomState(0)
+    if dist == "uniform":
+        xs = rng.uniform(0.001, 2.0, size=5000)
+    elif dist == "lognormal":
+        xs = rng.lognormal(mean=-2.0, sigma=1.0, size=5000)
+    else:
+        xs = np.full(1000, 0.25)
+    h = Histogram("t", growth=1.05)
+    for x in xs:
+        h.observe(float(x))
+    for q in (0.5, 0.9, 0.99):
+        want = float(np.percentile(xs, q * 100))
+        got = h.quantile(q)
+        # geometric buckets: relative error bounded by the bucket width
+        assert got == pytest.approx(want, rel=0.08), (dist, q, got, want)
+    assert h.count == len(xs)
+    assert h.mean == pytest.approx(float(xs.mean()), rel=1e-6)
+    snap = h.snapshot()
+    assert snap["count"] == len(xs)
+    assert snap["max"] == pytest.approx(float(xs.max()))
+
+
+def test_histogram_edge_cases():
+    h = Histogram("e")
+    assert h.quantile(0.5) == 0.0  # empty
+    h.observe(0.0)     # underflow bucket
+    h.observe(-1.0)    # negative clamps to min_value
+    assert h.quantile(0.5) <= h.min_value
+    # quantiles never report outside the observed range
+    h2 = Histogram("e2")
+    h2.observe(3.0)
+    assert h2.quantile(0.99) == pytest.approx(3.0)
+    assert h2.quantile(0.0) <= 3.0

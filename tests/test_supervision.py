@@ -445,3 +445,12 @@ def test_supervise_e2e_crash_loop_report(tmp_path):
     assert [a["exit_code"] for a in report["attempts"]] == [9, 9]
     assert all(a["restored_from"] is None for a in report["attempts"])
     assert report["log_tail"]  # the child log tail is attached
+    # the report carries the child's structured telemetry tail (last N
+    # metrics.jsonl records per host), not just grepped log text: the
+    # trainer wrote run_start into <save_dir>/metrics.jsonl and the
+    # fault-injection layer flushed its own firing before os._exit
+    tail = report["metrics_tail"]["0"]
+    kinds = [rec["kind"] for rec in tail]
+    assert "run_start" in kinds and "fault" in kinds, kinds
+    fault = next(rec for rec in tail if rec["kind"] == "fault")
+    assert fault["site"] == "trainer.crash" and fault["action"] == "exit"
