@@ -26,7 +26,7 @@ def main(argv=None) -> int:
     if not argv or argv[0] in ("-h", "--help", "help"):
         print(__doc__)
         print("usage: paddle <train|supervise|test|gen|checkgrad|dump_config|"
-              "merge_model|check-checkpoint|metrics|version> [--flags]")
+              "merge_model|check-checkpoint|metrics|faults|version> [--flags]")
         return 0
     cmd, rest = argv[0], argv[1:]
     if cmd == "version":
@@ -52,8 +52,25 @@ def main(argv=None) -> int:
         from paddle_tpu.observability.analyze import main as metrics_main
 
         return metrics_main(rest)
+    if cmd == "faults":
+        return _faults()
     print(f"unknown command {cmd!r}", file=sys.stderr)
     return 2
+
+
+def _faults() -> int:
+    """`paddle faults` — list the fault-injection sites with their
+    one-line descriptions, so `--fault_spec` chaos specs are written
+    from documentation instead of guessed from source. jax-free."""
+    from paddle_tpu.resilience.faultinject import SITE_DOCS
+
+    print("fault-injection sites (--fault_spec='site=action[:arg][@trigger]"
+          "[;...]', actions: raise | oserror | exit[:code] | sleep[:secs];"
+          " see doc/resilience.md):")
+    width = max(len(s) for s in SITE_DOCS)
+    for site, desc in SITE_DOCS.items():
+        print(f"  {site:<{width}}  {desc}")
+    return 0
 
 
 def _setup(rest):
@@ -96,6 +113,12 @@ def _run_trainer_job(cmd, rest) -> int:
     trainer = Trainer(config, flags)
     if cmd == "train":
         trainer.train()
+        if getattr(trainer, "preempted", False):
+            # distinct exit code: supervisors/launchers restart a
+            # preempted run without consuming restart budget
+            from paddle_tpu.resilience import EXIT_PREEMPTED
+
+            return EXIT_PREEMPTED
         return 0
     if cmd == "test":
         if flags.test_pass >= 0:

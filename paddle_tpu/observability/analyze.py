@@ -65,6 +65,7 @@ def analyze(streams: Dict[int, List[Dict[str, Any]]]) -> Dict[str, Any]:
     per_host_pass: Dict[int, Dict[int, Dict[str, Any]]] = {}
     last_skew: Optional[Dict[str, Any]] = None
     run_ended = False
+    hangs: List[Dict[str, Any]] = []
 
     for host in hosts:
         for rec in streams[host]:
@@ -78,6 +79,8 @@ def analyze(streams: Dict[int, List[Dict[str, Any]]]) -> Dict[str, Any]:
                 checkpoints.append(rec)
             elif kind == "barrier_skew":
                 last_skew = rec
+            elif kind == "hang":
+                hangs.append(rec)
             elif kind == "pass_end":
                 p = int(rec.get("pass", -1))
                 per_host_pass.setdefault(host, {})[p] = rec
@@ -102,7 +105,12 @@ def analyze(streams: Dict[int, List[Dict[str, Any]]]) -> Dict[str, Any]:
                             "model_tflops_per_sec", "mfu"):
                     if src in rec:
                         row[src] = rec[src]
-            for k in ("step_time_p50_s", "step_time_p99_s"):
+            # worst-across-hosts per pass: step-time quantiles and the
+            # hangwatch's max progress age (a near-miss stall on ANY
+            # host is the number an operator tuning --step_hang_timeout
+            # needs)
+            for k in ("step_time_p50_s", "step_time_p99_s",
+                      "progress_age_max_s"):
                 if k in rec:
                     row[k] = max(float(row.get(k, 0.0)), float(rec[k]))
             pass_time = float(rec.get("pass_time_s", 0.0))
@@ -157,6 +165,13 @@ def analyze(streams: Dict[int, List[Dict[str, Any]]]) -> Dict[str, Any]:
                            ("bad_samples", "malformed sample(s) skipped")):
             if row.get(col, 0) > 0:
                 warnings.append(f"pass {p}: {int(row[col])} {label}")
+    for h in hangs:
+        warnings.append(
+            f"hang detected on host {h.get('host', '?')} at pass "
+            f"{h.get('pass', '?')} step {h.get('step', '?')}: no progress "
+            f"for {h.get('age_s', '?')}s (exit 19; forensics in "
+            f"{h.get('report', 'hang_report.json')})"
+        )
     if last_skew is not None and last_skew.get("line"):
         warnings.append(f"barrier skew: {last_skew['line']}")
     if passes and not run_ended:
@@ -174,6 +189,7 @@ def analyze(streams: Dict[int, List[Dict[str, Any]]]) -> Dict[str, Any]:
         "counters": {h: per_host_prev.get(h, {}) for h in hosts},
         "straggler": straggler,
         "barrier_skew": last_skew,
+        "hangs": hangs,
         "run_ended": run_ended,
         "invalid_records": invalid,
         "warnings": warnings,
@@ -181,12 +197,19 @@ def analyze(streams: Dict[int, List[Dict[str, Any]]]) -> Dict[str, Any]:
 
 
 def _fmt_table(doc: Dict[str, Any]) -> str:
-    lines = [
+    # the age column (hangwatch's max progress age per pass, worst host)
+    # only appears when some record carried it — telemetry from runs
+    # without --step_hang_timeout keeps the old table shape
+    with_age = any("progress_age_max_s" in r for r in doc["passes"])
+    header = (
         f"{'pass':>5} {'samples':>9} {'AvgCost':>10} {'p50 ms':>8} "
         f"{'p99 ms':>8} {'data-wait':>9} {'nf':>4} {'retry':>5} {'fault':>5}"
-    ]
+    )
+    if with_age:
+        header += f" {'age s':>6}"
+    lines = [header]
     for row in doc["passes"]:
-        lines.append(
+        line = (
             f"{row['pass']:>5} {row.get('samples', 0):>9} "
             f"{row.get('AvgCost', float('nan')):>10.5g} "
             f"{row.get('step_time_p50_s', 0.0) * 1e3:>8.2f} "
@@ -196,6 +219,9 @@ def _fmt_table(doc: Dict[str, Any]) -> str:
             f"{int(row.get('retries', 0)):>5} "
             f"{int(row.get('faults', 0)):>5}"
         )
+        if with_age:
+            line += f" {row.get('progress_age_max_s', 0.0):>6.2f}"
+        lines.append(line)
     if doc["checkpoints"]:
         lines.append("")
         lines.append(f"{'checkpoint':<10} {'pass':>5} {'secs':>8} {'MB':>9}")

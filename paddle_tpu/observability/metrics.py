@@ -443,6 +443,32 @@ def read_tail(run_dir: str, n: int = 20) -> Dict[int, List[Dict[str, Any]]]:
     return out
 
 
+def tail_with_last_skew(run_dir: str, n: int = 20):
+    """(``{host-str: [last n records]}``, newest ``barrier_skew`` record
+    or None) — the shared post-mortem evidence shape embedded by BOTH
+    the supervisor's ``crash_report.json`` and hangwatch's
+    ``hang_report.json``, extracted here so the skew-selection rule
+    cannot drift between them.
+
+    Newest skew: LAST in stream order per host (the ``t`` offset resets
+    to ~0 in every restarted child appending to the same stream, so it
+    cannot order records across attempts), then the highest pass across
+    hosts — all hosts emit the same allgathered table, so any host's
+    newest is authoritative."""
+    tails = read_tail(run_dir, n=n)
+    skew: Optional[Dict[str, Any]] = None
+    for recs in tails.values():
+        last = next(
+            (r for r in reversed(recs) if r.get("kind") == "barrier_skew"),
+            None,
+        )
+        if last is not None and (
+            skew is None or last.get("pass", -1) >= skew.get("pass", -1)
+        ):
+            skew = last
+    return {str(h): r for h, r in tails.items()}, skew
+
+
 def validate_record(rec: Dict[str, Any]) -> List[str]:
     """Problems with one record against the documented schema
     (doc/observability.md); empty list = valid."""

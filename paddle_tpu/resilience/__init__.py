@@ -21,7 +21,29 @@ Pieces (see doc/resilience.md for the failure model):
   process, restart it with backoff and ``--init_model_path=auto`` on
   nonzero exit, detect crash loops (repeated death at the same restored
   checkpoint), and emit a JSON crash report when recovery is hopeless.
+- ``hangwatch`` — in-process step-progress watchdog: the trainer pings
+  it at every launch boundary; a stall longer than
+  ``--step_hang_timeout`` dumps all thread stacks + the telemetry tail
+  into ``hang_report.json`` and exits ``EXIT_HANG`` so supervisors see
+  a *diagnosed* death instead of a silent external timeout.
+- ``heartbeat`` — cluster-level liveness: each host renews a heartbeat
+  file under the shared run dir; ``cluster_launch`` polls staleness so
+  a wedged-but-alive rank is named and torn down instead of burning
+  every other host inside a blocked collective.
 - errors below — typed failures the trainer and tools can act on.
+
+Exit-code discipline (supervisors and launchers dispatch on these —
+all distinct from each other and from ordinary crash codes):
+
+- ``EXIT_CRASH_LOOP`` (17) — the supervisor classified the failure as
+  deterministic poison and stopped restarting.
+- ``EXIT_PREEMPTED`` (18) — the trainer was SIGTERM-preempted, saved at
+  a launch boundary, and exited cleanly; supervisors/launchers restart
+  WITHOUT consuming restart budget (preemption is the scheduler's
+  decision, not the run's failure).
+- ``EXIT_HANG`` (19) — hangwatch detected a stalled step loop, wrote
+  ``hang_report.json``, and killed the process; counts as a real
+  failure (budget consumed), with forensics attached.
 
 The shared backoff machinery lives in ``paddle_tpu.utils.retry``
 (checkpoint I/O and data-provider iteration both use it). The
@@ -31,6 +53,14 @@ stays separate.
 """
 
 from __future__ import annotations
+
+# canonical process exit codes (see module docstring). EXIT_CRASH_LOOP
+# predates this table and is re-exported by resilience.supervisor for
+# existing importers; the values must stay distinct forever — wrappers
+# dispatch on them.
+EXIT_CRASH_LOOP = 17
+EXIT_PREEMPTED = 18
+EXIT_HANG = 19
 
 
 class CheckpointCorruptError(RuntimeError):
@@ -70,6 +100,9 @@ class NonFiniteLossError(FloatingPointError):
 
 
 __all__ = [
+    "EXIT_CRASH_LOOP",
+    "EXIT_PREEMPTED",
+    "EXIT_HANG",
     "CheckpointCorruptError",
     "DataStallError",
     "BadSampleError",

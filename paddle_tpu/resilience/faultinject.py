@@ -31,14 +31,9 @@ Examples::
     provider.stall=sleep:120@5        # prefetch worker hangs at item 5
     checkpoint.write=oserror@p0.2     # 20% of file writes flake
 
-Instrumented sites: ``checkpoint.write`` (before each checkpoint file
-write), ``checkpoint.rename`` (before the tmp→final commit rename),
-``provider.yield`` (before each sample leaves a data provider),
-``provider.stall`` (inside the prefetch worker loop), ``trainer.crash``
-(before each trained launch — ``exit`` here is a mid-run process death
-for `paddle supervise` drills), ``trainer.nonfinite`` (at the per-batch
-loss check — a firing ``raise`` turns that batch's loss into NaN, the
-deterministic divergence for ``--nonfinite_policy`` drills).
+Instrumented sites: see ``SITE_DOCS`` below — `paddle faults` prints
+the same table, so chaos specs are written from documentation instead
+of read out of source.
 
 Inactive cost is one global ``is None`` check per site hit.
 """
@@ -55,14 +50,36 @@ from typing import Dict, List, Optional
 ENV_SPEC = "PADDLE_TPU_FAULTS"
 ENV_SEED = "PADDLE_TPU_FAULT_SEED"
 
-KNOWN_SITES = (
-    "checkpoint.write",
-    "checkpoint.rename",
-    "provider.yield",
-    "provider.stall",
-    "trainer.crash",
-    "trainer.nonfinite",
-)
+# every instrumented site, with the one-line description `paddle faults`
+# prints — chaos specs should be written from this table, not guessed
+# from source. Keys double as the KNOWN_SITES membership set.
+SITE_DOCS = {
+    "checkpoint.write":
+        "before each checkpoint file write (oserror = flaky disk; "
+        "exit = die mid-write)",
+    "checkpoint.rename":
+        "between checkpoint write and the tmp->final commit rename "
+        "(exit = torn commit)",
+    "provider.yield":
+        "before each sample leaves a data provider (oserror = "
+        "retryable read flake)",
+    "provider.stall":
+        "inside the prefetch worker loop (sleep = hung data pipeline, "
+        "trips the --data_stall_timeout watchdog)",
+    "trainer.crash":
+        "before each trained launch (exit = mid-run process death for "
+        "`paddle supervise` drills)",
+    "trainer.stall":
+        "before each trained launch (sleep = wedged step loop, trips "
+        "the --step_hang_timeout hangwatch -> hang_report.json + "
+        "exit 19)",
+    "trainer.nonfinite":
+        "at the per-batch loss check (raise = that batch's loss "
+        "becomes NaN, the deterministic divergence for "
+        "--nonfinite_policy drills)",
+}
+
+KNOWN_SITES = tuple(SITE_DOCS)
 
 
 class FaultInjected(RuntimeError):

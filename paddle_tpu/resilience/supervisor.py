@@ -21,7 +21,13 @@ as a child process and
   attribution — log-line grepping only as the telemetry-less fallback);
 - forwards SIGTERM to the child, so a preempted supervised run still
   checkpoints at the next launch boundary (``--save_on_preempt``) and is
-  NOT restarted — the preemption is the scheduler's decision.
+  NOT restarted — the preemption is the scheduler's decision;
+- exit-code discipline: a child that exits ``EXIT_PREEMPTED`` (18 — it
+  was SIGTERMed directly, checkpointed, and left cleanly) is restarted
+  for FREE (no budget, no crash-loop accounting); a child that exits
+  ``EXIT_HANG`` (19 — hangwatch killed a wedged step loop) counts as a
+  real failure and its ``hang_report.json`` (thread stacks, telemetry
+  tail) is embedded in the crash report.
 
 The supervisor deliberately never initializes jax: probing the save_dir
 for checkpoint progress uses the manifest layer only, so a child killed
@@ -47,15 +53,18 @@ import sys
 import time
 from typing import Callable, Dict, List, Optional
 
+from paddle_tpu.resilience import EXIT_CRASH_LOOP, EXIT_HANG, EXIT_PREEMPTED
 from paddle_tpu.utils.logging import logger
 from paddle_tpu.utils.retry import RetryPolicy
 
 CRASH_REPORT = "crash_report.json"
 LOG_TAIL_BYTES = 8192
 METRICS_TAIL_RECORDS = 25  # last N metrics records per host in the report
-# distinct from any child code the trainer produces, so wrappers can
-# tell "supervisor classified this as poison" from "child died again"
-EXIT_CRASH_LOOP = 17
+# preemption restarts are budget-free, but not INFINITE: a child that is
+# SIGTERMed moments after every launch (broken node agent, cgroup
+# killer) would otherwise loop forever. 100 consecutive preemptions
+# with zero completed runs is a storm, not scheduling.
+FREE_RESTART_LIMIT = 100
 
 
 def probe_restorable(save_dir: str) -> Optional[str]:
@@ -126,6 +135,9 @@ class Supervisor:
             sleep=sleep,
         )
         self._probe = probe or (lambda: probe_restorable(self.save_dir))
+        # wall-clock birth of this supervise invocation: the staleness
+        # gate for hang_report.json (see _hang_report)
+        self._t0_wall = time.time()
         self._rng = random.Random()
         self._proc: Optional[subprocess.Popen] = None
         self._terminating = False
@@ -176,14 +188,17 @@ class Supervisor:
             return 0
         os.makedirs(self.dir, exist_ok=True)
         restarts = 0
+        restarts_free = 0  # preemption restarts: never charged to budget
         same_state_deaths = 0
         prev_restored: object = self  # sentinel: no failed attempt yet
         prev_handler = self._install_sigterm()
         try:
             while True:
                 restored = self._probe()
-                rc, log_path = self._run_once(restart=restarts > 0,
-                                              restored=restored)
+                rc, log_path = self._run_once(
+                    restart=(restarts + restarts_free) > 0,
+                    restored=restored,
+                )
                 if rc == 0:
                     logger.info(
                         "supervise: child finished cleanly after %d "
@@ -198,6 +213,42 @@ class Supervisor:
                         "preemption checkpoint)", rc,
                     )
                     return rc
+                if rc == EXIT_PREEMPTED:
+                    # the CHILD was preempted directly (its own SIGTERM,
+                    # not one we forwarded): it checkpointed and exited
+                    # cleanly. Preemption is the scheduler's decision,
+                    # not the run's failure — restart for free: no
+                    # restart budget consumed, no crash-loop accounting
+                    # (a preempted attempt that made no checkpoint
+                    # progress is NOT evidence of poison).
+                    restarts_free += 1
+                    if restarts_free > FREE_RESTART_LIMIT:
+                        self._crash_report(
+                            "preemption_storm", log_path,
+                            f"{restarts_free} consecutive preemption "
+                            "exits with no completed run — something is "
+                            "killing every child, not scheduling them",
+                        )
+                        return EXIT_PREEMPTED
+                    # escalating delay (capped at the policy max): a
+                    # rapid preemption storm must not hot-loop launches
+                    delay = self.backoff.delay_for(
+                        min(restarts_free, 8), self._rng
+                    )
+                    logger.info(
+                        "supervise: child preempted (rc=%d) — restarting "
+                        "without consuming budget (free restart #%d) in "
+                        "%.2gs", rc, restarts_free, delay,
+                    )
+                    if delay > 0:
+                        self.backoff.sleep(delay)
+                    if self._terminating:
+                        logger.info(
+                            "supervise: SIGTERM during preemption restart "
+                            "— not relaunching"
+                        )
+                        return rc
+                    continue
                 # crash-loop detection: consecutive deaths launched from
                 # the SAME restorable state made zero progress — a
                 # deterministic failure a restart would only replay
@@ -221,9 +272,12 @@ class Supervisor:
                 restarts += 1
                 delay = self.backoff.delay_for(restarts, self._rng)
                 logger.warning(
-                    "supervise: child died rc=%d (restored_from=%s) — "
+                    "supervise: child died rc=%d%s (restored_from=%s) — "
                     "restart %d/%d in %.2gs",
-                    rc, restored, restarts, self.budget, delay,
+                    rc,
+                    " (hang detected — see hang_report.json)"
+                    if rc == EXIT_HANG else "",
+                    restored, restarts, self.budget, delay,
                 )
                 if delay > 0:
                     self.backoff.sleep(delay)
@@ -316,25 +370,51 @@ class Supervisor:
         [records]}, last barrier_skew record or None)."""
         if not self.metrics_dir:
             return {}, None
-        from paddle_tpu.observability import metrics as obs
+        from paddle_tpu.observability.metrics import tail_with_last_skew
 
-        tails = obs.read_tail(self.metrics_dir, n=METRICS_TAIL_RECORDS)
-        # newest skew record: LAST in stream order per host (the 't'
-        # offset resets to ~0 in every restarted child appending to the
-        # same stream, so it cannot order records across attempts), then
-        # the highest pass across hosts — all hosts emit the same
-        # allgathered table, so any host's newest is authoritative
-        skew = None
-        for recs in tails.values():
-            last = next(
-                (r for r in reversed(recs) if r.get("kind") == "barrier_skew"),
-                None,
+        return tail_with_last_skew(self.metrics_dir, n=METRICS_TAIL_RECORDS)
+
+    def _hang_report(self):
+        """The child's hang forensics, when any attempt died of a
+        detected hang (EXIT_HANG): hangwatch writes hang_report.json
+        into the same run dir the metrics tail comes from. Parsed and
+        embedded so one crash_report.json carries the whole story.
+        A report older than THIS supervise invocation is a leftover
+        from a previous run in the same save_dir (e.g. the current
+        hang's own write failed on a flaky fs) — embedding it would
+        present another process's thread stacks as this run's
+        forensics, so it is rejected."""
+        if not self.metrics_dir:
+            return None
+        from paddle_tpu.resilience.hangwatch import HANG_REPORT, run_dir_of
+
+        path = os.path.join(run_dir_of(self.metrics_dir), HANG_REPORT)
+        try:
+            with open(path) as f:
+                report = json.load(f)
+        except (OSError, ValueError):
+            return None
+        # freshness gate: prefer the report's own written_at (stamped by
+        # the child, which runs on THIS host — same clock as _t0_wall;
+        # an NFS-server-assigned mtime can skew by seconds and reject
+        # genuine forensics, the exact hazard heartbeat.py documents)
+        written = None
+        try:
+            written = time.mktime(time.strptime(
+                str(report.get("written_at", ""))[:19], "%Y-%m-%dT%H:%M:%S"
+            ))
+        except ValueError:
+            try:
+                written = os.path.getmtime(path)
+            except OSError:
+                pass
+        if written is not None and written < self._t0_wall - 1.0:
+            logger.warning(
+                "supervise: %s predates this supervise run — stale "
+                "forensics from an earlier incident, not embedding", path,
             )
-            if last is not None and (
-                skew is None or last.get("pass", -1) >= skew.get("pass", -1)
-            ):
-                skew = last
-        return {str(h): r for h, r in tails.items()}, skew
+            return None
+        return report
 
     def _crash_report(self, reason: str, log_path: str, detail: str) -> str:
         tail = self._log_tail(log_path)
@@ -359,6 +439,9 @@ class Supervisor:
             "step_time_skew": skew,
             "written_at": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
         }
+        # a hung attempt left in-process forensics — attach them
+        if any(a.get("exit_code") == EXIT_HANG for a in self.attempts):
+            report["hang_report"] = self._hang_report()
         path = os.path.join(self.dir, CRASH_REPORT)
         with open(path, "w") as f:
             json.dump(report, f, indent=2)

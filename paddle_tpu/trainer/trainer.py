@@ -308,6 +308,54 @@ class Trainer:
         # spans (--trace_events_path). No-ops when neither is configured.
         obs.configure_from_flags(flags, host=jax.process_index())
         obs_spans.configure_from_flags(flags, host=jax.process_index())
+        # hang defense (doc/resilience.md "Hang detection"): the step
+        # loop pings the watchdog at every launch boundary; a stall
+        # beyond --step_hang_timeout dumps forensics (hang_report.json
+        # in the run dir — where the supervisor's crash report looks)
+        # and exits EXIT_HANG. On a multi-host pod every host runs one:
+        # a rank wedged inside a collective because ANOTHER rank died
+        # still produces a named, stack-carrying report.
+        self._hangwatch = None
+        hang_timeout = float(getattr(flags, "step_hang_timeout", 0) or 0)
+        if hang_timeout > 0:
+            from paddle_tpu.resilience.hangwatch import HangWatch, run_dir_of
+
+            self._hangwatch = HangWatch(
+                hang_timeout,
+                report_dir=run_dir_of(
+                    getattr(flags, "metrics_path", "")
+                    or self.save_dir or "."
+                ),
+            )
+        # cluster liveness: renew this host's heartbeat file so
+        # cluster_launch can tell a wedged-but-alive rank from a slow one
+        self._heartbeat = None
+        hb_interval = float(getattr(flags, "heartbeat_interval", 0) or 0)
+        if hb_interval > 0:
+            from paddle_tpu.resilience import heartbeat as hb
+
+            hb_dir = hb.resolve_dir(
+                getattr(flags, "heartbeat_dir", ""), self.save_dir
+            )
+            if hb_dir:
+                self._heartbeat = hb.HeartbeatWriter(
+                    hb_dir, jax.process_index(), hb_interval
+                )
+                # first beat NOW, before the (possibly multi-GB, shared-
+                # fs) checkpoint restore below: a monitor must see "this
+                # rank is alive and initializing", not silence it could
+                # mistake for a wedge
+                self._heartbeat.beat(phase="init")
+            else:
+                logger.warning(
+                    "--heartbeat_interval=%g but neither --heartbeat_dir "
+                    "nor --save_dir is set — heartbeats disabled",
+                    hb_interval,
+                )
+        # set by the PreemptionExit path: the CLI turns it into the
+        # distinct EXIT_PREEMPTED process code so supervisors/launchers
+        # can restart preempted runs without consuming restart budget
+        self.preempted = False
         self._maybe_restore()
         # StaticPruningHook init semantics: mask values once at startup
         self.params = self.updater.apply_init_hooks(self.params)
@@ -673,60 +721,99 @@ class Trainer:
         train_provider = self._provider(for_test=False)
         assert train_provider is not None, "no train data configured"
         if self._batch_method is not None:
-            return self._train_batch_mode(num_passes, train_provider)
+            if self._hangwatch is not None:
+                # honest degradation, not a silent one: the operator who
+                # set the flag must not believe the hangwatch is armed
+                logger.warning(
+                    "--step_hang_timeout is not supported under "
+                    "whole-data batch methods (the pass is one long "
+                    "sweep with no launch boundary to ping) — hangwatch "
+                    "disabled for this run"
+                )
+            # the heartbeat is a wall-clock daemon, no launch boundary
+            # needed — it MUST run here, or a cluster_launch monitoring
+            # the same flags would tear down a healthy batch-mode job
+            # as silent
+            if self._heartbeat is not None:
+                self._heartbeat.start()
+            try:
+                return self._train_batch_mode(num_passes, train_provider)
+            finally:
+                if self._heartbeat is not None:
+                    self._heartbeat.stop()
         rng = jax.random.PRNGKey(self.flags.seed)
         saved_pass = -1
-        with self._preemption_guard():
-            try:
-                # while-loop (not range): a rollback rewinds pass_id to
-                # just after the restored checkpoint. Per-pass keys are
-                # folded from the base key, so a re-run pass replays the
-                # same rng stream it saw the first time.
-                pass_id = self.start_pass
-                while pass_id < num_passes:
-                    pass_rng = jax.random.fold_in(rng, pass_id)
-                    try:
-                        self.train_one_pass(pass_id, train_provider, pass_rng)
-                    except _RollbackRequest as rb:
-                        pass_id = self._apply_rollback(rb)
-                        continue
-                    with stat_timer("test"):
-                        pass_results = self.test(pass_id=pass_id)
-                    if pass_results:
-                        self.test_history.append((pass_id, pass_results))
-                    if self.save_dir and (pass_id + 1) % max(self.flags.saving_period, 1) == 0:
-                        self.save(pass_id)
-                        saved_pass = pass_id
-                    logger.info(global_stats.summary())
-                    pass_id += 1
-            except PreemptionExit as e:
-                if e.saved_path:
-                    logger.info(
-                        "preemption: checkpoint saved at %s — exiting the "
-                        "train loop cleanly (resume with --init_model_path "
-                        "on that pass dir and --start_pass=%d)",
-                        e.saved_path, e.pass_id,
-                    )
-                else:
-                    logger.info(
-                        "preemption: exiting the train loop cleanly "
-                        "(no --save_dir configured, nothing was saved)"
-                    )
-                obs.emit("run_end", status="preempted")
-                obs.flush()
-                return
-        if (
-            self.save_dir
-            and saved_pass != num_passes - 1
-            and num_passes > self.start_pass  # at least one pass actually ran
-        ):
-            self.save(num_passes - 1, final=True)
-        # the on-purpose end of the run: a stream WITHOUT this record
-        # ended in a crash/kill (what `paddle metrics` flags and the
-        # supervisor's crash report captures)
-        obs.emit("run_end", status="completed")
-        obs.flush()
-        obs_spans.export()
+        # liveness plumbing runs for the whole loop INCLUDING the final
+        # save: a save wedged on a dead shared fs is still a hang, and
+        # the heartbeat must outlive the last step so cluster_launch
+        # never mistakes "finishing up" for "went silent"
+        if self._hangwatch is not None:
+            self._hangwatch.start()
+        if self._heartbeat is not None:
+            self._heartbeat.start()
+        try:
+            with self._preemption_guard():
+                try:
+                    # while-loop (not range): a rollback rewinds pass_id to
+                    # just after the restored checkpoint. Per-pass keys are
+                    # folded from the base key, so a re-run pass replays the
+                    # same rng stream it saw the first time.
+                    pass_id = self.start_pass
+                    while pass_id < num_passes:
+                        pass_rng = jax.random.fold_in(rng, pass_id)
+                        try:
+                            self.train_one_pass(pass_id, train_provider, pass_rng)
+                        except _RollbackRequest as rb:
+                            pass_id = self._apply_rollback(rb)
+                            continue
+                        with stat_timer("test"):
+                            pass_results = self.test(pass_id=pass_id)
+                        if pass_results:
+                            self.test_history.append((pass_id, pass_results))
+                        if self.save_dir and (pass_id + 1) % max(self.flags.saving_period, 1) == 0:
+                            self.save(pass_id)
+                            saved_pass = pass_id
+                        logger.info(global_stats.summary())
+                        if self._hangwatch is not None:
+                            self._hangwatch.ping(pass_id)
+                        pass_id += 1
+                except PreemptionExit as e:
+                    if e.saved_path:
+                        logger.info(
+                            "preemption: checkpoint saved at %s — exiting the "
+                            "train loop cleanly (resume with --init_model_path "
+                            "on that pass dir and --start_pass=%d)",
+                            e.saved_path, e.pass_id,
+                        )
+                    else:
+                        logger.info(
+                            "preemption: exiting the train loop cleanly "
+                            "(no --save_dir configured, nothing was saved)"
+                        )
+                    # the CLI maps this to EXIT_PREEMPTED (18): restart
+                    # machinery treats the death as the scheduler's call,
+                    # not the run's, and charges no restart budget
+                    self.preempted = True
+                    obs.emit("run_end", status="preempted")
+                    obs.flush()
+                    return
+            if (
+                self.save_dir
+                and saved_pass != num_passes - 1
+                and num_passes > self.start_pass  # at least one pass actually ran
+            ):
+                self.save(num_passes - 1, final=True)
+            # the on-purpose end of the run: a stream WITHOUT this record
+            # ended in a crash/kill (what `paddle metrics` flags and the
+            # supervisor's crash report captures)
+            obs.emit("run_end", status="completed")
+            obs.flush()
+            obs_spans.export()
+        finally:
+            if self._hangwatch is not None:
+                self._hangwatch.stop()
+            if self._heartbeat is not None:
+                self._heartbeat.stop()
 
     # --------------------------------------------- whole-data batch mode
 
@@ -965,14 +1052,28 @@ class Trainer:
         for kind, group in self._launch_groups(
             self._device_prefetch(self._global_batches(provider))
         ):
+            # launch boundary: the hangwatch ping that proves the step
+            # loop is alive — everything below (stall site included)
+            # counts against --step_hang_timeout. BEFORE the
+            # fast-forward skip: replaying the data pipeline past a
+            # rollback's poison region IS progress (same rationale as
+            # the feeder watchdog's fast-forward heartbeat), and a long
+            # replay must not be misdiagnosed as a hang mid-recovery.
+            if self._hangwatch is not None:
+                self._hangwatch.ping(pass_id, batch_id)
             if ff_until and batch_id < ff_until:
                 batch_id += len(group) if kind == "fused" else 1
                 continue
-            # chaos site: `trainer.crash=exit@N` is a deterministic
-            # mid-run process death (one hit per trained launch) —
-            # what `paddle supervise` drills recover from
+            # chaos sites (one hit per trained launch):
+            # `trainer.crash=exit@N` is a deterministic mid-run process
+            # death — what `paddle supervise` drills recover from;
+            # `trainer.stall=sleep:S@N` wedges the step loop — what the
+            # hangwatch (--step_hang_timeout) drills detect
             faultinject.fault_point(
                 "trainer.crash", info=f"pass={pass_id} batch={batch_id}"
+            )
+            faultinject.fault_point(
+                "trainer.stall", info=f"pass={pass_id} batch={batch_id}"
             )
             launch_counts[kind] += 1
             if (
@@ -1225,6 +1326,13 @@ class Trainer:
             record["step_time_p99_s"] = float(np.percentile(step_times, 99))
         record["launches_single"] = launch_counts["single"]
         record["launches_fused"] = launch_counts["fused"]
+        if self._hangwatch is not None:
+            # worst step-progress age this pass (the hangwatch gauge's
+            # max-since-last-read) — `paddle metrics` surfaces it, so a
+            # near-miss stall is visible before the one that kills a run
+            record["progress_age_max_s"] = round(
+                self._hangwatch.take_max_age(), 3
+            )
         if obs.enabled():
             record["counters"] = obs.registry().snapshot()
         obs.emit("pass_end", pass_id=pass_id, step=batch_id, **record)
@@ -1318,6 +1426,13 @@ class Trainer:
                 "checkpoint under --save_dir to roll back to",
                 pass_id=rb.pass_id, batch_id=rb.batch_id,
             )
+        # the restore below (multi-GB on a slow shared fs, then a full
+        # re-jit at the next launch) is recovery progress, not a hang —
+        # ping around it so an armed hangwatch does not kill a healthy
+        # rollback mid-flight (the fast-forward replay after it pings
+        # per launch for the same reason)
+        if self._hangwatch is not None:
+            self._hangwatch.ping(rb.pass_id, rb.batch_id)
         # find_restorable just CRC'd the candidate (verify=False mirrors
         # the auto-restore path); fallback may still walk earlier passes
         self.params, opt_state, meta = ckpt.load_checkpoint(
@@ -1325,6 +1440,8 @@ class Trainer:
             sharding_for=self.ckpt_sharding_for(),
             verify=False, fallback=True,
         )
+        if self._hangwatch is not None:
+            self._hangwatch.ping(rb.pass_id, rb.batch_id)
         if opt_state is not None:
             self.opt_state = opt_state
         restored = self._note_restored(path, meta)

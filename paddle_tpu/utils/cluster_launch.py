@@ -11,15 +11,25 @@ Failure handling (doc/resilience.md): children are POLLED, not serially
 waited — when any host's process dies, the remaining hosts are torn down
 immediately (SIGTERM, then SIGKILL after --grace seconds) instead of
 hanging forever inside collectives waiting for the dead rank, and the
-failing rank is named in the exit message. With --max_restarts=N the
-whole job is relaunched up to N times with `--init_model_path=auto`
+failing rank is named in the exit message (signal deaths rendered by
+name: rc=-15 prints as SIGTERM). When the train flags enable heartbeats
+(--heartbeat_interval, resilience/heartbeat.py) the launcher ALSO polls
+heartbeat staleness, so a wedged-but-alive rank — the failure process
+liveness cannot see — is named and torn down too. With --max_restarts=N
+the whole job is relaunched up to N times with `--init_model_path=auto`
 appended, so a relaunch resumes from the newest manifest-verified
-checkpoint. SIGTERM to the launcher is forwarded to every host (pod
-preemption: each trainer checkpoints via --save_on_preempt).
+checkpoint; a host that exits EXIT_PREEMPTED (18, clean preemption
+save) triggers a relaunch that consumes NO restart budget, and with
+--elastic_min_hosts=M a host that keeps failing is dropped from the
+next relaunch as long as M hosts remain (the per-pass rng fold_in keeps
+feeder resharding deterministic for the survivors). SIGTERM to the
+launcher is forwarded to every host (pod preemption: each trainer
+checkpoints via --save_on_preempt).
 
 Usage:
     python -m paddle_tpu.utils.cluster_launch --conf=conf.py \
         --workdir=/path/on/hosts [--max_restarts=N] \
+        [--elastic_min_hosts=M] \
         -- --config=train.conf --mesh_shape=data=16 ...
 """
 
@@ -35,6 +45,16 @@ import sys
 import time
 from typing import List, Optional, Tuple
 
+from paddle_tpu.resilience import EXIT_HANG, EXIT_PREEMPTED
+
+# a host is dropped (when --elastic_min_hosts allows) after this many
+# job failures were attributed to it
+ELASTIC_STRIKES = 2
+
+# preemption relaunches are budget-free, but bounded: a broken node
+# agent SIGTERMing every fresh round would otherwise loop forever
+PREEMPT_RELAUNCH_LIMIT = 100
+
 
 def load_hosts(conf_path: str) -> List[str]:
     spec = importlib.util.spec_from_file_location("cluster_conf", conf_path)
@@ -45,11 +65,34 @@ def load_hosts(conf_path: str) -> List[str]:
     return list(hosts)
 
 
+def describe_rc(rc: int) -> str:
+    """Exit status as a human reads it: negative returncodes (subprocess
+    convention for signal deaths) carry the signal NAME, and the
+    disciplined codes carry their classification."""
+    if rc < 0:
+        try:
+            name = signal.Signals(-rc).name
+        except ValueError:
+            name = f"signal {-rc}"
+        return f"rc={rc} ({name})"
+    if rc == EXIT_PREEMPTED:
+        return f"rc={rc} (preempted — checkpointed and exited cleanly)"
+    if rc == EXIT_HANG:
+        return f"rc={rc} (hang detected by hangwatch — see hang_report.json)"
+    return f"rc={rc}"
+
+
+def _exit_code(rc: int) -> int:
+    """Launcher process exit status for a child rc: signal deaths map to
+    the shell's 128+signum convention instead of a wrapped negative."""
+    return 128 - rc if rc < 0 else rc
+
+
 def _launch(args, hosts: List[str], train_args: List[str],
-            attempt: int) -> List[subprocess.Popen]:
+            resume: bool) -> List[subprocess.Popen]:
     coordinator = f"{hosts[0].split('@')[-1]}:{args.port}"
     extra = []
-    if attempt > 0:
+    if resume:
         # relaunch after a failure: resume every host from the newest
         # verified checkpoint instead of its original init
         from paddle_tpu.utils.flags import strip_flag
@@ -86,12 +129,85 @@ def _signal_group(proc: subprocess.Popen, sig: int) -> None:
             pass
 
 
-def _wait_first_failure(procs: List[subprocess.Popen],
-                        poll_s: float) -> Optional[Tuple[int, int]]:
+class _HeartbeatMonitor:
+    """Staleness view over the shared heartbeat dir for ONE launch round.
+
+    ``since`` (construction time) is the observation epoch: beats from a
+    previous round cannot trigger, and a host that never writes one is
+    aged from launch — both fall out of heartbeat.stale_hosts' ``since``
+    clamp. Only still-running ranks are reported (an exited rank's
+    silence is process death's job to notice)."""
+
+    def __init__(self, dir_: str, num_hosts: int, stale_after_s: float,
+                 warmup_s: float = 0.0):
+        self.dir = dir_
+        self.num_hosts = num_hosts
+        self.stale_after_s = stale_after_s
+        self.warmup_s = warmup_s
+        self.since = time.time()
+        # staleness granularity is tens of seconds; re-listing and
+        # parsing every beat file on a shared mount at the liveness
+        # poll rate (0.5s) would be pure NFS-metadata churn
+        self._scan_every = max(stale_after_s / 4.0, 0.5)
+        self._last_scan = -float("inf")
+        self.disabled = False
+
+    def stale(self, alive_ranks) -> List[Tuple[int, float]]:
+        # startup warmup: ssh + interpreter + jax init + checkpoint
+        # restore all happen before the trainer's first beat (and again
+        # on every relaunch round) — enforcing staleness that early
+        # would tear down a healthy job that is merely starting. A
+        # genuinely wedged startup still gets caught, just warmup_s
+        # later.
+        now = time.monotonic()
+        if self.disabled or time.time() - self.since < self.warmup_s:
+            return []
+        if now - self._last_scan < self._scan_every:
+            return []
+        self._last_scan = now
+        from paddle_tpu.resilience.heartbeat import read_beats, stale_hosts
+
+        beats = read_beats(self.dir)
+        if not beats:
+            # not one beat from ANY host: too early to judge while the
+            # staleness window is still open; past it, all ranks
+            # wedging simultaneously is far less likely than a dir the
+            # launcher cannot actually see (wrong mount, unshared
+            # path). Tearing down a healthy job on that evidence would
+            # serially eject every host — disable loudly instead.
+            if time.time() - self.since > self.warmup_s + self.stale_after_s:
+                self.disabled = True
+                print(
+                    f"cluster_launch: no heartbeat from any host under "
+                    f"{self.dir!r} after the startup grace — the dir is "
+                    "probably not visible to the launcher (unshared "
+                    "mount?); heartbeat monitoring disabled, process "
+                    "liveness still active",
+                    file=sys.stderr,
+                )
+            return []
+        return [
+            (rank, age)
+            for rank, age in stale_hosts(
+                self.dir, self.num_hosts, self.stale_after_s,
+                since=self.since, beats=beats,
+            )
+            if rank in alive_ranks
+        ]
+
+
+def _wait_first_failure(
+    procs: List[subprocess.Popen],
+    poll_s: float,
+    hb: Optional[_HeartbeatMonitor] = None,
+) -> Optional[Tuple[int, int, str]]:
     """Poll all children; None when every one exited 0, else
-    (rank, exit code) of the FIRST failure observed — the launcher must
-    never sit in a serial wait() on rank 0 while rank 3 is already dead
-    and the survivors hang in collectives."""
+    (rank, exit code, human detail) of the FIRST failure observed — the
+    launcher must never sit in a serial wait() on rank 0 while rank 3 is
+    already dead and the survivors hang in collectives. With a heartbeat
+    monitor, a still-running rank whose beat went stale is a failure too
+    (reported as EXIT_HANG): wedged-but-alive is exactly the state
+    process liveness cannot see."""
     pending = dict(enumerate(procs))
     while pending:
         for rank, proc in list(pending.items()):
@@ -100,7 +216,16 @@ def _wait_first_failure(procs: List[subprocess.Popen],
                 continue
             del pending[rank]
             if rc != 0:
-                return rank, rc
+                return rank, rc, f"exited {describe_rc(rc)}"
+        if hb is not None and pending:
+            stale = hb.stale(pending.keys())
+            if stale:
+                rank, age = stale[0]
+                return rank, EXIT_HANG, (
+                    f"is wedged: heartbeat stale for {age:.1f}s "
+                    f"(> {hb.stale_after_s:g}s) while the process is "
+                    "still alive"
+                )
         if pending:
             time.sleep(poll_s)
     return None
@@ -108,17 +233,73 @@ def _wait_first_failure(procs: List[subprocess.Popen],
 
 def _teardown(procs: List[subprocess.Popen], grace_s: float) -> None:
     """SIGTERM every still-running host (their trainers checkpoint via
-    --save_on_preempt), escalate to SIGKILL after the grace window."""
+    --save_on_preempt), escalate to SIGKILL after the grace window. All
+    hosts share ONE deadline: each wait gets only the time remaining,
+    and once the deadline has passed the rest skip straight to SIGKILL —
+    never a serial ≥0.1s wait per already-expired host."""
     live = [p for p in procs if p.poll() is None]
     for p in live:
         _signal_group(p, signal.SIGTERM)
     deadline = time.monotonic() + grace_s
     for p in live:
-        try:
-            p.wait(timeout=max(deadline - time.monotonic(), 0.1))
-        except subprocess.TimeoutExpired:
-            _signal_group(p, signal.SIGKILL)
-            p.wait()
+        remaining = deadline - time.monotonic()
+        if remaining > 0:
+            try:
+                p.wait(timeout=remaining)
+                continue
+            except subprocess.TimeoutExpired:
+                pass
+        _signal_group(p, signal.SIGKILL)
+        p.wait()
+
+
+def _heartbeat_config(train_args: List[str]):
+    """(dir, stale_after_s) the launcher should monitor, or None.
+
+    Read from the TRAIN flags (one source of truth — the same flags the
+    hosts will heartbeat with): monitoring turns on when
+    --heartbeat_interval > 0 and a heartbeat dir is resolvable. The dir
+    must be visible to the launcher too (an absolute path on the shared
+    filesystem), exactly like the shared workdir assumption."""
+    from paddle_tpu.resilience.heartbeat import (
+        DEFAULT_STALE_MULTIPLE,
+        resolve_dir,
+    )
+    from paddle_tpu.utils.flags import flag_value
+
+    interval = float(flag_value(train_args, "heartbeat_interval", "0") or 0)
+    if interval <= 0:
+        return None
+    dir_ = resolve_dir(
+        flag_value(train_args, "heartbeat_dir", ""),
+        flag_value(train_args, "save_dir", ""),
+    )
+    if not dir_:
+        print(
+            "cluster_launch: --heartbeat_interval set but no "
+            "--heartbeat_dir/--save_dir to watch — heartbeat monitoring "
+            "disabled",
+            file=sys.stderr,
+        )
+        return None
+    if not os.path.isabs(dir_):
+        # the trainers resolve this path under the remote workdir; the
+        # launcher resolving it under its OWN cwd would watch an empty
+        # local directory and tear down healthy jobs as "wedged".
+        # Monitoring needs one path valid on both sides — an absolute
+        # path on the shared mount.
+        print(
+            f"cluster_launch: heartbeat dir {dir_!r} is relative (the "
+            "hosts resolve it under --workdir, this launcher cannot) — "
+            "heartbeat monitoring disabled; pass an absolute "
+            "--heartbeat_dir on the shared filesystem to enable it",
+            file=sys.stderr,
+        )
+        return None
+    stale = float(
+        flag_value(train_args, "heartbeat_stale_after", "0") or 0
+    ) or interval * DEFAULT_STALE_MULTIPLE
+    return dir_, stale
 
 
 def main(argv=None) -> int:
@@ -136,16 +317,31 @@ def main(argv=None) -> int:
     p.add_argument("--dry_run", action="store_true")
     p.add_argument("--max_restarts", type=int, default=0,
                    help="relaunch the whole job (with --init_model_path=auto) "
-                        "up to N times after a host failure; 0 = fail fast")
+                        "up to N times after a host failure; 0 = fail fast "
+                        "(a clean preemption exit never consumes budget)")
     p.add_argument("--restart_delay", type=float, default=5.0,
                    help="seconds between teardown and relaunch")
     p.add_argument("--poll_interval", type=float, default=0.5,
                    help="child liveness poll period, seconds")
     p.add_argument("--grace", type=float, default=10.0,
                    help="seconds between SIGTERM and SIGKILL at teardown")
+    p.add_argument("--heartbeat_startup_grace", type=float, default=120.0,
+                   help="seconds after each (re)launch before heartbeat "
+                        "staleness is enforced — covers ssh/jax startup "
+                        "and checkpoint restore, which happen before a "
+                        "host's first beat")
+    p.add_argument("--elastic_min_hosts", type=int, default=0,
+                   help="when > 0, a host that has caused "
+                        f"{ELASTIC_STRIKES} job failures is dropped "
+                        "(budget-free) from the next relaunch as long as "
+                        "this many hosts remain; 0 disables elastic "
+                        "shrink. Needs --max_restarts >= "
+                        f"{ELASTIC_STRIKES - 1}: the strikes before the "
+                        "drop are ordinary budgeted relaunches")
     args = p.parse_args(own)
 
     hosts = load_hosts(args.conf)
+    hb_conf = _heartbeat_config(train_args)
     current: List[subprocess.Popen] = []
     terminating = False
 
@@ -164,42 +360,98 @@ def main(argv=None) -> int:
     except ValueError:  # non-main thread (tests): degrade to no handler
         prev_handler = None
 
-    attempt = 0
+    restarts = 0          # budgeted relaunches (counted vs --max_restarts)
+    preempt_relaunches = 0  # budget-free rounds, bounded separately
+    resumed = False       # any relaunch at all → --init_model_path=auto
+    strikes = {h: 0 for h in hosts}  # per-host failure attribution
     try:
         while True:
-            current[:] = _launch(args, hosts, train_args, attempt)
+            current[:] = _launch(args, hosts, train_args, resume=resumed)
             if args.dry_run:
                 return 0
-            failure = _wait_first_failure(current, args.poll_interval)
+            hb = (
+                _HeartbeatMonitor(hb_conf[0], len(hosts), hb_conf[1],
+                                  warmup_s=args.heartbeat_startup_grace)
+                if hb_conf else None
+            )
+            failure = _wait_first_failure(current, args.poll_interval, hb)
             if failure is None:
                 return 0
-            rank, rc = failure
+            rank, rc, detail = failure
             _teardown(current, args.grace)
             if terminating:
                 print("cluster_launch: SIGTERM — job torn down, not "
                       "relaunching", file=sys.stderr)
-                return rc or 143
+                return _exit_code(rc) or 143
             print(
-                f"cluster_launch: host rank {rank} ({hosts[rank]}) exited "
-                f"rc={rc}; tore down the remaining {len(hosts) - 1} host(s) "
+                f"cluster_launch: host rank {rank} ({hosts[rank]}) {detail}; "
+                f"tore down the remaining {len(hosts) - 1} host(s) "
                 "to avoid hung collectives",
                 file=sys.stderr,
             )
-            if attempt >= args.max_restarts:
-                if args.max_restarts:
+            if rc == EXIT_PREEMPTED:
+                # the rank checkpointed and left on the scheduler's
+                # order — relaunch with auto-resume WITHOUT consuming
+                # the restart budget (and without a strike: preemption
+                # says nothing about the host's health). Bounded: a
+                # preemption STORM (every round killed) must terminate.
+                preempt_relaunches += 1
+                if preempt_relaunches > PREEMPT_RELAUNCH_LIMIT:
                     print(
-                        f"cluster_launch: restart budget "
-                        f"({args.max_restarts}) exhausted — giving up",
+                        f"cluster_launch: {preempt_relaunches} "
+                        "consecutive preemption rounds with no completed "
+                        "run — giving up (something is killing every "
+                        "launch, not scheduling it)",
                         file=sys.stderr,
                     )
-                return rc or 1
-            attempt += 1
-            print(
-                f"cluster_launch: relaunching whole job with "
-                f"--init_model_path=auto (restart {attempt}/"
-                f"{args.max_restarts}) in {args.restart_delay:g}s",
-                file=sys.stderr,
-            )
+                    return _exit_code(rc)
+                resumed = True
+                print(
+                    "cluster_launch: preemption — relaunching whole job "
+                    "with --init_model_path=auto (no restart budget "
+                    f"consumed) in {args.restart_delay:g}s",
+                    file=sys.stderr,
+                )
+            else:
+                strikes[hosts[rank]] = strikes.get(hosts[rank], 0) + 1
+                if (
+                    args.elastic_min_hosts > 0
+                    and strikes[hosts[rank]] >= ELASTIC_STRIKES
+                    and len(hosts) - 1 >= args.elastic_min_hosts
+                ):
+                    # dropping the offender IS the fix, not another try
+                    # at the same job — this relaunch consumes no budget
+                    # (otherwise the drop round could announce
+                    # "continuing" and then immediately exhaust the
+                    # budget it just consumed)
+                    dropped = hosts.pop(rank)
+                    resumed = True
+                    print(
+                        f"cluster_launch: dropping host {dropped} after "
+                        f"{ELASTIC_STRIKES} failures — relaunching with "
+                        f"{len(hosts)} host(s), no restart budget "
+                        "consumed (--elastic_min_hosts allows it); "
+                        "feeder resharding stays deterministic via the "
+                        "per-pass rng fold_in",
+                        file=sys.stderr,
+                    )
+                elif restarts >= args.max_restarts:
+                    if args.max_restarts:
+                        print(
+                            f"cluster_launch: restart budget "
+                            f"({args.max_restarts}) exhausted — giving up",
+                            file=sys.stderr,
+                        )
+                    return _exit_code(rc) or 1
+                else:
+                    restarts += 1
+                    resumed = True
+                    print(
+                        f"cluster_launch: relaunching whole job with "
+                        f"--init_model_path=auto (restart {restarts}/"
+                        f"{args.max_restarts}) in {args.restart_delay:g}s",
+                        file=sys.stderr,
+                    )
             time.sleep(args.restart_delay)
             if terminating:
                 # SIGTERM landed while no hosts were running (teardown
@@ -207,7 +459,7 @@ def main(argv=None) -> int:
                 # instead of relaunching a job the scheduler is ending
                 print("cluster_launch: SIGTERM during restart delay — "
                       "not relaunching", file=sys.stderr)
-                return rc or 143
+                return _exit_code(rc) or 143
     finally:
         if prev_handler is not None:
             signal.signal(signal.SIGTERM, prev_handler)

@@ -91,6 +91,19 @@ class _Flags:
     nonfinite_policy: str = "abort"      # abort | skip | rollback
     max_nonfinite_steps: int = 3
     rollback_lr_scale: float = 0.5
+    # hang defense (resilience/hangwatch.py): no step-loop progress for
+    # this many seconds dumps all thread stacks + telemetry tail into
+    # hang_report.json and exits EXIT_HANG=19 (0 disables). Set it
+    # comfortably above the worst-case launch + in-pass save/test time.
+    step_hang_timeout: float = 0.0
+    # cluster liveness (resilience/heartbeat.py): each host renews a
+    # heartbeat file under heartbeat_dir (default <save_dir>/heartbeats)
+    # every heartbeat_interval seconds (0 disables); an observer
+    # (cluster_launch) declares a host wedged after heartbeat_stale_after
+    # seconds of silence (0 = 3x the interval)
+    heartbeat_interval: float = 0.0
+    heartbeat_stale_after: float = 0.0
+    heartbeat_dir: str = ""
     # run supervision (`paddle supervise`, resilience/supervisor.py):
     # restart a dead `paddle train` child with exponential backoff and
     # --init_model_path=auto, at most restart_budget times; repeated
@@ -129,6 +142,22 @@ class _Flags:
 
 def _parse_bool(v: str) -> bool:
     return str(v).lower() in ("1", "true", "yes", "on")
+
+
+def flag_value(argv: List[str], name: str, default: str = "") -> str:
+    """Last occurrence of ``--name=value`` / ``--name value`` in an argv
+    list, without a full parse. Used by wrappers (cluster_launch) that
+    forward train flags verbatim but need to READ a few of them — e.g.
+    the heartbeat settings — so there is exactly one source of truth:
+    the flags the trainers themselves will run with."""
+    out = default
+    for i, a in enumerate(argv):
+        if a == f"--{name}":
+            if i + 1 < len(argv):
+                out = argv[i + 1]
+        elif a.startswith(f"--{name}="):
+            out = a[len(name) + 3:]
+    return out
 
 
 def strip_flag(argv: List[str], name: str) -> List[str]:

@@ -127,6 +127,209 @@ def test_cluster_launch_relaunches_with_auto_resume(tmp_path):
     assert all("--init_model_path=auto" in l for l in lines[2:])
 
 
+def test_cluster_launch_names_signal_deaths(tmp_path):
+    """Satellite (doc/resilience.md): a host killed by a signal is
+    reported by signal NAME (rc=-15 → SIGTERM), and the launcher's own
+    exit status follows the 128+signum shell convention."""
+    conf = tmp_path / "conf.py"
+    conf.write_text("HOSTS = ['u@h_sig', 'u@h_ok']\n")
+    env = _write_fake_ssh(tmp_path, (
+        "case \"$host\" in\n"
+        "  *sig*) sleep 0.3; kill -TERM $$;;\n"
+        "  *) sleep 120;;\n"
+        "esac\n"
+    ))
+    out = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.utils.cluster_launch",
+         "--conf", str(conf), "--workdir", "/job",
+         "--poll_interval", "0.1", "--grace", "2",
+         "--", "--config=train.conf"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=60,
+    )
+    assert out.returncode == 143, (out.returncode, out.stderr)
+    assert "SIGTERM" in out.stderr and "rc=-15" in out.stderr
+
+
+def test_cluster_launch_preemption_exit_is_budget_free(tmp_path):
+    """A host exiting EXIT_PREEMPTED (18 — clean preemption save) must
+    trigger an auto-resume relaunch that consumes NO restart budget:
+    even --max_restarts=0 (fail fast) relaunches."""
+    conf = tmp_path / "conf.py"
+    conf.write_text("HOSTS = ['u@h_pre', 'u@h_ok']\n")
+    calls = tmp_path / "calls.log"
+    marker = tmp_path / "round2"
+    env = _write_fake_ssh(tmp_path, (
+        f"echo \"$remote\" >> {calls}\n"
+        "case \"$host\" in\n"
+        f"  *pre*) if [ ! -f {marker} ]; then touch {marker}; exit 18; fi;"
+        " exit 0;;\n"
+        "  *) exit 0;;\n"
+        "esac\n"
+    ))
+    out = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.utils.cluster_launch",
+         "--conf", str(conf), "--workdir", "/job",
+         "--poll_interval", "0.1", "--grace", "2",
+         "--max_restarts", "0", "--restart_delay", "0.1",
+         "--", "--config=train.conf"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=60,
+    )
+    assert out.returncode == 0, (out.returncode, out.stderr)
+    assert "preempt" in out.stderr
+    assert "no restart budget" in out.stderr
+    lines = calls.read_text().splitlines()
+    assert len(lines) == 4  # 2 hosts x 2 rounds despite max_restarts=0
+    assert all("--init_model_path=auto" in l for l in lines[2:])
+
+
+def test_cluster_launch_elastic_drops_repeat_offender(tmp_path):
+    """--elastic_min_hosts: a host that caused two job failures is
+    dropped from the next relaunch; the survivors get recomputed ranks
+    and --num_processes, and the job completes without it."""
+    conf = tmp_path / "conf.py"
+    conf.write_text("HOSTS = ['u@h_bad', 'u@h_ok']\n")
+    calls = tmp_path / "calls.log"
+    # h_ok hangs while h_bad is around (it would be torn down anyway)
+    # and exits 0 once it is the only host (--num_processes=1): round 3
+    # — after the drop — is the clean single-host completion
+    env = _write_fake_ssh(tmp_path, (
+        f"echo \"$host $remote\" >> {calls}\n"
+        "case \"$host\" in\n"
+        "  *bad*) sleep 0.2; exit 2;;\n"
+        "  *) case \"$remote\" in\n"
+        "       *--num_processes=1*) exit 0;;\n"
+        "       *) sleep 120;;\n"
+        "     esac;;\n"
+        "esac\n"
+    ))
+    # budget of ONE: round 1 consumes it; round 2's failure triggers the
+    # drop, whose relaunch must be budget-free (the drop IS the fix) —
+    # with budget accounting on the drop round the job would give up here
+    out = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.utils.cluster_launch",
+         "--conf", str(conf), "--workdir", "/job",
+         "--poll_interval", "0.1", "--grace", "2",
+         "--max_restarts", "1", "--restart_delay", "0.1",
+         "--elastic_min_hosts", "1",
+         "--", "--config=train.conf"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=60,
+    )
+    assert out.returncode == 0, (out.returncode, out.stderr)
+    assert "dropping host u@h_bad" in out.stderr, out.stderr
+    assert "no restart budget consumed" in out.stderr
+    lines = calls.read_text().splitlines()
+    rounds3 = [l for l in lines if "--num_processes=1" in l]
+    assert rounds3 and all("h_ok" in l.split()[0] for l in rounds3)
+    assert all("--process_id=0" in l for l in rounds3)
+
+
+def test_cluster_launch_heartbeat_staleness_names_wedged_rank(tmp_path):
+    """Tentpole: a wedged-but-alive rank (process running, heartbeat
+    stale) is detected by the launcher's staleness poll, named, and the
+    job torn down with the hang exit code — the failure process
+    liveness alone can never see."""
+    import time
+
+    conf = tmp_path / "conf.py"
+    conf.write_text("HOSTS = ['u@h_beat', 'u@h_wedge']\n")
+    hb_dir = tmp_path / "hb"
+    hb_dir.mkdir()
+    # the stub hosts write the heartbeat files themselves: h_beat renews
+    # every 0.2s, h_wedge writes ONE beat then goes silent while staying
+    # alive — exactly a wedged collective
+    env = _write_fake_ssh(tmp_path, (
+        "case \"$host\" in\n"
+        "  *beat*)\n"
+        "    i=0\n"
+        "    while [ $i -lt 300 ]; do\n"
+        f"      echo '{{\"host\": 0, \"t\": '$(date +%s)'}}' > {hb_dir}/host-0.json\n"
+        "      sleep 0.2; i=$((i+1))\n"
+        "    done;;\n"
+        "  *wedge*)\n"
+        f"    echo '{{\"host\": 1, \"t\": '$(date +%s)'}}' > {hb_dir}/host-1.json\n"
+        "    sleep 120;;\n"
+        "esac\n"
+    ))
+    t0 = time.monotonic()
+    out = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.utils.cluster_launch",
+         "--conf", str(conf), "--workdir", "/job",
+         "--poll_interval", "0.1", "--grace", "2",
+         "--heartbeat_startup_grace", "0",  # stubs beat instantly
+         "--", "--config=train.conf",
+         "--heartbeat_interval=0.2", "--heartbeat_stale_after=3",
+         f"--heartbeat_dir={hb_dir}"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=120,
+    )
+    elapsed = time.monotonic() - t0
+    from paddle_tpu.resilience import EXIT_HANG
+
+    assert out.returncode == EXIT_HANG, (out.returncode, out.stderr)
+    assert elapsed < 60, elapsed  # did not wait out the 120s wedge
+    assert "rank 1" in out.stderr and "heartbeat stale" in out.stderr
+    assert "wedged" in out.stderr
+
+
+def test_cluster_launch_relative_heartbeat_dir_disables_monitoring(capsys):
+    """A relative heartbeat dir resolves differently on the launcher
+    and the hosts — monitoring must refuse it loudly instead of watching
+    an empty local directory and tearing down healthy jobs."""
+    from paddle_tpu.utils.cluster_launch import _heartbeat_config
+
+    assert _heartbeat_config(
+        ["--heartbeat_interval=5", "--save_dir=ckpts"]
+    ) is None
+    assert "relative" in capsys.readouterr().err
+    dir_, stale = _heartbeat_config(
+        ["--heartbeat_interval=5", "--heartbeat_dir=/shared/hb"]
+    )
+    assert dir_ == "/shared/hb" and stale == 15.0  # 3x interval default
+    assert _heartbeat_config(["--config=c.py"]) is None  # hb off
+
+
+def test_teardown_escalates_on_one_shared_deadline(monkeypatch):
+    """Satellite: _teardown must not serially wait ≥0.1s per
+    already-expired host — once the shared grace deadline has passed,
+    the remaining hosts skip straight to SIGKILL."""
+    import signal as _signal
+    import time
+
+    from paddle_tpu.utils import cluster_launch as cl
+
+    class FakeProc:
+        """A host that ignores SIGTERM for the whole grace window."""
+
+        def __init__(self):
+            self.signals = []
+            self.wait_timeouts = []
+
+        def got(self, sig):
+            self.signals.append(sig)
+
+        def poll(self):
+            return None
+
+        def wait(self, timeout=None):
+            if timeout is None:
+                return -9  # SIGKILL always lands
+            self.wait_timeouts.append(timeout)
+            time.sleep(timeout)  # stubborn: rides out the full grace
+            raise subprocess.TimeoutExpired("ssh", timeout)
+
+    monkeypatch.setattr(cl, "_signal_group", lambda p, sig: p.got(sig))
+    procs = [FakeProc() for _ in range(20)]
+    t0 = time.monotonic()
+    cl._teardown(procs, grace_s=0.2)
+    elapsed = time.monotonic() - t0
+    # old behavior: 19 extra clamped 0.1s waits ≈ 2.1s total
+    assert elapsed < 1.0, elapsed
+    # only the host(s) inside the grace window got a timed wait; the
+    # rest were killed outright
+    assert sum(len(p.wait_timeouts) for p in procs) == 1
+    for p in procs:
+        assert p.signals == [_signal.SIGTERM, _signal.SIGKILL]
+
+
 def test_cmd_arguments_doc_flags_exist():
     """Every `--flag` referenced in a doc/cmd_arguments.md table row must
     exist in utils/flags.py, so the flag reference can't silently rot —
