@@ -21,6 +21,7 @@ import queue
 import random
 import threading
 import time
+from concurrent.futures import TimeoutError as _FutureTimeout
 from typing import Any, Dict, Iterator, List, Optional, Sequence
 
 import numpy as np
@@ -299,11 +300,12 @@ class MultiDataProvider:
                             live[i] = False
                             break
 
-        inner = self._base._batches_from(mixed_samples())
         if self.async_prefetch:
-            yield from self._base._double_buffered(inner)
+            yield from self._base._prefetched(
+                self._base._batch_lists_from(mixed_samples())
+            )
         else:
-            yield from inner
+            yield from self._base._batches_from(mixed_samples())
 
 
 class DataProvider:
@@ -327,6 +329,8 @@ class DataProvider:
         stall_timeout: Optional[float] = None,
         max_bad_samples: Optional[int] = None,
         retry: Optional[RetryPolicy] = None,
+        packer_threads: Optional[int] = None,
+        prefetch_depth: Optional[int] = None,
     ):
         from paddle_tpu.utils.flags import FLAGS
 
@@ -340,9 +344,20 @@ class DataProvider:
         self.max_bad_samples = (
             int(FLAGS.max_bad_samples) if max_bad_samples is None else int(max_bad_samples)
         )
+        # packing-stage parallelism (doc/performance.md "Zero-stall
+        # host"): N pool threads run BatchAssembler.assemble (the native
+        # C packers release the GIL) feeding an order-preserving queue
+        # of prefetch_depth packed batches; 1 keeps the classic single
+        # prefetch thread
+        self.packer_threads = max(1, int(
+            FLAGS.data_packer_threads if packer_threads is None else packer_threads
+        ))
+        self.prefetch_depth = max(1, int(
+            FLAGS.prefetch_depth if prefetch_depth is None else prefetch_depth
+        ))
         self.retry = retry if retry is not None else RetryPolicy.from_flags(FLAGS)
         self._bad_samples = 0
-        # sample-granular watchdog heartbeat (see _double_buffered): a
+        # sample-granular watchdog heartbeat (see _watched_get): a
         # provider legitimately spending minutes filling a big shuffle
         # pool IS making progress and must not trip the stall timeout
         self._progress = time.monotonic()
@@ -456,14 +471,31 @@ class DataProvider:
     def batches(self) -> Iterator[Dict[str, Argument]]:
         """One pass of batches (shuffled within the pool)."""
         if self.async_prefetch:
-            yield from self._double_buffered(self._batches_sync())
+            yield from self._prefetched(self._batch_lists_from(self._samples()))
         else:
             yield from self._batches_sync()
+
+    def _prefetched(self, batch_lists) -> Iterator[Dict[str, Argument]]:
+        """The async pipeline over a raw batch-list stream — always the
+        packer-pool pipeline: with ``packer_threads=1`` a one-worker
+        pool IS the classic double-buffer (one thread packs ahead of
+        the consumer through a bounded queue), so a single
+        implementation carries the order, watchdog, fault-site, and
+        telemetry contracts for every thread count."""
+        yield from self._pool_packed(batch_lists)
 
     def _batches_sync(self) -> Iterator[Dict[str, Argument]]:
         yield from self._batches_from(self._samples())
 
     def _batches_from(self, samples) -> Iterator[Dict[str, Argument]]:
+        for batch in self._batch_lists_from(samples):
+            yield self.assembler.assemble(batch)
+
+    def _batch_lists_from(self, samples) -> Iterator[List]:
+        """The sequential half of batching: shuffle pool, length sort,
+        batch slicing — yields raw SAMPLE LISTS so the CPU-heavy
+        ``assemble`` can run wherever the caller wants (inline, one
+        prefetch thread, or the packer pool)."""
         pool_size = self.settings.pool_size
         if pool_size is None or pool_size <= 0:
             pool_size = 10000 * max(1, self.batch_size // 128 + 1)
@@ -494,7 +526,8 @@ class DataProvider:
                 cost = max(cost, len(v))
         return cost
 
-    def _drain(self, pool: List, final: bool) -> Iterator[Dict[str, Argument]]:
+    def _drain(self, pool: List, final: bool) -> Iterator[List]:
+        """Slice the (shuffled/sorted) pool into raw batch sample lists."""
         if self.shuffle:
             self.rng.shuffle(pool)
         if self.sort_by_length:
@@ -508,8 +541,7 @@ class DataProvider:
                 batches.append(pool[: self.batch_size])
                 del pool[: self.batch_size]
             self.rng.shuffle(batches)
-            for batch in batches:
-                yield self.assembler.assemble(batch)
+            yield from batches
             # the remainder (the longest leftovers) mixes into the next drain
         else:
             # keep a remainder in the pool between drains so shuffling
@@ -517,87 +549,146 @@ class DataProvider:
             while len(pool) >= self.batch_size:
                 batch = pool[: self.batch_size]
                 del pool[: self.batch_size]
-                yield self.assembler.assemble(batch)
+                yield batch
         if final and pool and not self.drop_last:
-            yield self.assembler.assemble(pool)
+            yield list(pool)
             pool.clear()
 
-    def _double_buffered(self, it: Iterator) -> Iterator:
-        """Background-thread prefetch (DoubleBuffer analog) with a
-        heartbeat watchdog.
+    def _watched_get(self, fetch, beat: List[float], worker, q, age_gauge) -> Any:
+        """One watchdog-guarded wait for a pipeline item.
 
-        A provider that blocks forever (dead NFS mount, a generator stuck
-        on a socket) used to hang the training loop inside ``q.get()`` —
-        which also blocked SIGTERM preemption handling, the worst possible
-        failure on a pod. Now the consumer polls with a timeout: when it
-        has waited ``stall_timeout`` seconds AND the worker produced no
-        item in that window, it raises a diagnosable DataStallError
-        (worker liveness, queue depth, stall age) instead of hanging.
-        0 disables the watchdog."""
-        q: "queue.Queue" = queue.Queue(maxsize=4)
+        ``fetch(timeout_or_None)`` must return the item or raise
+        ``queue.Empty`` / ``TimeoutError`` on a bounded wait that came
+        up empty. Shared by the pool consumer's two wait points (queue
+        get, future result) so the stall-detection rule cannot drift:
+        when the consumer has waited ``stall_timeout`` seconds AND
+        nothing in the pipeline made progress in that window (not a
+        batch handed over — ``beat`` — nor one raw sample pulled —
+        ``self._progress``), raise a diagnosable DataStallError instead
+        of hanging. 0 disables the watchdog. ``age_gauge`` is resolved
+        once by the caller — this runs twice per batch on the consumer
+        hot path and must not pay a locked registry lookup each time."""
+        timeout = self.stall_timeout
+        if not timeout or timeout <= 0:
+            return fetch(None)
+        wait_start = time.monotonic()
+        while True:
+            try:
+                return fetch(min(timeout / 4.0, 1.0))
+            except (queue.Empty, TimeoutError, _FutureTimeout):
+                now = time.monotonic()
+                # progress = a batch handed over (beat) OR a raw sample
+                # pulled (self._progress): pool-filling counts as
+                # progress, only true dead air trips
+                last = max(beat[0], self._progress)
+                age_gauge.set(now - last)
+                if now - wait_start >= timeout and now - last >= timeout:
+                    raise DataStallError(
+                        f"data pipeline stalled: no batch for "
+                        f"{now - wait_start:.1f}s (stall timeout "
+                        f"{timeout:g}s; provider "
+                        f"{getattr(self.provider, 'name', '?')}; "
+                        f"prefetch worker "
+                        f"{'alive' if worker.is_alive() else 'dead'}, "
+                        f"last progress {now - last:.1f}s ago, "
+                        f"queue depth {q.qsize()}). Raise "
+                        f"--data_stall_timeout or fix the provider."
+                    )
+
+    def _pool_packed(self, batch_lists: Iterator[List]) -> Iterator[Dict[str, Argument]]:
+        """N-thread packing stage (``--data_packer_threads``): a
+        dispatcher thread runs the sequential pool/shuffle half and
+        submits each raw batch to a thread pool whose workers run
+        ``BatchAssembler.assemble`` (the native C packers release the
+        GIL, so packs genuinely overlap); completed batches flow to the
+        consumer through an order-preserving queue bounded at
+        ``--prefetch_depth``. With one packer this IS the classic
+        DoubleBuffer analog: one thread packs ahead of the consumer
+        through a bounded queue. A provider that blocks forever (dead
+        NFS mount, a generator stuck on a socket) used to hang the
+        training loop inside ``q.get()`` — which also blocked SIGTERM
+        preemption handling, the worst possible failure on a pod; the
+        consumer polls via ``_watched_get`` and raises a diagnosable
+        DataStallError (worker liveness, queue depth, stall age)
+        instead. The ``provider.stall`` fault site and bad-sample
+        budget (upstream in ``_samples``) keep their old semantics."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch_depth)
         sentinel = object()
         err: List[BaseException] = []
-        beat = [time.monotonic()]  # last time the worker pulled an item
+        beat = [time.monotonic()]
+        busy = [0]
+        busy_lock = threading.Lock()
+        busy_hist = obs.registry().histogram("data.pack_threads_busy")
 
-        def worker():
+        def pack(batch):
+            with busy_lock:
+                busy[0] += 1
+                n_busy = busy[0]
             try:
-                for item in it:
+                busy_hist.observe(float(n_busy))
+                out = self.assembler.assemble(batch)
+                beat[0] = time.monotonic()  # a finished pack IS progress
+                return out
+            finally:
+                with busy_lock:
+                    busy[0] -= 1
+
+        pool = ThreadPoolExecutor(
+            max_workers=self.packer_threads, thread_name_prefix="pt-data-pack"
+        )
+
+        def dispatcher():
+            try:
+                for batch in batch_lists:
                     fault_point("provider.stall")
                     beat[0] = time.monotonic()
-                    q.put(item)
+                    # the bounded put is the backpressure: at most
+                    # prefetch_depth packed/packing batches run ahead
+                    q.put(pool.submit(pack, batch))
             except BaseException as e:  # propagate into the consumer
                 err.append(e)
             finally:
                 q.put(sentinel)
 
-        t = threading.Thread(target=worker, daemon=True, name="pt-data-prefetch")
+        t = threading.Thread(
+            target=dispatcher, daemon=True, name="pt-data-prefetch"
+        )
         t.start()
-        timeout = self.stall_timeout
-        # telemetry: summed consumer wait (the share of run time the step
-        # loop spent starved — `paddle metrics` reports it per pass) and
-        # the watchdog's view of heartbeat age
         wait_counter = obs.registry().counter("data.prefetch_wait_s")
         age_gauge = obs.registry().gauge("data.heartbeat_age_s")
-        while True:
-            wait_t0 = time.perf_counter()
-            if timeout and timeout > 0:
-                wait_start = time.monotonic()
-                while True:
-                    try:
-                        item = q.get(timeout=min(timeout / 4.0, 1.0))
-                        break
-                    except queue.Empty:
-                        now = time.monotonic()
-                        # progress = a batch handed over (beat) OR a raw
-                        # sample pulled (self._progress): pool-filling
-                        # counts as progress, only true dead air trips
-                        last = max(beat[0], self._progress)
-                        age_gauge.set(now - last)
-                        if (now - wait_start >= timeout
-                                and now - last >= timeout):
-                            raise DataStallError(
-                                f"data pipeline stalled: no batch for "
-                                f"{now - wait_start:.1f}s (stall timeout "
-                                f"{timeout:g}s; provider "
-                                f"{getattr(self.provider, 'name', '?')}; "
-                                f"prefetch worker "
-                                f"{'alive' if t.is_alive() else 'dead'}, "
-                                f"last progress {now - last:.1f}s ago, "
-                                f"queue depth {q.qsize()}). Raise "
-                                f"--data_stall_timeout or fix the provider."
-                            )
-            else:
-                item = q.get()
-            waited = time.perf_counter() - wait_t0
-            wait_counter.inc(waited)
-            age_gauge.set(0.0)
-            if waited > 1e-3:  # only waits worth seeing in a trace
-                obs_spans.record_perf("data/prefetch_wait", wait_t0, waited)
-            if item is sentinel:
-                break
-            yield item
-        if err:
-            raise err[0]
+
+        def fetch_future(to):
+            return q.get(timeout=to) if to is not None else q.get()
+
+        try:
+            while True:
+                wait_t0 = time.perf_counter()
+                fut = self._watched_get(fetch_future, beat, t, q, age_gauge)
+                if fut is not sentinel:
+                    # the future is already executing (pool order =
+                    # submission order), so this wait is short — but a
+                    # packer wedged inside a bad native call must still
+                    # trip the watchdog, not hang the step loop
+                    item = self._watched_get(
+                        lambda to: fut.result(timeout=to), beat, t, q,
+                        age_gauge,
+                    )
+                else:
+                    item = sentinel
+                waited = time.perf_counter() - wait_t0
+                wait_counter.inc(waited)
+                age_gauge.set(0.0)
+                if waited > 1e-3:
+                    obs_spans.record_perf("data/prefetch_wait", wait_t0, waited)
+                if item is sentinel:
+                    break
+                yield item
+            if err:
+                raise err[0]
+        finally:
+            pool.shutdown(wait=False, cancel_futures=True)
 
 
 def create_data_provider(
@@ -610,18 +701,23 @@ def create_data_provider(
     stall_timeout: Optional[float] = None,
     max_bad_samples: Optional[int] = None,
     retry: Optional[RetryPolicy] = None,
+    packer_threads: Optional[int] = None,
+    prefetch_depth: Optional[int] = None,
 ) -> DataProvider:
     """Instantiate from a DataConfig (define_py_data_sources2 output).
 
     ``stall_timeout`` / ``max_bad_samples`` / ``retry`` override the
     global flags (--data_stall_timeout / --max_bad_samples /
-    --io_retry_*) for this provider; None inherits them."""
+    --io_retry_*) for this provider; ``packer_threads`` /
+    ``prefetch_depth`` override --data_packer_threads /
+    --prefetch_depth. None inherits the flag."""
     import importlib
     import os
     import sys
 
     resilience_kw = dict(
         stall_timeout=stall_timeout, max_bad_samples=max_bad_samples, retry=retry,
+        packer_threads=packer_threads, prefetch_depth=prefetch_depth,
     )
     if data_config.type == "multi":
         subs = [

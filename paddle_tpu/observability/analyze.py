@@ -31,6 +31,14 @@ _COUNTER_COLS = (
     ("retry.attempts", "retries"),
     ("faults.fired", "faults"),
     ("nonfinite.events", "nonfinite"),
+    # async checkpointing (doc/performance.md): the background write
+    # time plus queued saves dropped by --ckpt_inflight_limit (what the
+    # step loop actually waited — ckpt_blocked_s — is attributed from
+    # the op="snapshot" checkpoint records instead: pass-end saves run
+    # AFTER the pass_end counter snapshot, so a counter delta would
+    # land each save's cost one pass late)
+    ("ckpt.write_s", "ckpt_write_s"),
+    ("ckpt.async_dropped", "ckpt_dropped"),
 )
 
 
@@ -91,6 +99,10 @@ def analyze(streams: Dict[int, List[Dict[str, Any]]]) -> Dict[str, Any]:
     host_steps: Dict[int, Dict[int, tuple]] = {}
     for host in hosts:
         prev_counters: Dict[str, float] = {}
+        # (count, count·mean) of the pack_threads_busy histogram at the
+        # previous pass_end — the snapshot is run-cumulative, so the
+        # per-pass mean must come from the delta like the counter cols
+        prev_pack = (0.0, 0.0)
         for p in sorted(per_host_pass.get(host, {})):
             rec = per_host_pass[host][p]
             row = passes.setdefault(p, {"pass": p, "samples": 0, "hosts": 0})
@@ -122,6 +134,20 @@ def analyze(streams: Dict[int, List[Dict[str, Any]]]) -> Dict[str, Any]:
                 d = cur[name] - prev_counters.get(name, 0.0)
                 row[col] = row.get(col, 0.0) + max(d, 0.0)
             prev_counters = cur
+            # packer-pool utilization: mean packers busy at each batch
+            # handoff THIS pass (delta of the cumulative histogram) —
+            # worst host wins, like the step quantiles
+            pack = (rec.get("counters") or {}).get("data.pack_threads_busy")
+            if isinstance(pack, dict) and pack.get("count"):
+                cnt = float(pack["count"])
+                tot = cnt * float(pack.get("mean", 0.0))
+                d_cnt, d_tot = cnt - prev_pack[0], tot - prev_pack[1]
+                prev_pack = (cnt, tot)
+                if d_cnt > 0:
+                    row["pack_busy_mean"] = max(
+                        float(row.get("pack_busy_mean", 0.0)),
+                        round(d_tot / d_cnt, 4),
+                    )
             if row.get("pass_time_s", 0.0) > 0:
                 share = row.get("data_wait_s", 0.0) / (
                     row["pass_time_s"] * max(row["hosts"], 1)
@@ -151,6 +177,34 @@ def analyze(streams: Dict[int, List[Dict[str, Any]]]) -> Dict[str, Any]:
                 straggler = {"pass": p, "line": summarize_host_stats(table)}
                 break
 
+    # step-loop checkpoint-stall attribution, from the checkpoint
+    # records themselves: op="snapshot" records exist exactly when
+    # --async_checkpoint is on and their duration is what the step loop
+    # actually waited (ckpt_blocked_s); op="save" blocks the step loop
+    # only when async checkpointing is OFF (with it on, saves are the
+    # background writer's time)
+    async_ckpt = any(c.get("op") == "snapshot" for c in checkpoints)
+    # latest-wins per (host, pass, op, step), mirroring the pass_end
+    # dedupe: a supervised restart or rollback re-run re-saves the same
+    # save point, and summing every attempt would charge one run's
+    # pass_time_s with N runs' worth of blocked seconds. Mid-pass
+    # periodic saves (--saving_period_by_batches) of one pass carry
+    # distinct `step`s and stay individually counted
+    latest_dur: Dict[tuple, float] = {}
+    for c in checkpoints:
+        if isinstance(c.get("pass"), int) and c.get("op") in ("save", "snapshot"):
+            latest_dur[(c.get("host"), c["pass"], c["op"], c.get("step"))] = (
+                float(c.get("duration_s", 0.0))
+            )
+    sync_save_s: Dict[int, float] = {}
+    snap_s: Dict[int, float] = {}
+    for (_h, p_ckpt, op, _s), dur in latest_dur.items():
+        tgt = sync_save_s if op == "save" else snap_s
+        tgt[p_ckpt] = tgt.get(p_ckpt, 0.0) + dur
+    for p, blocked in snap_s.items():
+        if p in passes:
+            passes[p]["ckpt_blocked_s"] = round(blocked, 6)
+
     warnings: List[str] = []
     for p in sorted(passes):
         row = passes[p]
@@ -159,6 +213,30 @@ def analyze(streams: Dict[int, List[Dict[str, Any]]]) -> Dict[str, Any]:
                 f"pass {p}: data-bound — the step loop spent "
                 f"{row['data_wait_share'] * 100:.0f}% of the pass waiting "
                 "on the provider (grow pool_size / check input storage)"
+            )
+        pass_time = row.get("pass_time_s", 0.0)
+        if not async_ckpt and pass_time > 0:
+            blocked = sync_save_s.get(p, 0.0)
+            if blocked / pass_time > 0.1:
+                warnings.append(
+                    f"pass {p}: checkpoint-bound — synchronous saves "
+                    f"blocked the step loop {blocked / pass_time * 100:.0f}% "
+                    "of the pass (consider --async_checkpoint)"
+                )
+        if async_ckpt and pass_time > 0:
+            blocked = row.get("ckpt_blocked_s", 0.0)
+            if blocked / pass_time > 0.1:
+                warnings.append(
+                    f"pass {p}: snapshot-heavy — async checkpointing still "
+                    f"blocked the step loop {blocked / pass_time * 100:.0f}% "
+                    "of the pass on device→host copies (save less often or "
+                    "shrink the model state)"
+                )
+        if row.get("ckpt_dropped", 0) > 0:
+            warnings.append(
+                f"pass {p}: {int(row['ckpt_dropped'])} queued async "
+                "checkpoint save(s) dropped (superseded; raise "
+                "--ckpt_inflight_limit or save less often)"
             )
         for col, label in (("nonfinite", "non-finite loss event(s)"),
                            ("faults", "injected fault firing(s)"),
@@ -201,12 +279,21 @@ def _fmt_table(doc: Dict[str, Any]) -> str:
     # only appears when some record carried it — telemetry from runs
     # without --step_hang_timeout keeps the old table shape
     with_age = any("progress_age_max_s" in r for r in doc["passes"])
+    # async-checkpoint / packer-pool columns only appear when some record
+    # carried them — telemetry from runs without the overlap knobs keeps
+    # the old table shape
+    with_ckpt = any(r.get("ckpt_blocked_s", 0.0) > 0 for r in doc["passes"])
+    with_pack = any("pack_busy_mean" in r for r in doc["passes"])
     header = (
         f"{'pass':>5} {'samples':>9} {'AvgCost':>10} {'p50 ms':>8} "
         f"{'p99 ms':>8} {'data-wait':>9} {'nf':>4} {'retry':>5} {'fault':>5}"
     )
     if with_age:
         header += f" {'age s':>6}"
+    if with_ckpt:
+        header += f" {'ckpt blk s':>10}"
+    if with_pack:
+        header += f" {'pack busy':>9}"
     lines = [header]
     for row in doc["passes"]:
         line = (
@@ -221,6 +308,10 @@ def _fmt_table(doc: Dict[str, Any]) -> str:
         )
         if with_age:
             line += f" {row.get('progress_age_max_s', 0.0):>6.2f}"
+        if with_ckpt:
+            line += f" {row.get('ckpt_blocked_s', 0.0):>10.4f}"
+        if with_pack:
+            line += f" {row.get('pack_busy_mean', 0.0):>9.2f}"
         lines.append(line)
     if doc["checkpoints"]:
         lines.append("")

@@ -63,7 +63,15 @@ EXIT_PREEMPTED = 18
 EXIT_HANG = 19
 
 
-class CheckpointCorruptError(RuntimeError):
+class CheckpointError(RuntimeError):
+    """A checkpoint operation failed. Base of the corruption case below;
+    raised directly by the async checkpoint pipeline
+    (``trainer/async_ckpt.py``) when a background write failed — the
+    error surfaces on the NEXT save or drain so an async failure can
+    never be silently lost."""
+
+
+class CheckpointCorruptError(CheckpointError):
     """A checkpoint directory failed manifest/completeness verification
     and no fallback pass directory could be restored either."""
 
@@ -103,6 +111,7 @@ __all__ = [
     "EXIT_CRASH_LOOP",
     "EXIT_PREEMPTED",
     "EXIT_HANG",
+    "CheckpointError",
     "CheckpointCorruptError",
     "DataStallError",
     "BadSampleError",

@@ -1,0 +1,303 @@
+"""Async checkpointing — the step loop never waits on checkpoint I/O.
+
+The synchronous ``Trainer.save()`` serializes + fsyncs the whole model
+inline at pass end: on a big model over a shared filesystem that is the
+single largest stall left in the hot path (PR 3's ``paddle metrics``
+measures it as the ``checkpoint`` row durations). The reference hid
+host work behind device compute everywhere it could (DoubleBuffer
+prefetch threads, async pserver pushes) but its ParamUtil save was just
+as synchronous — this module closes the gap for the TPU port.
+
+Behind ``--async_checkpoint`` a save becomes two halves:
+
+1. **Snapshot** (the step loop's only cost): every device array's
+   host copy is *dispatched* asynchronously (``copy_to_host_async``),
+   then collected — the one unavoidable device→host wait. The wall
+   time of this half is the ``ckpt.blocked_s`` counter and the
+   ``op="snapshot"`` checkpoint record.
+2. **Write** (background): a daemon writer thread runs the *unchanged*
+   PR-1 durability protocol over the host trees —
+   ``pass-N.tmp`` → fsync → ``MANIFEST.json`` → rename, rotation with
+   ``protect_pass`` — via ``checkpoint.save_checkpoint``. Its wall time
+   is the ``ckpt.write_s`` counter (and the usual ``op="save"`` record,
+   now emitted from the writer thread).
+
+Contracts that make this safe, not just fast:
+
+- **Bounded in-flight saves** (``--ckpt_inflight_limit``, default 1):
+  at most one save is actively writing and at most ``limit`` more may
+  queue behind it; enqueueing past the bound drops the OLDEST pending
+  (never the active, never the newest — the newest state is the one
+  worth making durable), counted by ``ckpt.async_dropped`` and logged.
+- **drain()** blocks until everything enqueued is durable. The trainer
+  drains at every pass-end test/eval (so a writer failure surfaces at
+  most one pass late), on preemption (the SIGTERM save must be durable
+  before exit ``EXIT_PREEMPTED``), before a rollback-restore (the
+  newest save must be on disk before ``find_restorable_checkpoint``
+  scans), and at the end of ``train()``.
+- **Writer failures are never silent**: an exception in the background
+  write is stored and re-raised as :class:`CheckpointError` from the
+  NEXT ``save()`` or ``drain()``. A crash before either loses only the
+  in-flight write — the PR-1 protocol guarantees the previous
+  checkpoint is still durable and restorable.
+- **Hangwatch**: the writer pings the step-progress watchdog at the
+  start and end of every background write, and ``drain()`` pings it
+  while an active write is still making the queue shrink — a long
+  (but live) write at a drain barrier is not misdiagnosed as a trainer
+  hang. A writer wedged forever on a dead shared fs still trips the
+  watchdog once pings stop, exactly like a wedged synchronous save.
+
+Multi-process runs keep the synchronous path: the sharded save is a
+collective (barriers + shard writes on every host) and must run where
+every process participates at the same launch boundary.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from paddle_tpu.observability import metrics as obs
+from paddle_tpu.resilience import CheckpointError
+from paddle_tpu.trainer import checkpoint as ckpt
+from paddle_tpu.utils.logging import logger
+
+__all__ = ["AsyncCheckpointer", "snapshot_to_host"]
+
+
+def snapshot_to_host(tree):
+    """Device→host copy of a pytree: dispatch EVERY leaf's async copy
+    first, then collect — the collection blocks only until the last DMA
+    lands, not once per leaf. Host leaves (numpy scalars in a restored
+    opt_state) pass through."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    for leaf in leaves:
+        copy_async = getattr(leaf, "copy_to_host_async", None)
+        if copy_async is not None:
+            try:
+                copy_async()
+            except Exception:
+                pass  # backends without async copies fall back to the
+                # blocking np.asarray below — correct, just slower
+    return jax.tree_util.tree_unflatten(
+        treedef, [np.asarray(leaf) for leaf in leaves]
+    )
+
+
+class _Job:
+    __slots__ = ("pass_id", "params", "opt_state", "extra_meta", "keep",
+                 "protect_pass", "on_durable")
+
+    def __init__(self, pass_id, params, opt_state, extra_meta, keep,
+                 protect_pass, on_durable):
+        self.pass_id = pass_id
+        self.params = params
+        self.opt_state = opt_state
+        self.extra_meta = extra_meta
+        self.keep = keep
+        self.protect_pass = protect_pass
+        self.on_durable = on_durable
+
+
+class AsyncCheckpointer:
+    """Background checkpoint writer (see module docstring).
+
+    ``write_fn`` is an injectable seam (fake-clock/gated unit tests);
+    production uses :func:`checkpoint.save_checkpoint` — the unchanged
+    durable protocol."""
+
+    def __init__(
+        self,
+        save_dir: str,
+        inflight_limit: int = 1,
+        hangwatch=None,
+        *,
+        write_fn: Optional[Callable[..., str]] = None,
+    ):
+        self.save_dir = save_dir
+        self.inflight_limit = max(1, int(inflight_limit))
+        self.hangwatch = hangwatch
+        self._write_fn = write_fn or ckpt.save_checkpoint
+        self._cv = threading.Condition()
+        self._pending: List[_Job] = []     # queued, oldest first
+        self._active: Optional[_Job] = None
+        self._error: Optional[BaseException] = None
+        self._thread: Optional[threading.Thread] = None
+        self.dropped = 0
+        self.completed = 0
+
+    # -------------------------------------------------------- trainer side
+
+    def save(
+        self,
+        pass_id: int,
+        params: Dict[str, jax.Array],
+        opt_state=None,
+        extra_meta: Optional[Dict[str, Any]] = None,
+        keep: int = 3,
+        protect_pass: Optional[int] = None,
+        on_durable: Optional[Callable[[int, str], None]] = None,
+    ) -> float:
+        """Snapshot device→host and enqueue the background write.
+        Returns the seconds the caller was blocked (the snapshot — what
+        ``ckpt.blocked_s`` accounts). Raises :class:`CheckpointError`
+        first if a PREVIOUS background write failed."""
+        self._raise_pending_error()
+        t0 = time.perf_counter()
+        # ONE pytree so every leaf's async copy (params AND opt_state)
+        # is dispatched before the first collection blocks — collecting
+        # params first would serialize the two DMA trees
+        host_params, host_opt = snapshot_to_host((params, opt_state))
+        blocked = time.perf_counter() - t0
+        job = _Job(pass_id, host_params, host_opt, dict(extra_meta or {}),
+                   keep, protect_pass, on_durable)
+        with self._cv:
+            self._pending.append(job)
+            # drop-oldest-pending: the active write cannot be revoked
+            # mid-protocol and the newest state is the one worth keeping
+            while len(self._pending) > self.inflight_limit:
+                old = self._pending.pop(0)
+                self.dropped += 1
+                obs.registry().counter("ckpt.async_dropped").inc()
+                logger.warning(
+                    "async checkpoint: dropping queued save of pass %d "
+                    "(superseded by pass %d; --ckpt_inflight_limit=%d)",
+                    old.pass_id, pass_id, self.inflight_limit,
+                )
+            self._set_inflight_gauge_locked()
+            self._cv.notify_all()
+        self._ensure_thread()
+        obs.registry().counter("ckpt.blocked_s").inc(blocked)
+        obs.emit(
+            "checkpoint", op="snapshot", pass_id=pass_id,
+            step=job.extra_meta.get("batch_id"),
+            path=ckpt.PASS_FMT % pass_id if self.save_dir else "",
+            duration_s=round(blocked, 6),
+        )
+        return blocked
+
+    def drain(self, timeout: Optional[float] = None) -> None:
+        """Block until every enqueued save is durable (or ``timeout``
+        seconds passed — then :class:`CheckpointError`). Re-raises a
+        stored writer failure. Pings the hangwatch while the writer is
+        demonstrably live so a long write at a drain barrier is not
+        misdiagnosed as a trainer hang."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        # a dead/never-started writer would leave the queue stuck: make
+        # sure one is running before waiting on it
+        self._ensure_thread()
+        with self._cv:
+            last_state = None
+            while self._pending or self._active is not None:
+                # ping only when the writer DEMONSTRABLY progressed
+                # (a write completed / a new job was claimed) since the
+                # last poll: an unconditional ping would keep a writer
+                # wedged forever on a dead fs from ever tripping the
+                # watchdog — the exact failure hangwatch exists for
+                state = (self.completed, len(self._pending),
+                         id(self._active))
+                if (self.hangwatch is not None
+                        and self._active is not None
+                        and state != last_state):
+                    self.hangwatch.ping(self._active.pass_id)
+                last_state = state
+                self._cv.wait(timeout=0.2)
+                if deadline is not None and time.monotonic() > deadline:
+                    raise CheckpointError(
+                        f"async checkpoint drain timed out after {timeout}s "
+                        f"({len(self._pending)} pending, active="
+                        f"{self._active.pass_id if self._active else None})"
+                    )
+        self._raise_pending_error()
+
+    def inflight(self) -> int:
+        with self._cv:
+            return len(self._pending) + (1 if self._active is not None else 0)
+
+    # --------------------------------------------------------- writer side
+
+    def _ensure_thread(self) -> None:
+        with self._cv:
+            if self._thread is not None and self._thread.is_alive():
+                return
+            self._thread = threading.Thread(
+                target=self._run, name="pt-ckpt-writer", daemon=True
+            )
+            self._thread.start()
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._pending:
+                    self._cv.wait()
+                self._active = self._pending.pop(0)
+                self._set_inflight_gauge_locked()
+                job = self._active
+            try:
+                self._write(job)
+            finally:
+                # drop BOTH references to the host snapshot before the
+                # idle wait — holding it would keep a full extra host
+                # copy of model+optimizer state resident between saves
+                job = None
+                with self._cv:
+                    self._active = None
+                    self._set_inflight_gauge_locked()
+                    self._cv.notify_all()
+
+    def _write(self, job: _Job) -> None:
+        if self.hangwatch is not None:
+            self.hangwatch.ping(job.pass_id)
+        t0 = time.perf_counter()
+        try:
+            path = self._write_fn(
+                self.save_dir,
+                job.pass_id,
+                job.params,
+                job.opt_state,
+                extra_meta=job.extra_meta,
+                keep=job.keep,
+                protect_pass=job.protect_pass,
+            )
+        except BaseException as e:
+            with self._cv:
+                self._error = e
+            logger.error(
+                "async checkpoint: background write of pass %d failed: %s "
+                "(will re-raise as CheckpointError on the next save/drain)",
+                job.pass_id, e,
+            )
+            return
+        finally:
+            if self.hangwatch is not None:
+                self.hangwatch.ping(job.pass_id)
+        dt = time.perf_counter() - t0
+        self.completed += 1
+        obs.registry().counter("ckpt.write_s").inc(dt)
+        if job.on_durable is not None:
+            try:
+                job.on_durable(job.pass_id, path)
+            except Exception:
+                logger.warning(
+                    "async checkpoint: on_durable callback failed for "
+                    "pass %d", job.pass_id, exc_info=True,
+                )
+
+    # ------------------------------------------------------------- plumbing
+
+    def _set_inflight_gauge_locked(self) -> None:
+        obs.registry().gauge("ckpt.async_inflight").set(
+            len(self._pending) + (1 if self._active is not None else 0)
+        )
+
+    def _raise_pending_error(self) -> None:
+        with self._cv:
+            err, self._error = self._error, None
+        if err is not None:
+            raise CheckpointError(
+                f"async checkpoint write failed: {err}"
+            ) from err

@@ -350,7 +350,11 @@ def save_checkpoint(
         _commit(tmp, final)
         _rotate(save_dir, keep, protect=protect_pass)
     logger.info("saved checkpoint %s", final)
-    _ckpt_record("save", final, t0, pass_id=pass_id, measure_bytes=True)
+    _ckpt_record("save", final, t0, pass_id=pass_id, measure_bytes=True,
+                 # mid-pass periodic saves (--saving_period_by_batches)
+                 # of one pass are distinct stalls: the batch id keys
+                 # them apart in `paddle metrics` dedupe
+                 step=(extra_meta or {}).get("batch_id"))
     return final
 
 

@@ -356,6 +356,30 @@ class Trainer:
         # distinct EXIT_PREEMPTED process code so supervisors/launchers
         # can restart preempted runs without consuming restart budget
         self.preempted = False
+        # async checkpointing (--async_checkpoint, doc/performance.md):
+        # save() pays only the device→host snapshot; the durable-protocol
+        # write runs on a background thread. Multi-process keeps the
+        # synchronous path — the sharded save is a collective (barriers +
+        # per-host shard writes) and must run where every process
+        # participates at the same launch boundary.
+        self._async_ckpt = None
+        if getattr(flags, "async_checkpoint", False) and self.save_dir:
+            if self._multiproc:
+                logger.warning(
+                    "--async_checkpoint is not supported multi-process "
+                    "(the sharded save is a collective) — saving "
+                    "synchronously"
+                )
+            else:
+                from paddle_tpu.trainer.async_ckpt import AsyncCheckpointer
+
+                self._async_ckpt = AsyncCheckpointer(
+                    self.save_dir,
+                    inflight_limit=int(
+                        getattr(flags, "ckpt_inflight_limit", 1) or 1
+                    ),
+                    hangwatch=self._hangwatch,
+                )
         self._maybe_restore()
         # StaticPruningHook init semantics: mask values once at startup
         self.params = self.updater.apply_init_hooks(self.params)
@@ -594,16 +618,27 @@ class Trainer:
             for item in gen:
                 yield "single", item
             return
-        jtu = jax.tree_util
 
         def sig_of(item):
-            n, _host, dev = item
-            leaves, treedef = jtu.tree_flatten(dev)
-            return (
-                n,
-                treedef,
-                tuple((getattr(l, "shape", ()), str(getattr(l, "dtype", ""))) for l in leaves),
-            )
+            # signature from the HOST-side Argument dict: the packed
+            # device tree is a deterministic function of the host batch,
+            # so identical host field shapes/dtypes imply an identical
+            # device tree — and reading ``.shape`` off O(slots) numpy
+            # fields costs nothing, where the old jax tree_flatten of
+            # the device tree walked O(leaves) registered pytree nodes
+            # per batch, every step, on the hot path
+            n, host, _dev = item
+            sig = [n]
+            for name, arg in host.items():  # dict order is stable per provider
+                sig.append((
+                    name,
+                    tuple(
+                        None if f is None else (f.shape, str(f.dtype))
+                        for f in (arg.value, arg.ids, arg.seq_lengths,
+                                  arg.sub_seq_lengths, arg.weight)
+                    ),
+                ))
+            return tuple(sig)
 
         buf, sig = [], None
         for item in gen:
@@ -666,6 +701,8 @@ class Trainer:
             stall_timeout=self.flags.data_stall_timeout,
             max_bad_samples=self.flags.max_bad_samples,
             retry=RetryPolicy.from_flags(self.flags, name="data-provider"),
+            packer_threads=getattr(self.flags, "data_packer_threads", None),
+            prefetch_depth=getattr(self.flags, "prefetch_depth", None),
         )
 
     # ------------------------------------------------------------- train
@@ -778,6 +815,10 @@ class Trainer:
                             self._hangwatch.ping(pass_id)
                         pass_id += 1
                 except PreemptionExit as e:
+                    # the SIGTERM save must be DURABLE before the clean
+                    # exit-18 return: a preempted pod may be reclaimed
+                    # the instant the process dies
+                    self._drain_async_ckpt()
                     if e.saved_path:
                         logger.info(
                             "preemption: checkpoint saved at %s — exiting the "
@@ -803,6 +844,10 @@ class Trainer:
                 and num_passes > self.start_pass  # at least one pass actually ran
             ):
                 self.save(num_passes - 1, final=True)
+            # process-exit barrier: everything enqueued must be durable
+            # (and any background-write failure must surface) before the
+            # run may claim it completed
+            self._drain_async_ckpt()
             # the on-purpose end of the run: a stream WITHOUT this record
             # ended in a crash/kill (what `paddle metrics` flags and the
             # supervisor's crash report captures)
@@ -951,6 +996,7 @@ class Trainer:
                     break
         if self.save_dir and saved_pass != last_pass and last_pass >= self.start_pass:
             self.save(last_pass, final=True)
+        self._drain_async_ckpt()
 
     def _count_model_flops(self, key, fn, *args) -> float:
         """Analytic model matmul FLOPs of one ``fn(*args)`` call, cached
@@ -1415,6 +1461,18 @@ class Trainer:
         """--nonfinite_policy=rollback: restore the newest verified
         checkpoint, temper the learning rate, and arrange to fast-forward
         past the poison region. Returns the pass id to resume from."""
+        # settle the background writer first: the newest enqueued save
+        # must be on disk before the restore scan, and a FAILED async
+        # write must not abort the rollback (older checkpoints remain) —
+        # log it and restore from what is actually durable
+        if self._async_ckpt is not None:
+            try:
+                self._async_ckpt.drain()
+            except Exception as e:
+                logger.warning(
+                    "rollback: async checkpoint writer reported %s — "
+                    "restoring from the newest durable checkpoint", e,
+                )
         path = (
             ckpt.find_restorable_checkpoint(self.save_dir)
             if self.save_dir else None
@@ -1703,6 +1761,11 @@ class Trainer:
     # -------------------------------------------------------------- test
 
     def test(self, pass_id: int = -1) -> Dict[str, float]:
+        # pass-end eval doubles as the async-checkpoint barrier: the
+        # previous pass's background write had a whole pass of training
+        # to overlap with, and a writer failure surfaces here at most
+        # one pass late instead of at process exit
+        self._drain_async_ckpt()
         provider = self._provider(for_test=True)
         if provider is None:
             return {}
@@ -1910,23 +1973,49 @@ class Trainer:
         extra = {"config_json": self.config.to_json()}
         if batch_id is not None:
             extra["batch_id"] = batch_id
+        keep = 0 if final else 3
+        if self._async_ckpt is not None:
+            # step-loop cost: device→host snapshot only; the durable
+            # write (and the protect-clearing below) happens when the
+            # background writer reports the checkpoint landed
+            self._async_ckpt.save(
+                pass_id,
+                self.params,
+                self.opt_state,
+                extra_meta=extra,
+                keep=keep,
+                protect_pass=self._restored_pass,
+                on_durable=self._on_ckpt_durable,
+            )
+            return
         ckpt.save_checkpoint(
             self.save_dir,
             pass_id,
             self.params,
             self.opt_state,
             extra_meta=extra,
-            keep=0 if final else 3,
+            keep=keep,
             # rolling deletion must never remove the checkpoint this run
             # restored from — until a newer save proves restorable it is
             # the only known-good state
             protect_pass=self._restored_pass,
         )
+        self._on_ckpt_durable(pass_id, "")
+
+    def _on_ckpt_durable(self, pass_id: int, _path: str) -> None:
+        """A checkpoint for ``pass_id`` is durable on disk (manifested +
+        renamed). Sync saves call this inline; async saves from the
+        writer thread once the background protocol finished — only THEN
+        may the restored-from pass rejoin the normal rotation budget."""
         if self._restored_pass is not None and pass_id != self._restored_pass:
-            # a NEWER checkpoint just landed durably (manifested + renamed):
-            # the restored-from pass rejoins the normal rotation budget
-            # instead of being retained for the run's lifetime
             self._restored_pass = None
+
+    def _drain_async_ckpt(self) -> None:
+        """Barrier on the background checkpoint writer (no-op when sync).
+        Raises CheckpointError if a background write failed — an async
+        save failure must never be silent (doc/performance.md)."""
+        if self._async_ckpt is not None:
+            self._async_ckpt.drain()
 
     # ---------------------------------------------------------- checkgrad
 

@@ -72,6 +72,18 @@ class _Flags:
     # hanging (0 disables). Generous default: 30 min of true dead air is
     # indistinguishable from a hang
     data_stall_timeout: float = 1800.0
+    # host-overlap knobs (doc/performance.md "Zero-stall host"):
+    # async_checkpoint moves checkpoint serialize/fsync/rename off the
+    # step loop onto a background writer — save() only pays the
+    # device→host snapshot; ckpt_inflight_limit bounds queued background
+    # saves (drop-oldest-pending beyond it). data_packer_threads packs
+    # batches on an N-thread pool (the native C packers release the
+    # GIL); prefetch_depth is the order-preserving packed-batch queue
+    # depth between the packers and the step loop.
+    async_checkpoint: bool = False
+    ckpt_inflight_limit: int = 1
+    data_packer_threads: int = 2
+    prefetch_depth: int = 4
     # skip-and-log up to N malformed samples per provider, then fail
     # (0 = fail on the first one, the old behavior)
     max_bad_samples: int = 0
