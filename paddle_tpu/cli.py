@@ -204,11 +204,18 @@ def _dump_config(rest) -> int:
 
 
 def _check_checkpoint(rest) -> int:
-    """`paddle check-checkpoint <dir>` — offline manifest verification.
+    """`paddle check-checkpoint <dir>` — offline checkpoint verification.
 
     <dir> is one pass directory, or a save_dir whose pass-NNNNN children
-    are each verified. Exit 0 = everything restorable, 1 = problems.
-    Never mutates anything (quarantine is load_checkpoint's job)."""
+    are each verified. Each dir gets BOTH checks: the byte-level manifest
+    verify (CRC/size of every manifested file) and the sharded-structure
+    verify (every shard record in each merged index resolves to its file
+    and key, coverage is exact — problems name the owning host). In
+    save-dir mode, uncommitted sharded saves (`pass-N.tmp` left by a
+    crashed run — the pass never reached its commit agreement) are
+    reported as PARTIAL. Exit 0 = everything restorable and no partial
+    passes, 1 = problems. Never mutates anything (quarantine is
+    load_checkpoint's job)."""
     from paddle_tpu.resilience.manifest import read_manifest
     from paddle_tpu.trainer import checkpoint as ckpt
 
@@ -221,19 +228,20 @@ def _check_checkpoint(rest) -> int:
         print(f"error: {root!r} is not a directory", file=sys.stderr)
         return 2
     if ckpt.has_params_tree(root):
-        dirs = [root]
+        dirs, partials = [root], []
     else:
         dirs = sorted(
             os.path.join(root, d)
             for d in os.listdir(root)
             if ckpt._is_pass_dir_name(d)
         )
-        if not dirs:
+        partials = ckpt.partial_pass_report(root)
+        if not dirs and not partials:
             print(f"error: no pass dirs (or params tree) under {root!r}", file=sys.stderr)
             return 2
     bad = 0
     for d in dirs:
-        problems = ckpt.verify_checkpoint(d)
+        problems = ckpt.verify_checkpoint(d) + ckpt.verify_sharded_shards(d)
         manifest = read_manifest(d)
         if problems:
             bad += 1
@@ -244,12 +252,18 @@ def _check_checkpoint(rest) -> int:
             print(f"OK?      {d} (no MANIFEST.json — pre-resilience save, contents unverified)")
         else:
             print(f"OK       {d} ({len(manifest.get('files', {}))} files verified)")
-    quarantined = [
-        d for d in os.listdir(root)
-        if ckpt.CORRUPT_SUFFIX in d
-    ] if not ckpt.has_params_tree(root) else []
-    for q in sorted(quarantined):
-        print(f"QUARANTINED  {os.path.join(root, q)} (previously failed restore)")
+    if not ckpt.has_params_tree(root):
+        for q in sorted(
+            d for d in os.listdir(root) if ckpt.CORRUPT_SUFFIX in d
+        ):
+            print(f"QUARANTINED  {os.path.join(root, q)} (previously failed restore)")
+        for tmp, n_manifests in partials:
+            bad += 1
+            print(
+                f"PARTIAL  {tmp} ({n_manifests} per-host partial manifest(s) "
+                "— the save never reached its commit agreement; not "
+                "restorable)"
+            )
     return 1 if bad else 0
 
 

@@ -74,6 +74,7 @@ def analyze(streams: Dict[int, List[Dict[str, Any]]]) -> Dict[str, Any]:
     last_skew: Optional[Dict[str, Any]] = None
     run_ended = False
     hangs: List[Dict[str, Any]] = []
+    restarts: List[Dict[str, Any]] = []
 
     for host in hosts:
         for rec in streams[host]:
@@ -89,6 +90,8 @@ def analyze(streams: Dict[int, List[Dict[str, Any]]]) -> Dict[str, Any]:
                 last_skew = rec
             elif kind == "hang":
                 hangs.append(rec)
+            elif kind == "restart":
+                restarts.append(rec)
             elif kind == "pass_end":
                 p = int(rec.get("pass", -1))
                 per_host_pass.setdefault(host, {})[p] = rec
@@ -260,10 +263,27 @@ def analyze(streams: Dict[int, List[Dict[str, Any]]]) -> Dict[str, Any]:
     if invalid:
         warnings.append(f"{invalid} record(s) failed schema validation")
 
+    # restart latency (ROADMAP item 5 groundwork): the measured numbers
+    # heartbeat-grace and crash-loop windows should be tuned from — the
+    # WORST observed restore and time-to-first-step across hosts/rounds
+    restart_latency = None
+    if restarts:
+        restart_latency = {
+            "rounds": len(restarts),
+            "restore_s_max": max(
+                float(r.get("restore_s", 0.0)) for r in restarts
+            ),
+            "time_to_first_step_s_max": max(
+                float(r.get("time_to_first_step_s", 0.0)) for r in restarts
+            ),
+        }
+
     return {
         "hosts": hosts,
         "passes": [passes[p] for p in sorted(passes)],
         "checkpoints": checkpoints,
+        "restarts": restarts,
+        "restart_latency": restart_latency,
         "counters": {h: per_host_prev.get(h, {}) for h in hosts},
         "straggler": straggler,
         "barrier_skew": last_skew,
@@ -321,6 +341,32 @@ def _fmt_table(doc: Dict[str, Any]) -> str:
                 f"{c.get('op', '?'):<10} {c.get('pass', -1):>5} "
                 f"{c.get('duration_s', 0.0):>8.3f} "
                 f"{c.get('bytes', 0) / 1e6:>9.2f}"
+            )
+    if doc.get("restarts"):
+        # one row per (re)start: restore cost vs full time-to-first-step
+        # (restore + trace + compile + step 1) — the gap between them is
+        # startup work a checkpoint cannot shrink. `resumed` separates
+        # cold starts from checkpoint restores.
+        lines.append("")
+        lines.append(
+            f"{'restart':<8} {'host':>4} {'pass':>5} {'restore s':>9} "
+            f"{'ttfs s':>8} {'resumed':>7}"
+        )
+        for i, r in enumerate(doc["restarts"]):
+            lines.append(
+                f"{i:<8} {r.get('host', 0):>4} {r.get('pass', -1):>5} "
+                f"{r.get('restore_s', 0.0):>9.3f} "
+                f"{r.get('time_to_first_step_s', 0.0):>8.3f} "
+                f"{'yes' if r.get('resumed') else 'no':>7}"
+            )
+        lat = doc.get("restart_latency") or {}
+        if lat:
+            lines.append(
+                f"restart latency: worst restore "
+                f"{lat['restore_s_max']:.3f}s, worst time-to-first-step "
+                f"{lat['time_to_first_step_s_max']:.3f}s over "
+                f"{lat['rounds']} round(s) — tune --heartbeat_startup_grace "
+                "and crash-loop windows above the ttfs number"
             )
     if doc["straggler"] and doc["straggler"].get("line"):
         lines.append("")

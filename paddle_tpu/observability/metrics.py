@@ -46,7 +46,8 @@ FILE_FMT = "metrics.host%d.jsonl"
 # record kinds that force a flush when emitted: each marks a window
 # boundary after which losing the buffer would lose a whole window
 FLUSH_KINDS = frozenset(
-    {"run_start", "run_end", "pass_end", "checkpoint", "crash", "barrier_skew"}
+    {"run_start", "run_end", "pass_end", "checkpoint", "crash",
+     "barrier_skew", "restart"}
 )
 
 # required keys of every record; kind-specific fields ride alongside

@@ -66,6 +66,60 @@ class MeshSpec:
         return tuple(k for _, k in self.axes)
 
 
+def rescale_mesh_spec(spec: str, orig_hosts: int, cur_hosts: int) -> str:
+    """The mesh spec an N-host launch becomes on M surviving hosts —
+    reshard-on-relaunch's shape rule (doc/resilience.md "Elastic sharded
+    checkpointing"): the "data" axis scales with the host count while
+    every other axis keeps its extent, so model/pipe/seq parallelism
+    groups stay intact and only the data-parallel width breathes.
+    Because the global batch is the config's ``batch_size`` (each
+    process takes a 1/num_processes row block — spmd.globalize_batch),
+    shrinking the data axis automatically grows the per-host batch and
+    the GLOBAL batch (and therefore sync-SGD semantics) is preserved.
+
+    Pure string math — no device queries, so the launcher can call it
+    for a pod whose accelerator runtime is the thing that just died. An
+    EMPTY spec is identity: the trainer sizes it from jax.devices() at
+    startup, which already follows the surviving host set (the
+    auto-sized mesh is the most elastic of all). Raises ValueError when
+    an explicit spec cannot rescale: no data axis to scale, or a data
+    extent not integrally divisible by the host-count ratio."""
+    if orig_hosts <= 0 or cur_hosts <= 0:
+        raise ValueError(f"host counts must be positive ({orig_hosts}->{cur_hosts})")
+    spec = (spec or "").strip()
+    if cur_hosts == orig_hosts or not spec:
+        return spec
+    axes: List[Tuple[str, int]] = []
+    for part in spec.split(","):
+        part = part.strip()
+        if "=" in part:
+            name, _, n = part.partition("=")
+            axes.append((name.strip(), int(n)))
+        else:
+            axes.append(("data", int(part)))
+    names = [n for n, _ in axes]
+    if "data" not in names:
+        raise ValueError(
+            f"mesh spec {spec!r} has no data axis to rescale for "
+            f"{cur_hosts}/{orig_hosts} hosts"
+        )
+    out = []
+    for name, extent in axes:
+        if name == "data":
+            if (extent * cur_hosts) % orig_hosts:
+                raise ValueError(
+                    f"data axis {extent} cannot scale by "
+                    f"{cur_hosts}/{orig_hosts} integrally"
+                )
+            extent = extent * cur_hosts // orig_hosts
+            if extent < 1:
+                raise ValueError(
+                    f"data axis vanishes at {cur_hosts}/{orig_hosts} hosts"
+                )
+        out.append(f"{name}={extent}")
+    return ",".join(out)
+
+
 def make_mesh(spec: str = "", devices: Optional[list] = None) -> Mesh:
     ms = MeshSpec.parse(spec) if isinstance(spec, str) else spec
     devices = devices if devices is not None else jax.devices()

@@ -47,13 +47,41 @@ Contracts that make this safe, not just fast:
   hang. A writer wedged forever on a dead shared fs still trips the
   watchdog once pings stop, exactly like a wedged synchronous save.
 
-Multi-process runs keep the synchronous path: the sharded save is a
-collective (barriers + shard writes on every host) and must run where
-every process participates at the same launch boundary.
+Multi-process runs use :class:`ShardedAsyncCheckpointer` — the elastic
+sharded twin (doc/resilience.md "Elastic sharded checkpointing"):
+
+- ``save()`` snapshots only the shards THIS process uniquely owns
+  (``checkpoint.snapshot_owned_trees`` — every owned shard's
+  device→host copy dispatched before the first collect blocks) and
+  enqueues them on the same bounded queue.
+- The per-host background writer runs the PR-1 durable discipline over
+  its own files only: shard npz + partial index + partial manifest into
+  ``pass-N.tmp`` (``checkpoint.write_sharded_host_trees``). No
+  cross-process coordination happens on the write path at all.
+- The ONLY collective is ``drain()``'s cheap pass-end agreement, and it
+  is a HOST protocol (the jax distributed runtime's KV store + barrier
+  — no device collectives): every process publishes which passes its
+  writer made locally durable (or its writer error), all rendezvous,
+  and the commit set is the INTERSECTION (writer speeds differ, so the
+  drop-oldest policy can drop different passes per host — a pass is
+  durable only where EVERY host's shards landed). Process 0 then merges
+  partial indexes + manifests and renames each agreed pass into place;
+  a second agreement round carries process 0's commit verdict to every
+  host (and keeps the round counters aligned even when the commit
+  itself fails).
+- **Writer failures propagate to every host**: a failed write surfaces
+  as :class:`CheckpointError` from drain() on ALL processes (the
+  agreement carries the error), so the job tears down together instead
+  of one rank dying while the rest block in a barrier. This is the
+  sharded analog of the single-process "next save/drain" contract —
+  made symmetric, which is why sharded ``save()`` does NOT re-raise a
+  pending local error early.
 """
 
 from __future__ import annotations
 
+import json
+import os
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
@@ -66,7 +94,9 @@ from paddle_tpu.resilience import CheckpointError
 from paddle_tpu.trainer import checkpoint as ckpt
 from paddle_tpu.utils.logging import logger
 
-__all__ = ["AsyncCheckpointer", "snapshot_to_host"]
+__all__ = [
+    "AsyncCheckpointer", "ShardedAsyncCheckpointer", "snapshot_to_host",
+]
 
 
 def snapshot_to_host(tree):
@@ -90,10 +120,10 @@ def snapshot_to_host(tree):
 
 class _Job:
     __slots__ = ("pass_id", "params", "opt_state", "extra_meta", "keep",
-                 "protect_pass", "on_durable")
+                 "protect_pass", "on_durable", "snapshot", "meta")
 
     def __init__(self, pass_id, params, opt_state, extra_meta, keep,
-                 protect_pass, on_durable):
+                 protect_pass, on_durable, snapshot=None, meta=None):
         self.pass_id = pass_id
         self.params = params
         self.opt_state = opt_state
@@ -101,6 +131,11 @@ class _Job:
         self.keep = keep
         self.protect_pass = protect_pass
         self.on_durable = on_durable
+        # sharded-mode payload: {base: (pieces, partial_index)} host
+        # snapshot + the pass meta dict (built at save time — the live
+        # state keeps training while the write is in flight)
+        self.snapshot = snapshot
+        self.meta = meta
 
 
 class AsyncCheckpointer:
@@ -155,6 +190,13 @@ class AsyncCheckpointer:
         blocked = time.perf_counter() - t0
         job = _Job(pass_id, host_params, host_opt, dict(extra_meta or {}),
                    keep, protect_pass, on_durable)
+        self._enqueue(job, blocked)
+        return blocked
+
+    def _enqueue(self, job: _Job, blocked: float) -> None:
+        """Queue one snapshotted job on the bounded writer queue (the
+        shared half of sync-tree and sharded saves): drop-oldest-pending
+        beyond the limit, wake the writer, account the snapshot cost."""
         with self._cv:
             self._pending.append(job)
             # drop-oldest-pending: the active write cannot be revoked
@@ -166,26 +208,24 @@ class AsyncCheckpointer:
                 logger.warning(
                     "async checkpoint: dropping queued save of pass %d "
                     "(superseded by pass %d; --ckpt_inflight_limit=%d)",
-                    old.pass_id, pass_id, self.inflight_limit,
+                    old.pass_id, job.pass_id, self.inflight_limit,
                 )
             self._set_inflight_gauge_locked()
             self._cv.notify_all()
         self._ensure_thread()
         obs.registry().counter("ckpt.blocked_s").inc(blocked)
         obs.emit(
-            "checkpoint", op="snapshot", pass_id=pass_id,
+            "checkpoint", op="snapshot", pass_id=job.pass_id,
             step=job.extra_meta.get("batch_id"),
-            path=ckpt.PASS_FMT % pass_id if self.save_dir else "",
+            path=ckpt.PASS_FMT % job.pass_id if self.save_dir else "",
             duration_s=round(blocked, 6),
         )
-        return blocked
 
-    def drain(self, timeout: Optional[float] = None) -> None:
-        """Block until every enqueued save is durable (or ``timeout``
-        seconds passed — then :class:`CheckpointError`). Re-raises a
-        stored writer failure. Pings the hangwatch while the writer is
-        demonstrably live so a long write at a drain barrier is not
-        misdiagnosed as a trainer hang."""
+    def _wait_idle(self, timeout: Optional[float] = None) -> None:
+        """Block until the local writer queue is empty (or ``timeout``
+        seconds passed — then :class:`CheckpointError`). Pings the
+        hangwatch while the writer is demonstrably live so a long write
+        at a drain barrier is not misdiagnosed as a trainer hang."""
         deadline = None if timeout is None else time.monotonic() + timeout
         # a dead/never-started writer would leave the queue stuck: make
         # sure one is running before waiting on it
@@ -212,6 +252,12 @@ class AsyncCheckpointer:
                         f"({len(self._pending)} pending, active="
                         f"{self._active.pass_id if self._active else None})"
                     )
+
+    def drain(self, timeout: Optional[float] = None) -> None:
+        """Block until every enqueued save is durable (or ``timeout``
+        seconds passed — then :class:`CheckpointError`). Re-raises a
+        stored writer failure."""
+        self._wait_idle(timeout)
         self._raise_pending_error()
 
     def inflight(self) -> int:
@@ -294,10 +340,267 @@ class AsyncCheckpointer:
             len(self._pending) + (1 if self._active is not None else 0)
         )
 
-    def _raise_pending_error(self) -> None:
+    def _take_error(self) -> Optional[BaseException]:
         with self._cv:
             err, self._error = self._error, None
+        return err
+
+    def _raise_pending_error(self) -> None:
+        err = self._take_error()
         if err is not None:
             raise CheckpointError(
                 f"async checkpoint write failed: {err}"
             ) from err
+
+
+class _KvAgreement:
+    """The pass-end agreement channel: publish a small payload, wait for
+    every process, read everyone's payloads back — over the jax
+    distributed runtime's KV store + host barrier. No device collectives
+    (the agreement must work even when the backend cannot run
+    cross-process computations, and must not occupy the accelerator).
+    Single-process (or no distributed client): degenerates to returning
+    only the local payload. Rounds are numbered locally; the agreement
+    is only ever called from collective call sites (drain), so every
+    process's round counter stays aligned."""
+
+    def __init__(self, timeout_s: float = 600.0):
+        from paddle_tpu.utils.barrier import distributed_client
+
+        self.timeout_s = float(timeout_s)
+        self.client = distributed_client()
+        self.pid = jax.process_index()
+        self.count = jax.process_count()
+        self._round = 0
+        self._prev_key: Optional[str] = None
+
+    def agree(self, payload: str) -> List[str]:
+        """Everyone's payloads, pid-ordered. Raises on rendezvous
+        failure (a peer died mid-protocol)."""
+        r = self._round
+        self._round += 1
+        if self.client is None or self.count == 1:
+            return [payload]
+        timeout_ms = int(self.timeout_s * 1000)
+        key = f"ckpt_agree/{r}/{self.pid:05d}"
+        if self._prev_key is not None:
+            # bound KV-store growth by one round, deleting only NOW:
+            # deleting right after our own dir read would race a slower
+            # peer still reading that round's directory (the barrier
+            # orders the sets before any read, but nothing orders one
+            # process's delete after ANOTHER's read — except the next
+            # round's barrier, which is where we are)
+            try:
+                self.client.key_value_delete(self._prev_key)
+            except Exception:
+                pass
+        self._prev_key = key
+        self.client.key_value_set(key, payload)
+        self.client.wait_at_barrier(f"ckpt_agree_{r}", timeout_ms)
+        items = self.client.key_value_dir_get(f"ckpt_agree/{r}/")
+        return [v for _k, v in sorted(items)]
+
+
+
+class ShardedAsyncCheckpointer(AsyncCheckpointer):
+    """Per-host async shard writer + pass-end commit agreement — the
+    multi-process elastic twin of :class:`AsyncCheckpointer` (see the
+    module docstring for the protocol and its failure contract)."""
+
+    def __init__(
+        self,
+        save_dir: str,
+        inflight_limit: int = 1,
+        hangwatch=None,
+        *,
+        process_index: Optional[int] = None,
+        process_count: Optional[int] = None,
+        agreement=None,
+        agree_timeout: float = 600.0,
+        write_fn: Optional[Callable[..., None]] = None,
+    ):
+        super().__init__(
+            save_dir, inflight_limit, hangwatch,
+            write_fn=write_fn or ckpt.write_sharded_host_trees,
+        )
+        self.pid = jax.process_index() if process_index is None else int(process_index)
+        self.count = jax.process_count() if process_count is None else int(process_count)
+        self.agreement = agreement or _KvAgreement(agree_timeout)
+        # locally durable jobs awaiting the commit agreement
+        self._durable: List[_Job] = []
+        # save() calls since the last drain: when zero on every process
+        # (deterministic — saves are collective call sites), drain skips
+        # the agreement round entirely, so saving_period > 1 does not
+        # pay per-pass KV chatter
+        self._saves_since_drain = 0
+
+    # -------------------------------------------------------- trainer side
+
+    def save(
+        self,
+        pass_id: int,
+        params: Dict[str, jax.Array],
+        opt_state=None,
+        extra_meta: Optional[Dict[str, Any]] = None,
+        keep: int = 3,
+        protect_pass: Optional[int] = None,
+        on_durable: Optional[Callable[[int, str], None]] = None,
+    ) -> float:
+        """Snapshot this process's owned shards device→host and enqueue
+        the background shard write. Unlike the single-process save, a
+        pending LOCAL writer error is NOT raised here — it travels
+        through the next drain's agreement so every host fails together
+        instead of this one desyncing the collective call sites."""
+        t0 = time.perf_counter()
+        trees, meta = ckpt.build_save_trees(
+            pass_id, params, opt_state, extra_meta, multihost=True
+        )
+        snapshot = ckpt.snapshot_owned_trees(trees, self.pid)
+        blocked = time.perf_counter() - t0
+        job = _Job(pass_id, None, None, dict(extra_meta or {}), keep,
+                   protect_pass, on_durable, snapshot=snapshot, meta=meta)
+        self._saves_since_drain += 1
+        self._enqueue(job, blocked)
+        return blocked
+
+    def drain(self, timeout: Optional[float] = None) -> None:
+        """Local writer barrier + the pass-end commit agreement.
+
+        1. Wait for THIS host's writer queue to empty.
+        2. Publish ``{ok, passes}`` (locally durable pass ids, or the
+           writer error) and rendezvous with every process.
+        3. Any host not ok → :class:`CheckpointError` on EVERY host.
+        4. Process 0 finalizes the agreed (intersection) passes: merge
+           indexes + manifests, meta, rename, one rotation at the end.
+        5. A second agreement round carries process 0's commit verdict
+           (a barrier alone could not say WHY it was released): a failed
+           finalize raises :class:`CheckpointError` on every host with
+           the rounds still aligned, instead of process 0 dying raw
+           while the peers stall out a bare barrier.
+        6. Per-process ``on_durable`` callbacks for the committed set.
+        """
+        self._wait_idle(timeout)
+        err = self._take_error()
+        with self._cv:
+            durable, self._durable = self._durable, []
+        saves, self._saves_since_drain = self._saves_since_drain, 0
+        if not saves and err is None and not durable:
+            return  # nothing enqueued anywhere since the last agreement
+        local: Dict[int, _Job] = {}
+        for job in durable:  # latest-wins per pass (periodic + pass-end)
+            local[job.pass_id] = job
+        payload = json.dumps({
+            "pid": self.pid,
+            "ok": err is None,
+            "passes": sorted(local),
+            "error": "" if err is None else f"{type(err).__name__}: {err}",
+        })
+        if self.hangwatch is not None and local:
+            # entering a blocking rendezvous that lasts as long as the
+            # slowest peer's write: one ping so the wait is measured
+            # from here, exactly like the sync sharded save's barrier
+            self.hangwatch.ping(max(local))
+        try:
+            replies = [json.loads(r) for r in self.agreement.agree(payload)]
+        except Exception as e:
+            raise CheckpointError(
+                f"sharded checkpoint agreement failed (peer died "
+                f"mid-protocol?): {e}"
+            ) from e
+        bad = [d for d in replies if not d.get("ok")]
+        if bad or err is not None:
+            detail = "; ".join(
+                f"host {d.get('pid')}: {d.get('error') or 'failed'}" for d in bad
+            ) or f"host {self.pid}: {err}"
+            raise CheckpointError(
+                f"sharded async checkpoint write failed — {detail} "
+                "(no pass from this round was committed)"
+            ) from err
+        commit = set(local)
+        for d in replies:
+            commit &= set(d.get("passes", []))
+        ordered = sorted(commit)
+        finals: Dict[int, str] = {}
+        commit_err: Optional[BaseException] = None
+        if self.pid == 0:
+            try:
+                for i, p in enumerate(ordered):
+                    job = local[p]
+                    t0 = time.perf_counter()
+                    finals[p] = ckpt.finalize_sharded_pass(
+                        self.save_dir, p, job.snapshot.keys(), job.meta,
+                        keep=job.keep, protect_pass=job.protect_pass,
+                        expected_pids=range(self.count),
+                        # ONE rotation after the last commit: rotating
+                        # mid-batch would sweep the .tmp of the next pass
+                        # awaiting its own commit
+                        rotate=(i == len(ordered) - 1),
+                    )
+                    logger.info("saved checkpoint %s", finals[p])
+                    ckpt._ckpt_record(
+                        "save", finals[p], t0, pass_id=p, measure_bytes=True,
+                        step=job.extra_meta.get("batch_id"),
+                    )
+            except BaseException as e:
+                # captured, not raised: the commit round below must still
+                # run so the peers learn the verdict and every process's
+                # agreement round counter stays aligned
+                commit_err = e
+        try:
+            verdicts = self.agreement.agree(json.dumps({
+                "pid": self.pid, "committed": commit_err is None,
+            }))
+        except Exception as e:
+            raise CheckpointError(
+                f"sharded checkpoint commit rendezvous failed: {e}"
+            ) from e
+        # pid-ordered replies: the head is process 0's commit verdict
+        head = json.loads(verdicts[0])
+        if not head.get("committed", False):
+            raise CheckpointError(
+                "sharded checkpoint commit failed on host 0: "
+                f"{commit_err if commit_err is not None else 'see host 0 log'}"
+            ) from commit_err
+        for p in ordered:
+            job = local[p]
+            if job.on_durable is not None:
+                try:
+                    job.on_durable(
+                        p, finals.get(p, os.path.join(self.save_dir, ckpt.PASS_FMT % p))
+                    )
+                except Exception:
+                    logger.warning(
+                        "async checkpoint: on_durable callback failed for "
+                        "pass %d", p, exc_info=True,
+                    )
+
+    # --------------------------------------------------------- writer side
+
+    def _write(self, job: _Job) -> None:
+        if self.hangwatch is not None:
+            self.hangwatch.ping(job.pass_id)
+        t0 = time.perf_counter()
+        try:
+            self._write_fn(self.save_dir, job.pass_id, job.snapshot, self.pid)
+        except BaseException as e:
+            with self._cv:
+                self._error = e
+            logger.error(
+                "async checkpoint: background shard write of pass %d failed "
+                "on host %d: %s (will surface as CheckpointError on every "
+                "host at the next drain agreement)",
+                job.pass_id, self.pid, e,
+            )
+            return
+        finally:
+            if self.hangwatch is not None:
+                self.hangwatch.ping(job.pass_id)
+        dt = time.perf_counter() - t0
+        self.completed += 1
+        obs.registry().counter("ckpt.write_s").inc(dt)
+        # the written pieces are on disk now — keep only the tree bases
+        # (what the commit merge needs), so a pass awaiting its
+        # agreement does not pin a full host copy of this host's shards
+        job.snapshot = dict.fromkeys(job.snapshot)
+        with self._cv:
+            self._durable.append(job)

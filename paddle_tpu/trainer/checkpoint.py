@@ -38,11 +38,12 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import shutil
 import time
 import zipfile
 import zlib
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -185,23 +186,50 @@ def _unflatten(flat: Dict[str, np.ndarray]) -> Dict:
     return out
 
 
-def _save_tree_sharded(path: str, base: str, flat: Dict[str, jax.Array]) -> str:
-    """Write this process's uniquely-owned shards of one tree + a partial
-    index. Called by EVERY process. Returns the shard filename (the
-    caller manifests the files it wrote)."""
-    pid = jax.process_index()
-    shard_file = f"{base}.shard{pid:05d}.npz"
-    pieces: Dict[str, np.ndarray] = {}
-    partial: Dict[str, Any] = {}
-    for name, arr in flat.items():
-        arr = jnp.asarray(arr) if not isinstance(arr, jax.Array) else arr
-        entry = {"shape": list(arr.shape), "dtype": str(arr.dtype), "shards": []}
-        for i, sh in enumerate(arr.addressable_shards):
-            if sh.replica_id != 0:
-                continue  # exactly one process owns each distinct slice
-            key = f"{name}::{i}"
+def snapshot_owned_trees(
+    trees: Dict[str, Dict[str, Any]], pid: Optional[int] = None
+) -> Dict[str, Tuple[Dict[str, np.ndarray], Dict[str, Any]]]:
+    """Device→host snapshot of the shards THIS process uniquely owns
+    (replica_id == 0), across ALL trees at once: every owned shard's
+    async copy is dispatched before the first collection blocks (the
+    sharded twin of async_ckpt.snapshot_to_host), so the caller pays one
+    DMA wait, not one per shard. Returns ``{base: (pieces, partial)}``
+    where ``pieces`` are the npz members to write and ``partial`` is the
+    per-process index fragment (shard filenames already stamped)."""
+    pid = jax.process_index() if pid is None else int(pid)
+    staged: Dict[str, List[Tuple[str, Any, str, Any]]] = {}
+    for base, flat in trees.items():
+        owned: List[Tuple[str, Any, str, Any]] = []
+        for name, arr in flat.items():
+            arr = jnp.asarray(arr) if not isinstance(arr, jax.Array) else arr
+            for i, sh in enumerate(arr.addressable_shards):
+                if sh.replica_id != 0:
+                    continue  # exactly one process owns each distinct slice
+                copy_async = getattr(sh.data, "copy_to_host_async", None)
+                if copy_async is not None:
+                    try:
+                        copy_async()
+                    except Exception:
+                        pass  # backends without async copies: the
+                        # np.asarray below blocks — correct, just slower
+                owned.append((name, arr, f"{name}::{i}", sh))
+        staged[base] = owned
+    out: Dict[str, Tuple[Dict[str, np.ndarray], Dict[str, Any]]] = {}
+    for base, owned in staged.items():
+        shard_file = f"{base}.shard{pid:05d}.npz"
+        pieces: Dict[str, np.ndarray] = {}
+        partial: Dict[str, Any] = {}
+        for name, arr, key, sh in owned:
             data = np.asarray(sh.data)
             pieces[key] = data
+            entry = partial.get(name)
+            if entry is None:
+                # the GLOBAL parameter shape/dtype, not the shard's
+                entry = partial[name] = {
+                    "shape": list(arr.shape),
+                    "dtype": str(arr.dtype),
+                    "shards": [],
+                }
             entry["shards"].append(
                 {
                     "file": shard_file,
@@ -212,8 +240,18 @@ def _save_tree_sharded(path: str, base: str, flat: Dict[str, jax.Array]) -> str:
                     "shape": list(data.shape),
                 }
             )
-        if entry["shards"]:
-            partial[name] = entry
+        out[base] = (pieces, partial)
+    return out
+
+
+def write_owned_shards(
+    path: str, base: str, pid: int,
+    pieces: Dict[str, np.ndarray], partial: Dict[str, Any],
+) -> str:
+    """Durably write one process's shard file + partial index for one
+    tree (the write half of ``_save_tree_sharded``). Returns the shard
+    filename (the caller manifests the files it wrote)."""
+    shard_file = f"{base}.shard{pid:05d}.npz"
     _write_file(os.path.join(path, shard_file), lambda f: np.savez(f, **pieces))
     # the partial index is transient (merged then deleted): durable write,
     # but never manifested
@@ -223,6 +261,122 @@ def _save_tree_sharded(path: str, base: str, flat: Dict[str, jax.Array]) -> str:
         mode="w",
     )
     return shard_file
+
+
+def _save_tree_sharded(path: str, base: str, flat: Dict[str, jax.Array]) -> str:
+    """Write this process's uniquely-owned shards of one tree + a partial
+    index. Called by EVERY process (snapshot + write in one step — the
+    synchronous path; the async path stages the two halves)."""
+    pid = jax.process_index()
+    pieces, partial = snapshot_owned_trees({base: flat}, pid)[base]
+    return write_owned_shards(path, base, pid, pieces, partial)
+
+
+def write_sharded_host_trees(
+    save_dir: str, pass_id: int,
+    snapshot: Dict[str, Tuple[Dict[str, np.ndarray], Dict[str, Any]]],
+    pid: int,
+) -> None:
+    """Background-writer half of a sharded ASYNC save: write this
+    process's shard files + partial indexes + partial manifest into the
+    pass's tmp dir. Every process's writer calls this independently
+    (``exist_ok``: no cross-process ordering before the pass-end
+    agreement); the commit half is :func:`finalize_sharded_pass`."""
+    tmp = os.path.join(save_dir, PASS_FMT % pass_id) + TMP_SUFFIX
+    os.makedirs(tmp, exist_ok=True)
+    own_files = [
+        write_owned_shards(tmp, base, pid, pieces, partial)
+        for base, (pieces, partial) in snapshot.items()
+    ]
+    _durable_manifest(
+        ckpt_manifest.write_partial_manifest, tmp, pid, own_files,
+        label=f"MANIFEST.partial.{pid:05d}.json",
+    )
+
+
+_SHARD_FILE_RE = re.compile(r"^(?P<base>.+)\.shard(?P<pid>\d{5})\.npz$")
+_PARTIAL_IDX_RE = re.compile(r"^(?P<base>.+)\.index\.(?P<pid>\d{5})\.json$")
+_MERGED_IDX_RE = re.compile(r"^(?P<base>.+)\.index\.json$")
+_PARTIAL_MANIFEST_RE = re.compile(r"^MANIFEST\.partial\.(?P<pid>\d{5})\.json$")
+
+
+def _sweep_stale_sharded_files(
+    tmp: str, tree_bases: Iterable[str], expected_pids: Iterable[int]
+) -> None:
+    """Drop litter from a CRASHED earlier attempt at this pass out of the
+    tmp dir before merging: shard/index/partial-manifest files from a pid
+    outside the current process set, or from a tree the current save does
+    not write (e.g. an optimizer tree that existed before). Without this,
+    the manifest merge would digest a dead process's stale shard into the
+    checkpoint and the index merge would resurrect its slices. Only
+    recognized checkpoint file patterns are touched."""
+    bases = set(tree_bases)
+    pids = {int(p) for p in expected_pids}
+    for fn in os.listdir(tmp):
+        m = _SHARD_FILE_RE.match(fn)
+        if m:
+            if m.group("base") in bases and int(m.group("pid")) in pids:
+                continue
+        else:
+            m = _PARTIAL_IDX_RE.match(fn)
+            if m:
+                if m.group("base") in bases and int(m.group("pid")) in pids:
+                    continue
+            else:
+                m = _PARTIAL_MANIFEST_RE.match(fn)
+                if m:
+                    if int(m.group("pid")) in pids:
+                        continue
+                else:
+                    m = _MERGED_IDX_RE.match(fn)
+                    if not m or m.group("base") in bases:
+                        continue  # unknown files and live merged indexes stay
+        logger.warning("sharded save: sweeping stale file %s from %s", fn, tmp)
+        try:
+            os.remove(os.path.join(tmp, fn))
+        except OSError:
+            pass
+
+
+def finalize_sharded_pass(
+    save_dir: str,
+    pass_id: int,
+    tree_bases: Iterable[str],
+    meta: Dict[str, Any],
+    keep: int = 3,
+    protect_pass: Optional[int] = None,
+    expected_pids: Optional[Iterable[int]] = None,
+    rotate: bool = True,
+) -> str:
+    """Process-0 commit half of a sharded save: merge the partial indexes
+    and partial manifests every process left in ``pass-N.tmp``, write
+    meta.json, and atomically publish the dir (``_commit``). Must only
+    run once every process's shards + partial manifest are known durable
+    (the sync path's barrier / the async path's pass-end agreement).
+    ``expected_pids`` turns on the stale-file sweep (async saves reuse a
+    tmp dir a crashed run may have littered); ``rotate=False`` lets a
+    caller committing SEVERAL passes in one drain defer rotation until
+    the last one (rotation sweeps ``*.tmp`` dirs — including, otherwise,
+    the tmp of the next pass awaiting its own commit)."""
+    final = os.path.join(save_dir, PASS_FMT % pass_id)
+    tmp = final + TMP_SUFFIX
+    tree_bases = list(tree_bases)
+    if expected_pids is not None:
+        _sweep_stale_sharded_files(tmp, tree_bases, expected_pids)
+    for base in tree_bases:
+        _merge_tree_indexes(tmp, base)
+    _write_file(
+        os.path.join(tmp, "meta.json"),
+        lambda f: json.dump(meta, f, indent=2),
+        mode="w",
+    )
+    _durable_manifest(
+        ckpt_manifest.merge_partial_manifests, tmp, label="MANIFEST.json"
+    )
+    _commit(tmp, final)
+    if rotate:
+        _rotate(save_dir, keep, protect=protect_pass)
+    return final
 
 
 def _merge_tree_indexes(path: str, base: str) -> None:
@@ -259,6 +413,35 @@ def _optimizer_trees(opt_state: UpdaterState) -> Dict[str, Dict]:
     return trees
 
 
+def build_save_trees(
+    pass_id: int,
+    params: Dict[str, jax.Array],
+    opt_state: Optional[UpdaterState],
+    extra_meta: Optional[Dict[str, Any]],
+    multihost: bool,
+) -> Tuple[Dict[str, Dict], Dict[str, Any]]:
+    """(trees, meta) of one save — the single source both the sync
+    ``save_checkpoint`` and the async sharded snapshot build from, so
+    the two paths cannot diverge on format."""
+    trees: Dict[str, Dict] = {"params": _flatten(params)}
+    meta: Dict[str, Any] = {"pass_id": pass_id, "format_version": 2 if multihost else 1}
+    if opt_state is not None:
+        trees.update(_optimizer_trees(opt_state))
+        meta["optimizer"] = {
+            "step": int(opt_state.step),
+            "num_samples": float(opt_state.num_samples),
+            "avg_count": float(opt_state.avg_count),
+            "avg_old_count": (
+                float(opt_state.avg_old_count)
+                if opt_state.avg_old_count is not None
+                else 0.0
+            ),
+        }
+    if extra_meta:
+        meta.update(extra_meta)
+    return trees, meta
+
+
 def save_checkpoint(
     save_dir: str,
     pass_id: int,
@@ -291,50 +474,30 @@ def save_checkpoint(
         # untouched until the fresh write is durable
         shutil.rmtree(tmp, ignore_errors=True)
         os.makedirs(tmp)
-    trees: Dict[str, Dict] = {"params": _flatten(params)}
-    meta: Dict[str, Any] = {"pass_id": pass_id, "format_version": 2 if multihost else 1}
-    if opt_state is not None:
-        trees.update(_optimizer_trees(opt_state))
-        meta["optimizer"] = {
-            "step": int(opt_state.step),
-            "num_samples": float(opt_state.num_samples),
-            "avg_count": float(opt_state.avg_count),
-            "avg_old_count": (
-                float(opt_state.avg_old_count)
-                if opt_state.avg_old_count is not None
-                else 0.0
-            ),
-        }
-    if extra_meta:
-        meta.update(extra_meta)
+    trees, meta = build_save_trees(pass_id, params, opt_state, extra_meta, multihost)
     if multihost:
-        from jax.experimental import multihost_utils
+        from paddle_tpu.utils.barrier import host_barrier
 
         # everyone waits for mkdir, writes its shards + its slice of the
         # manifest, then process 0 merges partial indexes and manifests,
-        # finalizes meta, and commits the rename
-        multihost_utils.sync_global_devices("ckpt_dir:" + tmp)
+        # finalizes meta, and commits the rename. The barriers are HOST
+        # barriers (distributed-runtime rendezvous): this is a pure
+        # filesystem protocol and must not depend on the backend being
+        # able to run cross-process device computations.
+        host_barrier("ckpt_dir:" + os.path.basename(tmp))
         own_files = [_save_tree_sharded(tmp, base, flat) for base, flat in trees.items()]
         pid = jax.process_index()
         _durable_manifest(
             ckpt_manifest.write_partial_manifest, tmp, pid, own_files,
             label=f"MANIFEST.partial.{pid:05d}.json",
         )
-        multihost_utils.sync_global_devices("ckpt_shards:" + tmp)
+        host_barrier("ckpt_shards:" + os.path.basename(tmp))
         if jax.process_index() == 0:
-            for base in trees:
-                _merge_tree_indexes(tmp, base)
-            _write_file(
-                os.path.join(tmp, "meta.json"),
-                lambda f: json.dump(meta, f, indent=2),
-                mode="w",
+            finalize_sharded_pass(
+                save_dir, pass_id, trees, meta, keep=keep,
+                protect_pass=protect_pass,
             )
-            _durable_manifest(
-                ckpt_manifest.merge_partial_manifests, tmp, label="MANIFEST.json"
-            )
-            _commit(tmp, final)
-            _rotate(save_dir, keep, protect=protect_pass)
-        multihost_utils.sync_global_devices("ckpt_done:" + final)
+        host_barrier("ckpt_done:" + os.path.basename(final))
     else:
         for base, flat in trees.items():
             _write_file(
@@ -447,6 +610,121 @@ def verify_checkpoint(path: str) -> List[str]:
     )
     _ckpt_record("verify", path, t0, ok=not problems)
     return problems
+
+
+def _shard_host(fname: str) -> Optional[int]:
+    m = _SHARD_FILE_RE.match(fname)
+    return int(m.group("pid")) if m else None
+
+
+def verify_sharded_shards(path: str) -> List[str]:
+    """Structural verification of the SHARDED trees in one pass dir —
+    what the byte-level manifest check cannot see: every shard record in
+    each merged index must resolve (its file present, its key in the npz
+    archive), and the records of each parameter must cover its full
+    extent exactly once (a bad merge that silently lost one host's
+    partial index leaves a hole the manifest never notices, because the
+    manifest only covers files that EXIST). Problems name the owning
+    host parsed from the shard filename. Cheap: only zip directories are
+    read, never shard data (CRC content checks are the manifest's job).
+    Empty list = clean; non-sharded (format-1) dirs verify trivially."""
+    problems: List[str] = []
+    if not os.path.isdir(path):
+        return [f"{path}: not a directory"]
+    members: Dict[str, Optional[set]] = {}  # shard file -> npz keys (None=unreadable)
+
+    def keys_of(fname: str) -> Optional[set]:
+        if fname not in members:
+            full = os.path.join(path, fname)
+            if not os.path.exists(full):
+                members[fname] = None
+            else:
+                try:
+                    with zipfile.ZipFile(full) as z:
+                        members[fname] = {
+                            n[:-4] if n.endswith(".npy") else n
+                            for n in z.namelist()
+                        }
+                except (OSError, zipfile.BadZipFile):
+                    members[fname] = None
+        return members[fname]
+
+    for fn in sorted(os.listdir(path)):
+        m = _MERGED_IDX_RE.match(fn)
+        if not m or _PARTIAL_IDX_RE.match(fn):
+            continue
+        base = m.group("base")
+        try:
+            with open(os.path.join(path, fn)) as f:
+                index = json.load(f)
+        except (OSError, ValueError) as e:
+            problems.append(f"{fn}: unreadable index ({e})")
+            continue
+        for name, entry in sorted(index.items()):
+            total = 1
+            for d in entry.get("shape", []):
+                total *= int(d)
+            covered = 0
+            coverage_known = True
+            for rec in entry.get("shards", []):
+                fname = rec.get("file", "")
+                host = _shard_host(fname)
+                who = f"host {host}" if host is not None else fname
+                keys = keys_of(fname)
+                if keys is None:
+                    word = ("missing" if not os.path.exists(os.path.join(path, fname))
+                            else "unreadable")
+                    problems.append(
+                        f"{base}/{name}: shard file {fname} {word} ({who})"
+                    )
+                    coverage_known = False
+                    continue
+                if rec.get("key") not in keys:
+                    problems.append(
+                        f"{base}/{name}: record {rec.get('key')!r} absent "
+                        f"from {fname} ({who})"
+                    )
+                    coverage_known = False
+                    continue
+                rshape = rec.get("shape")
+                if rshape is None:
+                    coverage_known = False  # pre-'shape' checkpoints
+                    continue
+                vol = 1
+                for d in rshape:
+                    vol *= int(d)
+                covered += vol
+            if coverage_known and covered != total:
+                problems.append(
+                    f"{base}/{name}: shard records cover {covered} of "
+                    f"{total} elements (lost or duplicated host shards?)"
+                )
+    return problems
+
+
+def partial_pass_report(save_dir: str) -> List[Tuple[str, int]]:
+    """Uncommitted sharded saves under ``save_dir``: ``pass-N.tmp`` dirs
+    a crashed run left behind, with how many per-process partial
+    manifests each holds. These are NOT restorable (the pass never
+    reached its commit agreement) — `paddle check-checkpoint` surfaces
+    them so an operator can tell 'that save never landed' from 'all
+    good'."""
+    out: List[Tuple[str, int]] = []
+    if not os.path.isdir(save_dir):
+        return out
+    for d in sorted(os.listdir(save_dir)):
+        if not (d.endswith(TMP_SUFFIX)
+                and _is_pass_dir_name(d[: -len(TMP_SUFFIX)])):
+            continue
+        full = os.path.join(save_dir, d)
+        try:
+            partials = sum(
+                1 for fn in os.listdir(full) if _PARTIAL_MANIFEST_RE.match(fn)
+            )
+        except OSError:
+            continue
+        out.append((full, partials))
+    return out
 
 
 def find_restorable_checkpoint(save_dir: str) -> Optional[str]:

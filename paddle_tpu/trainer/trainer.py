@@ -99,6 +99,11 @@ class _RollbackRequest(Exception):
 
 class Trainer:
     def __init__(self, config: TrainerConfig, flags=FLAGS):
+        # restart-latency anchor: time_to_first_step_s (the `restart`
+        # telemetry record) is measured from here to the first completed
+        # launch — the number ROADMAP item 5 tightens heartbeat-grace
+        # and crash-loop windows from
+        self._t_construct = time.perf_counter()
         self.config = config
         self.flags = flags
         dtype = jnp.float32
@@ -356,30 +361,52 @@ class Trainer:
         # distinct EXIT_PREEMPTED process code so supervisors/launchers
         # can restart preempted runs without consuming restart budget
         self.preempted = False
-        # async checkpointing (--async_checkpoint, doc/performance.md):
-        # save() pays only the device→host snapshot; the durable-protocol
-        # write runs on a background thread. Multi-process keeps the
-        # synchronous path — the sharded save is a collective (barriers +
-        # per-host shard writes) and must run where every process
-        # participates at the same launch boundary.
+        # async checkpointing (--async_checkpoint, doc/performance.md +
+        # doc/resilience.md "Elastic sharded checkpointing"): save() pays
+        # only the device→host snapshot; the durable-protocol write runs
+        # on a background thread. Multi-process runs use the SHARDED
+        # async checkpointer: each host's writer persists only the
+        # shards it owns, and the one remaining collective is drain()'s
+        # cheap pass-end commit agreement over the distributed runtime's
+        # host KV store (no device collectives on the save path at all).
         self._async_ckpt = None
         if getattr(flags, "async_checkpoint", False) and self.save_dir:
+            inflight = int(getattr(flags, "ckpt_inflight_limit", 1) or 1)
             if self._multiproc:
-                logger.warning(
-                    "--async_checkpoint is not supported multi-process "
-                    "(the sharded save is a collective) — saving "
-                    "synchronously"
-                )
+                from paddle_tpu.utils.barrier import distributed_client
+
+                if distributed_client() is None:
+                    logger.warning(
+                        "--async_checkpoint multi-process needs the jax "
+                        "distributed runtime's KV client for the pass-end "
+                        "commit agreement — unavailable here; saving "
+                        "synchronously"
+                    )
+                else:
+                    from paddle_tpu.trainer.async_ckpt import (
+                        ShardedAsyncCheckpointer,
+                    )
+
+                    self._async_ckpt = ShardedAsyncCheckpointer(
+                        self.save_dir,
+                        inflight_limit=inflight,
+                        hangwatch=self._hangwatch,
+                        agree_timeout=float(
+                            getattr(flags, "ckpt_agree_timeout", 600.0) or 600.0
+                        ),
+                    )
             else:
                 from paddle_tpu.trainer.async_ckpt import AsyncCheckpointer
 
                 self._async_ckpt = AsyncCheckpointer(
                     self.save_dir,
-                    inflight_limit=int(
-                        getattr(flags, "ckpt_inflight_limit", 1) or 1
-                    ),
+                    inflight_limit=inflight,
                     hangwatch=self._hangwatch,
                 )
+        # restart telemetry: restore cost is captured by _maybe_restore,
+        # the `restart` record is emitted at the first completed launch
+        self._restore_s = 0.0
+        self._restart_pending = True
         self._maybe_restore()
         # StaticPruningHook init semantics: mask values once at startup
         self.params = self.updater.apply_init_hooks(self.params)
@@ -424,6 +451,7 @@ class Trainer:
             own = bool(self.save_dir) and os.path.abspath(
                 os.path.dirname(os.path.normpath(init_path))
             ) == os.path.abspath(self.save_dir)
+            t_restore = time.perf_counter()
             self.params, opt_state, meta = ckpt.load_checkpoint(
                 init_path,
                 self.opt_state,
@@ -436,6 +464,7 @@ class Trainer:
                 verify=not pre_verified,
                 fallback=pre_verified or own,
             )
+            self._restore_s = time.perf_counter() - t_restore
             if opt_state is not None:
                 self.opt_state = opt_state
             restored = self._note_restored(init_path, meta)
@@ -453,10 +482,12 @@ class Trainer:
             return
         if self.start_pass > 0:
             path = os.path.join(self.save_dir, ckpt.PASS_FMT % (self.start_pass - 1))
+            t_restore = time.perf_counter()
             self.params, opt_state, meta = ckpt.load_checkpoint(
                 path, self.opt_state, expected_params=self.params,
                 sharding_for=sharding_for,
             )
+            self._restore_s = time.perf_counter() - t_restore
             if opt_state is not None:
                 self.opt_state = opt_state
             self._note_restored(path, meta)
@@ -627,8 +658,18 @@ class Trainer:
             # fields costs nothing, where the old jax tree_flatten of
             # the device tree walked O(leaves) registered pytree nodes
             # per batch, every step, on the hot path
-            n, host, _dev = item
+            n, host, dev = item
             sig = [n]
+            if host is None:
+                # no host-side view (direct device trees — tests, future
+                # host-less providers): fall back to the device-tree
+                # signature the grouping originally used
+                leaves, treedef = jax.tree_util.tree_flatten(dev)
+                sig.append((
+                    treedef,
+                    tuple((l.shape, str(l.dtype)) for l in leaves),
+                ))
+                return tuple(sig)
             for name, arg in host.items():  # dict order is stable per provider
                 sig.append((
                     name,
@@ -1234,6 +1275,21 @@ class Trainer:
                 self._pass_train_s += time.perf_counter() - t_step
                 step_dt = time.perf_counter() - t_step
                 results = [(loss_f, outputs, n)]
+            if self._restart_pending:
+                # the run's first completed launch: restart latency is
+                # now fully paid (restore + trace + compile + step 1) —
+                # the structured number heartbeat-grace and crash-loop
+                # windows are tuned from (`paddle metrics` "restore s" /
+                # "ttfs s" columns)
+                self._restart_pending = False
+                obs.emit(
+                    "restart", pass_id=pass_id, step=batch_id,
+                    restore_s=round(self._restore_s, 6),
+                    time_to_first_step_s=round(
+                        time.perf_counter() - self._t_construct, 6
+                    ),
+                    resumed=self._restored_pass is not None,
+                )
             batch_id_start = batch_id
             for loss_f, outputs, n in results:
                 step_times.append(step_dt)

@@ -10,11 +10,66 @@ spend waiting inside collectives for stragglers.
 
 from __future__ import annotations
 
+import itertools
 from typing import List, Optional
 
 import numpy as np
 
 from paddle_tpu.utils.logging import logger
+
+# host_barrier ids must be unique per rendezvous; all processes make the
+# same sequence of host_barrier calls (they are collective by contract),
+# so a shared monotonic counter keeps ids aligned across the pod
+_BARRIER_SEQ = itertools.count()
+
+
+def distributed_client():
+    """The jax distributed-runtime KV/barrier client of this process, or
+    None (single process, or jax.distributed never initialized). The
+    client provides HOST-level coordination — key_value_set/get and
+    wait_at_barrier — that works even on backends that cannot run
+    cross-process device computations (the CPU backend in CI), which is
+    exactly why the checkpoint protocol rendezvous rides it instead of
+    a device collective."""
+    try:
+        from jax._src import distributed
+
+        return distributed.global_state.client
+    except Exception:  # private API moved / jax too old: degrade
+        return None
+
+
+def host_barrier(tag: str, timeout_s: float = 600.0) -> None:
+    """Cross-process rendezvous with NO device collective.
+
+    The sharded checkpoint protocol only needs ordering between host-side
+    filesystem effects (shards written before the merge, merge durable
+    before anyone loads); a device collective (sync_global_devices) would
+    drag the accelerator runtime into a pure host protocol — and fails
+    outright on backends without cross-process computations. Uses the
+    distributed runtime's host barrier; single-process is a no-op; falls
+    back to sync_global_devices if the client API is unavailable.
+
+    Raises RuntimeError when the rendezvous times out (a peer died
+    mid-protocol) — callers translate to their own error type."""
+    import jax
+
+    if jax.process_count() == 1:
+        return
+    client = distributed_client()
+    if client is None:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(tag)
+        return
+    barrier_id = f"{tag}#{next(_BARRIER_SEQ)}"
+    try:
+        client.wait_at_barrier(barrier_id, int(timeout_s * 1000))
+    except Exception as e:
+        raise RuntimeError(
+            f"host barrier {tag!r} failed after {timeout_s:g}s — a peer "
+            f"process likely died mid-protocol: {e}"
+        ) from e
 
 
 def step_time_skew_summary(

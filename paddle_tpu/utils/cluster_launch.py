@@ -26,6 +26,23 @@ feeder resharding deterministic for the survivors). SIGTERM to the
 launcher is forwarded to every host (pod preemption: each trainer
 checkpoints via --save_on_preempt).
 
+Elasticity is RESHARDING, not just shrinking (doc/resilience.md
+"Elastic sharded checkpointing"): every relaunch round recomputes the
+mesh from the surviving host set — the forwarded --mesh_shape's data
+axis is rescaled by mesh.rescale_mesh_spec (model/pipe axes keep their
+extents), so an N-host checkpoint restores onto the M-host mesh through
+the ordinary sharded-restore path (parallel/spmd.py sharding rules) and
+the GLOBAL batch is preserved: the config batch_size is the global
+batch, each process takes a 1/num_processes row block, so the per-host
+batch rescales automatically and sync-SGD semantics never change. A
+host dropped by --elastic_min_hosts is probed (`ssh host true`, bounded
+by --rejoin_probe_timeout) at each later relaunch round and REJOINS the
+mesh when reachable again — recovery is not permanent capacity loss.
+Before each relaunch round the heartbeat dir is swept: ranks renumber
+with the host set, and a stale host-N.json written by the previous
+mesh's rank N must not masquerade as (or spuriously condemn) the new
+rank N.
+
 Usage:
     python -m paddle_tpu.utils.cluster_launch --conf=conf.py \
         --workdir=/path/on/hosts [--max_restarts=N] \
@@ -88,10 +105,90 @@ def _exit_code(rc: int) -> int:
     return 128 - rc if rc < 0 else rc
 
 
+def _reshard_error(train_args: List[str], orig_n: int, cur_n: int) -> Optional[str]:
+    """Why the forwarded --mesh_shape cannot be rescaled from ``orig_n``
+    to ``cur_n`` hosts, or None when it can. Checked BEFORE committing to
+    a host-set change (elastic drop / rejoin): changing the host count
+    without a reshardable mesh would launch a job whose mesh no longer
+    matches its devices."""
+    from paddle_tpu.parallel.mesh import rescale_mesh_spec
+    from paddle_tpu.utils.flags import flag_value
+
+    try:
+        rescale_mesh_spec(flag_value(train_args, "mesh_shape", ""), orig_n, cur_n)
+    except ValueError as e:
+        return str(e)
+    return None
+
+
+def _rescaled_train_args(train_args: List[str], orig_n: int,
+                         cur_n: int) -> List[str]:
+    """The train args for a round on ``cur_n`` hosts: --mesh_shape's data
+    axis rescaled from the ORIGINAL launch spec (reshard-on-relaunch —
+    the N-host checkpoint restores onto the M-host mesh through the
+    normal sharded-restore path, and the global batch is preserved
+    because each process takes a 1/num_processes row block of the
+    config's batch_size). Identity when the host count is unchanged."""
+    if cur_n == orig_n:
+        return train_args
+    from paddle_tpu.parallel.mesh import rescale_mesh_spec
+    from paddle_tpu.utils.flags import flag_value, strip_flag
+
+    spec = rescale_mesh_spec(
+        flag_value(train_args, "mesh_shape", ""), orig_n, cur_n
+    )
+    if not spec:
+        # auto-sized mesh (no --mesh_shape): the trainer derives it from
+        # jax.devices(), which already follows the surviving host set
+        return train_args
+    return strip_flag(train_args, "mesh_shape") + [f"--mesh_shape={spec}"]
+
+
+def _probe_host(host: str, timeout_s: float) -> bool:
+    """Is a dropped host reachable again? One bounded `ssh host true` —
+    the same transport the launch itself uses, so "probe ok" means "the
+    next round's ssh will connect", nothing stronger."""
+    if timeout_s <= 0:
+        return False
+    try:
+        return subprocess.run(
+            ["ssh", "-o", "BatchMode=yes", host, "true"],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            timeout=timeout_s,
+        ).returncode == 0
+    except (subprocess.TimeoutExpired, OSError):
+        return False
+
+
+def _clear_heartbeats(dir_: Optional[str]) -> int:
+    """Delete every host-N.json beat before a relaunch round. Ranks are
+    positional: when the host set shrinks, grows, or renumbers, a beat
+    file written by the PREVIOUS mesh's rank N is stale evidence about
+    the NEW rank N — left in place it can trigger a spurious staleness
+    teardown (or hide a genuinely silent host behind a fresh-looking
+    file, and defeat the monitor's no-beats unshared-mount guard).
+    Returns how many files were removed; missing dir is fine."""
+    if not dir_ or not os.path.isdir(dir_):
+        return 0
+    removed = 0
+    for name in os.listdir(dir_):
+        if name.startswith("host-") and name.endswith(".json"):
+            try:
+                os.remove(os.path.join(dir_, name))
+                removed += 1
+            except OSError:
+                pass
+    return removed
+
+
 def _launch(args, hosts: List[str], train_args: List[str],
-            resume: bool) -> List[subprocess.Popen]:
+            resume: bool, orig_n: Optional[int] = None) -> List[subprocess.Popen]:
     coordinator = f"{hosts[0].split('@')[-1]}:{args.port}"
     extra = []
+    if orig_n is not None:
+        # reshard-on-relaunch: recompute the mesh for THIS round's host
+        # count (no-op while the full original set is launching)
+        train_args = _rescaled_train_args(train_args, orig_n, len(hosts))
     if resume:
         # relaunch after a failure: resume every host from the newest
         # verified checkpoint instead of its original init
@@ -337,7 +434,16 @@ def main(argv=None) -> int:
                         "this many hosts remain; 0 disables elastic "
                         "shrink. Needs --max_restarts >= "
                         f"{ELASTIC_STRIKES - 1}: the strikes before the "
-                        "drop are ordinary budgeted relaunches")
+                        "drop are ordinary budgeted relaunches. The mesh "
+                        "is resharded for the surviving host count "
+                        "(--mesh_shape data axis rescaled; global batch "
+                        "preserved)")
+    p.add_argument("--rejoin_probe_timeout", type=float, default=5.0,
+                   help="seconds allowed for the `ssh host true` "
+                        "reachability probe of each dropped host at every "
+                        "relaunch round; a host that answers rejoins the "
+                        "mesh (on probation: one more failure re-drops "
+                        "it). 0 disables rejoin — drops become permanent")
     args = p.parse_args(own)
 
     hosts = load_hosts(args.conf)
@@ -364,9 +470,66 @@ def main(argv=None) -> int:
     preempt_relaunches = 0  # budget-free rounds, bounded separately
     resumed = False       # any relaunch at all → --init_model_path=auto
     strikes = {h: 0 for h in hosts}  # per-host failure attribution
+    orig_hosts = list(hosts)  # rank order + mesh anchor: --mesh_shape
+    orig_n = len(hosts)       # describes THIS many hosts, rescale from it
+    round_no = 0
+    # (original index, host, round it was dropped in): the round number
+    # gates the rejoin probe to LATER rounds — probing in the drop round
+    # itself would immediately reinstate a crash-looping host whose sshd
+    # is healthy, turning the budget-free drop into an unbounded
+    # drop/rejoin relaunch loop. Delayed one round, every rejoin is
+    # preceded by a budget-consuming (or completing) round, so the
+    # cycle stays bounded by --max_restarts.
+    dropped: List[Tuple[int, str, int]] = []
     try:
         while True:
-            current[:] = _launch(args, hosts, train_args, resume=resumed)
+            round_no += 1
+            if resumed:
+                # new mesh epoch: sweep beats written by the previous
+                # round's (possibly renumbered) ranks, and offer every
+                # dropped host its way back in
+                if hb_conf is not None:
+                    swept = _clear_heartbeats(hb_conf[0])
+                    if swept:
+                        print(
+                            f"cluster_launch: cleared {swept} heartbeat "
+                            "file(s) from the previous round (ranks "
+                            "renumber with the host set)",
+                            file=sys.stderr,
+                        )
+                if dropped and args.rejoin_probe_timeout > 0:
+                    still_out: List[Tuple[int, str, int]] = []
+                    for oidx, host, drop_round in dropped:
+                        if (
+                            round_no > drop_round + 1
+                            and _reshard_error(train_args, orig_n, len(hosts) + 1)
+                            is None
+                            and _probe_host(host, args.rejoin_probe_timeout)
+                        ):
+                            # original relative order ⇒ deterministic
+                            # ranks: insert before every current host
+                            # that originally came after it
+                            pos = sum(
+                                1 for h in hosts
+                                if orig_hosts.index(h) < oidx
+                            )
+                            hosts.insert(pos, host)
+                            # probation: one more failure re-drops it
+                            # immediately instead of charging two fresh
+                            # strikes to a flapping host
+                            strikes[host] = ELASTIC_STRIKES - 1
+                            print(
+                                f"cluster_launch: host {host} is reachable "
+                                f"again — rejoining the mesh at rank {pos} "
+                                f"({len(hosts)} host(s); mesh reshards "
+                                "this round)",
+                                file=sys.stderr,
+                            )
+                        else:
+                            still_out.append((oidx, host, drop_round))
+                    dropped[:] = still_out
+            current[:] = _launch(args, hosts, train_args, resume=resumed,
+                                 orig_n=orig_n)
             if args.dry_run:
                 return 0
             hb = (
@@ -414,25 +577,43 @@ def main(argv=None) -> int:
                 )
             else:
                 strikes[hosts[rank]] = strikes.get(hosts[rank], 0) + 1
-                if (
+                drop_ok = (
                     args.elastic_min_hosts > 0
                     and strikes[hosts[rank]] >= ELASTIC_STRIKES
                     and len(hosts) - 1 >= args.elastic_min_hosts
-                ):
+                )
+                if drop_ok:
+                    err = _reshard_error(train_args, orig_n, len(hosts) - 1)
+                    if err is not None:
+                        # a drop the mesh cannot follow would launch a
+                        # job whose --mesh_shape no longer matches its
+                        # devices — keep the host and spend budget on an
+                        # ordinary full-set relaunch instead
+                        drop_ok = False
+                        print(
+                            f"cluster_launch: cannot drop host "
+                            f"{hosts[rank]} — the mesh does not reshard "
+                            f"to {len(hosts) - 1} host(s) ({err}); "
+                            "keeping it and relaunching on budget",
+                            file=sys.stderr,
+                        )
+                if drop_ok:
                     # dropping the offender IS the fix, not another try
                     # at the same job — this relaunch consumes no budget
                     # (otherwise the drop round could announce
                     # "continuing" and then immediately exhaust the
                     # budget it just consumed)
-                    dropped = hosts.pop(rank)
+                    bad = hosts.pop(rank)
+                    dropped.append((orig_hosts.index(bad), bad, round_no))
                     resumed = True
                     print(
-                        f"cluster_launch: dropping host {dropped} after "
+                        f"cluster_launch: dropping host {bad} after "
                         f"{ELASTIC_STRIKES} failures — relaunching with "
                         f"{len(hosts)} host(s), no restart budget "
-                        "consumed (--elastic_min_hosts allows it); "
-                        "feeder resharding stays deterministic via the "
-                        "per-pass rng fold_in",
+                        "consumed (--elastic_min_hosts allows it); the "
+                        "mesh reshards to the survivors (global batch "
+                        "preserved) and the host may rejoin when it "
+                        "answers the reachability probe",
                         file=sys.stderr,
                     )
                 elif restarts >= args.max_restarts:

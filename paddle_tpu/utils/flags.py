@@ -82,6 +82,10 @@ class _Flags:
     # depth between the packers and the step loop.
     async_checkpoint: bool = False
     ckpt_inflight_limit: int = 1
+    # multi-process async saves: how long drain()'s pass-end commit
+    # agreement (host KV rendezvous) waits for the slowest peer's
+    # background shard write before declaring the pod torn
+    ckpt_agree_timeout: float = 600.0
     data_packer_threads: int = 2
     prefetch_depth: int = 4
     # skip-and-log up to N malformed samples per provider, then fail

@@ -221,10 +221,17 @@ print("WORKER_OK", pid, flush=True)
 def test_generate_job_two_process_matches_single(tmp_path):
     """generate() in a REAL two-process run: collectives + gather + single
     writer; the result file must match a single-process run bit-for-bit
-    (params are deterministic from the seed — no training involved)."""
+    (params are deterministic from the seed — no training involved).
+    Skips (capability probe) where the backend cannot compile
+    cross-process device computations — the generation forward spans
+    both processes' devices."""
     import socket
     import subprocess
     import sys
+
+    import mp_harness
+
+    mp_harness.skip_unless_cross_process_computations()
 
     from paddle_tpu.config import parse_config
     from paddle_tpu.trainer import Trainer

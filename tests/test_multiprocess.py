@@ -96,6 +96,7 @@ def _write_config(ws):
 
 
 def test_two_process_training_matches_single(tmp_path):
+    mp_harness.skip_unless_cross_process_computations()
     ws = str(tmp_path)
     cfg_path = _write_config(ws)
     sys.path.insert(0, PROVIDERS)

@@ -59,6 +59,7 @@ print("WORKER_OK", pid, flush=True)
 
 
 def test_two_process_generation_matches_single(tmp_path):
+    mp_harness.skip_unless_cross_process_computations()
     ws = str(tmp_path)
     test_list = os.path.join(ws, "test.list")
     with open(test_list, "w") as f:

@@ -55,6 +55,7 @@ outputs(classification_cost(input=output, label=label))
 
 
 def test_two_process_recurrent_group_matches_single(tmp_path):
+    mp_harness.skip_unless_cross_process_computations()
     ws = str(tmp_path)
     train_list = os.path.join(ws, "train.list")
     with open(train_list, "w") as f:
