@@ -150,9 +150,13 @@ def _jit_train_step(tc, spl=1):
 
 def _time_steps(step, params, opt_state, batch, bs, steps, warmup, trace=False, spl=1,
                 count_fn=None):
-    """Returns (elapsed seconds, flops-per-LAUNCH or None) — a launch is
-    ``spl`` fused optimizer steps, and the elapsed time likewise covers
-    ``steps`` launches, so callers must treat both as per-launch.
+    """Returns (elapsed seconds, flops-per-LAUNCH or None, compile-info
+    dict) — a launch is ``spl`` fused optimizer steps, and the elapsed
+    time likewise covers ``steps`` launches, so callers must treat both
+    as per-launch. The compile info (``trace_s``/``compile_s``/
+    ``compile_cache_hit``) rides each leg's JSON extras into the
+    ``kind=bench`` record, so BENCH_*.json carries compile cost and the
+    persistent cache's effect is measured run over run.
 
     FLOPs are analytic MODEL matmul FLOPs from a jaxpr walk of
     ``count_fn`` (the per-step function) — NOT XLA's cost analysis, which
@@ -164,10 +168,12 @@ def _time_steps(step, params, opt_state, batch, bs, steps, warmup, trace=False, 
     import jax
 
     from benchmarks.mfu import flops_of_compiled
+    from paddle_tpu.observability.compile_log import cache_probe
     from paddle_tpu.ops.kernel_flops import capture as kernel_flops_capture
     from paddle_tpu.ops.kernel_flops import train_step_flops
 
     flops = None
+    compile_info = {}
     if count_fn is not None:
         try:
             flops = train_step_flops(count_fn, params, opt_state, batch, bs)
@@ -179,9 +185,17 @@ def _time_steps(step, params, opt_state, batch, bs, steps, warmup, trace=False, 
     # Pallas kernels traced inside the step — the cost-analysis fallback
     # cannot see into a pallas_call custom call
     try:
+        hit_probe = cache_probe()
+        t0 = time.perf_counter()
         with kernel_flops_capture() as kernel_log:
             lowered = step.lower(params, opt_state, batch, bs)
+        t1 = time.perf_counter()
         compiled = lowered.compile()
+        compile_info["trace_s"] = round(t1 - t0, 4)
+        compile_info["compile_s"] = round(time.perf_counter() - t1, 4)
+        hit = hit_probe()
+        if hit is not None:
+            compile_info["compile_cache_hit"] = hit
         if flops is None:
             flops = flops_of_compiled(compiled)
             if flops is not None and kernel_log:
@@ -213,7 +227,7 @@ def _time_steps(step, params, opt_state, batch, bs, steps, warmup, trace=False, 
             params, opt_state, loss = step(params, opt_state, batch, bs)
         float(loss)
         dt = time.perf_counter() - t0
-    return dt, flops
+    return dt, flops, compile_info
 
 
 def _mfu_of(flops, dt, steps):
@@ -406,12 +420,13 @@ def bench_resnet50(B=None, img_size=224, classes=1000, steps=20, warmup=3, trace
         spl = _leg_spl(1)  # long compute-bound steps: fusing launches is noise
         step, params, opt_state, one_step = _jit_train_step(tc, spl)
         batch = make_image_batch(b, img_size, classes)
-        dt, flops = _time_steps(
+        dt, flops, cinfo = _time_steps(
             step, params, opt_state, batch, jnp.asarray(float(b)), steps, warmup,
             trace=trace and TRACE_LEG in ("", "resnet"), spl=spl, count_fn=one_step,
         )
         m, kind = _mfu_of(flops, dt, steps)
-        extras = _leg_extras(spl=spl, device_kind=kind, dtype=tc.opt_config.dtype, batch=b)
+        extras = _leg_extras(spl=spl, device_kind=kind, dtype=tc.opt_config.dtype, batch=b,
+                             **cinfo)
         if _conv_stats_mode():
             extras["conv_stats"] = _conv_stats_mode()
         if remat == "none":
@@ -445,12 +460,13 @@ def bench_lstm_classifier(B=256, T=64, steps=20, warmup=3, dtype=None):
     spl = _leg_spl(8 if jax.default_backend() != "cpu" else 1)
     step, params, opt_state, one_step = _jit_train_step(tc, spl)
     batch = example_batch(dict_dim=10000, B=B, T=T)
-    dt, flops = _time_steps(
+    dt, flops, cinfo = _time_steps(
         step, params, opt_state, batch, jnp.asarray(float(B)), steps, warmup,
         trace=TRACE_LEG == "lstm", spl=spl, count_fn=one_step,
     )
     m, _ = _mfu_of(flops, dt, steps)
-    extras = _leg_extras(spl=spl, rnn_leg=True, mfu=m, dtype=tc.opt_config.dtype)
+    extras = _leg_extras(spl=spl, rnn_leg=True, mfu=m, dtype=tc.opt_config.dtype,
+                         **cinfo)
     return B * T * steps * spl / dt, extras
 
 
@@ -478,12 +494,13 @@ def bench_nmt(B=None, T=32, vocab=30000, dim=512, steps=10, warmup=2, dtype=None
         spl = _leg_spl(8 if jax.default_backend() != "cpu" else 1)
         step, params, opt_state, one_step = _jit_train_step(tc, spl)
         batch = nmt_batch(vocab=vocab, B=b, T=T)
-        dt, flops = _time_steps(
+        dt, flops, cinfo = _time_steps(
             step, params, opt_state, batch, jnp.asarray(float(b)), steps, warmup,
             trace=TRACE_LEG == "nmt", spl=spl, count_fn=one_step,
         )
         m, _ = _mfu_of(flops, dt, steps)
-        extras = _leg_extras(spl=spl, rnn_leg=True, mfu=m, dtype=tc.opt_config.dtype, tokens="target", batch=b)
+        extras = _leg_extras(spl=spl, rnn_leg=True, mfu=m, dtype=tc.opt_config.dtype,
+                             tokens="target", batch=b, **cinfo)
         return b * T * steps * spl / dt, extras
 
     env_b = os.environ.get("PADDLE_TPU_BENCH_NMT_B")
@@ -744,12 +761,14 @@ def main():
 
     # persistent compilation cache: repeat measurement sessions skip the
     # slow (remote-tunnel) recompiles of unchanged steps; a cold cache is
-    # merely the old speed
-    import jax
+    # merely the old speed. Shared helper also drops jax's
+    # min-compile-time gate so cache hits are measurable (and measured —
+    # _time_steps stamps trace_s/compile_s/compile_cache_hit into every
+    # leg's record)
+    from paddle_tpu.observability.compile_log import enable_compile_cache
 
-    jax.config.update(
-        "jax_compilation_cache_dir",
-        os.environ.get("JAX_COMPILATION_CACHE_DIR", "/tmp/paddle_tpu_jax_cache"),
+    enable_compile_cache(
+        os.environ.get("JAX_COMPILATION_CACHE_DIR", "/tmp/paddle_tpu_jax_cache")
     )
 
     # bf16 on XLA CPU is emulated and slow — CPU fallbacks run f32 so

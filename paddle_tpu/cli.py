@@ -1,5 +1,5 @@
 """`paddle` CLI — train / supervise / test / checkgrad / dump_config /
-merge_model / metrics / version.
+merge_model / metrics / roofline / compare / version.
 
 Role of the reference's TrainerMain + `paddle` shell dispatcher
 (/root/reference/paddle/trainer/TrainerMain.cpp:35-110,
@@ -26,7 +26,8 @@ def main(argv=None) -> int:
     if not argv or argv[0] in ("-h", "--help", "help"):
         print(__doc__)
         print("usage: paddle <train|supervise|test|gen|checkgrad|dump_config|"
-              "merge_model|check-checkpoint|metrics|faults|version> [--flags]")
+              "merge_model|check-checkpoint|metrics|roofline|compare|faults|"
+              "version> [--flags]")
         return 0
     cmd, rest = argv[0], argv[1:]
     if cmd == "version":
@@ -52,6 +53,17 @@ def main(argv=None) -> int:
         from paddle_tpu.observability.analyze import main as metrics_main
 
         return metrics_main(rest)
+    if cmd == "roofline":
+        # per-launch-group cost attribution (doc/performance.md
+        # "Roofline methodology") — jax-free like `metrics`
+        from paddle_tpu.observability.costs import main as roofline_main
+
+        return roofline_main(rest)
+    if cmd == "compare":
+        # run/bench diff with a regression verdict — jax-free
+        from paddle_tpu.observability.compare import main as compare_main
+
+        return compare_main(rest)
     if cmd == "faults":
         return _faults()
     print(f"unknown command {cmd!r}", file=sys.stderr)
@@ -85,7 +97,16 @@ def _setup(rest):
 
         faultinject.configure(FLAGS.fault_spec, FLAGS.fault_seed)
     if not FLAGS.use_tpu:
+        # before ANYTHING imports jax — jax reads JAX_PLATFORMS once at
+        # import, so the compile-cache block below must come after
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if FLAGS.compile_cache_dir:
+        # before any jax compile (Trainer re-applies the same dir, which
+        # is a no-op): warm restarts skip the XLA backend compile and
+        # the compile telemetry records the hits
+        from paddle_tpu.observability.compile_log import enable_compile_cache
+
+        enable_compile_cache(FLAGS.compile_cache_dir)
     if FLAGS.coordinator_address:
         import jax
 

@@ -8,6 +8,10 @@ pipeline, checkpoint I/O, retry layer, fault injection, barrier skew)
 emits into one per-host, schema-versioned ``metrics.jsonl`` stream, and
 ``spans.py`` upgrades ``stat_timer`` scopes into Chrome trace-event
 spans. ``paddle metrics <run_dir>`` (analyze.py) reads it all back.
+``compile_log.py`` adds per-launch-group compile telemetry and the
+persistent compilation cache; ``costs.py`` turns XLA cost analysis into
+``paddle roofline`` reports; ``compare.py`` diffs two runs with a
+regression verdict (``paddle compare``).
 
 Deliberately jax-free at import time: the supervisor and the analyzer
 must work when the accelerator runtime is exactly what keeps crashing.

@@ -11,17 +11,24 @@ off a pod.
 
 Usage::
 
-    paddle metrics <run_dir | metrics.jsonl> [--json] [--tail N]
+    paddle metrics <run_dir | metrics.jsonl> [--json] [--tail N] [--follow]
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
-from typing import Any, Dict, List, Optional
+import time
+from typing import Any, Dict, Iterator, List, Optional
 
 from paddle_tpu.observability import metrics as obs
+
+# data-wait share of pass time above which the run is data-bound — the
+# analyzer's warning AND the roofline host-bound bucket (costs.py)
+# classify against this ONE constant so the two tools cannot disagree
+DATA_BOUND_SHARE = 0.5
 
 # counters whose per-pass DELTA the table surfaces (snapshot keys from
 # MetricsRegistry — cumulative in the records, differenced here)
@@ -75,6 +82,7 @@ def analyze(streams: Dict[int, List[Dict[str, Any]]]) -> Dict[str, Any]:
     run_ended = False
     hangs: List[Dict[str, Any]] = []
     restarts: List[Dict[str, Any]] = []
+    compiles: List[Dict[str, Any]] = []
 
     for host in hosts:
         for rec in streams[host]:
@@ -92,6 +100,8 @@ def analyze(streams: Dict[int, List[Dict[str, Any]]]) -> Dict[str, Any]:
                 hangs.append(rec)
             elif kind == "restart":
                 restarts.append(rec)
+            elif kind == "compile":
+                compiles.append(rec)
             elif kind == "pass_end":
                 p = int(rec.get("pass", -1))
                 per_host_pass.setdefault(host, {})[p] = rec
@@ -211,7 +221,7 @@ def analyze(streams: Dict[int, List[Dict[str, Any]]]) -> Dict[str, Any]:
     warnings: List[str] = []
     for p in sorted(passes):
         row = passes[p]
-        if row.get("data_wait_share", 0.0) > 0.5:
+        if row.get("data_wait_share", 0.0) > DATA_BOUND_SHARE:
             warnings.append(
                 f"pass {p}: data-bound — the step loop spent "
                 f"{row['data_wait_share'] * 100:.0f}% of the pass waiting "
@@ -278,10 +288,23 @@ def analyze(streams: Dict[int, List[Dict[str, Any]]]) -> Dict[str, Any]:
             ),
         }
 
+    # compile-cost totals (doc/observability.md "Compile telemetry"):
+    # every (re)compile is a record, so the totals are exact — the
+    # numbers `paddle compare` diffs and a warm-restart claim is
+    # checked against. One aggregation, shared with `paddle roofline`
+    # (lazy import: costs imports this module inside a function too).
+    compile_totals = None
+    if compiles:
+        from paddle_tpu.observability.costs import totals_of
+
+        compile_totals = totals_of(compiles)
+
     return {
         "hosts": hosts,
         "passes": [passes[p] for p in sorted(passes)],
         "checkpoints": checkpoints,
+        "compiles": compiles,
+        "compile_totals": compile_totals,
         "restarts": restarts,
         "restart_latency": restart_latency,
         "counters": {h: per_host_prev.get(h, {}) for h in hosts},
@@ -342,6 +365,33 @@ def _fmt_table(doc: Dict[str, Any]) -> str:
                 f"{c.get('duration_s', 0.0):>8.3f} "
                 f"{c.get('bytes', 0) / 1e6:>9.2f}"
             )
+    if doc.get("compiles"):
+        # one row per launch-group (re)compile: where the trace/compile
+        # seconds went and whether the persistent cache absorbed the
+        # XLA half (`--compile_cache_dir`)
+        lines.append("")
+        lines.append(
+            f"{'compile':<12} {'sig':<10} {'pass':>5} {'trace s':>8} "
+            f"{'compile s':>9} {'cache':>6} {'GFLOP':>8}"
+        )
+        for c in doc["compiles"]:
+            hit = c.get("cache_hit")
+            flops = c.get("flops_analytic") or c.get("flops")
+            lines.append(
+                f"{c.get('group', '?'):<12} {c.get('sig', '?'):<10} "
+                f"{c.get('pass', -1):>5} {c.get('trace_s', 0.0):>8.3f} "
+                f"{c.get('compile_s', 0.0):>9.3f} "
+                f"{'hit' if hit is True else 'miss' if hit is False else '-':>6} "
+                f"{flops / 1e9 if flops else 0.0:>8.3g}"
+            )
+        t = doc.get("compile_totals") or {}
+        if t:
+            lines.append(
+                f"compile totals: {t['count']} compilation(s), trace "
+                f"{t['trace_s']:.3f}s + compile {t['compile_s']:.3f}s, "
+                f"cache {t['cache_hits']} hit(s) / {t['cache_misses']} "
+                "miss(es)"
+            )
     if doc.get("restarts"):
         # one row per (re)start: restore cost vs full time-to-first-step
         # (restore + trace + compile + step 1) — the gap between them is
@@ -378,6 +428,89 @@ def _fmt_table(doc: Dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
+def follow(run_dir: str, poll_s: float = 0.5,
+           max_polls: Optional[int] = None,
+           poll_boundaries: bool = False) -> Iterator[Optional[Dict[str, Any]]]:
+    """Live-tail every ``metrics*.jsonl`` stream of a run dir.
+
+    Yields each newly appended record in file order, re-discovering
+    per-host stream files as they appear (a late host joining mid-run).
+    Torn-tail tolerant like :func:`metrics.read_records`: only complete
+    (newline-terminated) lines are consumed — a partially flushed tail
+    stays buffered in the file until its newline lands, so a record is
+    never yielded twice or half-parsed. ``max_polls`` bounds the scan
+    loop for tests; the CLI polls until interrupted or ``run_end``.
+    ``poll_boundaries=True`` additionally yields ``None`` after each
+    full scan over every stream — the only safe point to decide "all
+    observed hosts are done" (mid-scan, later hosts' files are still
+    unread)."""
+    offsets: Dict[str, int] = {}
+    polls = 0
+    while True:
+        for path in obs.metrics_files(run_dir):
+            pos = offsets.get(path, 0)
+            try:
+                if os.path.getsize(path) < pos:
+                    # file shrank: truncated/recreated (run dir reused)
+                    # — restart this stream from the top instead of
+                    # waiting forever past its EOF
+                    pos = offsets[path] = 0
+                with open(path) as f:
+                    f.seek(pos)
+                    data = f.read()
+            except OSError:
+                continue
+            end = data.rfind("\n")
+            if end < 0:
+                continue  # nothing complete yet (or only a torn tail)
+            offsets[path] = pos + end + 1
+            # same torn-line tolerance policy as every other reader
+            yield from obs.parse_record_lines(data[:end])
+        polls += 1
+        if poll_boundaries:
+            yield None
+        if max_polls is not None and polls >= max_polls:
+            return
+        time.sleep(poll_s)
+
+
+def _follow_cli(run_dir: str) -> int:
+    """``paddle metrics --follow``: print each new record as a JSON line
+    (tail -f for the telemetry stream) until the run ends or ^C. A pod
+    run has one stream per host, each with its own ``run_end`` — the
+    tail stops only once every OBSERVED host has COMPLETED (hosts are
+    tracked from the records themselves — stream-file counts can
+    mismatch host ids when a run dir is reused across topologies): a
+    ``status="preempted"`` run_end means the supervisor is about to
+    relaunch into the same stream, and a later ``run_start`` from a
+    host un-ends it. Hosts that crash without a run_end keep the tail
+    alive (^C to stop) — silence is not completion."""
+    seen: set = set()
+    ended: set = set()
+    try:
+        for rec in follow(run_dir, poll_boundaries=True):
+            if rec is None:
+                # full scan over every stream done — the only safe
+                # point to conclude: mid-scan, later hosts' files are
+                # still unread and would look "never seen"
+                if seen and ended >= seen:
+                    print("# run_end on every observed host — complete",
+                          file=sys.stderr)
+                    return 0
+                continue
+            print(json.dumps(rec, default=str), flush=True)
+            host = rec.get("host", 0)
+            kind = rec.get("kind")
+            seen.add(host)
+            if kind == "run_end" and rec.get("status") == "completed":
+                ended.add(host)
+            elif kind == "run_start":
+                ended.discard(host)
+    except KeyboardInterrupt:
+        return 0
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="paddle metrics",
@@ -388,7 +521,19 @@ def main(argv=None) -> int:
                    help="emit the full analysis as JSON")
     p.add_argument("--tail", type=int, default=0, metavar="N",
                    help="also print the last N raw records per host")
+    p.add_argument("--follow", action="store_true",
+                   help="live-tail the stream: print each new record as "
+                        "a JSON line until run_end or ^C (long runs can "
+                        "be watched without re-parsing from zero)")
     args = p.parse_args(argv)
+
+    if args.follow:
+        # a not-yet-started run dir is fine: streams are discovered as
+        # they appear
+        if not os.path.isdir(args.run_dir) and not os.path.isfile(args.run_dir):
+            print(f"{args.run_dir!r} does not exist (yet?) — waiting for "
+                  "streams to appear", file=sys.stderr)
+        return _follow_cli(args.run_dir)
 
     files = obs.metrics_files(args.run_dir)
     if not files:

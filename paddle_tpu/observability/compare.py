@@ -1,0 +1,292 @@
+"""``paddle compare <run_a> <run_b>`` — diff two runs, with a verdict.
+
+Bench trajectory has been eyeballed across ``BENCH_*.json`` files and
+run dirs since round 1; this makes the comparison mechanical. Each side
+may be:
+
+- a **run dir** (or one ``metrics*.jsonl``): compared on the analyzer's
+  steady-state numbers — last-pass step p50/p99, samples/s, MFU,
+  data-wait share, total checkpoint-blocked seconds, compile totals
+  (count / seconds / cache hits), and worst time-to-first-step;
+- a **bench artifact**: a ``BENCH_*.json`` driver record (the last
+  parseable result line inside its ``tail``), or a raw bench JSON line
+  file — compared on the headline value plus every numeric leg.
+
+Every shared metric gets a relative delta and a per-metric verdict
+against a noise threshold (``--threshold``, default 5%): metrics where
+higher is better (throughput, MFU) regress when B is lower; latency-like
+metrics (step quantiles, data-wait, compile seconds, ttfs) regress when
+B is higher. The overall verdict is REGRESSION if any metric regressed,
+IMPROVED if any improved (and none regressed), else NO CHANGE — and the
+exit code is 1 on REGRESSION so scripts can gate on it.
+
+jax-free, like the other analyzers.
+
+Usage::
+
+    paddle compare <run_a> <run_b> [--threshold 0.05] [--abs-floor 0.05]
+                   [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+from paddle_tpu.observability import metrics as obs
+
+# metric name -> True when higher is better (throughput-like); absent
+# names are matched by _higher_is_better's suffix rules
+_HIGHER_BETTER = {
+    "samples_per_sec": True,
+    "mfu": True,
+    "step_p50_ms": False,
+    "step_p99_ms": False,
+    "data_wait_share": False,
+    "ckpt_blocked_s": False,
+    "compile_count": False,
+    "compile_total_s": False,
+    "time_to_first_step_s": False,
+    "restore_s": False,
+    "cache_hits": True,
+}
+
+
+def _higher_is_better(name: str) -> bool:
+    if name in _HIGHER_BETTER:
+        return _HIGHER_BETTER[name]
+    n = name.lower()
+    if any(s in n for s in ("per_sec", "per_chip", "samples", "tokens",
+                            "imgs", "speedup", "mfu", "hits")):
+        return True
+    if any(s in n for s in ("_s", "_ms", "latency", "wait", "blocked",
+                            "compile", "p50", "p99")):
+        return False
+    return True  # bench values are throughput by convention
+
+
+# ------------------------------------------------------------- run sides
+
+
+def _run_side(path: str) -> Dict[str, float]:
+    """Comparable scalars of one run dir / metrics stream."""
+    from paddle_tpu.observability.analyze import analyze, load_run
+
+    streams = load_run(path)
+    doc = analyze(streams)
+    out: Dict[str, float] = {}
+    # steady state: the LAST pass row carries the converged step shape
+    if doc["passes"]:
+        last = doc["passes"][-1]
+        for src, dst, scale in (
+            ("samples_per_sec", "samples_per_sec", 1.0),
+            ("mfu", "mfu", 1.0),
+            ("step_time_p50_s", "step_p50_ms", 1e3),
+            ("step_time_p99_s", "step_p99_ms", 1e3),
+            ("data_wait_share", "data_wait_share", 1.0),
+        ):
+            if src in last:
+                out[dst] = float(last[src]) * scale
+        # 0.0 is a real measurement (async saves block nothing) and must
+        # stay comparable — omitting it would hide a 0 → nonzero
+        # regression from the verdict
+        out["ckpt_blocked_s"] = sum(
+            float(r.get("ckpt_blocked_s", 0.0)) for r in doc["passes"]
+        )
+    t = doc.get("compile_totals") or {}
+    if t.get("count"):
+        out["compile_count"] = float(t["count"])
+        out["compile_total_s"] = t["trace_s"] + t["compile_s"]
+        out["cache_hits"] = float(t["cache_hits"])
+    lat = doc.get("restart_latency") or {}
+    if lat:
+        out["time_to_first_step_s"] = float(lat["time_to_first_step_s_max"])
+        out["restore_s"] = float(lat["restore_s_max"])
+    return out
+
+
+# ----------------------------------------------------------- bench sides
+
+
+def _bench_lines(text: str) -> List[Dict[str, Any]]:
+    """Bench result lines: the shared tolerant JSONL policy, narrowed
+    to records carrying a ``metric`` key (driver tails mix result lines
+    with free-form log output)."""
+    return [rec for rec in obs.parse_record_lines(text) if "metric" in rec]
+
+
+def _bench_side(path: str) -> Dict[str, float]:
+    """Comparable scalars of one bench artifact: the headline value plus
+    every numeric leg/extras field (compile_s, cache-hit counts included
+    — bench records carry them since the compile-telemetry PR)."""
+    with open(path) as f:
+        raw = f.read()
+    try:
+        doc = json.loads(raw)
+    except ValueError:
+        doc = None
+    if isinstance(doc, dict) and "tail" in doc and "metric" not in doc:
+        # BENCH_*.json driver artifact: result lines live in the tail
+        lines = _bench_lines(doc["tail"])
+    elif isinstance(doc, dict) and "metric" in doc:
+        lines = [doc]
+    else:
+        lines = _bench_lines(raw)
+    good = [
+        l for l in lines
+        if l.get("metric") != "bench_failed"
+        and isinstance(l.get("value"), (int, float))
+    ]
+    if not good:
+        raise ValueError(f"no bench result line in {path!r}")
+    line = good[-1]  # cumulative re-emits: the last line is most complete
+    out: Dict[str, float] = {line["metric"]: float(line["value"])}
+    if isinstance(line.get("mfu"), (int, float)):
+        out["mfu"] = float(line["mfu"])
+    # same quantity under the same name as the run-dir side: trace +
+    # XLA compile together (a bench-vs-run comparison must not diff
+    # two different definitions of "compile_total_s")
+    if isinstance(line.get("compile_s"), (int, float)):
+        out["compile_total_s"] = float(line["compile_s"]) + float(
+            line.get("trace_s") or 0.0
+        )
+    for leg, payload in (line.get("legs") or {}).items():
+        if isinstance(payload, dict) and isinstance(
+            payload.get("value"), (int, float)
+        ):
+            out[leg] = float(payload["value"])
+            for key in ("mfu", "compile_s", "trace_s"):
+                v = payload.get(key)
+                # bool is an int subclass — exclude it explicitly
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    out[f"{leg}.{key}"] = float(v)
+            hit = payload.get("compile_cache_hit")
+            if isinstance(hit, bool):
+                out[f"{leg}.cache_hits"] = 1.0 if hit else 0.0
+    return out
+
+
+def load_side(path: str) -> Dict[str, float]:
+    if os.path.isfile(path) and not path.endswith(".jsonl"):
+        return _bench_side(path)
+    if not obs.metrics_files(path):
+        raise ValueError(
+            f"{path!r} is neither a bench artifact nor a run dir with "
+            "metrics*.jsonl"
+        )
+    return _run_side(path)
+
+
+# --------------------------------------------------------------- compare
+
+
+def compare(a: Dict[str, float], b: Dict[str, float],
+            threshold: float = 0.05,
+            abs_floor: float = 0.05) -> Dict[str, Any]:
+    rows = []
+    regressions, improvements = [], []
+    for name in sorted(set(a) & set(b)):
+        va, vb = a[name], b[name]
+        delta = (vb - va) / abs(va) if va else (0.0 if vb == va else float("inf"))
+        hb = _higher_is_better(name)
+        # a zero baseline makes every nonzero delta infinite — the
+        # relative threshold can never absorb it, so sub-`abs_floor`
+        # absolute movement (metric units) stays noise instead of an
+        # automatic verdict (0 -> 0.002 s of ckpt block is not a
+        # regression; 0 -> 4 cache hits still registers)
+        if abs(delta) <= threshold or (va == 0 and abs(vb) <= abs_floor):
+            verdict = "SAME"
+        elif (delta > 0) == hb:
+            verdict = "IMPROVED"
+            improvements.append((name, delta))
+        else:
+            verdict = "REGRESSION"
+            regressions.append((name, delta))
+        rows.append({
+            "metric": name, "a": va, "b": vb,
+            "delta": None if delta == float("inf") else round(delta, 4),
+            "higher_is_better": hb, "verdict": verdict,
+        })
+    if regressions:
+        verdict = "REGRESSION"
+    elif improvements:
+        verdict = "IMPROVED"
+    else:
+        verdict = "NO CHANGE"
+    return {
+        "threshold": threshold,
+        "metrics": rows,
+        "only_a": sorted(set(a) - set(b)),
+        "only_b": sorted(set(b) - set(a)),
+        "regressions": [n for n, _ in regressions],
+        "improvements": [n for n, _ in improvements],
+        "verdict": verdict,
+    }
+
+
+def format_comparison(doc: Dict[str, Any], label_a: str, label_b: str) -> str:
+    lines = [
+        f"# compare: A={label_a}  B={label_b}  "
+        f"(noise threshold {doc['threshold'] * 100:.1f}%)",
+        f"{'metric':<36} {'A':>12} {'B':>12} {'delta':>8} {'verdict':>11}",
+    ]
+    for row in doc["metrics"]:
+        d = row["delta"]
+        lines.append(
+            f"{row['metric']:<36} {row['a']:>12.4g} {row['b']:>12.4g} "
+            f"{'inf' if d is None else format(d * 100, '+.1f') + '%':>8} "
+            f"{row['verdict']:>11}"
+        )
+    for side, names in (("A", doc["only_a"]), ("B", doc["only_b"])):
+        if names:
+            lines.append(f"only in {side}: {', '.join(names)}")
+    detail = ""
+    if doc["regressions"]:
+        detail = f" ({', '.join(doc['regressions'])})"
+    elif doc["improvements"]:
+        detail = f" ({', '.join(doc['improvements'])})"
+    lines.append(f"verdict: {doc['verdict']}{detail}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="paddle compare",
+        description="diff two run dirs or bench artifacts with a "
+                    "noise-thresholded regression verdict",
+    )
+    p.add_argument("run_a", help="baseline: run dir, metrics*.jsonl, or "
+                                 "BENCH_*.json")
+    p.add_argument("run_b", help="candidate: same shapes as run_a")
+    p.add_argument("--threshold", type=float, default=0.05,
+                   help="relative noise threshold (default 0.05 = 5%%)")
+    p.add_argument("--abs-floor", type=float, default=0.05, dest="abs_floor",
+                   help="absolute noise floor (metric units) for "
+                        "zero-baseline metrics, where every nonzero "
+                        "delta is infinite (default 0.05)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit the comparison as JSON")
+    args = p.parse_args(argv)
+
+    try:
+        a, b = load_side(args.run_a), load_side(args.run_b)
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if not set(a) & set(b):
+        print("error: the two sides share no comparable metrics "
+              f"(A has {sorted(a)}, B has {sorted(b)})", file=sys.stderr)
+        return 2
+    doc = compare(a, b, threshold=args.threshold, abs_floor=args.abs_floor)
+    if args.as_json:
+        print(json.dumps(doc, indent=2))
+    else:
+        print(format_comparison(doc, args.run_a, args.run_b))
+    return 1 if doc["verdict"] == "REGRESSION" else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

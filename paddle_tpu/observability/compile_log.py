@@ -1,0 +1,363 @@
+"""Per-launch-group compile telemetry + the persistent compilation cache.
+
+Every distinct launch group the trainer dispatches (fused step, single
+step, test forward, generator — one per batch-shape signature) costs a
+trace + an XLA compile the first time it runs, and costs it AGAIN on
+every process restart: the elastic/preemption machinery made restarts
+frequent, which made recompilation a first-order throughput tax nobody
+could see (ROADMAP item 5). This module makes every compile a schema
+record and makes the cache persistent:
+
+- :class:`CompileRegistry` AOT-compiles each (group, signature) once
+  via ``fn.lower(...).compile()`` — timing the trace and the compile
+  separately — pulls XLA's cost analysis off the executable
+  (``observability/costs.py``), and emits a ``kind=compile`` record
+  (trace_s, compile_s, recompile count, cache hit/miss, FLOPs, bytes).
+  Callables without ``.lower`` (the mesh-sharded step closures, plain
+  python) degrade to timing the first dispatch as one combined number
+  (``mode="inline"``) — the telemetry never loses a compile, it just
+  reports it coarser.
+- :func:`enable_compile_cache` wires jax's persistent compilation cache
+  to ``--compile_cache_dir``: warm restarts skip the XLA backend
+  compile, and the compile records prove it (``cache_hit=true``, lower
+  ``time_to_first_step_s`` in the PR-6 ``restart`` record).
+- The registry also accumulates per-group execution time
+  (:meth:`CompileRegistry.note_exec`) and emits ``kind=roofline``
+  records at pass end — the raw material of ``paddle roofline``.
+
+Cache-hit detection is host-side and observational: a compile that
+consults the persistent cache writes a new ``*-cache`` entry on a miss
+and writes nothing on a hit, so counting entries around the compile
+classifies it without reaching into jax internals. Single-process
+precise; on a pod several hosts may race the same entry — the records
+stay per-host honest ("this host's compile did not add an entry").
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from paddle_tpu.observability import metrics as obs
+from paddle_tpu.utils.logging import logger
+
+# the enabled persistent-cache dir ("" = off) — module state, one per
+# process, matching jax's own process-global cache config
+_cache_dir: str = ""
+
+
+def enable_compile_cache(cache_dir: str) -> bool:
+    """Point jax's persistent compilation cache at ``cache_dir``
+    (created if missing). Also drops the min-compile-time/entry-size
+    gates so even fast CPU-backend compiles populate the cache — without
+    that, smoke-scale steps would never cache and a warm restart would
+    measure nothing. Returns True when the cache is active; never
+    raises (telemetry must not take down the run it observes)."""
+    global _cache_dir
+    if not cache_dir:
+        return False
+    try:
+        import jax
+
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        for name, val in (
+            ("jax_persistent_cache_min_compile_time_secs", 0.0),
+            ("jax_persistent_cache_min_entry_size_bytes", -1),
+        ):
+            try:
+                jax.config.update(name, val)
+            except Exception:
+                pass  # older jax: its defaults apply
+        _cache_dir = cache_dir
+        logger.info("persistent compilation cache: %s", cache_dir)
+        return True
+    except Exception as e:
+        logger.warning(
+            "persistent compilation cache unavailable (%s): %s", cache_dir, e
+        )
+        return False
+
+
+def cache_dir() -> str:
+    return _cache_dir
+
+
+def _cache_entries() -> Optional[int]:
+    """Number of compiled-executable entries in the persistent cache
+    (None = cache off/unreadable)."""
+    if not _cache_dir:
+        return None
+    try:
+        return sum(1 for f in os.listdir(_cache_dir) if f.endswith("-cache"))
+    except OSError:
+        return None
+
+
+def cache_probe() -> Callable[[], Optional[bool]]:
+    """Snapshot for hit detection: call BEFORE a compile, call the
+    returned closure after — True = hit (no new cache entry written),
+    False = miss, None = cache disabled/unreadable."""
+    before = _cache_entries()
+
+    def hit() -> Optional[bool]:
+        after = _cache_entries()
+        if before is None or after is None:
+            return None
+        return after == before
+
+    return hit
+
+
+def sig_hash(key: Any) -> str:
+    """Short stable id of a launch-group signature key for records
+    (the full key is a nested shape/dtype tuple — too long to log)."""
+    return hashlib.md5(repr(key).encode()).hexdigest()[:10]
+
+
+class _Entry:
+    __slots__ = (
+        "sig", "callable", "fallback_fn", "flops", "bytes_accessed",
+        "flops_analytic", "exec_s", "calls", "batches", "compile_s_pending",
+        "degraded",
+    )
+
+    def __init__(self, sig: str, callable_, fallback_fn):
+        self.sig = sig
+        self.callable = callable_
+        self.fallback_fn = fallback_fn
+        self.flops: Optional[float] = None
+        self.bytes_accessed: Optional[float] = None
+        self.flops_analytic: Optional[float] = None
+        self.exec_s = 0.0
+        self.calls = 0
+        self.batches = 0
+        # trace+compile seconds paid INSIDE the first timed launch —
+        # note_exec subtracts it once so roofline exec time measures
+        # execution, not compilation
+        self.compile_s_pending = 0.0
+        self.degraded = False
+
+
+class CompileRegistry:
+    """Per-trainer compile/cost bookkeeping for launch groups.
+
+    ``call(group, key, fn, *args)`` routes a launch through the cached
+    AOT executable for its (group, signature); the first call per
+    signature is the instrumented compile. ``note_exec`` accumulates the
+    caller-measured wall time (the caller's timing includes the
+    device sync the registry cannot see), and ``emit_roofline`` turns
+    the accumulated totals into ``kind=roofline`` records.
+    """
+
+    def __init__(self, device_kind: Optional[str] = None):
+        self._entries: Dict[Tuple[str, Any], _Entry] = {}
+        self._warned_flops: set = set()
+        self._warned_degraded: set = set()
+        self._device_kind = device_kind
+        # compiles per group over the registry's LIFETIME — survives
+        # invalidate(), so a rollback re-jit records recompiles>0
+        self._group_compiles: Dict[str, int] = {}
+        # exec totals of invalidated entries, re-seeded into the
+        # recompiled entry: roofline records are cumulative per
+        # (group, sig) and the analyzers keep latest-wins, so losing
+        # the pre-rollback totals would skew achieved FLOP/s upward
+        self._carryover: Dict[Tuple[str, Any], Tuple[float, int, int]] = {}
+
+    # ------------------------------------------------------------- call
+
+    def call(self, group: str, key: Any, fn, *args,
+             analytic_flops: Optional[float] = None,
+             pass_id: Optional[int] = None, step: Optional[int] = None):
+        ent = self._entries.get((group, key))
+        if ent is not None:
+            return self._run(group, ent, args)
+        return self._first_call(group, key, fn, args, analytic_flops,
+                                pass_id, step)
+
+    def _run(self, group: str, ent: _Entry, args):
+        if ent.callable is not ent.fallback_fn:
+            try:
+                return ent.callable(*args)
+            except (TypeError, ValueError) as e:
+                # an AOT executable is stricter than jit dispatch about
+                # input avals/shardings; a rejection is raised BEFORE
+                # dispatch (TypeError/ValueError), so re-running via the
+                # jit path is safe even with donated buffers. Runtime
+                # failures (OOM etc.) propagate — after dispatch the
+                # donated args are gone and a retry would only mask the
+                # real error with "Array has been deleted".
+                if group not in self._warned_degraded:
+                    self._warned_degraded.add(group)
+                    logger.warning(
+                        "AOT executable for launch group %r rejected its "
+                        "inputs (%s: %s) — falling back to jit dispatch",
+                        group, type(e).__name__, e,
+                    )
+                ent.callable = ent.fallback_fn
+                ent.degraded = True
+        return ent.fallback_fn(*args)
+
+    def _first_call(self, group, key, fn, args, analytic_flops,
+                    pass_id, step):
+        rec: Dict[str, Any] = {
+            "group": group,
+            "sig": sig_hash(key),
+            # compiles of this group BEFORE this one: >0 means the group
+            # recompiled (new batch signature / rollback invalidation —
+            # lifetime count, so invalidate() cannot reset it to 0)
+            "recompiles": self._group_compiles.get(group, 0),
+        }
+        self._group_compiles[group] = self._group_compiles.get(group, 0) + 1
+        hit_probe = cache_probe()
+        out = None
+        callable_ = fn
+        cost = None
+        lower = getattr(fn, "lower", None)
+        if lower is not None:
+            try:
+                t0 = time.perf_counter()
+                lowered = lower(*args)
+                t1 = time.perf_counter()
+                compiled = lowered.compile()
+                t2 = time.perf_counter()
+                rec["trace_s"] = round(t1 - t0, 6)
+                rec["compile_s"] = round(t2 - t1, 6)
+                from paddle_tpu.observability.costs import cost_analysis_of
+
+                cost = cost_analysis_of(compiled)
+                callable_ = compiled
+            except Exception as e:
+                logger.debug(
+                    "AOT compile of launch group %r failed (%s) — timing "
+                    "the first dispatch instead", group, e, exc_info=True,
+                )
+                lower = None
+        if lower is None:
+            # no .lower (mesh-sharded closures, plain python) or AOT
+            # refused: the first dispatch pays trace+compile together —
+            # still measured, just not separable
+            t0 = time.perf_counter()
+            out = fn(*args)
+            rec["compile_s"] = round(time.perf_counter() - t0, 6)
+            rec["mode"] = "inline"
+        hit = hit_probe()
+        if hit is not None:
+            rec["cache_hit"] = hit
+        if cost is not None:
+            rec.update(cost)  # flops / bytes_accessed, whichever exist
+        if analytic_flops:
+            rec["flops_analytic"] = float(analytic_flops)
+        self._cross_check(group, rec)
+        r = obs.registry()
+        r.counter("compile.count").inc()
+        r.counter("compile.total_s").inc(
+            rec.get("compile_s", 0.0) + rec.get("trace_s", 0.0)
+        )
+        if hit is True:
+            r.counter("compile.cache_hits").inc()
+        elif hit is False:
+            r.counter("compile.cache_misses").inc()
+        obs.emit("compile", pass_id=pass_id, step=step, **rec)
+        ent = _Entry(rec["sig"], callable_, fn)
+        ent.flops = rec.get("flops")
+        ent.bytes_accessed = rec.get("bytes_accessed")
+        ent.flops_analytic = rec.get("flops_analytic")
+        ent.compile_s_pending = rec.get("compile_s", 0.0) + rec.get("trace_s", 0.0)
+        carried = self._carryover.pop((group, key), None)
+        if carried is not None:
+            ent.exec_s, ent.calls, ent.batches = carried
+        self._entries[(group, key)] = ent
+        if out is None:
+            out = self._run(group, ent, args)
+        return out
+
+    def _cross_check(self, group: str, rec: Dict[str, Any]) -> None:
+        """Satellite: the analytic matmul count (the MFU basis) vs XLA's
+        cost analysis, once per signature — >10% disagreement becomes a
+        logged warning instead of folklore (kernel_flops.py documents
+        that XLA counts scan/while bodies once regardless of trip count,
+        so scanned models are understated there)."""
+        af, xf = rec.get("flops_analytic"), rec.get("flops")
+        if not af or not xf:
+            return
+        ratio = abs(af - xf) / max(abs(af), abs(xf))
+        rec["flops_disagreement"] = round(ratio, 4)
+        mark = (group, rec["sig"])
+        if ratio > 0.10 and mark not in self._warned_flops:
+            self._warned_flops.add(mark)
+            logger.warning(
+                "FLOPs accounting disagreement for launch group %r (sig "
+                "%s): analytic %.4g vs XLA cost analysis %.4g (%.0f%% "
+                "apart). XLA counts scan/while bodies once regardless of "
+                "trip count (ops/kernel_flops.py), so scanned models are "
+                "understated there; MFU and the roofline use the analytic "
+                "count when present.",
+                group, rec["sig"], af, xf, ratio * 100,
+            )
+
+    # ------------------------------------------------------ exec/roofline
+
+    def note_exec(self, group: str, key: Any, seconds: float,
+                  batches: int = 1) -> None:
+        """Attribute one launch's measured wall time (caller-timed, sync
+        included) to its group. The first launch's time has the compile
+        cost deducted — roofline positions measure execution."""
+        ent = self._entries.get((group, key))
+        if ent is None:
+            return
+        s = float(seconds)
+        if ent.compile_s_pending:
+            s = max(s - ent.compile_s_pending, 0.0)
+            ent.compile_s_pending = 0.0
+        ent.exec_s += s
+        ent.calls += 1
+        ent.batches += int(batches)
+
+    def drop_pending(self, group: str, key: Any) -> None:
+        """Discard the pending compile-cost deduction of a group whose
+        first launch was thrown away (non-finite skip): the launch that
+        paid the compile never reaches note_exec, and the deduction
+        must not zero a later clean launch's exec time instead."""
+        ent = self._entries.get((group, key))
+        if ent is not None:
+            ent.compile_s_pending = 0.0
+
+    def emit_roofline(self, pass_id: Optional[int] = None) -> None:
+        """One ``kind=roofline`` record per launch group with execution
+        data — cumulative totals (the analyzer keeps latest-wins per
+        (host, group, sig), so restarts/re-runs never double-count)."""
+        for (group, _key), ent in self._entries.items():
+            if not ent.calls:
+                continue
+            rec: Dict[str, Any] = {
+                "group": group,
+                "sig": ent.sig,
+                "launches": ent.calls,
+                "batches": ent.batches,
+                "exec_s": round(ent.exec_s, 6),
+            }
+            if ent.flops:
+                rec["flops_per_launch"] = ent.flops
+            if ent.flops_analytic:
+                rec["flops_analytic_per_launch"] = ent.flops_analytic
+            if ent.bytes_accessed:
+                rec["bytes_per_launch"] = ent.bytes_accessed
+            if self._device_kind:
+                rec["device_kind"] = self._device_kind
+            obs.emit("roofline", pass_id=pass_id, **rec)
+
+    def invalidate(self, *groups: str) -> None:
+        """Drop the cached executables of the named groups (rollback
+        retunes the learning rate — the baked constants are stale). The
+        groups' cumulative exec totals are carried over to the
+        recompiled entries: the roofline records share the (group, sig)
+        identity across the recompile, and the analyzers keep
+        latest-wins, so a reset here would silently shed the
+        pre-rollback execution time."""
+        for k in [k for k in self._entries if k[0] in groups]:
+            ent = self._entries.pop(k)
+            if ent.calls:
+                self._carryover[k] = (ent.exec_s, ent.calls, ent.batches)

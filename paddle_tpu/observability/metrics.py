@@ -47,7 +47,7 @@ FILE_FMT = "metrics.host%d.jsonl"
 # boundary after which losing the buffer would lose a whole window
 FLUSH_KINDS = frozenset(
     {"run_start", "run_end", "pass_end", "checkpoint", "crash",
-     "barrier_skew", "restart"}
+     "barrier_skew", "restart", "compile", "roofline"}
 )
 
 # required keys of every record; kind-specific fields ride alongside
@@ -409,9 +409,29 @@ def metrics_files(run_dir: str) -> List[str]:
     return sorted(out)
 
 
+def parse_record_lines(text: str) -> Iterator[Dict[str, Any]]:
+    """The ONE torn-line tolerance policy, shared by every reader
+    (file reader, `--follow` live tail, bench-artifact parsing): blank
+    lines and unparseable/non-dict lines are skipped — a crash can
+    truncate the final line mid-write and that must never fail the
+    stream."""
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue  # torn tail line from a crash — expected
+        if isinstance(rec, dict):
+            yield rec
+
+
 def read_records(path: str) -> Iterator[Dict[str, Any]]:
     """Tolerant record reader: skips blank and torn lines (a crash can
-    truncate the final line mid-write) instead of failing the stream."""
+    truncate the final line mid-write) instead of failing the stream.
+    Streams line-by-line — a multi-day run's metrics.jsonl is never
+    held in memory whole."""
     try:
         f = open(path)
     except OSError as e:
@@ -419,15 +439,7 @@ def read_records(path: str) -> Iterator[Dict[str, Any]]:
         return
     with f:
         for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                rec = json.loads(line)
-            except ValueError:
-                continue  # torn tail line from a crash — expected
-            if isinstance(rec, dict):
-                yield rec
+            yield from parse_record_lines(line)
 
 
 def read_tail(run_dir: str, n: int = 20) -> Dict[int, List[Dict[str, Any]]]:

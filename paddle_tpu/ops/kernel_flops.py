@@ -170,15 +170,41 @@ _PEAK_BF16_TFLOPS = [
     ("v2", 23.0),
 ]
 
+# substring (lowercased device_kind) -> peak HBM bandwidth GB/s per jax
+# device (same published specs; v3 entry is per core). The ratio
+# peak_flops/peak_bytes is the roofline ridge point the cost-attribution
+# layer classifies launch groups against (observability/costs.py).
+_PEAK_HBM_GBPS = [
+    ("v6e", 1640.0),
+    ("v6 lite", 1640.0),
+    ("v5p", 2765.0),
+    ("v5e", 819.0),
+    ("v5 lite", 819.0),
+    ("v5litepod", 819.0),
+    ("v4", 1228.0),
+    ("v3", 450.0),
+    ("v2", 350.0),
+]
+
+
+def _peak_of(table, device_kind: str):
+    dk = device_kind.lower()
+    for key, peak in table:
+        if key in dk:
+            return peak
+    return None
+
 
 def peak_tflops(device_kind: str):
     """Peak bf16 TFLOP/s for a jax device kind; None when unknown (MFU
     is omitted, never guessed)."""
-    dk = device_kind.lower()
-    for key, peak in _PEAK_BF16_TFLOPS:
-        if key in dk:
-            return peak
-    return None
+    return _peak_of(_PEAK_BF16_TFLOPS, device_kind)
+
+
+def peak_gbps(device_kind: str):
+    """Peak HBM GB/s for a jax device kind; None when unknown (roofline
+    buckets degrade to 'unknown', never guessed)."""
+    return _peak_of(_PEAK_HBM_GBPS, device_kind)
 
 
 # ------------------------------------------------------------- trace capture

@@ -63,6 +63,20 @@ PASS_FMT = "pass-%05d"
 TMP_SUFFIX = ".tmp"
 CORRUPT_SUFFIX = ".corrupt"
 
+# pass dirs COMMITTED (written, fsynced, manifested, renamed into place)
+# by THIS process. An in-run restore of one of them — the rollback path,
+# where the trainer reloads a checkpoint it saved minutes earlier — may
+# skip re-CRCing the bytes (callers opt in via ``trust_own_writes``);
+# verification cost belongs to cold restores, and a fresh process
+# starts with an empty set, so those always verify in full.
+_written_this_process: set = set()
+
+
+def written_this_process(path: str) -> bool:
+    """True when this process committed ``path`` (and it has not been
+    quarantined since)."""
+    return os.path.abspath(os.path.normpath(path)) in _written_this_process
+
 
 def _is_pass_dir_name(d: str) -> bool:
     return d.startswith("pass-") and d[5:].isdigit()
@@ -373,7 +387,9 @@ def finalize_sharded_pass(
     _durable_manifest(
         ckpt_manifest.merge_partial_manifests, tmp, label="MANIFEST.json"
     )
-    _commit(tmp, final)
+    # peers' shards arrived over the shared fs — process 0 cannot vouch
+    # for their bytes, so the merged pass never rides the verify skip
+    _commit(tmp, final, self_written=False)
     if rotate:
         _rotate(save_dir, keep, protect=protect_pass)
     return final
@@ -521,12 +537,17 @@ def save_checkpoint(
     return final
 
 
-def _commit(tmp: str, final: str) -> None:
+def _commit(tmp: str, final: str, self_written: bool = True) -> None:
     """Atomically publish a complete tmp dir as the final pass dir. A
     crash before the rename leaves the old checkpoint untouched (plus a
     stale .tmp that the next save's rotation sweeps); a crash after it
     leaves the new checkpoint complete — there is no window in which
-    neither is restorable."""
+    neither is restorable.
+
+    ``self_written=False`` (the sharded-pass merge commit): the dir
+    holds shards PEER processes wrote over the shared fs, so it must
+    not enter the trust-own-writes verify skip — this process can only
+    vouch for bytes it wrote and fsynced itself."""
     _fsync_dir(tmp)
     fault_point("checkpoint.rename", info=os.path.basename(final))
     old = None
@@ -539,6 +560,8 @@ def _commit(tmp: str, final: str) -> None:
         os.rename(final, old)
     os.rename(tmp, final)
     _fsync_dir(os.path.dirname(final) or ".")
+    if self_written:
+        _written_this_process.add(os.path.abspath(final))
     if old is not None:
         shutil.rmtree(old, ignore_errors=True)
 
@@ -727,12 +750,20 @@ def partial_pass_report(save_dir: str) -> List[Tuple[str, int]]:
     return out
 
 
-def find_restorable_checkpoint(save_dir: str) -> Optional[str]:
+def find_restorable_checkpoint(
+    save_dir: str, trust_own_writes: bool = False
+) -> Optional[str]:
     """Newest pass dir under ``save_dir`` that verifies clean, or None.
 
     Read-only (corrupt candidates are logged and skipped, never
     quarantined here — that is load_checkpoint's job); backs
-    ``--init_model_path=auto``."""
+    ``--init_model_path=auto``.
+
+    ``trust_own_writes``: skip the CRC walk for pass dirs this process
+    committed itself (the trainer's in-run rollback path — re-reading a
+    multi-GB checkpoint just to re-hash bytes this process wrote and
+    fsynced minutes earlier is restart latency for nothing). Fresh
+    processes have committed nothing, so cold restores always verify."""
     if not os.path.isdir(save_dir):
         return None
     passes = sorted(
@@ -741,6 +772,12 @@ def find_restorable_checkpoint(save_dir: str) -> Optional[str]:
     )
     for p in passes:
         path = os.path.join(save_dir, PASS_FMT % p)
+        if trust_own_writes and written_this_process(path):
+            logger.info(
+                "find_restorable_checkpoint: %s was committed by this "
+                "process — skipping re-verification", path,
+            )
+            return path
         problems = verify_checkpoint(path)
         if not problems:
             return path
@@ -788,6 +825,8 @@ def _quarantine(path: str) -> Optional[str]:
     except OSError as e:
         logger.warning("could not quarantine %s: %s", path, e)
         return None
+    # proven bad: it must never ride the trust-own-writes verify skip
+    _written_this_process.discard(os.path.abspath(os.path.normpath(path)))
     logger.warning("quarantined corrupt checkpoint %s -> %s", path, dest)
     return dest
 
@@ -914,18 +953,24 @@ def load_checkpoint(
     io_stats: Optional[Dict[str, int]] = None,
     verify: bool = True,
     fallback: bool = True,
+    trust_own_writes: bool = False,
 ) -> Tuple[Dict[str, jax.Array], Optional[UpdaterState], Dict[str, Any]]:
     """Load params (+ optimizer state rebuilt onto ``opt_template``),
     with verification and a fallback restore chain.
 
     ``verify``: check completeness + the CRC32/size manifest before
-    deserializing anything. ``fallback``: when ``path`` is a
-    ``pass-NNNNN`` dir that fails verification, quarantine it
-    (``*.corrupt``) and retry with the newest earlier pass dir in the
-    same save_dir, logging exactly what was skipped and why; raises
-    CheckpointCorruptError only when no candidate survives. A mismatched
-    model (``missing='fail'`` KeyError) is a config error, not
-    corruption — it never triggers fallback.
+    deserializing anything. ``trust_own_writes``: also skip that check
+    when ``path`` is a checkpoint THIS process committed earlier in the
+    run (rollback/in-run restart) — verification cost belongs to cold
+    restores, and a fresh process has committed nothing, so those keep
+    the full verify. Only the first candidate is ever trusted; anything
+    the fallback chain reaches is verified regardless. ``fallback``:
+    when ``path`` is a ``pass-NNNNN`` dir that fails verification,
+    quarantine it (``*.corrupt``) and retry with the newest earlier pass
+    dir in the same save_dir, logging exactly what was skipped and why;
+    raises CheckpointCorruptError only when no candidate survives. A
+    mismatched model (``missing='fail'`` KeyError) is a config error,
+    not corruption — it never triggers fallback.
 
     A path that does not exist at all is a caller error (wrong
     ``--start_pass``, a typo'd ``--init_model_path``) and raises
@@ -951,10 +996,24 @@ def load_checkpoint(
     t0 = time.perf_counter()
     first = True
     while True:
-        # verify=False covers only the FIRST candidate (the caller just
-        # CRC'd it, e.g. find_restorable_checkpoint); anything the
-        # fallback chain reaches is unvetted and must be verified here
-        problems = [] if (not verify and first) else verify_checkpoint(cur)
+        # verify=False / trust_own_writes cover only the FIRST candidate
+        # (the caller just CRC'd it, e.g. find_restorable_checkpoint, or
+        # this process wrote it); anything the fallback chain reaches is
+        # unvetted and must be verified here
+        trusted = trust_own_writes and written_this_process(cur)
+        if first and trusted and verify:
+            logger.info(
+                "load_checkpoint: %s was committed by this process — "
+                "skipping re-verification", cur,
+            )
+        skip_crc = first and (not verify or trusted)
+        problems = [] if skip_crc else verify_checkpoint(cur)
+        # the corruption-vs-config disambiguation below may assume
+        # clean bytes only when a CRC actually ran — here, or by the
+        # caller (the verify=False contract). A trusted self-written
+        # skip verified NOTHING: its deserialization failures must
+        # enter the fallback chain, not re-raise as config errors.
+        bytes_vetted = not (skip_crc and trusted)
         first = False
         if not problems:
             try:
@@ -988,8 +1047,10 @@ def load_checkpoint(
                 # good checkpoints over it would walk the whole chain into
                 # *.corrupt. Config errors propagate; only manifest-less
                 # dirs (and vanished files) enter the fallback chain here.
-                if not isinstance(e, FileNotFoundError) and (
-                    ckpt_manifest.read_manifest(cur) is not None
+                if (
+                    bytes_vetted
+                    and not isinstance(e, FileNotFoundError)
+                    and ckpt_manifest.read_manifest(cur) is not None
                 ):
                     raise
                 problems = [f"load failed: {e}"]

@@ -35,6 +35,7 @@ from paddle_tpu.optimizer import Updater
 from paddle_tpu.proto import TrainerConfig
 from paddle_tpu.trainer import checkpoint as ckpt
 from paddle_tpu.trainer.evaluators import EvaluatorChain
+from paddle_tpu.observability import compile_log
 from paddle_tpu.observability import metrics as obs
 from paddle_tpu.observability import spans as obs_spans
 from paddle_tpu.utils.flags import FLAGS
@@ -313,6 +314,17 @@ class Trainer:
         # spans (--trace_events_path). No-ops when neither is configured.
         obs.configure_from_flags(flags, host=jax.process_index())
         obs_spans.configure_from_flags(flags, host=jax.process_index())
+        # compile & cost attribution (doc/observability.md "Compile
+        # telemetry"): every launch-group compilation becomes a
+        # kind=compile record (trace/compile seconds, cache hit/miss,
+        # XLA cost analysis), and --compile_cache_dir persists compiled
+        # executables across processes so elastic relaunches stop
+        # re-paying the full trace+compile (ROADMAP item 5)
+        if getattr(flags, "compile_cache_dir", ""):
+            compile_log.enable_compile_cache(flags.compile_cache_dir)
+        self._compiles = compile_log.CompileRegistry(
+            device_kind=jax.devices()[0].device_kind
+        )
         # hang defense (doc/resilience.md "Hang detection"): the step
         # loop pings the watchdog at every launch boundary; a stall
         # beyond --step_hang_timeout dumps forensics (hang_report.json
@@ -1205,16 +1217,20 @@ class Trainer:
                 # step window (a cache-miss jaxpr trace must not inflate
                 # step timing), while host-side stacking/rng prep stays
                 # INSIDE it, preserving the window's original semantics
+                launch_key = ("fused", kf, self._shape_sig(stacked))
                 self._pass_flops += self._count_model_flops(
-                    ("fused", kf, self._shape_sig(stacked)),
+                    launch_key,
                     self.fused_step, self.params, self.opt_state, stacked,
                     rngs, ns_arr,
                 )
                 t_step = time.perf_counter() - prep_s
                 snap = self._nf_snapshot()
                 with stat_timer("train_step"):
-                    self.params, self.opt_state, losses, keeps = self.fused_step(
+                    self.params, self.opt_state, losses, keeps = self._compiles.call(
+                        "fused_step", launch_key, self.fused_step,
                         self.params, self.opt_state, stacked, rngs, ns_arr,
+                        analytic_flops=self._flops_cache.get(launch_key),
+                        pass_id=pass_id, step=batch_id,
                     )
                 # ONE device→host transfer per launch (losses + kept
                 # outputs together); numpy slicing below adds no further
@@ -1237,11 +1253,20 @@ class Trainer:
                         snap, f"(launch of {kf}) ",
                     ):
                         # poisoned launch discarded whole (skip policy):
-                        # pre-launch params/opt_state are back in place
+                        # pre-launch params/opt_state are back in place.
+                        # If this was the group's FIRST launch, nobody
+                        # consumed its compile-cost deduction — drop it,
+                        # or the next clean launch's exec time would be
+                        # zeroed by a compile it never paid
+                        self._compiles.drop_pending("fused_step", launch_key)
                         batch_id += kf
                         continue
-                self._pass_train_s += time.perf_counter() - t_step
-                step_dt = (time.perf_counter() - t_step) / kf
+                launch_s = time.perf_counter() - t_step
+                self._pass_train_s += launch_s
+                self._compiles.note_exec(
+                    "fused_step", launch_key, launch_s, batches=kf
+                )
+                step_dt = launch_s / kf
                 results = [
                     (
                         float(losses_host[i]),
@@ -1253,9 +1278,11 @@ class Trainer:
             else:
                 rng, step_rng = jax.random.split(rng)
                 n, _host_batch, batch = group
+                launch_key = None
                 if self._accum_n <= 1 and not self._async:
+                    launch_key = ("single", self._shape_sig(batch))
                     self._pass_flops += self._count_model_flops(
-                        ("single", self._shape_sig(batch)),
+                        launch_key,
                         self.train_step, self.params, self.opt_state, batch,
                         step_rng, jnp.asarray(float(n)),
                     )
@@ -1267,13 +1294,18 @@ class Trainer:
                     elif self._async:
                         loss, outputs = self._async_step(batch, step_rng, n)
                     else:
-                        self.params, self.opt_state, loss, outputs = self.train_step(
+                        self.params, self.opt_state, loss, outputs = self._compiles.call(
+                            "train_step", launch_key, self.train_step,
                             self.params, self.opt_state, batch, step_rng,
                             jnp.asarray(float(n)),
+                            analytic_flops=self._flops_cache.get(launch_key),
+                            pass_id=pass_id, step=batch_id,
                         )
                 loss_f = self._poisoned_loss(float(loss), pass_id, batch_id)
-                self._pass_train_s += time.perf_counter() - t_step
                 step_dt = time.perf_counter() - t_step
+                self._pass_train_s += step_dt
+                if launch_key is not None:
+                    self._compiles.note_exec("train_step", launch_key, step_dt)
                 results = [(loss_f, outputs, n)]
             if self._restart_pending:
                 # the run's first completed launch: restart latency is
@@ -1438,6 +1470,10 @@ class Trainer:
         if obs.enabled():
             record["counters"] = obs.registry().snapshot()
         obs.emit("pass_end", pass_id=pass_id, step=batch_id, **record)
+        # per-launch-group cost attribution (cumulative totals —
+        # `paddle roofline` keeps latest-wins per group, so re-run
+        # passes never double-count)
+        self._compiles.emit_roofline(pass_id=pass_id)
         obs_spans.record_perf(
             "trainer/pass", pass_t0, time.perf_counter() - pass_t0
         )
@@ -1529,8 +1565,12 @@ class Trainer:
                     "rollback: async checkpoint writer reported %s — "
                     "restoring from the newest durable checkpoint", e,
                 )
+        # warm-resume: a checkpoint THIS process committed earlier in
+        # the run needs no re-CRC before the rollback restore —
+        # verification cost belongs to cold restores (fresh processes
+        # have written nothing, so they still verify in full)
         path = (
-            ckpt.find_restorable_checkpoint(self.save_dir)
+            ckpt.find_restorable_checkpoint(self.save_dir, trust_own_writes=True)
             if self.save_dir else None
         )
         if path is None:
@@ -1547,12 +1587,15 @@ class Trainer:
         # per launch for the same reason)
         if self._hangwatch is not None:
             self._hangwatch.ping(rb.pass_id, rb.batch_id)
-        # find_restorable just CRC'd the candidate (verify=False mirrors
-        # the auto-restore path); fallback may still walk earlier passes
+        # find_restorable either CRC'd the candidate or trusted this
+        # process's own write — verify=False skips the redundant re-CRC
+        # in both cases, and trust_own_writes tells load_checkpoint
+        # which case it is (a corrupt TRUSTED checkpoint must fall back
+        # to an earlier pass, not re-raise as a config error)
         self.params, opt_state, meta = ckpt.load_checkpoint(
             path, self.opt_state, expected_params=self.params,
             sharding_for=self.ckpt_sharding_for(),
-            verify=False, fallback=True,
+            verify=False, fallback=True, trust_own_writes=True,
         )
         if self._hangwatch is not None:
             self._hangwatch.ping(rb.pass_id, rb.batch_id)
@@ -1565,9 +1608,12 @@ class Trainer:
         oc.learning_rate = old_lr * scale
         # the jitted steps baked the old schedule constants at trace
         # time — drop them so the tempered lr actually takes effect
+        # (including the compile registry's AOT executables; the re-jit
+        # shows up in the compile telemetry as recompiles>0)
         self._train_step_fn = None
         self._fused_step_fn = None
         self._accum_fns = None
+        self._compiles.invalidate("train_step", "fused_step")
         self._acc = None
         self._acc_batches = 0
         self._acc_samples = 0
@@ -1833,7 +1879,18 @@ class Trainer:
         evaluators = EvaluatorChain(self.config.model_config)
         evaluators.start()
         for n, _host_batch, batch in self._global_batches(provider, pad=True):
-            outputs = self.test_fwd(params, batch)
+            launch_key = ("test", self._shape_sig(batch))
+            t_launch = time.perf_counter()
+            outputs = jax.block_until_ready(self._compiles.call(
+                "test_fwd", launch_key, self.test_fwd,
+                params, batch, pass_id=pass_id,
+            ))
+            # the block makes exec_s measure execution, not dispatch —
+            # the registry's roofline contract (the train paths sync via
+            # their loss transfer instead)
+            self._compiles.note_exec(
+                "test_fwd", launch_key, time.perf_counter() - t_launch
+            )
             if self._multiproc:
                 # gather only what cost + evaluators read, then slice the
                 # padding off host-side
@@ -1852,6 +1909,10 @@ class Trainer:
         logger.info("Test (pass %d): %s  %s", pass_id, stats.summary(),
                     evaluators.summary())
         obs.emit("test", pass_id=pass_id, **results)
+        # standalone `paddle test` never reaches a train pass_end —
+        # emit the roofline totals here (cumulative + latest-wins, so
+        # the in-train duplicate emission is harmless)
+        self._compiles.emit_roofline(pass_id=pass_id)
         return results
 
     def predict(self, provider: DataProvider, params=None) -> Dict[str, float]:
@@ -1873,7 +1934,14 @@ class Trainer:
         n_total = 0
         try:
             for n, _host_batch, batch in self._global_batches(provider, pad=True):
-                outputs = self.test_fwd(params, batch)
+                launch_key = ("test", self._shape_sig(batch))
+                t_launch = time.perf_counter()
+                outputs = jax.block_until_ready(self._compiles.call(
+                    "test_fwd", launch_key, self.test_fwd, params, batch,
+                ))
+                self._compiles.note_exec(
+                    "test_fwd", launch_key, time.perf_counter() - t_launch
+                )
                 if self._multiproc:
                     # collective: every host gathers, only process 0 writes
                     outputs = self._gather_host(
@@ -1921,6 +1989,8 @@ class Trainer:
             n_total,
             f" → {out_dir}" if out_dir else "",
         )
+        # predict jobs have no pass_end either — flush roofline totals
+        self._compiles.emit_roofline()
         return {"samples": float(n_total)}
 
     # --------------------------------------------------------------- gen
@@ -1981,7 +2051,14 @@ class Trainer:
                 sample_ids = (
                     np.asarray(id_arg.ids).reshape(-1) if id_arg is not None else None
                 )
-                outputs = gen_fwd(params, batch)
+                launch_key = ("gen", self._shape_sig(batch))
+                t_launch = time.perf_counter()
+                outputs = jax.block_until_ready(self._compiles.call(
+                    "generator", launch_key, gen_fwd, params, batch,
+                ))
+                self._compiles.note_exec(
+                    "generator", launch_key, time.perf_counter() - t_launch
+                )
                 if self._multiproc:
                     outputs = self._gather_host(outputs, [group, f"{group}@beams"])
                 outputs = self._trim_outputs(outputs, n)
@@ -2016,6 +2093,10 @@ class Trainer:
             if out_f is not None:
                 out_f.close()
                 logger.info("generation results written to %s", result_file)
+        # `paddle gen` has no pass_end — the ROADMAP-2 ask ("give
+        # generation the same roofline discipline training got") needs
+        # the totals flushed here
+        self._compiles.emit_roofline()
         return results
 
     # -------------------------------------------------------------- save

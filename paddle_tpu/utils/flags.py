@@ -62,6 +62,14 @@ class _Flags:
     # xplanes via the shared scope names)
     metrics_path: str = ""
     trace_events_path: str = ""
+    # persistent XLA compilation cache (doc/observability.md "Compile
+    # telemetry"): compiled launch groups are cached here across
+    # processes, so elastic relaunches and repeat runs skip the XLA
+    # backend compile of unchanged steps — compile records then show
+    # cache_hit=true and the restart record a lower
+    # time_to_first_step_s ("" disables; point every host of a pod at a
+    # shared dir)
+    compile_cache_dir: str = ""
     # resilience (doc/resilience.md)
     # fault injection: site=action[:arg][@trigger];... (see
     # paddle_tpu/resilience/faultinject.py; PADDLE_TPU_FAULTS env also works)
