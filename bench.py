@@ -554,17 +554,23 @@ def bench_nmt_gen(B=None, T=32, vocab=30000, dim=512, beam_size=3,
         tracing = TRACE_DIR and TRACE_LEG == "gen"
         if tracing:
             jax.profiler.start_trace(TRACE_DIR)
+        # count generated tokens EVERY timed step (the lens readback is
+        # also the per-step device sync): the old once-after-loop
+        # `tokens * steps / dt` assumed every step produced identical
+        # trip counts — data-dependent decode lengths (early-EOS beams)
+        # would silently skew the headline
         t0 = time.perf_counter()
+        tokens = 0.0
         for _ in range(steps):
             ids, lens = fwd(params, batch)
-        tokens = float(np.asarray(lens).sum())  # device sync via readback
+            tokens += float(np.asarray(lens).sum())  # sync via readback
         dt = time.perf_counter() - t0
         if tracing:
             jax.profiler.stop_trace()
         extras = _leg_extras(beam_size=beam_size, max_length=max_length,
                              dtype=tc.opt_config.dtype, batch=b,
                              tokens="best-beam generated")
-        return tokens * steps / dt, extras
+        return tokens / dt, extras
 
     env_b = os.environ.get("PADDLE_TPU_BENCH_GEN_B")
     if env_b:
@@ -576,6 +582,170 @@ def bench_nmt_gen(B=None, T=32, vocab=30000, dim=512, beam_size=3,
         # 3114.4 (512) tok/s at beam=3
         ladder = [(B,)] if B else [(512,), (256,), (128,), (64,)]
     return _try_ladder(ladder, run_one)
+
+
+def bench_serve(B=None, T=None, vocab=None, dim=None, beam_size=None,
+                max_length=None, n_requests=None, rates=None, seed=None,
+                run_dir=None, timeout_s=None, queue_cap=None, dtype=None):
+    """Offered-load serving leg (doc/observability.md "Serving
+    telemetry"): a deterministic seeded open-loop arrival process at a
+    sweep of offered loads drives a dynamic micro-batch aggregator over
+    the jitted seqToseq beam-search generator — admit up to B queued
+    requests per launch, pad to ONE batch signature so the serve launch
+    group never recompiles after warmup. Emits per-request
+    ``kind=request`` records and per-rung ``kind=serve_window`` rollups
+    into ``run_dir`` (PADDLE_TPU_BENCH_SERVE_DIR), the run dir `paddle
+    serve-report` renders. Headline: best goodput (generated tok/s)
+    across rungs; extras carry per-rung p50/p99 latency and TTFT vs
+    offered load plus the saturation knee.
+
+    Without PADDLE_TPU_BENCH_SERVE_RATES (comma-separated req/s), the
+    rungs are calibrated from a measured full-batch launch: 0.25x /
+    0.5x / 1x / 2x the back-to-back capacity, so the sweep brackets the
+    knee on any backend."""
+    import jax
+    import numpy as np
+
+    from paddle_tpu.flagship import nmt_gen_config
+    from paddle_tpu.graph import GradientMachine, make_seq
+    from paddle_tpu.graph.machine import compute_dtype_of
+    from paddle_tpu.observability import metrics as obsm
+    from paddle_tpu.observability import serving
+    from paddle_tpu.observability.compile_log import CompileRegistry
+
+    on_cpu = jax.default_backend() == "cpu"
+    env = os.environ.get
+    B = int(env("PADDLE_TPU_BENCH_SERVE_B", 0)) or B or (4 if on_cpu else 64)
+    T = T or (8 if on_cpu else 32)
+    vocab = vocab or (200 if on_cpu else 30000)
+    dim = dim or (32 if on_cpu else 512)
+    beam_size = beam_size or (2 if on_cpu else 3)
+    max_length = max_length or (8 if on_cpu else 32)
+    n_requests = (int(env("PADDLE_TPU_BENCH_SERVE_REQUESTS", 0))
+                  or n_requests or (32 if on_cpu else 256))
+    seed = int(env("PADDLE_TPU_BENCH_SERVE_SEED", "0")) if seed is None else seed
+    # 0 is a LEGAL deadline (drop everything not admitted immediately)
+    # — None, not falsiness, is the unset sentinel
+    if timeout_s is None:
+        t_env = env("PADDLE_TPU_BENCH_SERVE_TIMEOUT")
+        timeout_s = float(t_env) if t_env is not None else 60.0
+    queue_cap = (int(env("PADDLE_TPU_BENCH_SERVE_QUEUE_CAP", 0))
+                 if queue_cap is None else queue_cap)
+    run_dir = run_dir or env("PADDLE_TPU_BENCH_SERVE_DIR",
+                             os.path.join(REPO, "output", "bench_serve"))
+    obsm.configure(run_dir)
+
+    tc = nmt_gen_config(vocab=vocab, dim=dim, beam_size=beam_size,
+                        max_length=max_length, dtype=dtype or BENCH_DTYPE,
+                        batch_size=B)
+    gm = GradientMachine(tc.model_config,
+                         compute_dtype=compute_dtype_of(tc.opt_config))
+    params = gm.init_params(seed=1)
+    group = next(s.name for s in tc.model_config.sub_models
+                 if s.generator is not None)
+
+    def fwd(params, batch):
+        outputs, _ = gm.forward(params, batch, pass_type="gen", rng=None)
+        best = outputs[group]
+        return best.ids, best.seq_lengths
+
+    fwd = jax.jit(fwd)
+    registry = CompileRegistry(device_kind=jax.devices()[0].device_kind)
+    sig_key = (B, T)  # ONE signature: every cohort pads to it
+
+    serving_now = [False]  # warmup/calibration launches stay out of the
+    # roofline totals: they serve no requests, and the rung windows'
+    # launches/exec_s must reconcile with the serve_gen roofline row
+
+    def launch_fn(requests):
+        # pad-to-signature: a fixed [B, T] int32 batch regardless of
+        # cohort size or prompt lengths — empty slots replay a 1-token
+        # dummy prompt whose output is discarded. The signature never
+        # changes, so CompileRegistry reuse keeps recompiles at 0.
+        ids = np.full((B, T), 2, dtype=np.int32)
+        lengths = np.ones((B,), dtype=np.int32)
+        for i, r in enumerate(requests):
+            p = np.asarray(r.prompt, dtype=np.int32)[:T]
+            ids[i, : len(p)] = p
+            lengths[i] = max(len(p), 1)
+        batch = {"source_language_word": make_seq(None, lengths, ids=ids)}
+        t0 = time.perf_counter()
+        _out_ids, out_lens = registry.call(
+            serving.SERVE_GROUP, sig_key, fwd, params, batch
+        )
+        lens_np = np.asarray(out_lens)  # device sync via readback
+        dt = time.perf_counter() - t0
+        if serving_now[0]:
+            registry.note_exec(serving.SERVE_GROUP, sig_key, dt)
+        return [int(lens_np[i]) for i in range(len(requests))], dt
+
+    def prompt_fn(rng, i):
+        return rng.randint(2, vocab, size=int(rng.randint(1, T + 1))).tolist()
+
+    # warmup: the ONE compile (kind=compile record, recompiles=0), then
+    # a clean measured launch to calibrate capacity for the rate ladder
+    prng = np.random.RandomState(seed)
+    warm = [serving.Request(rid=f"warm-{i}", t_enqueue=0.0,
+                            prompt=prompt_fn(prng, i))
+            for i in range(B)]
+    launch_fn(warm)
+    # the warmup launch paid the compile but isn't roofline-counted:
+    # discard the pending compile-cost deduction so it can't zero the
+    # first RUNG launch's exec time instead
+    registry.drop_pending(serving.SERVE_GROUP, sig_key)
+    _, service_s = launch_fn(warm)
+    capacity_rps = B / max(service_s, 1e-6)
+    serving_now[0] = True
+    rates_env = env("PADDLE_TPU_BENCH_SERVE_RATES", "")
+    if rates_env:
+        rates = [float(r) for r in rates_env.split(",") if r.strip()]
+    elif not rates:
+        rates = [round(f * capacity_rps, 4) for f in (0.25, 0.5, 1.0, 2.0)]
+
+    doc = serving.run_sweep(
+        launch_fn, rates, n_requests=n_requests, seed=seed, max_batch=B,
+        timeout_s=timeout_s, queue_cap=queue_cap, beam_size=beam_size,
+        prompt_fn=prompt_fn,
+    )
+    registry.emit_roofline()
+    # run_end must be the serve stream's LAST record (after the
+    # kind=bench headline — doc/observability.md). When the bench-record
+    # mirror will land in THIS stream (PADDLE_TPU_BENCH_METRICS_DIR
+    # unset → main() defaults it to run_dir, or explicitly equal), the
+    # caller emits run_end after the mirror through the same reused
+    # writer; when the mirror goes elsewhere, close the stream here
+    # while this leg's writer is still installed (re-opening later
+    # would append a second run_start with a re-anchored `t`)
+    mdir = env("PADDLE_TPU_BENCH_METRICS_DIR", "")
+    if mdir and os.path.abspath(mdir) != os.path.abspath(run_dir):
+        obsm.emit("run_end", status="completed")
+    obsm.flush()
+
+    rungs = [
+        {
+            "offered_rps": w.get("offered_rps"),
+            "completed": w.get("completed"),
+            "rejected": w.get("rejected"),
+            "timeouts": w.get("timeouts"),
+            "p50_ms": round((w.get("latency") or {}).get("p50", 0.0) * 1e3, 3),
+            "p99_ms": round((w.get("latency") or {}).get("p99", 0.0) * 1e3, 3),
+            "ttft_p50_ms": round((w.get("ttft") or {}).get("p50", 0.0) * 1e3, 3),
+            "ttft_p99_ms": round((w.get("ttft") or {}).get("p99", 0.0) * 1e3, 3),
+            "queue_wait_share": w.get("queue_wait_share"),
+            "occupancy_mean": round((w.get("occupancy") or {}).get("mean", 0.0), 3),
+            "goodput_tok_s": w.get("goodput_tok_s"),
+        }
+        for w in doc["rungs"]
+    ]
+    best = max((w.get("goodput_tok_s", 0.0) for w in doc["rungs"]), default=0.0)
+    extras = _leg_extras(
+        batch=B, beam_size=beam_size, max_length=max_length,
+        dtype=tc.opt_config.dtype, n_requests=n_requests,
+        capacity_rps=round(capacity_rps, 3),
+        knee_rps=doc.get("knee_rps"), rungs=rungs, run_dir=run_dir,
+        tokens="best-beam generated",
+    )
+    return best, extras
 
 
 def bench_feeder(B=128, dim=512, n_batches=40, max_threads=None,
@@ -719,10 +889,10 @@ def main():
             f"got {_SPL_RAW!r}"
         )
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
-    if which not in ("all", "resnet", "lstm", "nmt", "gen", "feeder"):
+    if which not in ("all", "resnet", "lstm", "nmt", "gen", "serve", "feeder"):
         print(
             f"unknown benchmark {which!r}: expected 'all', 'resnet', 'lstm', "
-            "'nmt', 'gen' or 'feeder'",
+            "'nmt', 'gen', 'serve' or 'feeder'",
             file=sys.stderr,
         )
         return 2
@@ -796,6 +966,19 @@ def main():
                 dtype="float32")
             metric = "nmt_gen_cpu_smoke_tokens_per_sec"
         unit, tkey = "tokens/s", None
+    elif which == "serve":
+        # offered-load serving leg: CPU smoke shapes are bench_serve's
+        # backend-aware defaults (tiny model, named so a toy run never
+        # masquerades as the flagship serving number)
+        value, extras = bench_serve(dtype=None if on_tpu else "float32")
+        metric = ("serve_goodput_tokens_per_sec" if on_tpu
+                  else "serve_cpu_smoke_goodput_tokens_per_sec")
+        unit, tkey = "tokens/s", None
+        # one schema, one stream: unless the driver already points the
+        # bench-record mirror somewhere, land the kind=bench headline in
+        # the serve run dir next to its request/serve_window records
+        os.environ.setdefault("PADDLE_TPU_BENCH_METRICS_DIR",
+                              extras["run_dir"])
     elif on_tpu:
         # headline: bf16 ResNet-50; "all" additionally runs the two
         # sequence flagships (emitted incrementally below)
@@ -825,6 +1008,18 @@ def main():
     # child output)
     _emit(metric, value, unit, vs_baseline, **common, **extras)
     sys.stdout.flush()
+    if which == "serve":
+        # the mirror above landed in the serve stream (same resolved
+        # writer — no reconfigure, no second run_start): NOW close it,
+        # run_end last, so `paddle metrics --follow` shows the headline
+        # before it stops. The other-dir case already closed in
+        # bench_serve.
+        mdir = os.environ.get("PADDLE_TPU_BENCH_METRICS_DIR", "")
+        if mdir and os.path.abspath(mdir) == os.path.abspath(extras["run_dir"]):
+            from paddle_tpu.observability import metrics as obsm
+
+            obsm.emit("run_end", status="completed")
+            obsm.flush()
     if which == "all":
         if on_tpu:
             leg_specs = [

@@ -220,6 +220,52 @@ class GradientMachine:
         )
 
 
+def _feed_signature(in_args):
+    """Best-effort batch-shape signature of a feed — what jit retraces
+    on. Unhashable/unreadable feeds collapse to one bucket (only the
+    first call is then flagged cold_start, the pre-signature behavior)."""
+    try:
+        parts = []
+        items = (sorted(in_args.items()) if isinstance(in_args, dict)
+                 else enumerate(in_args))
+        for name, arg in items:
+            for field in ("ids", "value", "seq_lengths"):
+                v = getattr(arg, field, None)
+                if v is not None:
+                    parts.append((str(name), field, tuple(np.asarray(v).shape)))
+        return tuple(parts)
+    except Exception:
+        return None
+
+
+def _feed_batch_size(in_args) -> int:
+    """Sample count of a feed, from any input's leading dimension —
+    best-effort, at least 1 (one error record beats none)."""
+    try:
+        for arg in (in_args.values() if isinstance(in_args, dict) else in_args):
+            for field in ("seq_lengths", "ids", "value"):
+                v = getattr(arg, field, None)
+                if v is not None:
+                    return max(int(np.asarray(v).shape[0]), 1)
+    except Exception:
+        pass
+    return 1
+
+
+def _prompt_token_counts(in_args) -> List[int]:
+    """Per-sample prompt token counts from a feed's first sequence input
+    (its seq_lengths column); best-effort — a dense-only feed yields
+    an empty list and the request records fall back to 0."""
+    try:
+        for arg in (in_args.values() if isinstance(in_args, dict) else in_args):
+            sl = getattr(arg, "seq_lengths", None)
+            if sl is not None:
+                return [int(x) for x in np.asarray(sl).reshape(-1)]
+    except Exception:
+        pass
+    return []
+
+
 class SequenceGenerator:
     """Beam-search generation façade (ref: PaddleAPI.h:775 and
     ISequenceResults). Works on configs whose sub-model declares a
@@ -266,10 +312,34 @@ class SequenceGenerator:
             machine._core if model_cfg is machine.model_config else _CoreMachine(model_cfg)
         )
         self._fwd = None
+        self._seen_sigs: set = set()
 
     def generate(self, in_args: Dict[str, Argument]) -> List[List[Dict[str, Any]]]:
         """Returns, per input sample, a list of beams:
-        ``{"ids": [...], "score": float, "words": [...]}`` sorted best-first."""
+        ``{"ids": [...], "score": float, "words": [...]}`` sorted best-first.
+
+        When telemetry is configured (``observability.metrics.configure``),
+        every call emits one ``kind=request`` record per input sample —
+        the call is one batch cohort, each sample a zero-queue-wait
+        request (doc/observability.md "Serving telemetry") — so even
+        embedding-API generation carries request-level latency evidence."""
+        import time as _time
+
+        from paddle_tpu.observability import metrics as _metrics
+        from paddle_tpu.observability import serving as _serving
+
+        # all instrumentation bookkeeping (feed signature, prompt lens)
+        # is gated like log_oneshot itself: the telemetry-off hot path
+        # pays nothing
+        telemetry = _metrics.enabled()
+        # cold_start marks any call that pays a jit trace+compile: the
+        # first one, AND any new batch-shape signature (jit retraces per
+        # shape) — steady-state latency aggregations must be able to
+        # split both out
+        sig = _feed_signature(in_args) if telemetry else None
+        cold_start = telemetry and (
+            self._fwd is None or sig not in self._seen_sigs
+        )
         if self._fwd is None:
             core = self._core
 
@@ -278,7 +348,31 @@ class SequenceGenerator:
                 return outputs
 
             self._fwd = jax.jit(fwd)
-        outputs = self._fwd(self.machine.params, in_args)
+        prompt_lens = _prompt_token_counts(in_args) if telemetry else []
+        t0 = _time.perf_counter()
+        try:
+            outputs = jax.block_until_ready(
+                self._fwd(self.machine.params, in_args)
+            )
+        except Exception:
+            if telemetry:
+                # even a dense-only feed (no seq_lengths → empty
+                # prompt_lens) must leave error evidence: size the
+                # cohort from the feed
+                _serving.log_oneshot(
+                    prompt_lens, [], _time.perf_counter() - t0,
+                    beam_size=self.sub.generator.beam_size,
+                    outcome="error",
+                    n=len(prompt_lens) or _feed_batch_size(in_args),
+                    cold_start=cold_start,
+                )
+            raise
+        service_s = _time.perf_counter() - t0
+        if telemetry:
+            # only a SUCCESSFUL forward warms the signature: a failed
+            # trace/compile isn't cached by jit, so the retry pays the
+            # compile again and must be flagged cold_start again
+            self._seen_sigs.add(sig)
         group = self.sub.name
         best = outputs[group]
         beams = outputs.get(f"{group}@beams")
@@ -304,4 +398,14 @@ class SequenceGenerator:
                 sample.append(entry)
             sample.sort(key=lambda e: -e["score"])
             results.append(sample)
+        # gen_tokens counts the BEST beam's tokens — taken from the
+        # sorted results the caller receives, not raw beam slot 0 (the
+        # forward may return beams in non-score order)
+        _serving.log_oneshot(
+            prompt_lens if len(prompt_lens) == len(results)
+            else [0] * len(results),
+            [len(sample[0]["ids"]) if sample else 0 for sample in results],
+            service_s, beam_size=self.sub.generator.beam_size,
+            cold_start=cold_start,
+        )
         return results

@@ -1,5 +1,5 @@
 """`paddle` CLI — train / supervise / test / checkgrad / dump_config /
-merge_model / metrics / roofline / compare / version.
+merge_model / metrics / roofline / compare / serve-report / version.
 
 Role of the reference's TrainerMain + `paddle` shell dispatcher
 (/root/reference/paddle/trainer/TrainerMain.cpp:35-110,
@@ -26,8 +26,8 @@ def main(argv=None) -> int:
     if not argv or argv[0] in ("-h", "--help", "help"):
         print(__doc__)
         print("usage: paddle <train|supervise|test|gen|checkgrad|dump_config|"
-              "merge_model|check-checkpoint|metrics|roofline|compare|faults|"
-              "version> [--flags]")
+              "merge_model|check-checkpoint|metrics|roofline|compare|"
+              "serve-report|faults|version> [--flags]")
         return 0
     cmd, rest = argv[0], argv[1:]
     if cmd == "version":
@@ -64,6 +64,12 @@ def main(argv=None) -> int:
         from paddle_tpu.observability.compare import main as compare_main
 
         return compare_main(rest)
+    if cmd in ("serve-report", "serve_report"):
+        # per-offered-load serving report (request/serve_window records
+        # from `bench.py serve`, doc/observability.md) — jax-free
+        from paddle_tpu.observability.serving import main as serve_report_main
+
+        return serve_report_main(rest)
     if cmd == "faults":
         return _faults()
     print(f"unknown command {cmd!r}", file=sys.stderr)

@@ -11,7 +11,10 @@ spans. ``paddle metrics <run_dir>`` (analyze.py) reads it all back.
 ``compile_log.py`` adds per-launch-group compile telemetry and the
 persistent compilation cache; ``costs.py`` turns XLA cost analysis into
 ``paddle roofline`` reports; ``compare.py`` diffs two runs with a
-regression verdict (``paddle compare``).
+regression verdict (``paddle compare``); ``serving.py`` gives
+generation the same treatment — request-lifecycle records, the
+deterministic offered-load serve driver behind ``bench.py serve``, and
+``paddle serve-report``.
 
 Deliberately jax-free at import time: the supervisor and the analyzer
 must work when the accelerator runtime is exactly what keeps crashing.

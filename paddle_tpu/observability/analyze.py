@@ -79,10 +79,27 @@ def analyze(streams: Dict[int, List[Dict[str, Any]]]) -> Dict[str, Any]:
     # {host: {pass: latest pass_end record}} — latest-wins dedupe
     per_host_pass: Dict[int, Dict[int, Dict[str, Any]]] = {}
     last_skew: Optional[Dict[str, Any]] = None
-    run_ended = False
+    # per-host, last-state: a run_start UN-ends its host (a restarted/
+    # rerun process appending to the same stream owes a fresh run_end —
+    # the same rule `--follow`'s stop condition applies), and the run
+    # counts as ended while any host's latest epoch completed
+    ended_hosts: set = set()
     hangs: List[Dict[str, Any]] = []
     restarts: List[Dict[str, Any]] = []
     compiles: List[Dict[str, Any]] = []
+    # request records dedupe by (host, id) — the SAME latest-wins
+    # discipline as the windows: a rerun appending to the default serve
+    # run dir re-emits the same request ids, and counting every record
+    # would report 2x requests next to a rung table summing to half
+    serve_request_ids: set = set()
+    # hosts whose CURRENT epoch has driver requests (rung >= 0): a serve
+    # DRIVER run owes a run_end even when it died before its first
+    # serve_window; oneshot records (rung -1, the embedding API) owe
+    # nothing, and a superseded epoch's driver doesn't haunt the next
+    serve_driver_hosts: set = set()
+    # serve_window rollups, latest-wins per (host, rung) like pass_end —
+    # a restarted serve driver re-emits its rungs into the same stream
+    serve_windows_by: Dict[tuple, Dict[str, Any]] = {}
 
     for host in hosts:
         for rec in streams[host]:
@@ -90,8 +107,20 @@ def analyze(streams: Dict[int, List[Dict[str, Any]]]) -> Dict[str, Any]:
                 invalid += 1
                 continue
             kind = rec.get("kind")
-            if kind == "run_end":
-                run_ended = True
+            if kind == "run_start":
+                # a new sweep appended to a reused serve run dir (or a
+                # relaunched driver) supersedes the host's earlier serve
+                # telemetry WHOLESALE: rung-keyed latest-wins alone would
+                # let a longer previous ladder leave ghost rungs behind
+                for k in [k for k in serve_windows_by if k[0] == host]:
+                    del serve_windows_by[k]
+                serve_request_ids = {
+                    k for k in serve_request_ids if k[0] != host
+                }
+                ended_hosts.discard(host)
+                serve_driver_hosts.discard(host)
+            elif kind == "run_end":
+                ended_hosts.add(host)
             elif kind == "checkpoint":
                 checkpoints.append(rec)
             elif kind == "barrier_skew":
@@ -102,9 +131,20 @@ def analyze(streams: Dict[int, List[Dict[str, Any]]]) -> Dict[str, Any]:
                 restarts.append(rec)
             elif kind == "compile":
                 compiles.append(rec)
+            elif kind == "request":
+                serve_request_ids.add((host, rec.get("id")))
+                if rec.get("rung", -1) >= 0:
+                    serve_driver_hosts.add(host)
+            elif kind == "serve_window":
+                serve_windows_by[(host, rec.get("rung"))] = rec
             elif kind == "pass_end":
                 p = int(rec.get("pass", -1))
                 per_host_pass.setdefault(host, {})[p] = rec
+    serve_windows = [
+        serve_windows_by[k] for k in sorted(
+            serve_windows_by, key=lambda k: (k[1] if k[1] is not None else -1, k[0])
+        )
+    ]
 
     passes: Dict[int, Dict[str, Any]] = {}
     per_host_prev: Dict[int, Dict[str, float]] = {}
@@ -265,7 +305,12 @@ def analyze(streams: Dict[int, List[Dict[str, Any]]]) -> Dict[str, Any]:
         )
     if last_skew is not None and last_skew.get("line"):
         warnings.append(f"barrier skew: {last_skew['line']}")
-    if passes and not run_ended:
+    # oneshot request records (the embedding API's SequenceGenerator —
+    # no driver, so no run_end is ever owed) must not trip the crash
+    # heuristic; driver streams (passes, serve windows, or rung>=0
+    # request records — a serve run killed before its first window) do
+    run_ended = bool(ended_hosts)
+    if (passes or serve_windows or serve_driver_hosts) and not run_ended:
         warnings.append(
             "stream ends without a run_end record — the run crashed, was "
             "killed, or is still going"
@@ -299,6 +344,17 @@ def analyze(streams: Dict[int, List[Dict[str, Any]]]) -> Dict[str, Any]:
 
         compile_totals = totals_of(compiles)
 
+    # serving telemetry (doc/observability.md "Serving telemetry"): the
+    # per-pass table has nothing to say about a serve run — point at the
+    # dedicated analyzer instead of printing an empty table silently
+    serve = None
+    if serve_request_ids or serve_windows:
+        serve = {
+            "requests": len(serve_request_ids),
+            "windows": len(serve_windows),
+            "rungs": len({w.get("rung") for w in serve_windows}),
+        }
+
     return {
         "hosts": hosts,
         "passes": [passes[p] for p in sorted(passes)],
@@ -307,6 +363,8 @@ def analyze(streams: Dict[int, List[Dict[str, Any]]]) -> Dict[str, Any]:
         "compile_totals": compile_totals,
         "restarts": restarts,
         "restart_latency": restart_latency,
+        "serve": serve,
+        "serve_windows": serve_windows,
         "counters": {h: per_host_prev.get(h, {}) for h in hosts},
         "straggler": straggler,
         "barrier_skew": last_skew,
@@ -418,6 +476,20 @@ def _fmt_table(doc: Dict[str, Any]) -> str:
                 f"{lat['rounds']} round(s) — tune --heartbeat_startup_grace "
                 "and crash-loop windows above the ttfs number"
             )
+    if doc.get("serve"):
+        s = doc["serve"]
+        lines.append("")
+        line = (
+            f"serve telemetry: {s['requests']} request record(s), "
+            f"{s['windows']} window(s) over {s['rungs']} offered-load "
+            "rung(s)"
+        )
+        if s["windows"]:
+            # serve-report needs windows — don't point at a tool that
+            # would exit 1 on an oneshot-only (embedding API) stream
+            line += (" — `paddle serve-report <run_dir>` for the "
+                     "latency/goodput table")
+        lines.append(line)
     if doc["straggler"] and doc["straggler"].get("line"):
         lines.append("")
         lines.append(doc["straggler"]["line"])

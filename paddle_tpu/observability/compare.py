@@ -55,12 +55,30 @@ _HIGHER_BETTER = {
 }
 
 
+def _serve_key(offered_rps, qualifier, seen_pre: set) -> str:
+    """The ONE serve rung key format, shared by the run-dir and bench-
+    artifact sides (a divergence would silently break their
+    comparability): 6 significant digits of offered load — a slow
+    backend's sub-1 req/s ladder must not collapse rungs into one key —
+    with later duplicates (variance-gauging repeated rates)
+    rung-qualified instead of silently overwritten."""
+    pre = f"serve.{format(float(offered_rps or 0.0), '.6g')}rps."
+    if pre in seen_pre:
+        pre = f"{pre[:-1]}.r{qualifier}."
+    seen_pre.add(pre)
+    return pre
+
+
 def _higher_is_better(name: str) -> bool:
     if name in _HIGHER_BETTER:
         return _HIGHER_BETTER[name]
     n = name.lower()
+    # serving metrics (doc/observability.md "Serving telemetry"):
+    # goodput and the saturation knee are throughput-like; latency/TTFT/
+    # queue-wait fall through to the lower-is-better suffixes below
     if any(s in n for s in ("per_sec", "per_chip", "samples", "tokens",
-                            "imgs", "speedup", "mfu", "hits")):
+                            "imgs", "speedup", "mfu", "hits", "goodput",
+                            "knee")):
         return True
     if any(s in n for s in ("_s", "_ms", "latency", "wait", "blocked",
                             "compile", "p50", "p99")):
@@ -105,6 +123,37 @@ def _run_side(path: str) -> Dict[str, float]:
     if lat:
         out["time_to_first_step_s"] = float(lat["time_to_first_step_s_max"])
         out["restore_s"] = float(lat["restore_s_max"])
+    # serve runs (doc/observability.md "Serving telemetry"): per-rung
+    # latency/TTFT (lower is better) and goodput (higher), keyed by the
+    # rung's OFFERED LOAD — not its index: two auto-calibrated sweeps
+    # can land different rate ladders, and joining rung 3 of a 20 req/s
+    # ladder against rung 3 of a 10 req/s ladder would judge a 2x-load
+    # latency gap as a perf regression. Mismatched ladders instead fall
+    # into only_a/only_b (visible, never a bogus verdict); pin
+    # PADDLE_TPU_BENCH_SERVE_RATES for A/B runs. The knee rides as one
+    # headline number either way. A run dir can carry both training and
+    # serve telemetry — the key namespaces never collide.
+    windows = doc.get("serve_windows") or []
+    seen_pre: set = set()
+    for w in windows:
+        pre = _serve_key(w.get("offered_rps"), w.get("rung", 0), seen_pre)
+        for snap_key, dst, scale in (
+            ("latency", "p50_ms", 1e3), ("latency", "p99_ms", 1e3),
+            ("ttft", "ttft_p50_ms", 1e3), ("ttft", "ttft_p99_ms", 1e3),
+        ):
+            q = "p99" if "p99" in dst else "p50"
+            v = (w.get(snap_key) or {}).get(q)
+            if isinstance(v, (int, float)):
+                out[pre + dst] = float(v) * scale
+        for src in ("goodput_tok_s", "queue_wait_share"):
+            if isinstance(w.get(src), (int, float)):
+                out[pre + src] = float(w[src])
+    if windows:
+        from paddle_tpu.observability.serving import saturation_knee
+
+        knee = saturation_knee(windows)
+        if knee is not None:
+            out["serve_knee_rps"] = float(knee)
     return out
 
 
@@ -153,6 +202,22 @@ def _bench_side(path: str) -> Dict[str, float]:
         out["compile_total_s"] = float(line["compile_s"]) + float(
             line.get("trace_s") or 0.0
         )
+    # serve-leg artifacts (doc/observability.md "Serving telemetry"):
+    # the archived BENCH_*.json carries per-rung latency/TTFT/goodput
+    # and the knee — comparable WITHOUT the telemetry run dir, under
+    # the same offered-load-keyed join as the run-dir side
+    seen_pre: set = set()
+    for i, r in enumerate(line.get("rungs") or []):
+        if not isinstance(r, dict):
+            continue
+        pre = _serve_key(r.get("offered_rps"), i, seen_pre)
+        for key in ("p50_ms", "p99_ms", "ttft_p50_ms", "ttft_p99_ms",
+                    "goodput_tok_s", "queue_wait_share"):
+            v = r.get(key)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                out[pre + key] = float(v)
+    if isinstance(line.get("knee_rps"), (int, float)):
+        out["serve_knee_rps"] = float(line["knee_rps"])
     for leg, payload in (line.get("legs") or {}).items():
         if isinstance(payload, dict) and isinstance(
             payload.get("value"), (int, float)

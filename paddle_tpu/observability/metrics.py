@@ -45,13 +45,27 @@ FILE_FMT = "metrics.host%d.jsonl"
 
 # record kinds that force a flush when emitted: each marks a window
 # boundary after which losing the buffer would lose a whole window
+# (request/serve_window: a serving run killed mid-rung must leave every
+# finished request's latency on disk — the whole point of the records.
+# The per-record append this buys costs ~tens of µs and is charged,
+# honestly, to the serve loop's host_share; telemetry-off pays nothing)
 FLUSH_KINDS = frozenset(
     {"run_start", "run_end", "pass_end", "checkpoint", "crash",
-     "barrier_skew", "restart", "compile", "roofline"}
+     "barrier_skew", "restart", "compile", "roofline",
+     "request", "serve_window"}
 )
 
 # required keys of every record; kind-specific fields ride alongside
 REQUIRED_KEYS = ("v", "kind", "host", "t")
+
+# kind-specific required fields (doc/observability.md) — the serving
+# telemetry contract the continuous-batching server must keep: a
+# request record without an id/outcome, or a window without its rung
+# and offered load, is unanalyzable
+KIND_REQUIRED = {
+    "request": ("id", "outcome"),
+    "serve_window": ("rung", "offered_rps"),
+}
 
 
 # --------------------------------------------------------------- metrics
@@ -495,7 +509,10 @@ def validate_record(rec: Dict[str, Any]) -> List[str]:
         problems.append("kind must be a string")
     if "t" in rec and not isinstance(rec["t"], (int, float)):
         problems.append("t must be a number (seconds since run_start)")
-    for k in ("pass", "step", "host"):
+    for k in ("pass", "step", "host", "rung"):
         if k in rec and not isinstance(rec[k], int):
             problems.append(f"{k} must be an integer")
+    for k in KIND_REQUIRED.get(rec.get("kind"), ()):
+        if k not in rec:
+            problems.append(f"{rec['kind']} record missing required key {k!r}")
     return problems
