@@ -1,0 +1,28 @@
+"""`paddle lint` — jax-aware static analysis for the framework's own
+invariants.
+
+Eight PRs of resilience/observability/perf work rest on invariants that
+previously lived only in commit messages: no wall-clock in hot paths,
+no host syncs inside the step loop, recompile-stable launch signatures,
+flush-before-exit for crash evidence, locked shared state on daemon
+threads, and documented record kinds / fault sites. This package turns
+each into a mechanical AST check with a stable rule ID (PTL001-PTL007,
+catalog in doc/static_analysis.md), a mandatory-reason suppression
+syntax (``# lint: disable=PTL00x -- reason``), and a checked-in JSON
+baseline so the CI gate is "zero NEW findings", not "zero findings".
+
+Everything here is stdlib-only (``ast`` + ``re`` + ``json``) and never
+imports jax — ``paddle lint`` must run on a dev laptop, in CI before
+the accelerator runtime exists, and over a tree copied off a pod.
+"""
+
+from paddle_tpu.analysis.core import (  # noqa: F401
+    ALL_RULES,
+    Finding,
+    LintResult,
+    run_lint,
+)
+from paddle_tpu.analysis.baseline import (  # noqa: F401
+    load_baseline,
+    write_baseline,
+)

@@ -1,0 +1,83 @@
+"""Checked-in baseline: grandfathered findings, so adopting a new rule
+never requires fixing (or loudly suppressing) every historical hit at
+once. The CI gate is "zero NEW findings"; the baseline is the honest,
+reviewable list of what was grandfathered and why that was acceptable.
+
+Format (``.paddle_lint_baseline.json`` at the repo root)::
+
+    {
+      "version": 1,
+      "findings": [
+        {"rule": "PTL00x", "path": "...", "fingerprint": "...",
+         "message": "..."},
+        ...
+      ]
+    }
+
+Only ``fingerprint`` is matched (rule + path + source line content —
+line-number independent, see core._fingerprint); ``rule``/``path``/
+``message`` ride along for reviewability. ``paddle lint
+--write-baseline`` regenerates the file from the current findings;
+entries that no longer match anything are reported as stale so the
+baseline shrinks monotonically instead of fossilizing.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence
+
+BASELINE_VERSION = 1
+BASELINE_NAME = ".paddle_lint_baseline.json"
+
+
+def default_baseline_path(repo_root: str) -> Optional[str]:
+    """The conventional location, when it exists."""
+    path = os.path.join(repo_root, BASELINE_NAME)
+    return path if os.path.isfile(path) else None
+
+
+def load_baseline(path: str) -> Dict[str, Any]:
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict) or doc.get("version") != BASELINE_VERSION:
+        raise ValueError(
+            f"{path}: not a v{BASELINE_VERSION} lint baseline "
+            f"(got version={doc.get('version') if isinstance(doc, dict) else None!r})"
+        )
+    if not isinstance(doc.get("findings"), list):
+        raise ValueError(f"{path}: baseline 'findings' must be a list")
+    return doc
+
+
+def write_baseline(path: str, findings: Sequence,
+                   keep_entries: Sequence[Dict[str, Any]] = ()) -> Dict[str, Any]:
+    """Serialize ``findings`` (core.Finding objects) as a baseline doc,
+    written atomically (tmp + replace) so a killed run never leaves a
+    torn baseline for the next one to trust. ``keep_entries`` are raw
+    prior-baseline entries carried over verbatim — the write path for a
+    SUBSET scan, whose non-scanned files' grandfathered entries must
+    not be dropped just because this run couldn't see them."""
+    entries = [
+        {
+            "rule": f.rule,
+            "path": f.path,
+            "fingerprint": f.fingerprint,
+            "message": f.message,
+        }
+        for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+    ]
+    entries.extend(keep_entries)
+    entries.sort(key=lambda e: (e.get("path", ""), e.get("rule", ""),
+                                e.get("fingerprint", "")))
+    doc = {
+        "version": BASELINE_VERSION,
+        "findings": entries,
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=2, sort_keys=False)
+        f.write("\n")
+    os.replace(tmp, path)
+    return doc

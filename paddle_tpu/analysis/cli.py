@@ -1,0 +1,144 @@
+"""``paddle lint [paths] [--json] [--baseline FILE]`` — the CLI.
+
+jax-free like the other analyzers: `paddle lint` is the CI gate and
+must run before the accelerator runtime exists. Exit codes: 0 = no new
+(non-baselined) findings, 1 = new findings, 2 = usage/baseline errors.
+
+``--json`` emits one schema-v1 JSONL record per finding
+(``kind=lint_finding``) plus a closing ``kind=lint_summary`` with
+per-rule counts — the artifact ``paddle compare`` diffs between two
+lint runs (doc/observability.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from paddle_tpu.analysis import baseline as bl
+from paddle_tpu.analysis.core import ALL_RULES, find_repo_root, run_lint
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="paddle lint",
+        description=(
+            "jax-aware static analysis for the framework's hot-path, "
+            "concurrency, and telemetry invariants (rule catalog: "
+            "doc/static_analysis.md)"
+        ),
+    )
+    p.add_argument("paths", nargs="*", default=None,
+                   help="files/directories to lint (default: paddle_tpu "
+                        "under the current directory, else '.')")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit JSONL lint_finding/lint_summary records "
+                        "(validate_record-compatible; feed to "
+                        "`paddle compare`)")
+    p.add_argument("--baseline", default=None, metavar="FILE",
+                   help="baseline JSON of grandfathered findings "
+                        f"(default: {bl.BASELINE_NAME} at the repo root, "
+                        "when present)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore any baseline (report every finding as new)")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="write the current findings to the baseline file "
+                        "and exit 0 (grandfathering)")
+    p.add_argument("--rules", action="store_true",
+                   help="print the rule catalog and exit")
+    args = p.parse_args(argv)
+
+    if args.rules:
+        for rid in sorted(ALL_RULES):
+            print(f"{rid}  {ALL_RULES[rid]}")
+        return 0
+
+    paths = args.paths or (
+        ["paddle_tpu"] if os.path.isdir("paddle_tpu") else ["."]
+    )
+    for path in paths:
+        if not os.path.exists(path):
+            print(f"error: {path!r} does not exist", file=sys.stderr)
+            return 2
+
+    repo_root = find_repo_root(paths)
+    baseline_path = args.baseline or bl.default_baseline_path(repo_root)
+    baseline = None
+    if baseline_path and not args.no_baseline and not args.write_baseline:
+        try:
+            baseline = bl.load_baseline(baseline_path)
+        except (OSError, ValueError) as e:
+            print(f"error: cannot load baseline: {e}", file=sys.stderr)
+            return 2
+
+    result = run_lint(paths, baseline=baseline)
+
+    if args.write_baseline:
+        path = args.baseline or os.path.join(repo_root, bl.BASELINE_NAME)
+        # a SUBSET scan regenerates only what it could see: prior
+        # entries for files outside this scan are carried over, never
+        # silently dropped (they'd resurface as "new" on the next full
+        # run and break the gate)
+        keep = []
+        if os.path.isfile(path) and not args.no_baseline:
+            try:
+                from paddle_tpu.analysis.core import root_is_marked
+
+                scanned = set(result.scanned_paths)
+                marked = root_is_marked(repo_root)
+                keep = [
+                    ent for ent in bl.load_baseline(path).get("findings", [])
+                    if ent.get("path") not in scanned
+                    # entries for deleted/renamed files are dropped, not
+                    # carried forward forever (only judged under a real
+                    # repo root, where entry paths are stable)
+                    and (not marked or os.path.exists(
+                        os.path.join(repo_root, ent.get("path", ""))
+                    ))
+                ]
+            except (OSError, ValueError) as e:
+                print(f"error: cannot merge existing baseline: {e}",
+                      file=sys.stderr)
+                return 2
+        bl.write_baseline(path, result.findings, keep_entries=keep)
+        print(
+            f"wrote {len(result.findings)} finding(s) to {path}"
+            + (f" (kept {len(keep)} out-of-scope "
+               f"entr{'y' if len(keep) == 1 else 'ies'})" if keep else ""),
+            file=sys.stderr,
+        )
+        return 0
+
+    # diagnostics go to stderr in BOTH modes: a CI gate reading --json
+    # stdout still sees shrunken coverage and staleness in its log
+    for path, why in result.skipped:
+        print(f"# skipped {path}: {why}", file=sys.stderr)
+    if result.stale_baseline:
+        print(
+            f"# {len(result.stale_baseline)} stale baseline entr"
+            f"{'y' if len(result.stale_baseline) == 1 else 'ies'} no "
+            "longer match anything — regenerate with --write-baseline: "
+            + ", ".join(result.stale_baseline),
+            file=sys.stderr,
+        )
+    if args.as_json:
+        for f in result.findings:
+            print(json.dumps(f.record()))
+        print(json.dumps(result.summary_record()))
+    else:
+        for f in result.findings:
+            print(f.render())
+        n_new = len(result.new)
+        n_base = len(result.findings) - n_new
+        print(
+            f"# {n_new} new finding(s), {n_base} baselined, "
+            f"{result.files_scanned} file(s) scanned"
+        )
+    return 1 if result.new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

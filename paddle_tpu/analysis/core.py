@@ -1,0 +1,462 @@
+"""Rule engine: source model, suppressions, fingerprints, the runner.
+
+Design constraints, in order:
+
+1. **jax-free and fast.** Pure ``ast`` over source text — linting the
+   whole package must finish in seconds so it rides early in tier-1
+   even when the CI window truncates the suite.
+2. **Stable IDs, greppable findings.** Every rule has a ``PTLnnn`` id;
+   a finding renders as ``path:line:col: PTLnnn message`` and carries a
+   line-number-independent fingerprint (rule + path + source line), so
+   the checked-in baseline survives unrelated edits above a finding.
+3. **Suppressions carry their why.** ``# lint: disable=PTL001 -- reason``
+   on the finding's line (or a comment-only line above) suppresses it;
+   a suppression WITHOUT a reason suppresses nothing and is itself a
+   finding (PTL000) — the reason is the documentation the invariant
+   would otherwise lose.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+# ---------------------------------------------------------------- model
+
+#: rule id -> one-line description. doc/static_analysis.md and the
+#: reverse-consistency test in tests/test_lint.py pin this catalog
+#: against the documentation (PTL007's discipline applied to the linter
+#: itself).
+ALL_RULES: Dict[str, str] = {
+    "PTL000": "suppression comment missing its mandatory reason",
+}
+
+FILE_RULES: List[Tuple[str, Callable]] = []
+PROJECT_RULES: List[Tuple[str, Callable]] = []
+
+
+def rule(rid: str, desc: str, *, project: bool = False):
+    """Register a rule. File rules run as ``fn(sf, ctx)`` per parsed
+    file; project rules run once as ``fn(ctx)`` (cross-file checks)."""
+
+    def deco(fn):
+        assert rid not in ALL_RULES, f"duplicate rule id {rid}"
+        ALL_RULES[rid] = desc
+        (PROJECT_RULES if project else FILE_RULES).append((rid, fn))
+        fn.rule_id = rid
+        return fn
+
+    return deco
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str  # repo-root-relative posix path
+    line: int
+    col: int
+    message: str
+    snippet: str = ""
+    fingerprint: str = ""
+    baselined: bool = False
+    # last line of the flagged node (0 = same as `line`): a suppression
+    # trailing a black-style wrapped call sits on the closing-paren
+    # line, and must still govern the finding anchored to line 1 of it
+    end_line: int = 0
+
+    def render(self) -> str:
+        base = f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+        return base + ("  [baselined]" if self.baselined else "")
+
+    def record(self) -> Dict[str, Any]:
+        """The ``--json`` shape: a schema-v1 record ``validate_record``
+        accepts (kind=lint_finding, doc/observability.md), so lint
+        output flows through the same jsonl tooling as run telemetry
+        (``paddle compare`` diffs two lint runs)."""
+        return {
+            "v": 1, "kind": "lint_finding", "host": 0, "t": 0.0,
+            "rule": self.rule, "path": self.path, "line": self.line,
+            "col": self.col, "message": self.message,
+            "snippet": self.snippet, "fingerprint": self.fingerprint,
+            "baselined": self.baselined,
+        }
+
+
+@dataclass
+class Suppression:
+    line: int
+    ids: Tuple[str, ...]
+    reason: Optional[str]
+
+
+SUPPRESS_RE = re.compile(
+    r"#\s*lint:\s*disable=(?P<ids>PTL\d{3}(?:\s*,\s*PTL\d{3})*)"
+    r"(?:\s*--\s*(?P<reason>\S.*?))?\s*$"
+)
+
+
+class SourceFile:
+    """One parsed module: text, lines, AST, suppression table."""
+
+    def __init__(self, path: str, rel: str, text: str):
+        self.path = path
+        self.rel = rel.replace(os.sep, "/")
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=path)
+        # line -> Suppression. Regex over raw lines: a '#' inside a
+        # string literal could false-match, but only for lines that also
+        # spell 'lint: disable=' — an accepted non-risk.
+        self.suppressions: Dict[int, Suppression] = {}
+        for i, line in enumerate(self.lines, start=1):
+            m = SUPPRESS_RE.search(line)
+            if m:
+                ids = tuple(
+                    s.strip() for s in m.group("ids").split(",") if s.strip()
+                )
+                self.suppressions[i] = Suppression(i, ids, m.group("reason"))
+        # line -> end line of the innermost SIMPLE statement spanning it
+        # (wrapped calls put the natural trailing comment on the closing
+        # paren line — the statement span lets a suppression there still
+        # govern a finding anchored to an inner line). Compound
+        # statements are excluded: a `for` header's span must not let a
+        # suppression deep in the body govern the header.
+        self.stmt_end: Dict[int, int] = {}
+        compound = (
+            ast.If, ast.For, ast.AsyncFor, ast.While, ast.With,
+            ast.AsyncWith, ast.Try, ast.FunctionDef, ast.AsyncFunctionDef,
+            ast.ClassDef,
+        )
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.stmt) and not isinstance(node, compound):
+                end = getattr(node, "end_lineno", None) or node.lineno
+                for ln in range(node.lineno, end + 1):
+                    self.stmt_end[ln] = end
+
+    def snippet(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def suppression_for(self, rid: str, lineno: int,
+                        end_lineno: int = 0) -> Optional[Suppression]:
+        """A suppression governs its own (code) line — any line of the
+        flagged node's span, so a trailing comment after a wrapped
+        call's closing paren counts — or, when written as a comment-only
+        line, the next line of code below it."""
+        end_lineno = max(end_lineno, self.stmt_end.get(lineno, 0))
+        for line in range(lineno, max(lineno, end_lineno) + 1):
+            sup = self.suppressions.get(line)
+            if sup is not None and rid in sup.ids:
+                return sup
+        above = self.suppressions.get(lineno - 1)
+        if (
+            above is not None
+            and rid in above.ids
+            and self.snippet(lineno - 1).startswith("#")
+        ):
+            return above
+        return None
+
+
+@dataclass
+class LintContext:
+    files: List[SourceFile]
+    repo_root: str
+    config: Dict[str, Any]
+
+    def find(self, suffix: str) -> Optional[SourceFile]:
+        for sf in self.files:
+            if path_matches(sf.rel, suffix):
+                return sf
+        return None
+
+
+@dataclass
+class LintResult:
+    findings: List[Finding] = field(default_factory=list)  # sorted, incl. baselined
+    skipped: List[Tuple[str, str]] = field(default_factory=list)  # (path, why)
+    stale_baseline: List[str] = field(default_factory=list)  # unmatched fingerprints
+    files_scanned: int = 0
+    scanned_paths: List[str] = field(default_factory=list)  # repo-relative
+
+    @property
+    def new(self) -> List[Finding]:
+        return [f for f in self.findings if not f.baselined]
+
+    def counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for f in self.new:
+            out[f.rule] = out.get(f.rule, 0) + 1
+        return out
+
+    def summary_record(self) -> Dict[str, Any]:
+        """kind=lint_summary (doc/observability.md): the per-rule count
+        surface ``paddle compare`` diffs between two lint runs."""
+        return {
+            "v": 1, "kind": "lint_summary", "host": 0, "t": 0.0,
+            "findings": len(self.new),
+            "baselined": len(self.findings) - len(self.new),
+            "counts": self.counts(),
+            "files_scanned": self.files_scanned,
+            # coverage honesty: a consumer gating on --json must be able
+            # to see that files went unscanned (their findings CANNOT
+            # have been found) or that baseline entries went stale
+            "skipped": len(self.skipped),
+            "stale_baseline": len(self.stale_baseline),
+            "rules": sorted(ALL_RULES),
+        }
+
+
+# ------------------------------------------------- shared config/helpers
+
+#: Per-rule scoping the invariants were stated against (rationale in
+#: doc/static_analysis.md). Paths are repo-relative suffix patterns:
+#: a trailing '/' means "anywhere under a directory of this name".
+DEFAULT_CONFIG: Dict[str, Any] = {
+    # PTL001: modules whose records carry the monotonic `t`-offset
+    # schema contract — wall-clock reads there break restart merging
+    "hot_path_files": (
+        "observability/",
+        "data/feeder.py",
+        "trainer/trainer.py",
+        "trainer/async_ckpt.py",
+    ),
+    # PTL002: (file pattern, function) pairs that ARE the hot loops
+    "hot_loop_funcs": (
+        ("trainer/trainer.py", "train_one_pass"),
+        ("observability/serving.py", "run_rung"),
+    ),
+    # PTL002: calls whose results live on device (taint sources)
+    "device_source_res": (r"\.call$", r"_step$", r"^launch_fn$"),
+    # PTL005: a `with` context whose source mentions one of these is
+    # treated as a lock
+    "lock_name_re": r"lock|cv|cond|mutex",
+}
+
+
+def path_matches(rel: str, pattern: str) -> bool:
+    rel = "/" + rel.replace(os.sep, "/")
+    if pattern.endswith("/"):
+        return f"/{pattern}" in rel + "/"
+    return rel.endswith("/" + pattern)
+
+
+def dotted(node: ast.AST) -> str:
+    """``a.b.c`` for a Name/Attribute chain, '' for anything else."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def str_arg0(call: ast.Call) -> Optional[str]:
+    if call.args and isinstance(call.args[0], ast.Constant) and isinstance(
+        call.args[0].value, str
+    ):
+        return call.args[0].value
+    return None
+
+
+def walk_calls(tree: ast.AST) -> Iterable[ast.Call]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            yield node
+
+
+def const_strings(node: ast.AST) -> List[str]:
+    """Every string constant anywhere under ``node``."""
+    return [
+        n.value
+        for n in ast.walk(node)
+        if isinstance(n, ast.Constant) and isinstance(n.value, str)
+    ]
+
+
+# --------------------------------------------------------------- runner
+
+
+def discover_files(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+            continue
+        for root, dirs, names in os.walk(p):
+            dirs[:] = sorted(
+                d for d in dirs
+                if not d.startswith(".") and d != "__pycache__"
+            )
+            for name in sorted(names):
+                if name.endswith(".py"):
+                    out.append(os.path.join(root, name))
+    # de-dupe while keeping order (overlapping path args)
+    seen: set = set()
+    uniq = []
+    for p in out:
+        ap = os.path.abspath(p)
+        if ap not in seen:
+            seen.add(ap)
+            uniq.append(p)
+    return uniq
+
+
+def root_is_marked(repo_root: str) -> bool:
+    """True when ``repo_root`` is a real project root (pyproject/.git)
+    rather than the bare-directory fallback. Baseline entry paths are
+    only stable across invocations under a marked root, so deletion
+    detection (an entry whose file is gone) is gated on it."""
+    return os.path.exists(
+        os.path.join(repo_root, "pyproject.toml")
+    ) or os.path.exists(os.path.join(repo_root, ".git"))
+
+
+def find_repo_root(paths: Sequence[str]) -> str:
+    """Walk up from the first path to the enclosing repo (pyproject.toml
+    or .git); fall back to the first path's directory, so fixture trees
+    without project files get self-relative finding paths."""
+    if not paths:
+        return os.getcwd()
+    start = os.path.abspath(paths[0])
+    if os.path.isfile(start):
+        start = os.path.dirname(start)
+    d = start
+    while True:
+        if os.path.exists(os.path.join(d, "pyproject.toml")) or os.path.exists(
+            os.path.join(d, ".git")
+        ):
+            return d
+        parent = os.path.dirname(d)
+        if parent == d:
+            return start
+        d = parent
+
+
+def _fingerprint(findings: List[Finding]) -> None:
+    """Line-number-independent fingerprints: hash of (rule, path,
+    stripped source line), with an occurrence suffix so N identical
+    lines get N distinct prints. Survives edits that only shift lines."""
+    seen: Dict[str, int] = {}
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.col, f.rule)):
+        base = hashlib.sha1(
+            f"{f.rule}|{f.path}|{f.snippet}".encode()
+        ).hexdigest()[:16]
+        n = seen.get(base, 0)
+        seen[base] = n + 1
+        f.fingerprint = base if n == 0 else f"{base}-{n}"
+
+
+def run_lint(
+    paths: Sequence[str],
+    baseline: Optional[Dict[str, Any]] = None,
+    config: Optional[Dict[str, Any]] = None,
+) -> LintResult:
+    """Lint ``paths`` (files or directories). ``baseline`` is a loaded
+    baseline document (see baseline.py); matched findings are kept but
+    marked ``baselined`` so only NEW findings gate the exit code."""
+    cfg = dict(DEFAULT_CONFIG)
+    if config:
+        cfg.update(config)
+    repo_root = find_repo_root(paths)
+    result = LintResult()
+    files: List[SourceFile] = []
+    for path in discover_files(paths):
+        try:
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+        except (OSError, UnicodeDecodeError) as e:
+            result.skipped.append((path, str(e)))
+            continue
+        rel = os.path.relpath(os.path.abspath(path), repo_root)
+        try:
+            files.append(SourceFile(path, rel, text))
+        except SyntaxError as e:
+            result.skipped.append((path, f"syntax error: {e.msg} (line {e.lineno})"))
+    result.files_scanned = len(files)
+    result.scanned_paths = [sf.rel for sf in files]
+    ctx = LintContext(files=files, repo_root=repo_root, config=cfg)
+
+    raw: List[Finding] = []
+    for sf in files:
+        for rid, fn in FILE_RULES:
+            raw.extend(fn(sf, ctx))
+        # PTL000: a reason-less suppression suppresses nothing AND is a
+        # finding — otherwise "# lint: disable" becomes a free pass
+        for sup in sf.suppressions.values():
+            if sup.reason is None:
+                raw.append(Finding(
+                    rule="PTL000", path=sf.rel, line=sup.line, col=0,
+                    message=(
+                        "suppression missing its mandatory reason — use "
+                        "`# lint: disable="
+                        + ",".join(sup.ids)
+                        + " -- <why this is safe>`"
+                    ),
+                    snippet=sf.snippet(sup.line),
+                ))
+    for rid, fn in PROJECT_RULES:
+        raw.extend(fn(ctx))
+
+    # suppression pass (PTL000 itself is not suppressible)
+    by_rel = {sf.rel: sf for sf in files}
+    kept: List[Finding] = []
+    for f in raw:
+        sf = by_rel.get(f.path)
+        if f.rule != "PTL000" and sf is not None:
+            sup = sf.suppression_for(f.rule, f.line, f.end_line)
+            if sup is not None and sup.reason:
+                continue
+        if not f.snippet and sf is not None:
+            f.snippet = sf.snippet(f.line)
+        kept.append(f)
+
+    kept.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    _fingerprint(kept)
+
+    if baseline:
+        allowed: Dict[str, int] = {}
+        ent_path: Dict[str, str] = {}
+        for ent in baseline.get("findings", []):
+            fp = ent.get("fingerprint")
+            if fp:
+                allowed[fp] = allowed.get(fp, 0) + 1
+                ent_path[fp] = ent.get("path", "")
+        for f in kept:
+            if allowed.get(f.fingerprint, 0) > 0:
+                allowed[f.fingerprint] -= 1
+                f.baselined = True
+        # staleness is only judged for entries whose file was IN this
+        # scan — a subset run must not call the full tree's grandfathered
+        # entries stale (and tempt a --write-baseline that drops them) —
+        # EXCEPT entries whose file no longer exists at all: a deleted/
+        # renamed module's entries would otherwise be immortal (never
+        # scanned, never flagged, carried over by every regeneration)
+        scanned = {sf.rel for sf in files}
+        marked = root_is_marked(repo_root)
+        result.stale_baseline = sorted(
+            fp for fp, n in allowed.items()
+            if n > 0 and (
+                ent_path.get(fp, "") in scanned
+                or (marked and not os.path.exists(
+                    os.path.join(repo_root, ent_path.get(fp, ""))
+                ))
+            )
+        )
+    result.findings = kept
+    return result
+
+
+# rule modules self-register via the @rule decorator; imported last so
+# the decorators above exist. noqa: the imports ARE the side effect.
+from paddle_tpu.analysis import rules_hotpath  # noqa: E402,F401
+from paddle_tpu.analysis import rules_jax  # noqa: E402,F401
+from paddle_tpu.analysis import rules_concurrency  # noqa: E402,F401
+from paddle_tpu.analysis import rules_registry  # noqa: E402,F401

@@ -1,0 +1,275 @@
+"""PTL005 (daemon-thread shared-state writes without a lock) and
+PTL006 (exit paths not dominated by a metrics flush) — the concurrency
+and crash-evidence invariants from the async-checkpoint / hangwatch /
+heartbeat work.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from paddle_tpu.analysis.core import (
+    Finding,
+    LintContext,
+    SourceFile,
+    dotted,
+    rule,
+    str_arg0,
+)
+
+# ------------------------------------------------------------- PTL005
+
+
+class _FileIndex:
+    """Functions by qualname + the class that owns each method."""
+
+    def __init__(self, sf: SourceFile):
+        self.sf = sf
+        self.module_funcs: Dict[str, ast.AST] = {}
+        self.methods: Dict[Tuple[str, str], ast.AST] = {}  # (class, name)
+        self.class_of: Dict[ast.AST, str] = {}
+        for node in sf.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.module_funcs[node.name] = node
+            elif isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        self.methods[(node.name, sub.name)] = sub
+                        self.class_of[sub] = node.name
+        # nested defs (closures handed to Thread(target=...))
+        for node in ast.walk(sf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for sub in ast.walk(node):
+                    if (
+                        sub is not node
+                        and isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef))
+                        and sub.name not in self.module_funcs
+                    ):
+                        self.module_funcs.setdefault(sub.name, sub)
+
+    def resolve(self, ref: ast.AST) -> List[ast.AST]:
+        """Function nodes a callable reference might mean: a bare name
+        (module or nested def) or ``self.method`` (any class defining
+        that method — conservative when several do)."""
+        if isinstance(ref, ast.Name):
+            fn = self.module_funcs.get(ref.id)
+            return [fn] if fn is not None else []
+        if (
+            isinstance(ref, ast.Attribute)
+            and isinstance(ref.value, ast.Name)
+            and ref.value.id == "self"
+        ):
+            # every same-named method in the file, deliberately: a
+            # subclass override of a method the base's thread loop calls
+            # (`ShardedAsyncCheckpointer._write` via the inherited
+            # `_run`) is also thread-side
+            return [
+                fn for (cls, name), fn in self.methods.items()
+                if name == ref.attr
+            ]
+        return []
+
+
+def _thread_entry_refs(sf: SourceFile) -> List[ast.AST]:
+    """The callable ref of every Thread(target=...), Timer(..., fn),
+    and pool.submit(fn, ...) in the file."""
+    out: List[ast.AST] = []
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted(node.func)
+        if d.endswith("Thread"):
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    out.append(kw.value)
+        elif d.endswith("Timer"):
+            for kw in node.keywords:
+                if kw.arg == "function":
+                    out.append(kw.value)
+            if len(node.args) >= 2:
+                out.append(node.args[1])
+        elif isinstance(node.func, ast.Attribute) and node.func.attr == "submit":
+            if node.args:
+                out.append(node.args[0])
+    return out
+
+
+def _locked_lines(fn: ast.AST, lock_re: re.Pattern) -> Set[int]:
+    """Line numbers lexically inside a ``with <something-lockish>:``."""
+    lines: Set[int] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            if any(
+                lock_re.search(ast.unparse(item.context_expr))
+                for item in node.items
+            ):
+                end = getattr(node, "end_lineno", node.lineno)
+                lines.update(range(node.lineno, (end or node.lineno) + 1))
+    return lines
+
+
+@rule(
+    "PTL005",
+    "self-attribute written on a daemon-thread code path without an "
+    "enclosing lock",
+)
+def check_unlocked_thread_writes(sf: SourceFile, ctx: LintContext) -> Iterable[Finding]:
+    """State shared between a worker thread and the step loop (the
+    async-ckpt writer's progress counters, the heartbeat sequence, the
+    hangwatch fired-flag) must be written under the object's lock —
+    torn read-modify-writes there turn into lost progress pings, double
+    saves, or double hang reports. The walk: thread entry points
+    (``Thread(target=...)``/``Timer``/``pool.submit``) plus everything
+    they transitively call in-file; any ``self.attr = ...`` /
+    ``self.attr += ...`` there must sit inside a ``with <lock>:``."""
+    entries = _thread_entry_refs(sf)
+    if not entries:
+        return []
+    idx = _FileIndex(sf)
+    lock_re = re.compile(ctx.config["lock_name_re"], re.IGNORECASE)
+    # transitive closure over in-file calls from the entry functions
+    thread_side: List[ast.AST] = []
+    seen: Set[int] = set()
+    work = []
+    for ref in entries:
+        work.extend(idx.resolve(ref))
+    while work:
+        fn = work.pop()
+        if fn is None or id(fn) in seen:
+            continue
+        seen.add(id(fn))
+        thread_side.append(fn)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                work.extend(idx.resolve(node.func))
+    out: List[Finding] = []
+    reported: Set[Tuple[int, int]] = set()
+    for fn in thread_side:
+        locked = _locked_lines(fn, lock_re)
+        for node in ast.walk(fn):
+            targets: List[ast.AST] = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, ast.AugAssign):
+                targets = [node.target]
+            for t in targets:
+                if not (
+                    isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                ):
+                    continue
+                if node.lineno in locked:
+                    continue
+                key = (node.lineno, node.col_offset)
+                if key in reported:
+                    continue
+                reported.add(key)
+                out.append(Finding(
+                    rule="PTL005", path=sf.rel, line=node.lineno,
+                    col=node.col_offset,
+                    end_line=getattr(node, "end_lineno", 0) or 0,
+                    message=(
+                        f"`self.{t.attr}` written on the "
+                        f"thread-run path `{getattr(fn, 'name', '?')}` "
+                        "without an enclosing lock — wrap the write in "
+                        "`with <lock>:` (shared with the thread's readers)"
+                    ),
+                    snippet=sf.snippet(node.lineno),
+                ))
+    return out
+
+
+# ------------------------------------------------------------- PTL006
+
+_EXIT_CALLS = {"os._exit", "sys.exit", "exit"}
+
+
+def _is_instrumented(sf: SourceFile) -> bool:
+    """A module that writes telemetry records: any ``*.emit("kind")`` /
+    ``emit("kind")`` call with a literal kind."""
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Call):
+            d = dotted(node.func)
+            if (d == "emit" or d.endswith(".emit")) and str_arg0(node):
+                return True
+    return False
+
+
+def _in_main_guard(sf: SourceFile, lineno: int) -> bool:
+    """Inside ``if __name__ == "__main__":`` — the process-entry idiom
+    where ``sys.exit(main())`` runs atexit (and so the metrics flush
+    hook) normally."""
+    for node in sf.tree.body:
+        if isinstance(node, ast.If):
+            src = ast.unparse(node.test)
+            if "__name__" in src and "__main__" in src:
+                end = getattr(node, "end_lineno", node.lineno) or node.lineno
+                if node.lineno <= lineno <= end:
+                    return True
+    return False
+
+
+@rule(
+    "PTL006",
+    "exit path (os._exit/sys.exit/raise SystemExit) in an instrumented "
+    "module without a preceding metrics flush",
+)
+def check_exit_without_flush(sf: SourceFile, ctx: LintContext) -> Iterable[Finding]:
+    """The crash-evidence discipline: a record flushed BEFORE the death
+    is the only record that exists after it. ``os._exit`` skips atexit
+    entirely; explicit exits in instrumented modules must therefore be
+    dominated by a ``flush()`` call in the same function (the pattern
+    the fault injector and hangwatch established)."""
+    if not _is_instrumented(sf):
+        return []
+    # function node -> flush-call line numbers, exit nodes
+    out: List[Finding] = []
+    funcs = [
+        n for n in ast.walk(sf.tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+    owner: Dict[int, ast.AST] = {}
+    for fn in funcs:
+        for sub in ast.walk(fn):
+            owner[id(sub)] = fn  # innermost wins (walk order outer->inner)
+    for node in ast.walk(sf.tree):
+        exit_desc = None
+        if isinstance(node, ast.Call) and dotted(node.func) in _EXIT_CALLS:
+            exit_desc = f"{dotted(node.func)}()"
+        elif isinstance(node, ast.Raise) and node.exc is not None:
+            d = dotted(node.exc) or (
+                dotted(node.exc.func) if isinstance(node.exc, ast.Call) else ""
+            )
+            if d == "SystemExit":
+                exit_desc = "raise SystemExit"
+        if exit_desc is None:
+            continue
+        if _in_main_guard(sf, node.lineno):
+            continue
+        fn = owner.get(id(node))
+        flushed = False
+        if fn is not None:
+            for sub in ast.walk(fn):
+                if (
+                    isinstance(sub, ast.Call)
+                    and dotted(sub.func).split(".")[-1] == "flush"
+                    and sub.lineno < node.lineno
+                ):
+                    flushed = True
+                    break
+        if not flushed:
+            out.append(Finding(
+                rule="PTL006", path=sf.rel, line=node.lineno,
+                col=node.col_offset,
+                end_line=getattr(node, "end_lineno", 0) or 0,
+                message=(
+                    f"`{exit_desc}` in an instrumented module without a "
+                    "preceding metrics flush — flush the evidence BEFORE "
+                    "the death (fault records survive their own exit)"
+                ),
+                snippet=sf.snippet(node.lineno),
+            ))
+    return out

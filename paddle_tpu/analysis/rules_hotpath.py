@@ -1,0 +1,277 @@
+"""PTL001 (wall-clock in hot paths) and PTL002 (host syncs in hot
+loops) — the timing and overlap invariants from the telemetry and
+zero-stall-host work.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, List, Set, Tuple
+
+from paddle_tpu.analysis.core import (
+    Finding,
+    LintContext,
+    SourceFile,
+    dotted,
+    path_matches,
+    rule,
+)
+
+# ------------------------------------------------------------- PTL001
+
+# every reading of civil time the stdlib offers under two module names.
+# time.monotonic()/perf_counter() are the sanctioned clocks.
+_WALL_CLOCK = {
+    "time.time",
+    "datetime.now",
+    "datetime.utcnow",
+    "datetime.datetime.now",
+    "datetime.datetime.utcnow",
+    "datetime.date.today",
+    "date.today",
+}
+
+
+@rule(
+    "PTL001",
+    "wall-clock read (time.time/datetime.now) in a hot-path module — "
+    "records carry monotonic t-offsets",
+)
+def check_wall_clock(sf: SourceFile, ctx: LintContext) -> Iterable[Finding]:
+    """The metrics schema's ``t`` is a monotonic offset from
+    ``run_start`` — the ONE sanctioned wall-clock read. Any other wall
+    clock in an instrumented hot path (observability/, the feeder, the
+    trainer step loop, the async checkpointer) re-introduces the
+    NTP-step / clock-skew hazards the offset schema exists to avoid."""
+    if not any(
+        path_matches(sf.rel, p) for p in ctx.config["hot_path_files"]
+    ):
+        return []
+    out: List[Finding] = []
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Call) and dotted(node.func) in _WALL_CLOCK:
+            out.append(Finding(
+                rule="PTL001", path=sf.rel, line=node.lineno,
+                col=node.col_offset,
+                end_line=getattr(node, "end_lineno", 0) or 0,
+                message=(
+                    f"wall-clock read `{dotted(node.func)}()` in a hot-path "
+                    "module — use time.monotonic()/time.perf_counter() "
+                    "(the t-offset schema contract, doc/observability.md)"
+                ),
+                snippet=sf.snippet(node.lineno),
+            ))
+    return out
+
+
+# ------------------------------------------------------------- PTL002
+
+# calls that force a device->host sync regardless of argument
+_ALWAYS_SYNC = {"jax.device_get", "jax.block_until_ready"}
+# calls that sync when applied to a device value (tainted name)
+_SYNC_IF_TAINTED = {"float", "np.asarray", "numpy.asarray", "np.array",
+                    "numpy.array"}
+
+
+def _call_names(call: ast.Call) -> Tuple[str, str]:
+    d = dotted(call.func)
+    attr = call.func.attr if isinstance(call.func, ast.Attribute) else ""
+    return d, attr
+
+
+def _assigned_names(target: ast.AST) -> List[str]:
+    """Top-level bound names only: ``a, (b, *c) = ...`` -> a, b, c.
+    Attribute/subscript targets bind no local name (``self.x = ...``
+    must not taint ``self``)."""
+    if isinstance(target, ast.Name):
+        return [target.id]
+    if isinstance(target, ast.Starred):
+        return _assigned_names(target.value)
+    if isinstance(target, (ast.Tuple, ast.List)):
+        out: List[str] = []
+        for e in target.elts:
+            out.extend(_assigned_names(e))
+        return out
+    return []
+
+
+class _HotLoopVisitor(ast.NodeVisitor):
+    """Taint walk over ONE hot-loop function: names assigned from
+    device-producing calls (``*.call``, ``*_step``, ``launch_fn``) are
+    device values; reading one back on host (`float()`, `.item()`,
+    `np.asarray()`, `jax.device_get`, `block_until_ready`) inside a
+    for/while body is a per-step stall and gets flagged. A flagged sync
+    un-taints its assignment targets (the value is host-side after)."""
+
+    def __init__(self, sf: SourceFile, source_res: List[re.Pattern]):
+        self.sf = sf
+        self.source_res = source_res
+        self.tainted: Set[str] = set()
+        self.loop_depth = 0
+        self.findings: List[Finding] = []
+        self._seen: Set[Tuple[int, int]] = set()  # loops re-scan their test
+
+    # ---- helpers
+
+    def _is_device_source(self, node: ast.AST) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        d, _ = _call_names(node)
+        return bool(d) and any(r.search(d) for r in self.source_res)
+
+    def _has_tainted_name(self, node: ast.AST) -> bool:
+        return any(
+            isinstance(n, ast.Name) and n.id in self.tainted
+            for n in ast.walk(node)
+        )
+
+    def _flag(self, node: ast.AST, what: str) -> None:
+        key = (node.lineno, node.col_offset)
+        if key in self._seen:
+            return
+        self._seen.add(key)
+        self.findings.append(Finding(
+            rule="PTL002", path=self.sf.rel, line=node.lineno,
+            col=node.col_offset,
+            end_line=getattr(node, "end_lineno", 0) or 0,
+            message=(
+                f"host sync `{what}` inside the hot loop — every launch "
+                "stalls on it; hoist it to a window boundary or keep the "
+                "value on device"
+            ),
+            snippet=self.sf.snippet(node.lineno),
+        ))
+
+    def _scan_syncs(self, node: ast.AST) -> bool:
+        """Flag sync calls under ``node`` (when inside a loop). Returns
+        True when one was found (the statement's value is host-side)."""
+        found = False
+        for call in ast.walk(node):
+            if not isinstance(call, ast.Call):
+                continue
+            d, attr = _call_names(call)
+            sync = None
+            if d in _ALWAYS_SYNC or attr == "block_until_ready":
+                sync = d or f".{attr}()"
+            elif attr == "item" and call.args == [] and self._has_tainted_name(
+                call.func
+            ):
+                sync = ".item()"
+            elif d in _SYNC_IF_TAINTED and call.args and self._has_tainted_name(
+                call.args[0]
+            ):
+                sync = f"{d}()"
+            if sync is not None:
+                found = True
+                if self.loop_depth > 0:
+                    self._flag(call, sync)
+        return found
+
+    # ---- statements (taint flows through assignments in source order)
+
+    def _handle_assign(self, node, targets, value) -> None:
+        names = []
+        for t in targets:
+            names.extend(_assigned_names(t))
+        synced = self._scan_syncs(value)
+        if self._is_device_source(value) or (
+            isinstance(value, ast.Tuple)
+            and any(self._is_device_source(e) for e in value.elts)
+        ):
+            self.tainted.update(names)
+        elif synced or not self._has_tainted_name(value):
+            # host-side now (or reassigned from untainted expression)
+            self.tainted.difference_update(names)
+        else:
+            # tainted rhs propagates (e.g. `x = losses[0]`)
+            self.tainted.update(names)
+        self.generic_visit_stmts(node)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self._handle_assign(node, node.targets, node.value)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._scan_syncs(node.value)
+
+    def visit_Expr(self, node: ast.Expr) -> None:
+        self._scan_syncs(node.value)
+
+    def generic_visit_stmts(self, node) -> None:
+        pass
+
+    def visit_For(self, node: ast.For) -> None:
+        self._scan_syncs(node.iter)
+        if self._is_device_source(node.iter):
+            self.tainted.update(_assigned_names(node.target))
+        self.loop_depth += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        self.loop_depth -= 1
+        for stmt in node.orelse:
+            self.visit(stmt)
+
+    def visit_While(self, node: ast.While) -> None:
+        # the test re-evaluates EVERY iteration — scan it at loop depth
+        # (unlike a for's iter, which evaluates once at entry), and
+        # AGAIN after the body so loop-carried taint (`loss` assigned
+        # inside, read by the next iteration's test) is seen; _flag
+        # dedupes the doubly-scanned site
+        self.loop_depth += 1
+        self._scan_syncs(node.test)
+        for stmt in node.body:
+            self.visit(stmt)
+        self._scan_syncs(node.test)
+        self.loop_depth -= 1
+        for stmt in node.orelse:
+            self.visit(stmt)
+
+    def visit_FunctionDef(self, node) -> None:
+        pass  # nested defs run on their own schedule, not per-step
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def generic_visit(self, node: ast.AST) -> None:
+        # default: scan expressions for syncs, recurse into bodies
+        for fname in ("test", "value", "exc"):
+            sub = getattr(node, fname, None)
+            if isinstance(sub, ast.AST):
+                self._scan_syncs(sub)
+        for fname in ("body", "orelse", "finalbody", "handlers"):
+            for stmt in getattr(node, fname, []) or []:
+                if isinstance(stmt, ast.stmt) or isinstance(
+                    stmt, ast.excepthandler
+                ):
+                    self.visit(stmt)
+
+
+@rule(
+    "PTL002",
+    "device->host sync (float/.item/np.asarray/device_get/"
+    "block_until_ready) inside a hot step/serve loop",
+)
+def check_host_sync(sf: SourceFile, ctx: LintContext) -> Iterable[Finding]:
+    """The zero-stall-host work moved every per-step host cost off the
+    critical path; one stray ``float(loss)`` per batch silently undoes
+    it. Syncs that are part of the design (the one documented
+    device->host transfer per launch, the nonfinite gate) carry
+    `# lint: disable=PTL002 -- reason` suppressions at the call site —
+    the reason IS the documentation."""
+    funcs = [
+        name
+        for pat, name in ctx.config["hot_loop_funcs"]
+        if path_matches(sf.rel, pat)
+    ]
+    if not funcs:
+        return []
+    source_res = [re.compile(r) for r in ctx.config["device_source_res"]]
+    out: List[Finding] = []
+    for node in ast.walk(sf.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and (
+            node.name in funcs
+        ):
+            v = _HotLoopVisitor(sf, source_res)
+            for stmt in node.body:
+                v.visit(stmt)
+            out.extend(v.findings)
+    return out

@@ -1,0 +1,278 @@
+"""PTL003 (use-after-donate) and PTL004 (recompile hazards) — the
+jax-semantics invariants behind donated step buffers and
+recompile-stable launch signatures.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from paddle_tpu.analysis.core import (
+    Finding,
+    LintContext,
+    SourceFile,
+    dotted,
+    rule,
+)
+
+_JIT_NAMES = {"jit", "jax.jit", "pjit", "jax.pjit"}
+
+
+def _donated_positions(call: ast.Call) -> Optional[List[int]]:
+    """The donate_argnums of a ``jax.jit(f, donate_argnums=...)`` call,
+    when statically readable."""
+    if dotted(call.func) not in _JIT_NAMES:
+        return None
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return [v.value]
+            if isinstance(v, (ast.Tuple, ast.List)):
+                out = []
+                for e in v.elts:
+                    if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                        out.append(e.value)
+                return out
+    return None
+
+
+def _scopes(tree: ast.Module):
+    """Every function scope (and the module itself) — donation tracking
+    is per-scope, matching "read afterwards in the same scope"."""
+    yield tree
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _walk_scope(scope):
+    """The nodes of ONE scope: descends expressions and control flow
+    but not nested function/class bodies (those are their own scopes —
+    walking them twice double-reports)."""
+    stack = list(ast.iter_child_nodes(scope))
+    while stack:
+        n = stack.pop()
+        if isinstance(
+            n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)
+        ):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+@rule(
+    "PTL003",
+    "a buffer passed through a donate_argnums jit call is read after "
+    "the call (use-after-donate)",
+)
+def check_use_after_donate(sf: SourceFile, ctx: LintContext) -> Iterable[Finding]:
+    """``donate_argnums`` hands the input buffer to XLA — after the
+    call the Python name still exists but its device buffer is deleted;
+    touching it raises (best case) or silently reads garbage on some
+    backends. The step functions donate params/opt_state, so the
+    correct idiom is the immediate rebind
+    (``params, opt = step(params, opt, ...)``)."""
+    out: List[Finding] = []
+    for scope in _scopes(sf.tree):
+        # 1) names bound to donating jitted callables in this scope
+        donators: Dict[str, List[int]] = {}
+        for node in _walk_scope(scope):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                pos = _donated_positions(node.value)
+                if pos:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            donators[t.id] = pos
+        if not donators:
+            continue
+        # 2) line-ordered events. Within one line, evaluation order is
+        # loads (0), then the donating call (1), then stores (2) — an
+        # assignment's target column precedes its rhs lexically but the
+        # store happens LAST (`params = step(params, ...)` is the safe
+        # rebind idiom and must not read as store-then-donate).
+        donated_at: Dict[str, int] = {}  # name -> donation line
+        events: List[Tuple[int, int, int, str, str]] = []
+        for node in _walk_scope(scope):
+            if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+                    and node.func.id in donators:
+                for p in donators[node.func.id]:
+                    if p < len(node.args) and isinstance(node.args[p], ast.Name):
+                        events.append(
+                            (node.lineno, 1, node.col_offset, "donate",
+                             node.args[p].id)
+                        )
+            elif isinstance(node, ast.Name):
+                store = isinstance(node.ctx, (ast.Store, ast.Del))
+                events.append((
+                    node.lineno, 2 if store else 0, node.col_offset,
+                    "store" if store else "load", node.id,
+                ))
+        flagged: Set[str] = set()
+        for line, _prio, col, op, name in sorted(events):
+            if op == "donate":
+                donated_at[name] = line
+            elif op == "store":
+                # rebinding (including the canonical same-statement
+                # `x, y = f(x, y)`) makes the name safe again
+                if name in donated_at and line >= donated_at[name]:
+                    donated_at.pop(name, None)
+            elif op == "load" and name in donated_at and name not in flagged:
+                if line > donated_at[name]:
+                    flagged.add(name)
+                    out.append(Finding(
+                        rule="PTL003", path=sf.rel, line=line, col=col,
+                        message=(
+                            f"`{name}` was donated to a jit call with "
+                            f"donate_argnums (line {donated_at[name]}) and is "
+                            "read afterwards — the buffer is gone; rebind "
+                            "the name from the call's result"
+                        ),
+                        snippet=sf.snippet(line),
+                    ))
+    return out
+
+
+# ------------------------------------------------------------- PTL004
+
+_DICT_ITER_ATTRS = {"keys", "values", "items"}
+
+
+def _is_dict_iter_call(node: ast.AST) -> Optional[str]:
+    if (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr in _DICT_ITER_ATTRS
+        and not node.args
+        and not node.keywords
+    ):
+        return node.func.attr
+    return None
+
+
+def _jit_decorated(node) -> bool:
+    """``@jit`` / ``@jax.jit`` / ``@partial(jax.jit, ...)``."""
+    for dec in node.decorator_list:
+        d = dotted(dec)
+        if d in _JIT_NAMES:
+            return True
+        if isinstance(dec, ast.Call):
+            if dotted(dec.func) in _JIT_NAMES:
+                return True
+            if dotted(dec.func) in ("partial", "functools.partial") and (
+                dec.args and dotted(dec.args[0]) in _JIT_NAMES
+            ):
+                return True
+    return False
+
+
+def _local_names(fn) -> Set[str]:
+    names: Set[str] = set()
+    a = fn.args
+    for arg in (
+        list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)
+        + ([a.vararg] if a.vararg else []) + ([a.kwarg] if a.kwarg else [])
+    ):
+        names.add(arg.arg)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            names.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and (
+            node is not fn
+        ):
+            names.add(node.name)
+    return names
+
+
+@rule(
+    "PTL004",
+    "recompile hazard: jit'd closure over a mutable Python value, or a "
+    "signature built from dict iteration order",
+)
+def check_recompile_hazards(sf: SourceFile, ctx: LintContext) -> Iterable[Finding]:
+    """Two ways launch signatures go unstable (the compile-telemetry
+    work made recompiles observable; this keeps them from appearing):
+
+    - a ``@jit`` function closing over a module-level **mutable**
+      value (list/dict/set, lowercase name — UPPERCASE is the constant
+      convention): jax traces it once and never again, so a later
+      mutation changes numerics WITHOUT a retrace, or forces
+      per-call retraces when used as a shape;
+    - a cache key / signature built from **dict iteration order**
+      (``tuple(d.items())`` et al. without ``sorted``): two processes
+      (or one process after a restart with different insertion order)
+      disagree on the same logical signature, defeating the persistent
+      compile cache and the recompile accounting.
+    """
+    out: List[Finding] = []
+    # module-level mutable bindings (lowercase only: UPPER_CASE module
+    # constants-by-convention are exempt)
+    mutable_mod: Set[str] = set()
+    for node in sf.tree.body:
+        if isinstance(node, ast.Assign) and isinstance(
+            node.value, (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+                         ast.SetComp)
+        ):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and not t.id.isupper():
+                    mutable_mod.add(t.id)
+
+    for node in ast.walk(sf.tree):
+        # (a) jit closure capture
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and (
+            _jit_decorated(node)
+        ):
+            local = _local_names(node)
+            seen: Set[str] = set()
+            for sub in ast.walk(node):
+                if (
+                    isinstance(sub, ast.Name)
+                    and isinstance(sub.ctx, ast.Load)
+                    and sub.id in mutable_mod
+                    and sub.id not in local
+                    and sub.id not in seen
+                ):
+                    seen.add(sub.id)
+                    out.append(Finding(
+                        rule="PTL004", path=sf.rel, line=sub.lineno,
+                        col=sub.col_offset,
+                        message=(
+                            f"jit'd `{node.name}` captures mutable "
+                            f"module value `{sub.id}` — traced once, "
+                            "mutations never retrigger compilation; pass "
+                            "it as an argument or freeze it (tuple/"
+                            "frozenset, UPPER_CASE constant)"
+                        ),
+                        snippet=sf.snippet(sub.lineno),
+                    ))
+        # (b) dict-iteration-order signatures
+        if isinstance(node, ast.Call):
+            attr = None
+            d = dotted(node.func)
+            if d == "tuple" and node.args:
+                attr = _is_dict_iter_call(node.args[0])
+                site = node.args[0]
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "join"
+                and node.args
+            ):
+                attr = _is_dict_iter_call(node.args[0])
+                site = node.args[0]
+            else:
+                continue
+            if attr:
+                out.append(Finding(
+                    rule="PTL004", path=sf.rel, line=site.lineno,
+                    col=site.col_offset,
+                    end_line=getattr(node, "end_lineno", 0) or 0,
+                    message=(
+                        f"signature component built from `.{attr}()` "
+                        "iteration order — wrap in sorted(...) so the "
+                        "launch signature is stable across processes and "
+                        "restarts (persistent compile cache contract)"
+                    ),
+                    snippet=sf.snippet(site.lineno),
+                ))
+    return out

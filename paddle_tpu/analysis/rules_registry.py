@@ -1,0 +1,218 @@
+"""PTL007 — registry drift: every emitted record kind must be
+registered in ``KIND_REQUIRED`` and documented in
+doc/observability.md's "Record kinds" table; every planted fault site
+must be in ``SITE_DOCS`` (and vice versa). The generalization of the
+doc-flags consistency test: the registries ARE the documentation, so
+drift between code, registry, and doc is mechanical to catch.
+
+Everything is read statically (AST of metrics.py / faultinject.py,
+regex over the doc) — no imports, so the check runs on any tree.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from paddle_tpu.analysis.core import (
+    Finding,
+    LintContext,
+    SourceFile,
+    const_strings,
+    dotted,
+    rule,
+    str_arg0,
+)
+
+_DOC_REL = os.path.join("doc", "observability.md")
+
+
+def _module_assign(sf: SourceFile, name: str) -> Optional[ast.Assign]:
+    for node in sf.tree.body:
+        if isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == name for t in node.targets
+        ):
+            return node
+    return None
+
+
+def _dict_keys(node: Optional[ast.Assign]) -> Set[str]:
+    if node is None or not isinstance(node.value, ast.Dict):
+        return set()
+    return {
+        k.value
+        for k in node.value.keys
+        if isinstance(k, ast.Constant) and isinstance(k.value, str)
+    }
+
+
+def _doc_kinds(repo_root: str) -> Optional[Set[str]]:
+    """First-column backticked names of the "Record kinds" table in
+    doc/observability.md (section-scoped: the envelope table's `v`/`t`
+    rows must not count as kinds). None = doc not found (fixture trees
+    without docs skip the doc half)."""
+    path = os.path.join(repo_root, _DOC_REL)
+    try:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+    except OSError:
+        return None
+    m = re.search(r"^#+\s*Record kinds\s*$", text, re.MULTILINE)
+    if m is None:
+        return None
+    section = text[m.end():]
+    nxt = re.search(r"^#+\s", section, re.MULTILINE)
+    if nxt:
+        section = section[: nxt.start()]
+    return set(re.findall(r"^\|\s*`(\w+)`", section, re.MULTILINE))
+
+
+def _emit_sites(ctx: LintContext) -> List[Tuple[SourceFile, ast.Call, str]]:
+    out = []
+    for sf in ctx.files:
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func)
+            if d == "emit" or d.endswith(".emit"):
+                kind = str_arg0(node)
+                if kind:
+                    out.append((sf, node, kind))
+    return out
+
+
+def _fault_sites(ctx: LintContext) -> List[Tuple[SourceFile, ast.Call, str]]:
+    out = []
+    for sf in ctx.files:
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func)
+            if d == "fault_point" or d.endswith(".fault_point"):
+                site = str_arg0(node)
+                if site:
+                    out.append((sf, node, site))
+    return out
+
+
+@rule(
+    "PTL007",
+    "registry drift: emitted kind without KIND_REQUIRED entry / doc "
+    "schema row, or fault site missing from SITE_DOCS",
+    project=True,
+)
+def check_registry_drift(ctx: LintContext) -> Iterable[Finding]:
+    out: List[Finding] = []
+
+    metrics_sf = ctx.find("observability/metrics.py")
+    fault_sf = ctx.find("resilience/faultinject.py")
+    doc_kinds = _doc_kinds(ctx.repo_root)
+
+    # ---------------- record kinds
+    if metrics_sf is not None:
+        kr_assign = _module_assign(metrics_sf, "KIND_REQUIRED")
+        fk_assign = _module_assign(metrics_sf, "FLUSH_KINDS")
+        kind_required = _dict_keys(kr_assign)
+        flush_kinds = set(
+            const_strings(fk_assign.value)
+        ) if fk_assign is not None else set()
+        emitted: Dict[str, Tuple[SourceFile, ast.Call]] = {}
+        for sf, node, kind in _emit_sites(ctx):
+            emitted.setdefault(kind, (sf, node))
+        for kind, (sf, node) in sorted(emitted.items()):
+            if kind_required and kind not in kind_required:
+                out.append(Finding(
+                    rule="PTL007", path=sf.rel, line=node.lineno,
+                    col=node.col_offset,
+                    message=(
+                        f"record kind `{kind}` is emitted but has no "
+                        "KIND_REQUIRED entry in observability/metrics.py — "
+                        "register its required fields (may be ()) so "
+                        "validate_record covers it"
+                    ),
+                    snippet=sf.snippet(node.lineno),
+                ))
+            if doc_kinds is not None and kind not in doc_kinds:
+                out.append(Finding(
+                    rule="PTL007", path=sf.rel, line=node.lineno,
+                    col=node.col_offset,
+                    message=(
+                        f"record kind `{kind}` is emitted but undocumented "
+                        f"— add its row to {_DOC_REL} \"Record kinds\""
+                    ),
+                    snippet=sf.snippet(node.lineno),
+                ))
+        # reverse direction: registry entries no code/doc backs. Only
+        # meaningful on a scan that includes the emitters — a kind that
+        # is documented counts as backed (bench.py emits `bench` from
+        # outside the package; `paddle lint --json` emits the lint kinds
+        # without going through MetricsWriter).
+        anchor_line = kr_assign.lineno if kr_assign is not None else 1
+        for kind in sorted(kind_required):
+            if kind not in emitted and doc_kinds is not None \
+                    and kind not in doc_kinds:
+                out.append(Finding(
+                    rule="PTL007", path=metrics_sf.rel, line=anchor_line,
+                    col=0,
+                    message=(
+                        f"KIND_REQUIRED entry `{kind}` is neither emitted "
+                        "anywhere in the scanned tree nor documented — "
+                        "dead registry entry?"
+                    ),
+                    snippet=metrics_sf.snippet(anchor_line),
+                ))
+        fk_line = fk_assign.lineno if fk_assign is not None else 1
+        # same doc-gating as the KIND_REQUIRED reverse check: with no
+        # doc in the tree (copied off a pod) documentation status is
+        # unknowable, so don't guess "dead"
+        for kind in sorted(flush_kinds):
+            if kind not in emitted and doc_kinds is not None \
+                    and kind not in doc_kinds:
+                out.append(Finding(
+                    rule="PTL007", path=metrics_sf.rel, line=fk_line, col=0,
+                    message=(
+                        f"FLUSH_KINDS names `{kind}`, which is neither "
+                        "emitted anywhere in the scanned tree nor "
+                        "documented — dead flush kind?"
+                    ),
+                    snippet=metrics_sf.snippet(fk_line),
+                ))
+
+    # ---------------- fault sites
+    if fault_sf is not None:
+        site_docs = _dict_keys(_module_assign(fault_sf, "SITE_DOCS"))
+        planted: Dict[str, Tuple[SourceFile, ast.Call]] = {}
+        for sf, node, site in _fault_sites(ctx):
+            planted.setdefault(site, (sf, node))
+        for site, (sf, node) in sorted(planted.items()):
+            if site_docs and site not in site_docs:
+                out.append(Finding(
+                    rule="PTL007", path=sf.rel, line=node.lineno,
+                    col=node.col_offset,
+                    message=(
+                        f"fault site `{site}` is planted but missing from "
+                        "SITE_DOCS — `paddle faults` and chaos-spec authors "
+                        "can't see it"
+                    ),
+                    snippet=sf.snippet(node.lineno),
+                ))
+        sd_assign = _module_assign(fault_sf, "SITE_DOCS")
+        sd_line = sd_assign.lineno if sd_assign is not None else 1
+        # reverse direction only when the scan includes SOME planting
+        # layer: a subset scan (e.g. resilience/ alone) sees SITE_DOCS
+        # but none of the trainer/feeder/checkpoint call sites, and
+        # must not report every documented site as unplanted
+        for site in sorted(site_docs) if planted else ():
+            if site not in planted:
+                out.append(Finding(
+                    rule="PTL007", path=fault_sf.rel, line=sd_line, col=0,
+                    message=(
+                        f"SITE_DOCS documents fault site `{site}` but no "
+                        "fault_point() in the scanned tree plants it — "
+                        "chaos specs naming it would silently never fire"
+                    ),
+                    snippet=fault_sf.snippet(sd_line),
+                ))
+    return out

@@ -27,7 +27,7 @@ def main(argv=None) -> int:
         print(__doc__)
         print("usage: paddle <train|supervise|test|gen|checkgrad|dump_config|"
               "merge_model|check-checkpoint|metrics|roofline|compare|"
-              "serve-report|faults|version> [--flags]")
+              "serve-report|lint|faults|version> [--flags]")
         return 0
     cmd, rest = argv[0], argv[1:]
     if cmd == "version":
@@ -70,6 +70,13 @@ def main(argv=None) -> int:
         from paddle_tpu.observability.serving import main as serve_report_main
 
         return serve_report_main(rest)
+    if cmd == "lint":
+        # static analysis over the package's own invariants
+        # (doc/static_analysis.md) — jax-free: this is the CI gate and
+        # runs before the accelerator runtime exists
+        from paddle_tpu.analysis.cli import main as lint_main
+
+        return lint_main(rest)
     if cmd == "faults":
         return _faults()
     print(f"unknown command {cmd!r}", file=sys.stderr)

@@ -10,7 +10,12 @@ may be:
   (count / seconds / cache hits), and worst time-to-first-step;
 - a **bench artifact**: a ``BENCH_*.json`` driver record (the last
   parseable result line inside its ``tail``), or a raw bench JSON line
-  file — compared on the headline value plus every numeric leg.
+  file — compared on the headline value plus every numeric leg;
+- a **lint artifact** (``paddle lint --json`` output): compared on the
+  total and per-rule NEW-finding counts from the ``lint_summary``
+  record — all lower-is-better, zero-filled from the summary's rule
+  list so a rule going 0 → N is judged (REGRESSION, exit 1) instead of
+  falling into ``only_b``.
 
 Every shared metric gets a relative delta and a per-metric verdict
 against a noise threshold (``--threshold``, default 5%): metrics where
@@ -73,6 +78,11 @@ def _higher_is_better(name: str) -> bool:
     if name in _HIGHER_BETTER:
         return _HIGHER_BETTER[name]
     n = name.lower()
+    # lint metrics are finding counts: fewer is always better (and the
+    # bare rule ids would otherwise fall through to the throughput
+    # default below)
+    if n.startswith("lint"):
+        return False
     # serving metrics (doc/observability.md "Serving telemetry"):
     # goodput and the saturation knee are throughput-like; latency/TTFT/
     # queue-wait fall through to the lower-is-better suffixes below
@@ -167,12 +177,10 @@ def _bench_lines(text: str) -> List[Dict[str, Any]]:
     return [rec for rec in obs.parse_record_lines(text) if "metric" in rec]
 
 
-def _bench_side(path: str) -> Dict[str, float]:
+def _bench_side(path: str, raw: str) -> Dict[str, float]:
     """Comparable scalars of one bench artifact: the headline value plus
     every numeric leg/extras field (compile_s, cache-hit counts included
     — bench records carry them since the compile-telemetry PR)."""
-    with open(path) as f:
-        raw = f.read()
     try:
         doc = json.loads(raw)
     except ValueError:
@@ -234,9 +242,65 @@ def _bench_side(path: str) -> Dict[str, float]:
     return out
 
 
+# ------------------------------------------------------------ lint sides
+
+
+def _lint_side(raw: str) -> Optional[Dict[str, float]]:
+    """Comparable scalars of a ``paddle lint --json`` artifact, or None
+    when the text carries no lint records (so bench/run detection can
+    proceed). Counts are NEW (non-baselined) findings; per-rule keys
+    are zero-filled from the summary's rule list so both sides share
+    every rule key and 0 -> N drift gets a verdict (new-findings
+    regression => exit 1) instead of landing in only_b."""
+    recs = list(obs.parse_record_lines(raw))
+    summaries = [r for r in recs if r.get("kind") == "lint_summary"]
+    if summaries:
+        s = summaries[-1]  # re-run appended to the same file: last wins
+        counts = s.get("counts") or {}
+        out = {"lint_findings": float(s.get("findings") or 0)}
+        for rid in (s.get("rules") or sorted(counts)):
+            out[f"lint.{rid}"] = float(counts.get(rid, 0))
+        return out
+    findings = [r for r in recs if r.get("kind") == "lint_finding"]
+    if findings:
+        # summary-less stream (filtered/truncated): count what's there
+        out = {"lint_findings": 0.0}
+        for r in findings:
+            if r.get("baselined"):
+                continue
+            out["lint_findings"] += 1.0
+            key = f"lint.{r.get('rule', '?')}"
+            out[key] = out.get(key, 0.0) + 1.0
+        return out
+    return None
+
+
+def _probe_lint(path: str) -> bool:
+    """O(1) probe for a lint artifact — a multi-hundred-MB run stream
+    must NOT be read (let alone JSON-parsed) just to learn it is not
+    one (read_records streams it later). `paddle lint --json` writes a
+    lint record as its very first line, so the first 64 KB decide."""
+    try:
+        with open(path) as f:
+            head = f.read(65536)
+    except OSError:
+        return False
+    return '"lint_summary"' in head or '"lint_finding"' in head
+
+
 def load_side(path: str) -> Dict[str, float]:
-    if os.path.isfile(path) and not path.endswith(".jsonl"):
-        return _bench_side(path)
+    if os.path.isfile(path):
+        if path.endswith(".jsonl") and not _probe_lint(path):
+            pass  # run stream: fall through to the streaming analyzer
+        else:
+            # ONE read serves both file-artifact detectors (lint, bench)
+            with open(path) as f:
+                raw = f.read()
+            lint = _lint_side(raw)
+            if lint is not None:
+                return lint
+            if not path.endswith(".jsonl"):
+                return _bench_side(path, raw)
     if not obs.metrics_files(path):
         raise ValueError(
             f"{path!r} is neither a bench artifact nor a run dir with "

@@ -48,9 +48,12 @@ FILE_FMT = "metrics.host%d.jsonl"
 # (request/serve_window: a serving run killed mid-rung must leave every
 # finished request's latency on disk — the whole point of the records.
 # The per-record append this buys costs ~tens of µs and is charged,
-# honestly, to the serve loop's host_share; telemetry-off pays nothing)
+# honestly, to the serve loop's host_share; telemetry-off pays nothing).
+# Historical note: a "crash" kind rode here for five PRs without any
+# emitter — the supervisor writes crash_report.json, not a record —
+# and was removed when `paddle lint` (PTL007) flagged the drift.
 FLUSH_KINDS = frozenset(
-    {"run_start", "run_end", "pass_end", "checkpoint", "crash",
+    {"run_start", "run_end", "pass_end", "checkpoint",
      "barrier_skew", "restart", "compile", "roofline",
      "request", "serve_window"}
 )
@@ -58,13 +61,35 @@ FLUSH_KINDS = frozenset(
 # required keys of every record; kind-specific fields ride alongside
 REQUIRED_KEYS = ("v", "kind", "host", "t")
 
-# kind-specific required fields (doc/observability.md) — the serving
-# telemetry contract the continuous-batching server must keep: a
-# request record without an id/outcome, or a window without its rung
-# and offered load, is unanalyzable
+# Kind-specific required fields, one entry per documented record kind
+# (doc/observability.md "Record kinds") — `paddle lint` rule PTL007
+# keeps this registry, the doc table, and the emit call sites in sync:
+# an emitted kind missing here (or documented here but emitted nowhere)
+# is a lint finding. An empty tuple means "envelope only"; non-empty
+# tuples are the fields without which the record is unanalyzable, and
+# validate_record enforces them. `bench` is emitted by bench.py;
+# `lint_finding`/`lint_summary` by `paddle lint --json` — both outside
+# this package's writer, same schema.
 KIND_REQUIRED = {
+    "run_start": ("wall_time",),
+    "run_end": ("status",),
+    "train_window": (),
+    "pass_end": (),
+    "test": (),
+    "checkpoint": ("op",),
+    "nonfinite": ("value", "policy"),
+    "fault": ("site", "action"),
+    "barrier_skew": ("skew_s",),
+    "preempt": (),
+    "hang": ("age_s",),
+    "bench": ("metric", "value"),
+    "restart": ("restore_s",),
+    "compile": ("group", "sig"),
+    "roofline": ("group", "sig"),
     "request": ("id", "outcome"),
     "serve_window": ("rung", "offered_rps"),
+    "lint_finding": ("rule", "path", "line"),
+    "lint_summary": ("findings", "counts"),
 }
 
 
@@ -258,7 +283,7 @@ class MetricsWriter:
         # monotonic offset from this instant
         self.emit(
             "run_start",
-            wall_time=time.time(),
+            wall_time=time.time(),  # lint: disable=PTL001 -- run_start anchor: the one read that maps t-offsets to civil time
             wall_time_iso=time.strftime("%Y-%m-%dT%H:%M:%S%z"),
             hostname=socket.gethostname(),
             pid=os.getpid(),

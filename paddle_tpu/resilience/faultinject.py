@@ -140,7 +140,7 @@ class _Rule:
             code = int(self.arg) if self.arg else 3
             # os._exit: no atexit, no finally — the honest simulation of
             # a preemption landing mid-write
-            os._exit(code)
+            os._exit(code)  # lint: disable=PTL006 -- FaultInjector.fire flushes the fault record before dispatching any action (evidence-before-action)
         if self.action == "sleep":
             time.sleep(float(self.arg) if self.arg else 3600.0)
 

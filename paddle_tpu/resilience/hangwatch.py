@@ -199,11 +199,16 @@ class HangWatch:
             if age > self._max_age:
                 self._max_age = age
             where = self._where
+            # claim the firing under the lock: check() is driven by the
+            # monitor thread in production AND directly by fake-clock
+            # tests — an unlocked test-and-set could file two reports
+            fire = age > self.timeout_s and not self._fired
+            if fire:
+                self._fired = True  # one report even if exit_fn returns (tests)
         from paddle_tpu.observability import metrics as obs
 
         obs.registry().gauge("trainer.progress_age_s").set(age)
-        if age > self.timeout_s and not self._fired:
-            self._fired = True  # one report even if exit_fn returns (tests)
+        if fire:
             self._trigger(age, where)
         return age
 

@@ -153,23 +153,47 @@ class HeartbeatWriter:
         self.interval_s = float(interval_s)
         self.clock = clock
         self._seq = 0
+        # beat() runs on BOTH the daemon renewal thread and the caller
+        # (start's synchronous first beat, stop's final one) — the seq
+        # increment must not tear between them, and monitors rely on
+        # seq to be strictly increasing per host
+        self._seq_lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
     def beat(self, **extra) -> None:
         from paddle_tpu.utils.logging import logger
 
-        self._seq += 1
+        # the lock serializes the WHOLE beat, not just the increment:
+        # stop()'s final beat can overlap a daemon-thread beat stuck in
+        # slow-fs I/O past the join timeout, and both share the same
+        # pid-keyed tmp file — an unserialized pair can tear the write
+        # or publish seq N over seq N+1, breaking the strictly-
+        # increasing contract monitors rely on. BOUNDED acquire: when
+        # the holder is wedged in dead-fs I/O, the caller (stop() at
+        # shutdown) must not inherit the wedge — skipping the beat and
+        # letting the monitor see staleness is the honest outcome, same
+        # rationale as the OSError swallow below
+        if not self._seq_lock.acquire(timeout=max(self.interval_s, 1.0)):
+            logger.warning(
+                "heartbeat: beat skipped for host %d — a concurrent beat "
+                "holds the lock (wedged shared-fs write?)", self.host,
+            )
+            return
         try:
-            write_beat(self.dir, self.host, seq=self._seq,
-                       clock=self.clock,
-                       extra={"interval_s": self.interval_s, **extra})
-        except OSError as e:
-            # liveness reporting must never kill the run it reports on;
-            # the monitor sees a stale beat and names this host, which
-            # is the honest outcome if the shared fs is gone
-            logger.warning("heartbeat write failed for host %d: %s",
-                           self.host, e)
+            self._seq += 1  # lint: disable=PTL005 -- _seq_lock IS held: acquired with a timeout above (bounded acquire has no with-form), released in the finally
+            try:
+                write_beat(self.dir, self.host, seq=self._seq,
+                           clock=self.clock,
+                           extra={"interval_s": self.interval_s, **extra})
+            except OSError as e:
+                # liveness reporting must never kill the run it reports
+                # on; the monitor sees a stale beat and names this host,
+                # which is the honest outcome if the shared fs is gone
+                logger.warning("heartbeat write failed for host %d: %s",
+                               self.host, e)
+        finally:
+            self._seq_lock.release()
 
     def start(self) -> "HeartbeatWriter":
         if self._thread is None:

@@ -322,7 +322,11 @@ class AsyncCheckpointer:
             if self.hangwatch is not None:
                 self.hangwatch.ping(job.pass_id)
         dt = time.perf_counter() - t0
-        self.completed += 1
+        # under the cv: drain() reads `completed` (from the step-loop
+        # thread) as its writer-progress signal — a torn increment would
+        # read as "no progress" and misdiagnose a live drain as a hang
+        with self._cv:
+            self.completed += 1
         obs.registry().counter("ckpt.write_s").inc(dt)
         if job.on_durable is not None:
             try:
@@ -596,11 +600,13 @@ class ShardedAsyncCheckpointer(AsyncCheckpointer):
             if self.hangwatch is not None:
                 self.hangwatch.ping(job.pass_id)
         dt = time.perf_counter() - t0
-        self.completed += 1
         obs.registry().counter("ckpt.write_s").inc(dt)
         # the written pieces are on disk now — keep only the tree bases
         # (what the commit merge needs), so a pass awaiting its
         # agreement does not pin a full host copy of this host's shards
         job.snapshot = dict.fromkeys(job.snapshot)
+        # `completed` under the cv with the durable list: drain() reads
+        # both from the step-loop thread as its writer-progress signal
         with self._cv:
+            self.completed += 1
             self._durable.append(job)

@@ -1128,7 +1128,7 @@ class Trainer:
         self._pass_train_s = 0.0
         self._pass_flops_incomplete = False
         self._lsgd_discarded = 0
-        t0 = time.time()
+        t0 = time.monotonic()  # rate clock: immune to NTP steps mid-pass
         pass_t0 = time.perf_counter()  # span + pass_time_s clock
         batch_id = 0
         step_times: list = []
@@ -1235,7 +1235,7 @@ class Trainer:
                 # ONE device→host transfer per launch (losses + kept
                 # outputs together); numpy slicing below adds no further
                 # device dispatches
-                losses_host, keeps_host = jax.device_get((losses, keeps))
+                losses_host, keeps_host = jax.device_get((losses, keeps))  # lint: disable=PTL002 -- the one designed sync: amortized over the k-batch launch, feeds the nonfinite gate
                 losses_host = np.asarray(losses_host)
                 if faultinject.is_active():
                     losses_host = np.asarray([
@@ -1301,7 +1301,7 @@ class Trainer:
                             analytic_flops=self._flops_cache.get(launch_key),
                             pass_id=pass_id, step=batch_id,
                         )
-                loss_f = self._poisoned_loss(float(loss), pass_id, batch_id)
+                loss_f = self._poisoned_loss(float(loss), pass_id, batch_id)  # lint: disable=PTL002 -- single-step path: the per-launch loss read IS the nonfinite gate
                 step_dt = time.perf_counter() - t_step
                 self._pass_train_s += step_dt
                 if launch_key is not None:
@@ -1434,7 +1434,7 @@ class Trainer:
             jax.profiler.stop_trace()
             logger.info("profiler trace written to %s", self.flags.profile_dir)
         self._end_dot_line()
-        dt = time.time() - t0
+        dt = time.monotonic() - t0
         rate = stats.total_samples / max(dt, 1e-9)
         mfu_fields = self._mfu_fields()
         logger.info(

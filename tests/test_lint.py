@@ -1,0 +1,565 @@
+"""`paddle lint` — rule fixtures, suppressions, baseline, repo gate.
+
+One golden fixture pair per rule (a violating snippet the rule must
+flag, a clean twin it must stay silent on), the mandatory-reason
+suppression contract, the baseline round trip, the doc/catalog
+reverse-consistency check, `--json` schema validation, the
+`paddle compare` lint diff, and the repo-wide run that IS the CI gate:
+zero non-baselined findings over paddle_tpu/.
+
+Everything here is jax-free and fast (<10 s) so the gate executes even
+when the tier-1 window truncates the suite.
+"""
+
+import json
+import os
+import re
+import textwrap
+
+import pytest
+
+from paddle_tpu.analysis import ALL_RULES, load_baseline, run_lint, write_baseline
+from paddle_tpu.analysis.baseline import BASELINE_NAME
+from paddle_tpu.analysis.cli import main as lint_main
+from paddle_tpu.observability import metrics as obs
+
+pytestmark = pytest.mark.lint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def lint_tree(tmp_path, files):
+    """Write {relpath: source} under tmp_path and lint the tree."""
+    for rel, text in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(text))
+    return run_lint([str(tmp_path)])
+
+
+def rules_of(result):
+    return sorted({f.rule for f in result.findings})
+
+
+# --------------------------------------------------------- fixture pairs
+
+
+def test_ptl001_wall_clock_pair(tmp_path):
+    viol = lint_tree(tmp_path / "v", {"observability/win.py": """\
+        import time
+
+        def window_start():
+            return time.time()
+        """})
+    assert [f.rule for f in viol.findings] == ["PTL001"]
+    assert "time.time" in viol.findings[0].message
+    clean = lint_tree(tmp_path / "c", {"observability/win.py": """\
+        import time
+
+        def window_start():
+            return time.monotonic()
+        """})
+    assert clean.findings == []
+
+
+def test_ptl001_scoped_to_hot_path_modules(tmp_path):
+    # the same wall-clock read OUTSIDE the hot-path module list (e.g. a
+    # supervisor-side module) is not this rule's business
+    res = lint_tree(tmp_path, {"resilience/supervisor.py": """\
+        import time
+
+        def stamp():
+            return time.time()
+        """})
+    assert res.findings == []
+
+
+def test_ptl002_host_sync_pair(tmp_path):
+    viol = lint_tree(tmp_path / "v", {"trainer/trainer.py": """\
+        def train_one_pass(provider, train_step, params, log):
+            for batch in provider:
+                params, loss = train_step(params, batch)
+                log(float(loss))
+        """})
+    assert [f.rule for f in viol.findings] == ["PTL002"]
+    assert "float" in viol.findings[0].message
+    # clean twin: the loss stays on device inside the loop; the read
+    # happens at the pass boundary (outside the loop body)
+    clean = lint_tree(tmp_path / "c", {"trainer/trainer.py": """\
+        def train_one_pass(provider, train_step, params, log):
+            loss = None
+            for batch in provider:
+                params, loss = train_step(params, batch)
+            log(float(loss))
+        """})
+    assert clean.findings == []
+
+
+def test_ptl002_while_test_is_per_iteration(tmp_path):
+    # a while's condition re-evaluates every iteration — a sync there
+    # is a per-step stall exactly like one in the body
+    res = lint_tree(tmp_path, {"trainer/trainer.py": """\
+        def train_one_pass(provider, train_step, params, done):
+            loss = None
+            while loss is None or not done(float(loss)):
+                params, loss = train_step(params, next(provider))
+        """})
+    assert [f.rule for f in res.findings] == ["PTL002"]
+
+
+def test_ptl003_use_after_donate_pair(tmp_path):
+    viol = lint_tree(tmp_path / "v", {"engine.py": """\
+        import jax
+
+        def run(update, params, batch):
+            step = jax.jit(update, donate_argnums=(0,))
+            new_params = step(params, batch)
+            return params, new_params
+        """})
+    assert [f.rule for f in viol.findings] == ["PTL003"]
+    assert "`params`" in viol.findings[0].message
+    clean = lint_tree(tmp_path / "c", {"engine.py": """\
+        import jax
+
+        def run(update, params, batch):
+            step = jax.jit(update, donate_argnums=(0,))
+            params = step(params, batch)
+            return params
+        """})
+    assert clean.findings == []
+
+
+def test_ptl004_recompile_hazard_pair(tmp_path):
+    viol = lint_tree(tmp_path / "v", {"sig.py": """\
+        import jax
+
+        scale_table = [1.0, 2.0]
+
+        @jax.jit
+        def scaled(x):
+            return x * scale_table[0]
+
+        def sig_of(shapes):
+            return tuple(shapes.items())
+        """})
+    assert [f.rule for f in viol.findings] == ["PTL004", "PTL004"]
+    msgs = " / ".join(f.message for f in viol.findings)
+    assert "scale_table" in msgs and "iteration order" in msgs
+    clean = lint_tree(tmp_path / "c", {"sig.py": """\
+        import jax
+
+        SCALE_TABLE = (1.0, 2.0)
+
+        @jax.jit
+        def scaled(x):
+            return x * SCALE_TABLE[0]
+
+        def sig_of(shapes):
+            return tuple(sorted(shapes.items()))
+        """})
+    assert clean.findings == []
+
+
+def test_ptl005_unlocked_thread_write_pair(tmp_path):
+    viol = lint_tree(tmp_path / "v", {"writer.py": """\
+        import threading
+
+        class Writer:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.completed = 0
+
+            def start(self):
+                threading.Thread(target=self._run, daemon=True).start()
+
+            def _run(self):
+                self._write()
+
+            def _write(self):
+                self.completed += 1
+        """})
+    assert [f.rule for f in viol.findings] == ["PTL005"]
+    assert "completed" in viol.findings[0].message
+    clean = lint_tree(tmp_path / "c", {"writer.py": """\
+        import threading
+
+        class Writer:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.completed = 0
+
+            def start(self):
+                threading.Thread(target=self._run, daemon=True).start()
+
+            def _run(self):
+                self._write()
+
+            def _write(self):
+                with self._lock:
+                    self.completed += 1
+        """})
+    assert clean.findings == []
+
+
+def test_ptl006_exit_without_flush_pair(tmp_path):
+    viol = lint_tree(tmp_path / "v", {"faults.py": """\
+        import os
+
+        def fire(obs):
+            obs.emit("fault", site="x", action="exit")
+            os._exit(3)
+        """})
+    assert [f.rule for f in viol.findings] == ["PTL006"]
+    clean = lint_tree(tmp_path / "c", {"faults.py": """\
+        import os
+
+        def fire(obs):
+            obs.emit("fault", site="x", action="exit")
+            obs.flush()
+            os._exit(3)
+        """})
+    assert clean.findings == []
+
+
+_PTL007_REGISTRIES = {
+    "observability/metrics.py": """\
+        KIND_REQUIRED = {
+            "pass_end": (),
+        }
+        FLUSH_KINDS = frozenset({"pass_end", "ghost"})
+        """,
+    "resilience/faultinject.py": """\
+        SITE_DOCS = {
+            "checkpoint.write": "before each checkpoint file write",
+            "phantom.site": "documented but never planted",
+        }
+        """,
+    "doc_stub": """\
+        ### Record kinds
+
+        | kind | emitted by | fields |
+        |---|---|---|
+        | `pass_end` | pass boundary | samples |
+        """,
+}
+
+
+def test_ptl007_registry_drift_pair(tmp_path):
+    files = dict(_PTL007_REGISTRIES)
+    doc = files.pop("doc_stub")
+    (tmp_path / "v" / "doc").mkdir(parents=True)
+    (tmp_path / "v" / "doc" / "observability.md").write_text(
+        textwrap.dedent(doc)
+    )
+    viol = lint_tree(tmp_path / "v", dict(files, **{
+        "trainer/trainer.py": """\
+            def run(emit, fault_point):
+                fault_point("checkpoint.write")
+                fault_point("trainer.unknown")
+                emit("pass_end", samples=1)
+                emit("mystery", foo=2)
+            """,
+    }))
+    msgs = [f.message for f in viol.findings]
+    assert all(f.rule == "PTL007" for f in viol.findings)
+    assert any("`mystery`" in m and "KIND_REQUIRED" in m for m in msgs)
+    assert any("`mystery`" in m and "undocumented" in m for m in msgs)
+    assert any("`ghost`" in m for m in msgs)
+    assert any("`trainer.unknown`" in m for m in msgs)
+    assert any("`phantom.site`" in m for m in msgs)
+
+    (tmp_path / "c" / "doc").mkdir(parents=True)
+    (tmp_path / "c" / "doc" / "observability.md").write_text(
+        textwrap.dedent(doc)
+    )
+    clean = lint_tree(tmp_path / "c", {
+        "observability/metrics.py": """\
+            KIND_REQUIRED = {
+                "pass_end": (),
+            }
+            FLUSH_KINDS = frozenset({"pass_end"})
+            """,
+        "resilience/faultinject.py": """\
+            SITE_DOCS = {
+                "checkpoint.write": "before each checkpoint file write",
+            }
+            """,
+        "trainer/trainer.py": """\
+            def run(emit, fault_point):
+                fault_point("checkpoint.write")
+                emit("pass_end", samples=1)
+            """,
+    })
+    assert clean.findings == []
+
+
+# ----------------------------------------------------------- suppressions
+
+
+def test_suppression_with_reason_silences(tmp_path):
+    res = lint_tree(tmp_path, {"observability/win.py": """\
+        import time
+
+        def window_start():
+            return time.time()  # lint: disable=PTL001 -- civil-time anchor for this fixture
+        """})
+    assert res.findings == []
+
+
+def test_suppression_on_comment_line_above(tmp_path):
+    res = lint_tree(tmp_path, {"observability/win.py": """\
+        import time
+
+        def window_start():
+            # lint: disable=PTL001 -- civil-time anchor for this fixture
+            return time.time()
+        """})
+    assert res.findings == []
+
+
+def test_suppression_trailing_a_wrapped_call(tmp_path):
+    # black-style wrapped call: the suppression lands on the closing-
+    # paren line; it must still govern the finding anchored to line 1
+    # of the call's span
+    res = lint_tree(tmp_path, {"observability/win.py": """\
+        import time
+
+        def window_start(fmt):
+            return fmt(
+                time.time(),
+                precision=6,
+            )  # lint: disable=PTL001 -- civil-time anchor for this fixture
+        """})
+    assert res.findings == []
+
+
+def test_suppression_requires_reason(tmp_path):
+    # a reason-less suppression suppresses NOTHING and is itself a
+    # finding (PTL000) — both must surface
+    res = lint_tree(tmp_path, {"observability/win.py": """\
+        import time
+
+        def window_start():
+            return time.time()  # lint: disable=PTL001
+        """})
+    assert rules_of(res) == ["PTL000", "PTL001"]
+    ptl000 = [f for f in res.findings if f.rule == "PTL000"][0]
+    assert "reason" in ptl000.message
+
+
+# --------------------------------------------------------------- baseline
+
+
+def test_baseline_round_trip(tmp_path):
+    tree = tmp_path / "t"
+    files = {"observability/a.py": """\
+        import time
+
+        def one():
+            return time.time()
+        """}
+    res = lint_tree(tree, files)
+    assert [f.rule for f in res.findings] == ["PTL001"]
+
+    # grandfather everything; the re-run reports zero NEW findings
+    bl_path = str(tmp_path / BASELINE_NAME)
+    write_baseline(bl_path, res.findings)
+    doc = load_baseline(bl_path)
+    assert len(doc["findings"]) == 1
+    again = run_lint([str(tree)], baseline=doc)
+    assert again.new == [] and len(again.findings) == 1
+    assert again.findings[0].baselined
+
+    # a NEW violation in another file is not covered by the baseline
+    (tree / "observability" / "b.py").write_text(
+        "import time\n\ndef two():\n    return time.time()\n"
+    )
+    drift = run_lint([str(tree)], baseline=doc)
+    assert len(drift.new) == 1 and drift.new[0].path.endswith("b.py")
+    # fingerprints are line-independent: shifting a.py's finding down
+    # must not invalidate its baseline entry
+    (tree / "observability" / "a.py").write_text(
+        "import time\n\n\n\ndef one():\n    return time.time()\n"
+    )
+    shifted = run_lint([str(tree)], baseline=doc)
+    assert [f.path for f in shifted.new] == [drift.new[0].path]
+    assert not shifted.stale_baseline
+
+
+# ------------------------------------------------------------ CLI / JSON
+
+
+def test_cli_json_records_validate(tmp_path, capsys):
+    (tmp_path / "observability").mkdir(parents=True)
+    (tmp_path / "observability" / "w.py").write_text(
+        "import time\n\ndef f():\n    return time.time()\n"
+    )
+    rc = lint_main([str(tmp_path), "--json", "--no-baseline"])
+    out = capsys.readouterr().out
+    recs = [json.loads(line) for line in out.splitlines() if line.strip()]
+    assert rc == 1
+    assert [r["kind"] for r in recs] == ["lint_finding", "lint_summary"]
+    for rec in recs:
+        assert obs.validate_record(rec) == [], rec
+    assert recs[-1]["counts"] == {"PTL001": 1}
+    assert set(recs[-1]["rules"]) == set(ALL_RULES)
+    assert recs[-1]["skipped"] == 0 and recs[-1]["stale_baseline"] == 0
+
+
+def test_json_summary_reports_skipped_files(tmp_path, capsys):
+    # coverage honesty: a syntax-error file scans nothing — the --json
+    # summary must say so instead of letting a gate read shrunken
+    # coverage as "clean"
+    (tmp_path / "broken.py").write_text("def oops(:\n")
+    rc = lint_main([str(tmp_path), "--json", "--no-baseline"])
+    cap = capsys.readouterr()
+    summary = json.loads(cap.out.splitlines()[-1])
+    assert rc == 0 and summary["skipped"] == 1
+    assert "broken.py" in cap.err
+
+
+def test_cli_clean_tree_exits_zero(tmp_path, capsys):
+    (tmp_path / "m.py").write_text("x = 1\n")
+    assert lint_main([str(tmp_path)]) == 0
+
+
+def test_compare_diffs_lint_runs(tmp_path, capsys):
+    """`paddle compare a.jsonl b.jsonl` on two lint artifacts: growing
+    per-rule counts are a REGRESSION (exit 1); identical runs are not."""
+    from paddle_tpu.observability.compare import main as compare_main
+
+    def artifact(name, n_viol):
+        d = tmp_path / name
+        (d / "observability").mkdir(parents=True)
+        for i in range(n_viol):
+            (d / "observability" / f"v{i}.py").write_text(
+                f"import time\n\ndef f{i}():\n    return time.time()\n"
+            )
+        lint_main([str(d), "--json", "--no-baseline"])
+        path = tmp_path / f"{name}.jsonl"
+        path.write_text(capsys.readouterr().out)
+        return str(path)
+
+    a, b = artifact("a", 1), artifact("b", 2)
+    assert compare_main([a, b]) == 1  # new finding => regression
+    out = capsys.readouterr().out
+    assert "REGRESSION" in out and "lint.PTL001" in out
+    assert compare_main([a, a]) == 0
+    assert "NO CHANGE" in capsys.readouterr().out
+    # direction-aware: fewer findings is an improvement, not a regression
+    assert compare_main([b, a]) == 0
+    assert "IMPROVED" in capsys.readouterr().out
+
+
+# ----------------------------------------------------- repo-wide CI gate
+
+
+def test_repo_wide_lint_zero_new_findings():
+    """THE gate: `paddle lint paddle_tpu/` is clean modulo the checked-in
+    baseline, which stays within its grandfathering budget."""
+    pkg = os.path.join(REPO, "paddle_tpu")
+    bl_path = os.path.join(REPO, BASELINE_NAME)
+    assert os.path.isfile(bl_path), "checked-in lint baseline missing"
+    doc = load_baseline(bl_path)
+    assert len(doc["findings"]) <= 10, (
+        "grandfathering budget exceeded — fix or suppress (with reasons) "
+        "instead of growing the baseline"
+    )
+    res = run_lint([pkg], baseline=doc)
+    assert res.files_scanned > 50
+    assert res.new == [], "new lint findings:\n" + "\n".join(
+        f.render() for f in res.new
+    )
+    assert not res.stale_baseline, (
+        "baseline entries no longer match — regenerate with "
+        "`paddle lint paddle_tpu/ --write-baseline`: "
+        + ", ".join(res.stale_baseline)
+    )
+    # every suppression in the tree carried a reason, or PTL000 would
+    # have surfaced above. (Deliberately NO assertion that the baseline
+    # is non-empty: fixing the grandfathered findings and shrinking the
+    # baseline to [] is the encouraged end state.)
+
+
+def test_subset_write_baseline_keeps_out_of_scope_entries(tmp_path, capsys):
+    """`--write-baseline` over a subset must carry forward grandfathered
+    entries for files the scan never saw."""
+    tree = tmp_path / "t"
+    for sub in ("observability", "trainer"):
+        (tree / sub).mkdir(parents=True)
+        (tree / sub / "m.py").write_text(
+            "import time\n\ndef f():\n    return time.time()\n"
+        )
+    bl_path = str(tmp_path / BASELINE_NAME)
+    # full-tree baseline: only observability/m.py is PTL001-scoped
+    # (trainer/m.py is not a hot-path file), so exactly 1 entry
+    lint_main([str(tree), "--write-baseline", "--baseline", bl_path])
+    capsys.readouterr()
+    full = load_baseline(bl_path)
+    assert len(full["findings"]) == 1  # only observability/m.py matches PTL001
+    # subset regeneration over trainer/ must not drop the entry
+    lint_main([str(tree / "trainer"), "--write-baseline",
+               "--baseline", bl_path])
+    capsys.readouterr()
+    merged = load_baseline(bl_path)
+    assert merged["findings"] == full["findings"]
+
+
+def test_subset_scan_keeps_out_of_scope_baseline_quiet():
+    """A subset run must not call the full tree's grandfathered entries
+    stale (the advice to --write-baseline would drop them)."""
+    doc = load_baseline(os.path.join(REPO, BASELINE_NAME))
+    res = run_lint(
+        [os.path.join(REPO, "paddle_tpu", "observability")], baseline=doc
+    )
+    assert res.stale_baseline == []
+    assert res.new == [], "\n".join(f.render() for f in res.new)
+
+
+def test_subset_scan_of_registry_module_has_no_spurious_drift():
+    """Scanning resilience/ alone sees SITE_DOCS but none of the
+    trainer/feeder/checkpoint planting sites — that must not read as
+    'every documented site is unplanted'."""
+    res = run_lint([os.path.join(REPO, "paddle_tpu", "resilience")])
+    drift = [f for f in res.findings if f.rule == "PTL007"]
+    assert drift == [], "\n".join(f.render() for f in drift)
+
+
+def test_baseline_entry_for_deleted_file_goes_stale(tmp_path):
+    """Entries whose file vanished must be reported stale (and dropped
+    by --write-baseline), never carried forward forever."""
+    tree = tmp_path / "t"
+    (tree / "observability").mkdir(parents=True)
+    # a marked root: deletion detection needs stable entry paths
+    (tree / "pyproject.toml").write_text("")
+    target = tree / "observability" / "gone.py"
+    target.write_text("import time\n\ndef f():\n    return time.time()\n")
+    res = run_lint([str(tree)])
+    bl_path = str(tmp_path / BASELINE_NAME)
+    write_baseline(bl_path, res.findings)
+    target.unlink()
+    stale = run_lint([str(tree)], baseline=load_baseline(bl_path))
+    assert stale.stale_baseline == [res.findings[0].fingerprint]
+
+
+def test_doc_catalog_reverse_consistency():
+    """Every implemented rule ID is documented in doc/static_analysis.md
+    and every documented ID is implemented (PTL007's discipline applied
+    to the linter itself)."""
+    path = os.path.join(REPO, "doc", "static_analysis.md")
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    documented = set(re.findall(r"PTL\d{3}", text))
+    implemented = set(ALL_RULES)
+    assert documented == implemented, (
+        f"doc/static_analysis.md vs ALL_RULES drift: "
+        f"undocumented={sorted(implemented - documented)} "
+        f"unimplemented={sorted(documented - implemented)}"
+    )
+
+
+def test_lint_marker_registered():
+    with open(os.path.join(REPO, "pyproject.toml"), encoding="utf-8") as f:
+        assert re.search(r'^\s*"lint:', f.read(), re.MULTILINE), (
+            "lint pytest marker missing from pyproject.toml"
+        )
