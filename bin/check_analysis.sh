@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# The pre-review analysis gate: `paddle lint` (static, PTL001-PTL008)
+# then `paddle race` (dynamic: schedule explorer + lock-order /
+# torn-read / lost-wakeup detectors), each against its checked-in
+# baseline (lint: .paddle_lint_baseline.json, race:
+# .paddle_race_baseline.json — BOTH empty; keep them that way).
+#
+# Wired into the test suite as tests/test_race.py's gate tests; run it
+# directly before sending a PR that touches threads, locks, queues, or
+# telemetry:
+#
+#   bin/check_analysis.sh [--schedules K]
+#
+# jax-free end to end, finishes in seconds. Exit: 0 clean, nonzero on
+# any new finding (the offending findings are printed with replay
+# seeds/traces).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+SCHEDULES=24
+if [[ "${1:-}" == "--schedules" && -n "${2:-}" ]]; then
+  SCHEDULES="$2"
+fi
+
+PY="${PYTHON:-python3}"
+
+echo "== paddle lint =="
+"$PY" -m paddle_tpu.cli lint paddle_tpu
+
+echo "== paddle race (schedules=$SCHEDULES) =="
+"$PY" -m paddle_tpu.cli race --schedules "$SCHEDULES"
+
+echo "== analysis gate clean =="

@@ -1,19 +1,26 @@
-"""`paddle lint` — jax-aware static analysis for the framework's own
-invariants.
+"""`paddle lint` / `paddle race` — the framework's own analysis stack.
 
 Eight PRs of resilience/observability/perf work rest on invariants that
 previously lived only in commit messages: no wall-clock in hot paths,
 no host syncs inside the step loop, recompile-stable launch signatures,
 flush-before-exit for crash evidence, locked shared state on daemon
-threads, and documented record kinds / fault sites. This package turns
-each into a mechanical AST check with a stable rule ID (PTL001-PTL007,
-catalog in doc/static_analysis.md), a mandatory-reason suppression
-syntax (``# lint: disable=PTL00x -- reason``), and a checked-in JSON
-baseline so the CI gate is "zero NEW findings", not "zero findings".
+threads, bounded daemon-thread waits, and documented record kinds /
+fault sites. This package turns each into a mechanical AST check with
+a stable rule ID (PTL001-PTL008, catalog in doc/static_analysis.md), a
+mandatory-reason suppression syntax (``# lint: disable=PTL00x --
+reason``), and a checked-in JSON baseline so the CI gate is "zero NEW
+findings", not "zero findings".
 
-Everything here is stdlib-only (``ast`` + ``re`` + ``json``) and never
-imports jax — ``paddle lint`` must run on a dev laptop, in CI before
-the accelerator runtime exists, and over a tree copied off a pod.
+The ``dynamic`` subpackage is the other half: `paddle race` runs the
+REAL daemon-thread code under a deterministic, seeded schedule
+explorer and proves (or clears) what the AST rules can only suspect —
+torn reads, lock-order inversions, lost wakeups (doc/static_analysis.md
+"Dynamic analysis").
+
+Everything here is stdlib-only (``ast`` + ``re`` + ``json`` +
+``threading`` for the explorer's gated threads) and never imports jax
+— both gates must run on a dev laptop, in CI before the accelerator
+runtime exists, and over a tree copied off a pod.
 """
 
 from paddle_tpu.analysis.core import (  # noqa: F401

@@ -1,0 +1,40 @@
+"""`paddle race` — deterministic schedule exploration for the
+framework's daemon-thread and commit-agreement paths.
+
+PR 9's `paddle lint` (the static half) can say "this write LOOKS
+unlocked"; this package runs the REAL code — the async checkpoint
+writers, the hangwatch monitor, the heartbeat renewer, the feeder
+pool's bounded-queue discipline — under a virtualized threading layer
+(`paddle_tpu/utils/concurrency.py` is the seam) and a deterministic,
+seeded scheduler, then mechanically detects:
+
+- **torn reads** (``detector=torn_read``): happens-before race
+  detection over watched shared attributes — the watch lists are
+  seeded from the same analysis PTL005 runs statically, so "static
+  finds the fields, dynamic proves the race";
+- **lock-order inversions** (``detector=lock_order``): the union
+  lock-order graph across every explored schedule, cycle ⇒ potential
+  deadlock even if no explored schedule hit it;
+- **lost wakeups / deadlocks** (``detector=lost_wakeup`` /
+  ``deadlock``): a quiesced schedule with a non-daemon thread parked
+  forever on a wait no future notify can reach;
+- **schedule-dependent crashes** (``detector=spec_error``): a spec
+  assertion or exception that only some interleaving triggers.
+
+Everything is replayable: a finding carries the seed + thread-switch
+trace, and re-running ``paddle race --spec NAME --seed N --schedules
+K`` reproduces the whole run bit-for-bit. jax-free by construction —
+the specs drive the real classes through their injectable seams.
+"""
+
+from paddle_tpu.analysis.dynamic.explore import (  # noqa: F401
+    DETECTORS,
+    Explorer,
+    SpecContext,
+    load_specs,
+)
+from paddle_tpu.analysis.dynamic.shim import (  # noqa: F401
+    ScheduleAbort,
+    Scheduler,
+    VirtualProvider,
+)

@@ -1,0 +1,188 @@
+"""``paddle race [--seed N] [--schedules K] [--json]`` — the CLI.
+
+jax-free like `paddle lint`: the specs drive the real daemon-thread
+code through its injectable seams, so the whole run executes before
+(and without) the accelerator runtime. Exit codes mirror lint: 0 = no
+new (non-baselined) findings, 1 = new findings, 2 = usage/baseline
+errors.
+
+``--json`` emits one schema-v1 record per finding
+(``kind=race_finding``) plus a closing ``kind=race_summary`` with
+per-detector counts — the artifact ``paddle compare`` diffs between
+two race runs (growth in any detector ⇒ REGRESSION, exit 1).
+
+Replay: the executed schedule set is a pure function of
+``(--seed, --schedules)`` per spec, so re-running the printed command
+reproduces any finding bit-for-bit; every finding also prints its
+thread-switch trace.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+from paddle_tpu.analysis import baseline as bl
+from paddle_tpu.analysis.core import find_repo_root
+from paddle_tpu.analysis.dynamic.explore import (
+    DETECTORS,
+    Explorer,
+    SpecResult,
+    load_specs,
+)
+
+RACE_BASELINE_NAME = ".paddle_race_baseline.json"
+DEFAULT_SEED = 0
+DEFAULT_SCHEDULES = 24
+
+
+def default_specs_dir() -> str:
+    root = find_repo_root([os.getcwd()])
+    return os.path.join(root, "tests", "race_specs")
+
+
+def summary_record(results: List[SpecResult], seed: int) -> Dict[str, Any]:
+    """kind=race_summary (doc/observability.md): the per-detector count
+    surface ``paddle compare`` diffs between two race runs."""
+    new = [f for r in results for f in r.findings if not f.baselined]
+    base = sum(
+        1 for r in results for f in r.findings if f.baselined
+    )
+    counts: Dict[str, int] = {}
+    for f in new:
+        counts[f.rule] = counts.get(f.rule, 0) + 1
+    return {
+        "v": 1, "kind": "race_summary", "host": 0, "t": 0.0,
+        "findings": len(new),
+        "baselined": base,
+        "counts": counts,
+        "detectors": list(DETECTORS),
+        "specs": [r.spec for r in results],
+        "schedules": sum(r.schedules_run for r in results),
+        "exhaustive": [r.spec for r in results if r.exhaustive],
+        "truncated": sum(r.truncated for r in results),
+        "seed": seed,
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="paddle race",
+        description=(
+            "deterministic schedule explorer + lock-order/torn-read/"
+            "lost-wakeup analyzer for the daemon-thread paths "
+            "(doc/static_analysis.md, 'Dynamic analysis')"
+        ),
+    )
+    p.add_argument("--seed", type=int, default=DEFAULT_SEED,
+                   help=f"schedule seed (default {DEFAULT_SEED}); the run "
+                        "is a pure function of (seed, schedules)")
+    p.add_argument("--schedules", type=int, default=DEFAULT_SCHEDULES,
+                   help="schedule budget per spec (default "
+                        f"{DEFAULT_SCHEDULES}): first half bounded-DFS "
+                        "(exhaustive when the tree fits), rest "
+                        "seeded-random")
+    p.add_argument("--spec", action="append", default=None, metavar="NAME",
+                   help="run only the named spec(s) (repeatable)")
+    p.add_argument("--specs", default=None, metavar="DIR",
+                   help="spec directory (default: tests/race_specs under "
+                        "the repo root)")
+    p.add_argument("--step-cap", type=int, default=20000, dest="step_cap",
+                   help="per-schedule scheduling-point cap (livelock "
+                        "backstop; capped schedules are counted as "
+                        "truncated in the summary)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit JSONL race_finding/race_summary records "
+                        "(validate_record-compatible; feed to "
+                        "`paddle compare`)")
+    p.add_argument("--baseline", default=None, metavar="FILE",
+                   help="baseline JSON of grandfathered findings "
+                        f"(default: {RACE_BASELINE_NAME} at the repo "
+                        "root, when present)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore any baseline (report every finding as new)")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="write the current findings to the baseline file "
+                        "and exit 0 (grandfathering)")
+    p.add_argument("--list", action="store_true", dest="list_specs",
+                   help="list discovered specs and exit")
+    args = p.parse_args(argv)
+
+    specs_dir = args.specs or default_specs_dir()
+    try:
+        specs = load_specs(specs_dir, names=args.spec)
+    except (OSError, KeyError, AssertionError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if args.list_specs:
+        for s in specs:
+            doc_lines = (s.__doc__ or "").strip().splitlines()
+            head = doc_lines[0] if doc_lines else ""
+            print(f"{s.NAME}  ({os.path.basename(s.__file__)}): {head}")
+        return 0
+    if not specs:
+        print(f"error: no spec_*.py under {specs_dir!r}", file=sys.stderr)
+        return 2
+
+    repo_root = find_repo_root([specs_dir])
+    baseline_path = args.baseline or os.path.join(repo_root,
+                                                  RACE_BASELINE_NAME)
+    baseline = None
+    if (not args.no_baseline and not args.write_baseline
+            and os.path.isfile(baseline_path)):
+        try:
+            baseline = bl.load_baseline(baseline_path)
+        except (OSError, ValueError) as e:
+            print(f"error: cannot load baseline: {e}", file=sys.stderr)
+            return 2
+
+    explorer = Explorer(seed=args.seed, schedules=args.schedules,
+                        step_cap=args.step_cap)
+    results = explorer.run(specs)
+
+    findings = [f for r in results for f in r.findings]
+    if baseline:
+        allowed: Dict[str, int] = {}
+        for ent in baseline.get("findings", []):
+            fp = ent.get("fingerprint")
+            if fp:
+                allowed[fp] = allowed.get(fp, 0) + 1
+        for f in findings:
+            if allowed.get(f.fingerprint, 0) > 0:
+                allowed[f.fingerprint] -= 1
+                f.baselined = True
+
+    if args.write_baseline:
+        path = args.baseline or os.path.join(repo_root, RACE_BASELINE_NAME)
+        bl.write_baseline(path, findings)
+        print(f"wrote {len(findings)} finding(s) to {path}", file=sys.stderr)
+        return 0
+
+    new = [f for f in findings if not f.baselined]
+    if args.as_json:
+        for f in findings:
+            print(json.dumps(f.record()))
+        print(json.dumps(summary_record(results, args.seed)))
+    else:
+        for f in findings:
+            print(f.render())
+        for r in results:
+            cov = "exhaustive" if r.exhaustive else "budgeted"
+            trunc = (f", {r.truncated} truncated at --step-cap"
+                     if r.truncated else "")
+            print(f"# {r.spec}: {r.schedules_run} schedule(s) [{cov}], "
+                  f"{r.steps} scheduling points{trunc}")
+        print(
+            f"# {len(new)} new finding(s), {len(findings) - len(new)} "
+            f"baselined, {len(results)} spec(s), seed={args.seed} — replay "
+            f"any finding with: paddle race --seed {args.seed} "
+            f"--schedules {args.schedules} --spec <name>"
+        )
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,466 @@
+"""Schedule enumeration + the three race detectors.
+
+Exploration discipline (the PR-1 fault injector's replay-from-seed
+rule, applied to interleavings): the set of schedules executed is a
+pure function of ``(spec, seed, schedules)`` —
+
+- **exhaustive for small state spaces**: a bounded-DFS over the choice
+  tree (a choice point = a moment more than one virtual thread is
+  runnable) runs first, up to half the budget; when the tree fits, the
+  sweep is *complete* and the summary says so;
+- **seeded-random beyond**: the remaining budget runs schedules whose
+  every pick comes from ``random.Random(f"{seed}:{spec}:{i}")`` —
+  deterministic across processes and platforms.
+
+Detectors:
+
+- ``torn_read`` — happens-before races on watched shared attributes
+  (collected by the shim's vector clocks; see shim.py);
+- ``lock_order`` — the UNION lock-order graph across all explored
+  schedules; a strongly connected component with ≥2 locks (or a
+  self-loop) is a potential deadlock even if no explored schedule
+  actually deadlocked;
+- ``lost_wakeup`` / ``deadlock`` — a quiesced schedule left a
+  non-daemon thread parked forever on a wait (cv/event/queue ⇒ lost
+  wakeup, lock ⇒ deadlock) that no runnable or timed thread can ever
+  satisfy;
+- ``spec_error`` — an exception (including a spec's own invariant
+  assertion) only some interleaving raises;
+- ``harness`` — the shim could not serialize the spec (real blocking
+  outside the seam); loud, because coverage silently shrank.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import inspect
+import os
+import random
+import re
+import shutil
+import tempfile
+import traceback
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from paddle_tpu.analysis.rules_concurrency import thread_shared_attrs
+from paddle_tpu.analysis.dynamic import shim
+from paddle_tpu.utils import concurrency as cc
+
+DETECTORS = ("torn_read", "lock_order", "deadlock", "lost_wakeup",
+             "spec_error", "harness")
+
+#: drop ``:<line>`` from primitive names when fingerprinting — findings
+#: must survive edits that only shift lines (same rule as lint's
+#: snippet-hash fingerprints)
+_LINE_RE = re.compile(r":\d+")
+
+
+@dataclass
+class RaceFinding:
+    """One dynamic finding. Field names mirror analysis.core.Finding
+    where they overlap (``rule`` is the detector id) so the PR-9
+    baseline machinery (analysis/baseline.py) serializes these
+    unchanged."""
+
+    rule: str            # detector id, one of DETECTORS
+    spec: str
+    message: str
+    path: str = ""       # repo-relative primary site
+    line: int = 0
+    col: int = 0
+    snippet: str = ""
+    fingerprint: str = ""
+    baselined: bool = False
+    seed: int = 0
+    schedule: str = ""   # e.g. "dfs[1,0]" or "rand#7"
+    trace: str = ""      # compact thread-switch trace
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}: " if self.path else ""
+        base = (f"{loc}{self.rule} [{self.spec}] {self.message}"
+                + ("  [baselined]" if self.baselined else ""))
+        if self.trace:
+            base += (f"\n    replay: seed={self.seed} schedule={self.schedule}"
+                     f"  trace: {self.trace}")
+        return base
+
+    def record(self) -> Dict[str, Any]:
+        """The ``--json`` shape: schema-v1 ``kind=race_finding``
+        (doc/observability.md), same discipline as lint_finding."""
+        return {
+            "v": 1, "kind": "race_finding", "host": 0, "t": 0.0,
+            "detector": self.rule, "spec": self.spec, "path": self.path,
+            "line": self.line, "message": self.message,
+            "snippet": self.snippet, "fingerprint": self.fingerprint,
+            "baselined": self.baselined, "seed": self.seed,
+            "schedule": self.schedule, "trace": self.trace,
+        }
+
+
+@dataclass
+class SpecResult:
+    spec: str
+    findings: List[RaceFinding] = field(default_factory=list)
+    schedules_run: int = 0
+    exhaustive: bool = False
+    truncated: int = 0   # schedules that hit the step cap
+    steps: int = 0
+
+
+def _fp(*parts: str) -> str:
+    return hashlib.sha1("|".join(parts).encode()).hexdigest()[:16]
+
+
+def _rel(path: str) -> str:
+    """Repo-relative rendering of a site path (best-effort)."""
+    from paddle_tpu.analysis.core import find_repo_root
+
+    root = find_repo_root([os.getcwd()])
+    ap = os.path.abspath(path)
+    if ap.startswith(root + os.sep):
+        return os.path.relpath(ap, root).replace(os.sep, "/")
+    return path
+
+
+def _site_str(site: Tuple[str, int, str]) -> str:
+    fn, line, func = site
+    return f"{_rel(fn)}:{line} ({func})"
+
+
+def _stable_site(site: Tuple[str, int, str]) -> str:
+    fn, _line, func = site
+    return f"{os.path.basename(fn)}:{func}"
+
+
+class SpecContext:
+    """Handed to ``spec.run(ctx)``. The spec constructs the code under
+    test as usual (the concurrency seam is already virtualized when
+    run() executes), spawns contention via ``cc.Thread``, and registers
+    watch lists here."""
+
+    def __init__(self, sched: shim.Scheduler):
+        self.sched = sched
+        self.cc = cc
+        self._tmpdir: Optional[str] = None
+
+    @property
+    def tmpdir(self) -> str:
+        if self._tmpdir is None:
+            self._tmpdir = tempfile.mkdtemp(prefix="paddle_race_")
+        return self._tmpdir
+
+    def watch(self, obj: Any, *attrs: str) -> Any:
+        """Watch explicit attributes of ``obj`` for torn reads."""
+        return shim.watch_object(self.sched, obj, attrs)
+
+    def static_watch(self, obj: Any, extra: Iterable[str] = ()) -> Set[str]:
+        """Watch ``obj`` with the PTL005-derived watch list: every
+        self-attribute the static analysis sees referenced on a
+        thread-run path of the class's module — static finds the
+        fields, dynamic proves (or clears) the race."""
+        src_file = inspect.getsourcefile(type(obj))
+        attrs: Set[str] = set(extra)
+        if src_file and os.path.exists(src_file):
+            with open(src_file, encoding="utf-8") as f:
+                attrs |= thread_shared_attrs(f.read(), src_file)
+        if attrs:
+            shim.watch_object(self.sched, obj, attrs)
+        return attrs
+
+    def _cleanup(self) -> None:
+        if self._tmpdir is not None:
+            shutil.rmtree(self._tmpdir, ignore_errors=True)
+            self._tmpdir = None
+
+
+# ------------------------------------------------------------ spec loading
+
+
+def load_specs(specs_dir: str,
+               names: Optional[Sequence[str]] = None) -> List[Any]:
+    """Import every ``spec_*.py`` under ``specs_dir`` (sorted — the
+    run order is part of determinism). A spec module must define
+    ``NAME`` (str) and ``run(ctx)``."""
+    import importlib.util
+
+    out = []
+    if not os.path.isdir(specs_dir):
+        raise FileNotFoundError(f"race specs directory {specs_dir!r} missing")
+    for fname in sorted(os.listdir(specs_dir)):
+        if not (fname.startswith("spec_") and fname.endswith(".py")):
+            continue
+        mod_name = f"paddle_race_specs.{fname[:-3]}"
+        spec = importlib.util.spec_from_file_location(
+            mod_name, os.path.join(specs_dir, fname)
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        assert hasattr(mod, "NAME") and hasattr(mod, "run"), (
+            f"{fname}: a race spec must define NAME and run(ctx)"
+        )
+        if names and mod.NAME not in names:
+            continue
+        out.append(mod)
+    if names:
+        known = {m.NAME for m in out}
+        missing = [n for n in names if n not in known]
+        if missing:
+            raise KeyError(f"unknown spec(s): {', '.join(missing)}")
+    return out
+
+
+# --------------------------------------------------------------- explorer
+
+
+class Explorer:
+    def __init__(self, seed: int = 0, schedules: int = 30,
+                 step_cap: int = 20000):
+        self.seed = int(seed)
+        self.schedules = max(1, int(schedules))
+        self.step_cap = step_cap
+
+    # one schedule
+
+    def _execute(self, spec, chooser, sched_id: str,
+                 result: SpecResult,
+                 edges: Dict[Tuple[str, str], Dict[str, str]]):
+        sched = shim.Scheduler(chooser, step_cap=self.step_cap)
+        ctx = SpecContext(sched)
+        cc.install(shim.VirtualProvider(sched))
+        try:
+            run = sched.run(lambda: spec.run(ctx))
+        finally:
+            cc.uninstall()
+            ctx._cleanup()
+            # metrics the code under test touched were created with
+            # THIS schedule's virtual locks (the registry is process-
+            # global); drop them so later real-threaded users get fresh
+            # counters with real locks — same reset discipline the test
+            # suites apply between cases
+            from paddle_tpu.observability import metrics as obs
+
+            obs.registry().reset()
+        result.schedules_run += 1
+        result.steps += run.steps
+        if run.truncated:
+            result.truncated += 1
+        self._harvest(spec.NAME, run, sched_id, result)
+        edges.update(run.lock_edges)
+        return run
+
+    def _add(self, result: SpecResult, f: RaceFinding) -> None:
+        if any(g.fingerprint == f.fingerprint for g in result.findings):
+            return
+        result.findings.append(f)
+
+    def _harvest(self, name: str, run: shim.ScheduleResult, sched_id: str,
+                 result: SpecResult) -> None:
+        trace = run.switch_trace()
+        if run.harness_stall:
+            self._add(result, RaceFinding(
+                rule="harness", spec=name,
+                message=f"unserializable schedule: {run.harness_stall}",
+                fingerprint=_fp("harness", name, run.harness_stall[:64]),
+                seed=self.seed, schedule=sched_id, trace=trace,
+            ))
+        for r in run.access_races:
+            prior = _site_str(r["prior_site"])
+            cur = _site_str(r["site"])
+            self._add(result, RaceFinding(
+                rule="torn_read", spec=name,
+                message=(
+                    f"unsynchronized {r['kind']} of `{r['label']}."
+                    f"{r['attr']}`: {r['prior_thread']} at {prior} vs "
+                    f"{r['thread']} at {cur} — no happens-before edge "
+                    "orders them (torn read-modify-write / stale read)"
+                ),
+                path=_rel(r["site"][0]), line=r["site"][1],
+                fingerprint=_fp("torn_read", name, r["label"], r["attr"],
+                                *sorted((_stable_site(r["prior_site"]),
+                                         _stable_site(r["site"])))),
+                seed=self.seed, schedule=sched_id, trace=trace,
+            ))
+        blocked_forever = [q for q in run.quiesce if not q["daemon"]]
+        if blocked_forever:
+            others = ", ".join(
+                f"{q['thread']}({'daemon' if q['daemon'] else 'non-daemon'} "
+                f"in {q['desc']})" for q in run.quiesce
+            )
+            for q in blocked_forever:
+                det = "deadlock" if q["kind"] == "lock" else "lost_wakeup"
+                self._add(result, RaceFinding(
+                    rule=det, spec=name,
+                    message=(
+                        f"thread {q['thread']} parked forever in "
+                        f"{q['kind']} wait on {q['desc']} with no "
+                        f"possible future wake (all parked: {others})"
+                    ),
+                    fingerprint=_fp(det, name, q["thread"],
+                                    _LINE_RE.sub("", q["desc"])),
+                    seed=self.seed, schedule=sched_id, trace=trace,
+                ))
+        excs = list(run.thread_excs)
+        if run.main_exc is not None:
+            excs.append(("main", run.main_exc))
+        seen_exc = set()
+        for tname, exc in excs:
+            if id(exc) in seen_exc:
+                continue
+            seen_exc.add(id(exc))
+            tb = traceback.extract_tb(exc.__traceback__)
+            last = tb[-1] if tb else None
+            where = f"{_rel(last.filename)}:{last.lineno}" if last else "?"
+            self._add(result, RaceFinding(
+                rule="spec_error", spec=name,
+                message=(
+                    f"{type(exc).__name__} in thread {tname} at {where}: "
+                    f"{exc} (raised only under this interleaving)"
+                ),
+                path=_rel(last.filename) if last else "",
+                line=last.lineno if last else 0,
+                fingerprint=_fp("spec_error", name, type(exc).__name__,
+                                str(exc)[:120]),
+                seed=self.seed, schedule=sched_id, trace=trace,
+            ))
+
+    # lock-order cycles (union graph, post-run)
+
+    def _lock_order_findings(self, name: str,
+                             edges: Dict[Tuple[str, str], Dict[str, str]],
+                             result: SpecResult) -> None:
+        graph: Dict[str, Set[str]] = {}
+        for a, b in edges:
+            graph.setdefault(a, set()).add(b)
+            graph.setdefault(b, set())
+        for comp in _sccs(graph):
+            cyclic = len(comp) > 1 or any(
+                (n, n) in edges for n in comp
+            )
+            if not cyclic:
+                continue
+            inner = sorted(
+                (a, b) for (a, b) in edges if a in comp and b in comp
+            )
+            detail = "; ".join(
+                f"{edges[e]['thread']} took {edges[e]['from']} then "
+                f"{edges[e]['to']} at {edges[e]['at']}" for e in inner
+            )
+            self._add(result, RaceFinding(
+                rule="lock_order", spec=name,
+                message=(
+                    "lock-order cycle over {"
+                    + ", ".join(sorted(comp))
+                    + f"}} — potential deadlock even though no explored "
+                      f"schedule wedged: {detail}"
+                ),
+                fingerprint=_fp("lock_order", name,
+                                *sorted(_LINE_RE.sub("", n) for n in comp)),
+                seed=self.seed, schedule="union", trace="",
+            ))
+
+    # the budgeted sweep
+
+    def run_spec(self, spec) -> SpecResult:
+        result = SpecResult(spec=spec.NAME)
+        edges: Dict[Tuple[str, str], Dict[str, str]] = {}
+        budget = self.schedules
+        dfs_budget = max(1, (budget + 1) // 2)
+        stack: List[Tuple[int, ...]] = [()]
+        stalled = False
+        while stack and result.schedules_run < dfs_budget:
+            prefix = stack.pop()
+            rec: List[Tuple[int, int]] = []
+
+            def chooser(k: int, _p=prefix, _r=rec) -> int:
+                i = len(_r)
+                pick = _p[i] if i < len(_p) else 0
+                pick = min(pick, k - 1)
+                _r.append((pick, k))
+                return pick
+
+            sched_id = "dfs[" + ",".join(str(c) for c in prefix) + "]"
+            run = self._execute(spec, chooser, sched_id, result, edges)
+            if run.harness_stall:
+                stalled = True
+                break  # every schedule would stall the same way
+            # push unexplored alternatives at and beyond this prefix.
+            # The child MUST spell out the recorded picks up to i (the
+            # picks past len(prefix) were implicit 0s): truncating to
+            # prefix[:i] would shift `alt` onto the wrong choice point,
+            # skipping branches while re-running others.
+            picks = [p for p, _k in rec]
+            for i in range(len(rec) - 1, len(prefix) - 1, -1):
+                _pick, k = rec[i]
+                for alt in range(k - 1, 0, -1):
+                    stack.append(tuple(picks[:i] + [alt]))
+        result.exhaustive = not stack and not stalled
+        # seeded-random tail for trees bigger than the DFS half (a
+        # harness stall burns REAL_STALL_S of wall clock per schedule —
+        # no tail then: every schedule would stall the same way)
+        i = 0
+        while (not result.exhaustive and not stalled
+               and result.schedules_run < budget):
+            rng = random.Random(f"{self.seed}:{spec.NAME}:{i}")
+            run = self._execute(
+                spec, lambda k, _r=rng: _r.randrange(k), f"rand#{i}",
+                result, edges,
+            )
+            i += 1
+            if run.harness_stall:
+                break
+        self._lock_order_findings(spec.NAME, edges, result)
+        result.findings.sort(
+            key=lambda f: (DETECTORS.index(f.rule), f.path, f.line,
+                           f.fingerprint)
+        )
+        return result
+
+    def run(self, specs: Sequence[Any]) -> List[SpecResult]:
+        return [self.run_spec(s) for s in specs]
+
+
+def _sccs(graph: Dict[str, Set[str]]) -> List[Set[str]]:
+    """Tarjan strongly-connected components (iterative)."""
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    out: List[Set[str]] = []
+    counter = [0]
+
+    for root in sorted(graph):
+        if root in index:
+            continue
+        work: List[Tuple[str, List[str]]] = [(root, sorted(graph[root]))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            node, succs = work[-1]
+            if succs:
+                nxt = succs.pop(0)
+                if nxt not in index:
+                    index[nxt] = low[nxt] = counter[0]
+                    counter[0] += 1
+                    stack.append(nxt)
+                    on_stack.add(nxt)
+                    work.append((nxt, sorted(graph[nxt])))
+                elif nxt in on_stack:
+                    low[node] = min(low[node], index[nxt])
+            else:
+                work.pop()
+                if work:
+                    parent = work[-1][0]
+                    low[parent] = min(low[parent], low[node])
+                if low[node] == index[node]:
+                    comp: Set[str] = set()
+                    while True:
+                        w = stack.pop()
+                        on_stack.discard(w)
+                        comp.add(w)
+                        if w == node:
+                            break
+                    out.append(comp)
+    return out

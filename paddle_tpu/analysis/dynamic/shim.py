@@ -1,0 +1,860 @@
+"""Virtualized concurrency primitives + the deterministic scheduler.
+
+The mechanism: every virtual thread is a REAL thread, but gated — the
+scheduler opens exactly one gate at a time, and the running thread
+hands control back at every *scheduling point* (lock acquire, cv
+wait/notify, event set/wait, queue put/get, sleep, thread start/join,
+watched-attribute access). Execution is therefore fully serialized,
+and the interleaving is a pure function of the scheduler's choice
+sequence — which is how a finding replays from a seed.
+
+Time is virtual: ``monotonic()`` reads the scheduler's clock, and the
+clock only advances when nothing is runnable but somebody is blocked
+with a deadline (a timed wait, a sleep, a timer) — so a spec that
+exercises a 60 s watchdog timeout costs microseconds.
+
+Vector clocks ride along for the happens-before race detector: lock
+release/acquire, cv notify, event set, queue put/get, and thread
+start/join all transfer clocks, so two accesses to a watched attribute
+race exactly when no chain of synchronization orders them — the
+detection does NOT need the losing interleaving to actually occur in
+the explored schedule.
+
+Everything here is stdlib-only and jax-free.
+"""
+
+from __future__ import annotations
+
+import os
+import queue as _queue
+import sys
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+#: real-time ceiling on one grant: if the resumed thread neither pauses
+#: nor finishes within this many WALL seconds, it blocked on something
+#: the shim cannot see (a real lock held cross-thread, real I/O wedge)
+#: — a harness error, reported loudly, never a silent hang of the run
+REAL_STALL_S = 20.0
+
+from paddle_tpu.utils import concurrency as _cc
+
+# frames never used to NAME a primitive or an access: the shim itself,
+# the seam module (cc.Lock() must be named after ITS caller), the
+# explorer driving the spec, and the real threading module hosting the
+# gated threads
+_SHIM_FILES = (
+    os.path.abspath(__file__),
+    os.path.abspath(_cc.__file__),
+    os.path.abspath(__file__).replace("shim.py", "explore.py"),
+    os.path.abspath(threading.__file__),
+)
+
+
+class ScheduleAbort(BaseException):
+    """Raised inside a virtual thread to unwind it at schedule end —
+    BaseException (like SystemExit) so ``except Exception`` handlers in
+    the code under test don't swallow the teardown. Equivalent to the
+    daemon-kill at process exit, which is what schedule end models."""
+
+
+class HarnessError(RuntimeError):
+    """The shim was used in a way the scheduler cannot serialize."""
+
+
+def _vjoin(dst: Dict[int, int], src: Dict[int, int]) -> None:
+    for k, v in src.items():
+        if v > dst.get(k, 0):
+            dst[k] = v
+
+
+def call_site() -> Tuple[str, int, str]:
+    """(filename, lineno, funcname) of the nearest caller frame outside
+    the shim and the seam — how primitives and accesses get named
+    after the code under test, not after this machinery."""
+    skip = set(_SHIM_FILES)
+    f = sys._getframe(1)
+    while f is not None:
+        fn = f.f_code.co_filename
+        if os.path.abspath(fn) not in skip and not fn.startswith("<"):
+            return fn, f.f_lineno, f.f_code.co_name
+        f = f.f_back
+    return "?", 0, "?"
+
+
+class _VThread:
+    __slots__ = (
+        "tid", "name", "daemon", "target", "args", "kwargs", "state",
+        "block_kind", "block_desc", "block_obj", "deadline", "wake_reason",
+        "pending_vc", "vc", "held", "exc", "killed", "finished", "go",
+        "paused", "real", "pending_op", "joiners",
+    )
+
+    def __init__(self, tid: int, name: str, daemon: bool, target, args, kwargs):
+        self.tid = tid
+        self.name = name
+        self.daemon = daemon
+        self.target = target
+        self.args = args
+        self.kwargs = kwargs
+        self.state = "runnable"    # runnable | running | blocked | finished
+        self.block_kind = ""
+        self.block_desc = ""
+        self.block_obj: Any = None
+        self.deadline: Optional[float] = None
+        self.wake_reason: Optional[str] = None
+        self.pending_vc: Optional[Dict[int, int]] = None
+        self.vc: Dict[int, int] = {}
+        self.held: List["VLock"] = []
+        self.exc: Optional[BaseException] = None
+        self.killed = False
+        self.finished = False
+        self.go = threading.Event()
+        self.paused = threading.Event()
+        self.real: Optional[threading.Thread] = None
+        self.pending_op = "spawn"
+        self.joiners: List["_VThread"] = []
+
+
+class Scheduler:
+    """One schedule's worth of serialized execution (see module doc).
+
+    ``chooser(k) -> int`` picks among the k runnable threads at every
+    branch point (k > 1); the recorded ``choices`` list of (pick, k)
+    is the schedule's identity. Detector raw material accumulates in
+    ``access_races`` / ``lock_edges`` / ``quiesce`` for explore.py."""
+
+    def __init__(self, chooser: Callable[[int], int], step_cap: int = 20000):
+        self.chooser = chooser
+        self.step_cap = step_cap
+        self.now = 0.0
+        self.steps = 0
+        self.active = False
+        self.truncated = False
+        self.harness_stall: Optional[str] = None
+        self.threads: List[_VThread] = []
+        self.trace: List[Tuple[str, str]] = []
+        self.choices: List[Tuple[int, int]] = []
+        self._tls = threading.local()
+        # detectors' raw material
+        self.access_log: Dict[Tuple[int, str], Dict[str, Any]] = {}
+        self.access_races: List[Dict[str, Any]] = []
+        self.lock_edges: Dict[Tuple[str, str], Dict[str, str]] = {}
+        self.quiesce: List[Dict[str, Any]] = []
+        self._next_tid = 0
+
+    # ------------------------------------------------------------- plumbing
+
+    def _cur(self) -> Optional[_VThread]:
+        return getattr(self._tls, "vt", None)
+
+    def _require(self) -> _VThread:
+        vt = self._cur()
+        if vt is None:
+            raise HarnessError(
+                "virtual primitive used from a thread the scheduler does "
+                "not manage (create threads via cc.Thread inside the spec)"
+            )
+        return vt
+
+    def _pause(self, vt: _VThread) -> None:
+        vt.paused.set()
+        vt.go.wait()
+        vt.go.clear()
+        if vt.killed:
+            raise ScheduleAbort()
+
+    def yield_point(self, op: str) -> None:
+        """Declare a scheduling point: hand control to the scheduler,
+        resume when granted. No-op outside managed execution and during
+        teardown unwind."""
+        vt = self._cur()
+        if vt is None:
+            return
+        if vt.killed:
+            raise ScheduleAbort()
+        self.steps += 1
+        vt.state = "runnable"
+        vt.pending_op = op
+        self._pause(vt)
+        vt.state = "running"
+
+    def block(self, kind: str, desc: str,
+              deadline: Optional[float] = None, obj: Any = None) -> str:
+        """Park the current thread until woken (returns the wake
+        reason: the waker's tag, or "timeout"). ``obj`` is the
+        primitive INSTANCE being waited on — wake routing matches on
+        identity, never on the site-derived display name (two
+        primitives constructed at the same source line must not
+        cross-wake each other's waiters)."""
+        vt = self._require()
+        if vt.killed:
+            raise ScheduleAbort()
+        self.steps += 1
+        vt.state = "blocked"
+        vt.block_kind = kind
+        vt.block_desc = desc
+        vt.block_obj = obj
+        vt.deadline = deadline
+        vt.pending_op = f"{kind}:{desc}"
+        self._pause(vt)
+        vt.state = "running"
+        if vt.pending_vc is not None:
+            _vjoin(vt.vc, vt.pending_vc)
+            vt.pending_vc = None
+        return vt.wake_reason or "timeout"
+
+    def wake(self, vt: _VThread, reason: str,
+             vc: Optional[Dict[int, int]] = None) -> None:
+        if vt.finished or vt.state != "blocked":
+            return
+        vt.state = "runnable"
+        vt.wake_reason = reason
+        vt.deadline = None
+        vt.pending_vc = dict(vc) if vc else None
+
+    # -------------------------------------------------------------- threads
+
+    def spawn(self, target, args=(), kwargs=None, name: Optional[str] = None,
+              daemon: bool = False) -> _VThread:
+        tid = self._next_tid
+        self._next_tid += 1
+        vt = _VThread(tid, name or f"T{tid}", daemon, target, args,
+                      kwargs or {})
+        parent = self._cur()
+        if parent is not None:
+            vt.vc = dict(parent.vc)
+            parent.vc[parent.tid] = parent.vc.get(parent.tid, 0) + 1
+        vt.vc[tid] = 1
+        self.threads.append(vt)
+
+        def _body():
+            self._tls.vt = vt
+            vt.go.wait()  # lint: disable=PTL008 -- the controller's gate: every grant path either sets it or kills the vthread (killall), and the run() loop cannot exit while a gated thread exists; a bounded wait would busy-wake every parked virtual thread
+            vt.go.clear()
+            try:
+                if not vt.killed:
+                    vt.target(*vt.args, **vt.kwargs)
+            except ScheduleAbort:
+                pass
+            except BaseException as e:  # the schedule's evidence
+                vt.exc = e
+            finally:
+                vt.finished = True
+                vt.state = "finished"
+                vt.paused.set()
+
+        vt.real = threading.Thread(target=_body, name=f"vsched-{vt.name}",
+                                   daemon=True)
+        vt.real.start()
+        return vt
+
+    def join_thread(self, vt: _VThread, timeout: Optional[float]) -> bool:
+        me = self._require()
+        self.yield_point(f"join {vt.name}")
+        if vt.finished:
+            _vjoin(me.vc, vt.vc)
+            return True
+        deadline = None if timeout is None else self.now + timeout
+        vt.joiners.append(me)
+        reason = self.block("join", vt.name, deadline, obj=vt)
+        if me in vt.joiners:
+            vt.joiners.remove(me)
+        if reason == "timeout" and not vt.finished:
+            return False
+        _vjoin(me.vc, vt.vc)
+        return True
+
+    # ---------------------------------------------------------- controller
+
+    def run(self, main_fn, name: str = "main") -> "ScheduleResult":
+        """Execute ``main_fn`` as the root virtual thread, driving the
+        schedule to completion (all non-daemon threads finished), a
+        quiesce (reported), or the step cap."""
+        assert not self.active, "Scheduler.run is one-shot"
+        self.active = True
+        main = self.spawn(main_fn, name=name, daemon=False)
+        try:
+            while True:
+                if self.steps > self.step_cap:
+                    self.truncated = True
+                    break
+                if not any(not t.daemon and not t.finished
+                           for t in self.threads):
+                    break
+                runnable = [t for t in self.threads if t.state == "runnable"]
+                if not runnable:
+                    if not self._advance_clock():
+                        self._report_quiesce()
+                        break
+                    continue
+                if len(runnable) > 1:
+                    idx = self.chooser(len(runnable))
+                    self.choices.append((idx, len(runnable)))
+                else:
+                    idx = 0
+                self._grant(runnable[idx])
+                if self.harness_stall:
+                    break
+        finally:
+            self._killall()
+            self.active = False
+        return ScheduleResult(self, main)
+
+    def _grant(self, vt: _VThread) -> None:
+        self.trace.append((vt.name, vt.pending_op))
+        vt.state = "running"
+        vt.go.set()
+        if not vt.paused.wait(REAL_STALL_S):
+            self.harness_stall = (
+                f"thread {vt.name} neither paused nor finished within "
+                f"{REAL_STALL_S}s wall time at op {vt.pending_op!r} — it "
+                "blocked on something outside the shim"
+            )
+            return
+        vt.paused.clear()
+        if vt.finished:
+            for j in list(vt.joiners):
+                self.wake(j, "join", vt.vc)
+            vt.joiners.clear()
+
+    def _advance_clock(self) -> bool:
+        timed = [t for t in self.threads
+                 if t.state == "blocked" and t.deadline is not None]
+        if not timed:
+            return False
+        self.now = max(self.now, min(t.deadline for t in timed))
+        for t in timed:
+            if t.deadline is not None and t.deadline <= self.now:
+                self.wake(t, "timeout")
+        return True
+
+    def _report_quiesce(self) -> None:
+        """Nothing runnable, nothing timed: every blocked thread here is
+        parked forever. Non-daemon ⇒ a real deadlock / lost wakeup (a
+        daemon parked at idle after main finished is normal shutdown —
+        that case never reaches here because the run loop exits first)."""
+        for t in self.threads:
+            if t.state != "blocked":
+                continue
+            self.quiesce.append({
+                "thread": t.name,
+                "daemon": t.daemon,
+                "kind": t.block_kind,
+                "desc": t.block_desc,
+            })
+
+    def _killall(self) -> None:
+        for vt in self.threads:
+            tries = 0
+            while not vt.finished and tries < 3:
+                tries += 1
+                vt.killed = True
+                vt.go.set()
+                if not vt.paused.wait(REAL_STALL_S):
+                    self.harness_stall = self.harness_stall or (
+                        f"thread {vt.name} did not unwind at schedule end"
+                    )
+                    break
+                vt.paused.clear()
+
+    # ------------------------------------------------- detector attach points
+
+    def on_lock_acquired(self, lock: "VLock") -> None:
+        vt = self._require()
+        _vjoin(vt.vc, lock.vc)
+        for held in vt.held:
+            if held is lock:
+                continue
+            edge = (held.site, lock.site)
+            if edge not in self.lock_edges:
+                fn, line, func = call_site()
+                self.lock_edges[edge] = {
+                    "from": held.name, "to": lock.name,
+                    "at": f"{fn}:{line} ({func})", "thread": vt.name,
+                }
+        vt.held.append(lock)
+
+    def on_lock_released(self, lock: "VLock") -> None:
+        vt = self._require()
+        lock.vc = dict(vt.vc)
+        vt.vc[vt.tid] = vt.vc.get(vt.tid, 0) + 1
+        if lock in vt.held:
+            vt.held.remove(lock)
+
+    def on_access(self, obj: Any, label: str, attr: str, kind: str) -> None:
+        """A watched-attribute access: a scheduling point AND a
+        happens-before check against the last write / outstanding reads
+        of the same attribute on the same object."""
+        vt = self._cur()
+        if vt is None or vt.killed or not self.active:
+            return
+        fn, line, func = call_site()
+        self.yield_point(f"{kind} {label}.{attr} @{os.path.basename(fn)}:{line}")
+        key = (id(obj), attr)
+        cell = self.access_log.setdefault(
+            key, {"label": label, "w": None, "r": {}}
+        )
+
+        def ordered(tid: int, clk: int) -> bool:
+            return tid == vt.tid or clk <= vt.vc.get(tid, 0)
+
+        site = (fn, line, func)
+        if kind == "write":
+            prior = []
+            w = cell["w"]
+            if w is not None and not ordered(w[0], w[1]):
+                prior.append(("write", w[2], w[3]))
+            for tid, (clk, rsite, rname) in cell["r"].items():
+                if not ordered(tid, clk):
+                    prior.append(("read", rsite, rname))
+            for pkind, psite, pname in prior:
+                self.access_races.append({
+                    "label": label, "attr": attr,
+                    "kind": f"{pkind}-write",
+                    "prior_site": psite, "prior_thread": pname,
+                    "site": site, "thread": vt.name,
+                })
+            cell["w"] = (vt.tid, vt.vc.get(vt.tid, 0), site, vt.name)
+            cell["r"] = {}
+        else:
+            w = cell["w"]
+            if w is not None and not ordered(w[0], w[1]):
+                self.access_races.append({
+                    "label": label, "attr": attr, "kind": "write-read",
+                    "prior_site": w[2], "prior_thread": w[3],
+                    "site": site, "thread": vt.name,
+                })
+            cell["r"][vt.tid] = (vt.vc.get(vt.tid, 0), site, vt.name)
+
+    # ------------------------------------------------------------ virtual time
+
+    def monotonic(self) -> float:
+        return self.now
+
+    def sleep(self, seconds: float) -> None:
+        vt = self._cur()
+        if vt is None:
+            return
+        self.block("sleep", f"{seconds:g}s", self.now + max(0.0, seconds))
+
+
+class ScheduleResult:
+    """What one executed schedule yields to the explorer."""
+
+    def __init__(self, sched: Scheduler, main: _VThread):
+        self.trace = list(sched.trace)
+        self.choices = list(sched.choices)
+        self.steps = sched.steps
+        self.truncated = sched.truncated
+        self.harness_stall = sched.harness_stall
+        self.access_races = list(sched.access_races)
+        self.lock_edges = dict(sched.lock_edges)
+        self.quiesce = list(sched.quiesce)
+        self.thread_excs = [
+            (t.name, t.exc) for t in sched.threads if t.exc is not None
+        ]
+        self.main_exc = main.exc
+
+    def switch_trace(self, limit: int = 14) -> str:
+        """Compact thread-switch rendering: consecutive grants to the
+        same thread collapse to ``name:count``."""
+        out: List[str] = []
+        runs: List[Tuple[str, int]] = []
+        for name, _op in self.trace:
+            if runs and runs[-1][0] == name:
+                runs[-1] = (name, runs[-1][1] + 1)
+            else:
+                runs.append((name, 1))
+        for name, n in runs[:limit]:
+            out.append(f"{name}:{n}")
+        if len(runs) > limit:
+            out.append("…")
+        return " → ".join(out)
+
+
+# ---------------------------------------------------------------- primitives
+
+
+class VLock:
+    def __init__(self, sched: Scheduler, reentrant: bool = False):
+        self.sched = sched
+        self.reentrant = reentrant
+        fn, line, _func = call_site()
+        self.site = f"{os.path.basename(fn)}:{line}"
+        self.name = f"{'RLock' if reentrant else 'Lock'}@{self.site}"
+        self.owner: Optional[_VThread] = None
+        self.count = 0
+        self.vc: Dict[int, int] = {}
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        s = self.sched
+        vt = s._cur()
+        if vt is None or vt.killed or not s.active:
+            # a primitive owned by a FINISHED schedule (cached in a
+            # process-global like the metrics registry), or teardown
+            # unwind: execution is serialized, take it plainly
+            self.owner, self.count = vt, 1
+            return True
+        s.yield_point(f"acquire {self.name}")
+        if self.reentrant and self.owner is vt:
+            self.count += 1
+            return True
+        deadline = None
+        if timeout is not None and timeout >= 0:
+            deadline = s.now + timeout
+        while True:
+            if self.owner is None:
+                self.owner = vt
+                self.count = 1
+                s.on_lock_acquired(self)
+                return True
+            if not blocking:
+                return False
+            reason = s.block("lock", self.name, deadline, obj=self)
+            if reason == "timeout" and self.owner is not None:
+                return False
+
+    def release(self) -> None:
+        s = self.sched
+        vt = s._cur()
+        if vt is None or vt.killed or not s.active:
+            self.owner, self.count = None, 0
+            return
+        if self.owner is not vt:
+            raise RuntimeError(f"release of un-acquired {self.name}")
+        self.count -= 1
+        if self.reentrant and self.count > 0:
+            return
+        s.on_lock_released(self)
+        self.owner = None
+        self.count = 0
+        for t in s.threads:
+            if t.state == "blocked" and t.block_kind == "lock" \
+                    and t.block_obj is self:
+                s.wake(t, "lock_free")
+
+    def locked(self) -> bool:
+        return self.owner is not None
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+
+class VCondition:
+    def __init__(self, sched: Scheduler, lock: Optional[VLock] = None):
+        self.sched = sched
+        self._lock = lock if lock is not None else VLock(sched)
+        fn, line, _func = call_site()
+        self.site = f"{os.path.basename(fn)}:{line}"
+        self.name = f"Condition@{self.site}"
+        self._waiters: List[_VThread] = []
+
+    # lock interface delegates
+    def acquire(self, *a, **kw):
+        return self._lock.acquire(*a, **kw)
+
+    def release(self):
+        self._lock.release()
+
+    def __enter__(self):
+        self._lock.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self._lock.release()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        s = self.sched
+        vt = s._require()
+        if vt.killed:
+            raise ScheduleAbort()
+        if not s.active:
+            raise HarnessError(f"wait on {self.name} after its schedule")
+        if self._lock.owner is not vt:
+            raise RuntimeError(f"wait on {self.name} without the lock")
+        # full release (cv.wait releases even a reentrantly-held lock)
+        saved = self._lock.count
+        self._lock.count = 1
+        self._lock.release()
+        self._waiters.append(vt)
+        deadline = None if timeout is None else s.now + timeout
+        reason = s.block("cond", self.name, deadline, obj=self)
+        if vt in self._waiters:  # timeout path: still registered
+            self._waiters.remove(vt)
+        # reacquire unconditionally (python semantics)
+        self._lock.acquire()
+        self._lock.count = saved
+        return reason == "notify"
+
+    def notify(self, n: int = 1) -> None:
+        s = self.sched
+        vt = s._cur()
+        if vt is None or vt.killed or not s.active:
+            return
+        if self._lock.owner is not vt:
+            raise RuntimeError(f"notify on {self.name} without the lock")
+        s.yield_point(f"notify {self.name}")
+        woken, self._waiters = self._waiters[:n], self._waiters[n:]
+        vt.vc[vt.tid] = vt.vc.get(vt.tid, 0) + 1
+        for w in woken:
+            s.wake(w, "notify", vt.vc)
+
+    def notify_all(self) -> None:
+        self.notify(len(self._waiters))
+
+
+class VEvent:
+    def __init__(self, sched: Scheduler):
+        self.sched = sched
+        fn, line, _func = call_site()
+        self.site = f"{os.path.basename(fn)}:{line}"
+        self.name = f"Event@{self.site}"
+        self._flag = False
+        self.vc: Dict[int, int] = {}
+
+    def is_set(self) -> bool:
+        return self._flag
+
+    def set(self) -> None:
+        s = self.sched
+        vt = s._cur()
+        self._flag = True
+        if vt is None or vt.killed or not s.active:
+            return
+        s.yield_point(f"set {self.name}")
+        vt.vc[vt.tid] = vt.vc.get(vt.tid, 0) + 1
+        _vjoin(self.vc, vt.vc)
+        for t in s.threads:
+            if t.state == "blocked" and t.block_kind == "event" \
+                    and t.block_obj is self:
+                s.wake(t, "notify", self.vc)
+
+    def clear(self) -> None:
+        self._flag = False
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        s = self.sched
+        if not s.active or s._cur() is None:
+            return self._flag  # stale-scheduler primitive: no blocking
+        vt = s._require()
+        if vt.killed:
+            raise ScheduleAbort()
+        s.yield_point(f"wait {self.name}")
+        if self._flag:
+            _vjoin(vt.vc, self.vc)
+            return True
+        deadline = None if timeout is None else s.now + timeout
+        s.block("event", self.name, deadline, obj=self)
+        if self._flag:
+            _vjoin(vt.vc, self.vc)
+        return self._flag
+
+
+class VQueue:
+    def __init__(self, sched: Scheduler, maxsize: int = 0):
+        self.sched = sched
+        self.maxsize = maxsize
+        fn, line, _func = call_site()
+        self.site = f"{os.path.basename(fn)}:{line}"
+        self.name = f"Queue@{self.site}"
+        self._items: List[Tuple[Any, Dict[int, int]]] = []
+
+    def qsize(self) -> int:
+        return len(self._items)
+
+    def empty(self) -> bool:
+        return not self._items
+
+    def full(self) -> bool:
+        return 0 < self.maxsize <= len(self._items)
+
+    def put(self, item, block: bool = True, timeout: Optional[float] = None):
+        s = self.sched
+        vt = s._require()
+        if vt.killed:  # unwind: best-effort append, no parking
+            self._items.append((item, {}))
+            return
+        s.yield_point(f"put {self.name}")
+        deadline = None if timeout is None else s.now + timeout
+        while self.full():
+            if not block:
+                raise _queue.Full()
+            reason = s.block("queue_put", self.name, deadline, obj=self)
+            if reason == "timeout" and self.full():
+                raise _queue.Full()
+        vt.vc[vt.tid] = vt.vc.get(vt.tid, 0) + 1
+        self._items.append((item, dict(vt.vc)))
+        for t in s.threads:
+            if t.state == "blocked" and t.block_kind == "queue_get" \
+                    and t.block_obj is self:
+                s.wake(t, "item")
+
+    def put_nowait(self, item):
+        self.put(item, block=False)
+
+    def get(self, block: bool = True, timeout: Optional[float] = None):
+        s = self.sched
+        vt = s._require()
+        if vt.killed:
+            raise ScheduleAbort()
+        s.yield_point(f"get {self.name}")
+        deadline = None if timeout is None else s.now + timeout
+        while not self._items:
+            if not block:
+                raise _queue.Empty()
+            reason = s.block("queue_get", self.name, deadline, obj=self)
+            if reason == "timeout" and not self._items:
+                raise _queue.Empty()
+        item, vc = self._items.pop(0)
+        _vjoin(vt.vc, vc)
+        for t in s.threads:
+            if t.state == "blocked" and t.block_kind == "queue_put" \
+                    and t.block_obj is self:
+                s.wake(t, "space")
+        return item
+
+    def get_nowait(self):
+        return self.get(block=False)
+
+
+class VThreadHandle:
+    """What ``cc.Thread(...)`` returns under the shim — the
+    ``threading.Thread`` surface the framework uses (start/join/
+    is_alive/name/daemon)."""
+
+    def __init__(self, sched: Scheduler, group=None, target=None, name=None,
+                 args=(), kwargs=None, daemon: Optional[bool] = None):
+        assert group is None
+        self.sched = sched
+        self._target = target
+        self.name = name  # None -> named at start() from the spawn tid
+        self.daemon = bool(daemon)
+        self._args = args
+        self._kwargs = kwargs or {}
+        self._vt: Optional[_VThread] = None
+
+    def start(self) -> None:
+        if self._vt is not None:
+            raise RuntimeError("threads can only be started once")
+        s = self.sched
+        vt = s._cur()
+        if vt is not None and not vt.killed:
+            s.yield_point(f"start {self.name or 'thread'}")
+        self._vt = s.spawn(self._target, self._args, self._kwargs,
+                           name=self.name, daemon=self.daemon)
+        self.name = self._vt.name
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        if self._vt is None:
+            raise RuntimeError("cannot join thread before it is started")
+        self.sched.join_thread(self._vt, timeout)
+
+    def is_alive(self) -> bool:
+        return self._vt is not None and not self._vt.finished
+
+    @property
+    def ident(self):
+        return self._vt.tid if self._vt is not None else None
+
+
+class VTimer(VThreadHandle):
+    """``threading.Timer`` twin: fires ``function`` after a VIRTUAL
+    ``interval`` unless cancelled — the hangwatch forensics backstop's
+    contract."""
+
+    def __init__(self, sched: Scheduler, interval: float, function,
+                 args=None, kwargs=None):
+        super().__init__(sched, target=self._run)
+        self.interval = float(interval)
+        self.function = function
+        self.fn_args = args or ()
+        self.fn_kwargs = kwargs or {}
+        self._cancel = VEvent(sched)
+
+    def cancel(self) -> None:
+        self._cancel.set()
+
+    def _run(self) -> None:
+        if not self._cancel.wait(timeout=self.interval):
+            if not self._cancel.is_set():
+                self.function(*self.fn_args, **self.fn_kwargs)
+
+
+class VirtualProvider:
+    """The ``concurrency.install()`` payload: constructors bound to one
+    scheduler. ``current_thread``/``main_thread`` stay REAL — they back
+    "am I allowed to install signal handlers" guards, and a virtual
+    thread (a real non-main thread) must answer no there."""
+
+    def __init__(self, sched: Scheduler):
+        self.sched = sched
+
+    def Thread(self, *args, **kwargs):
+        return VThreadHandle(self.sched, *args, **kwargs)
+
+    def Timer(self, interval, function, args=None, kwargs=None):
+        return VTimer(self.sched, interval, function, args, kwargs)
+
+    def Lock(self):
+        return VLock(self.sched)
+
+    def RLock(self):
+        return VLock(self.sched, reentrant=True)
+
+    def Condition(self, lock=None):
+        return VCondition(self.sched, lock)
+
+    def Event(self):
+        return VEvent(self.sched)
+
+    def Queue(self, maxsize: int = 0):
+        return VQueue(self.sched, maxsize)
+
+    def monotonic(self) -> float:
+        return self.sched.monotonic()
+
+    def perf_counter(self) -> float:
+        return self.sched.monotonic()
+
+    def sleep(self, seconds: float) -> None:
+        self.sched.sleep(seconds)
+
+    current_thread = staticmethod(threading.current_thread)
+    main_thread = staticmethod(threading.main_thread)
+    get_ident = staticmethod(threading.get_ident)
+    enumerate_threads = staticmethod(threading.enumerate)
+
+
+# ------------------------------------------------------- attribute watching
+
+
+def watch_object(sched: Scheduler, obj: Any, attrs) -> Any:
+    """Instrument ``obj`` so every read/write of the named attributes is
+    a scheduling point + a happens-before race check. Implemented by
+    swapping the instance's class for a generated subclass — works for
+    ordinary (non-slots) classes, which all the watched framework
+    classes are. Returns ``obj``."""
+    attrs = frozenset(attrs)
+    base = type(obj)
+    label = base.__name__
+
+    class _Watched(base):  # type: ignore[misc,valid-type]
+        def __getattribute__(self, name):
+            if name in attrs:
+                sched.on_access(self, label, name, "read")
+            return base.__getattribute__(self, name)
+
+        def __setattr__(self, name, value):
+            if name in attrs:
+                sched.on_access(self, label, name, "write")
+            base.__setattr__(self, name, value)
+
+    _Watched.__name__ = f"Watched{label}"
+    _Watched.__qualname__ = _Watched.__name__
+    obj.__class__ = _Watched
+    return obj

@@ -1,7 +1,9 @@
-"""PTL005 (daemon-thread shared-state writes without a lock) and
-PTL006 (exit paths not dominated by a metrics flush) — the concurrency
-and crash-evidence invariants from the async-checkpoint / hangwatch /
-heartbeat work.
+"""PTL005 (daemon-thread shared-state writes without a lock), PTL006
+(exit paths not dominated by a metrics flush), and PTL008 (unbounded
+blocking primitives on daemon-thread paths) — the concurrency and
+crash-evidence invariants from the async-checkpoint / hangwatch /
+heartbeat work. Also home of :func:`thread_shared_attrs`, the static
+seed for `paddle race`'s dynamic watch lists.
 """
 
 from __future__ import annotations
@@ -96,6 +98,59 @@ def _thread_entry_refs(sf: SourceFile) -> List[ast.AST]:
     return out
 
 
+def _reachable_functions(sf: SourceFile,
+                         entries: List[ast.AST]) -> List[ast.AST]:
+    """Every function reachable from the given entry refs by the
+    in-file transitive call walk — the ONE worklist shared by PTL005,
+    PTL008, and the dynamic analyzer's watch-list seeding (a fix to
+    call resolution lands everywhere at once)."""
+    if not entries:
+        return []
+    idx = _FileIndex(sf)
+    out: List[ast.AST] = []
+    seen: Set[int] = set()
+    work: List[ast.AST] = []
+    for ref in entries:
+        work.extend(idx.resolve(ref))
+    while work:
+        fn = work.pop()
+        if fn is None or id(fn) in seen:
+            continue
+        seen.add(id(fn))
+        out.append(fn)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                work.extend(idx.resolve(node.func))
+    return out
+
+
+def _thread_side_functions(sf: SourceFile) -> List[ast.AST]:
+    """Functions reachable from ANY thread entry (PTL005's scope)."""
+    return _reachable_functions(sf, _thread_entry_refs(sf))
+
+
+def thread_shared_attrs(text: str, filename: str = "<mem>") -> Set[str]:
+    """Self-attributes referenced (read OR written) on a thread-run
+    path of ``text`` — the static seed for `paddle race`'s dynamic
+    watch lists: PTL005's walk finds the fields, minus the lock filter
+    (whether the synchronization is sufficient is exactly what the
+    schedule explorer judges dynamically)."""
+    try:
+        sf = SourceFile(filename, "mem.py", text)
+    except SyntaxError:
+        return set()
+    attrs: Set[str] = set()
+    for fn in _thread_side_functions(sf):
+        for node in ast.walk(fn):
+            if (
+                isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+            ):
+                attrs.add(node.attr)
+    return attrs
+
+
 def _locked_lines(fn: ast.AST, lock_re: re.Pattern) -> Set[int]:
     """Line numbers lexically inside a ``with <something-lockish>:``."""
     lines: Set[int] = set()
@@ -124,26 +179,10 @@ def check_unlocked_thread_writes(sf: SourceFile, ctx: LintContext) -> Iterable[F
     (``Thread(target=...)``/``Timer``/``pool.submit``) plus everything
     they transitively call in-file; any ``self.attr = ...`` /
     ``self.attr += ...`` there must sit inside a ``with <lock>:``."""
-    entries = _thread_entry_refs(sf)
-    if not entries:
+    thread_side = _thread_side_functions(sf)
+    if not thread_side:
         return []
-    idx = _FileIndex(sf)
     lock_re = re.compile(ctx.config["lock_name_re"], re.IGNORECASE)
-    # transitive closure over in-file calls from the entry functions
-    thread_side: List[ast.AST] = []
-    seen: Set[int] = set()
-    work = []
-    for ref in entries:
-        work.extend(idx.resolve(ref))
-    while work:
-        fn = work.pop()
-        if fn is None or id(fn) in seen:
-            continue
-        seen.add(id(fn))
-        thread_side.append(fn)
-        for node in ast.walk(fn):
-            if isinstance(node, ast.Call):
-                work.extend(idx.resolve(node.func))
     out: List[Finding] = []
     reported: Set[Tuple[int, int]] = set()
     for fn in thread_side:
@@ -179,6 +218,139 @@ def check_unlocked_thread_writes(sf: SourceFile, ctx: LintContext) -> Iterable[F
                     ),
                     snippet=sf.snippet(node.lineno),
                 ))
+    return out
+
+
+# ------------------------------------------------------------- PTL008
+
+
+def _daemon_entry_refs(sf: SourceFile) -> List[ast.AST]:
+    """Callable refs of thread entries that run as DAEMONS: explicit
+    ``Thread(..., daemon=True)`` targets and every ``Timer`` function
+    (the codebase's timers are hang-defense backstops, daemonized by
+    attribute). Non-daemon threads and pool workers are excluded — the
+    interpreter joins them at exit, so an unbounded wait there is an
+    ordinary (diagnosable) hang, not a silent zombie."""
+    out: List[ast.AST] = []
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted(node.func)
+        if d.endswith("Thread"):
+            is_daemon = any(
+                kw.arg == "daemon"
+                and isinstance(kw.value, ast.Constant)
+                and kw.value.value is True
+                for kw in node.keywords
+            )
+            if is_daemon:
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        out.append(kw.value)
+        elif d.endswith("Timer"):
+            for kw in node.keywords:
+                if kw.arg == "function":
+                    out.append(kw.value)
+            if len(node.args) >= 2:
+                out.append(node.args[1])
+    return out
+
+
+#: method -> (what it is, the bounded idiom to suggest)
+_UNBOUNDED_BLOCKERS = {
+    "acquire": ("Lock.acquire()", "acquire(timeout=...)"),
+    "wait": ("Condition/Event.wait()", "wait(timeout=...) in a loop"),
+    "get": ("Queue.get()", "get(timeout=...) in a loop"),
+}
+
+
+def _call_is_bounded(name: str, call: ast.Call) -> bool:
+    """True when the blocking call carries a bound (or cannot block):
+    per-method argument semantics, conservative (an unknown expression
+    in a blocking/timeout position passes — never guess a finding)."""
+    args = call.args
+    kwargs = {kw.arg: kw.value for kw in call.keywords}
+    timeout = kwargs.get("timeout")
+    if timeout is not None and not (
+        isinstance(timeout, ast.Constant) and timeout.value is None
+    ):
+        return True
+    if name == "wait":
+        # wait(timeout) — the first positional IS the bound
+        return bool(args)
+    if name == "get":
+        if len(args) >= 2:
+            return True  # get(block, timeout)
+        if args:
+            a = args[0]
+            if isinstance(a, ast.Constant):
+                # get(False) cannot block; get(True) blocks unbounded.
+                # Any other constant first arg is a dict.get(key) — not
+                # a queue at all
+                return a.value is not True
+            return True  # get(<expr>): dict.get(key) shape, pass
+        blk = kwargs.get("block")
+        if blk is not None and not (
+            isinstance(blk, ast.Constant) and blk.value is True
+        ):
+            return True  # block=False (or unknown) cannot be pinned
+        return False
+    if name == "acquire":
+        if len(args) >= 2:
+            return True  # acquire(blocking, timeout)
+        blocking = args[0] if args else kwargs.get("blocking")
+        if blocking is not None:
+            if isinstance(blocking, ast.Constant):
+                # acquire(False) is a try-lock (cannot block);
+                # acquire(True) blocks unbounded
+                return blocking.value is not True
+            return True  # unknown expression: don't guess
+        return False
+    return True
+
+
+@rule(
+    "PTL008",
+    "unbounded blocking primitive (acquire()/wait()/get() without a "
+    "timeout) on a daemon-thread code path",
+)
+def check_unbounded_daemon_blocking(sf: SourceFile,
+                                    ctx: LintContext) -> Iterable[Finding]:
+    """The hang-defense stack (PR 4) can only forensically report a
+    thread that eventually RUNS: a daemon parked forever on an
+    uninstrumented primitive never dumps a stack, never pings, and
+    survives as a silent zombie past every watchdog. On code reachable
+    from a daemon-thread target, ``lock.acquire()`` /
+    ``cv.wait()`` / ``queue.get()`` must carry a timeout and re-check
+    their predicate (a spurious wake re-loop is free; an unreportable
+    block is not)."""
+    daemon_side = _reachable_functions(sf, _daemon_entry_refs(sf))
+    out: List[Finding] = []
+    for fn in daemon_side:
+        for call in ast.walk(fn):
+            if not (isinstance(call, ast.Call)
+                    and isinstance(call.func, ast.Attribute)):
+                continue
+            name = call.func.attr
+            if name not in _UNBOUNDED_BLOCKERS:
+                continue
+            if _call_is_bounded(name, call):
+                continue
+            what, idiom = _UNBOUNDED_BLOCKERS[name]
+            out.append(Finding(
+                rule="PTL008", path=sf.rel, line=call.lineno,
+                col=call.col_offset,
+                end_line=getattr(call, "end_lineno", 0) or 0,
+                message=(
+                    f"unbounded `{dotted(call.func) or '.' + name}()` "
+                    f"({what}) on the daemon-thread path "
+                    f"`{getattr(fn, 'name', '?')}` — a daemon parked "
+                    "forever on an uninstrumented primitive is invisible "
+                    f"to the hang-defense stack; use `{idiom}` and "
+                    "re-check the predicate"
+                ),
+                snippet=sf.snippet(call.lineno),
+            ))
     return out
 
 

@@ -27,7 +27,7 @@ def main(argv=None) -> int:
         print(__doc__)
         print("usage: paddle <train|supervise|test|gen|checkgrad|dump_config|"
               "merge_model|check-checkpoint|metrics|roofline|compare|"
-              "serve-report|lint|faults|version> [--flags]")
+              "serve-report|lint|race|faults|version> [--flags]")
         return 0
     cmd, rest = argv[0], argv[1:]
     if cmd == "version":
@@ -77,6 +77,13 @@ def main(argv=None) -> int:
         from paddle_tpu.analysis.cli import main as lint_main
 
         return lint_main(rest)
+    if cmd == "race":
+        # dynamic analysis: deterministic schedule explorer over the
+        # daemon-thread paths (doc/static_analysis.md "Dynamic
+        # analysis") — jax-free like lint, and gated the same way
+        from paddle_tpu.analysis.dynamic.cli import main as race_main
+
+        return race_main(rest)
     if cmd == "faults":
         return _faults()
     print(f"unknown command {cmd!r}", file=sys.stderr)

@@ -19,7 +19,6 @@ import itertools
 import json
 import queue
 import random
-import threading
 import time
 from concurrent.futures import TimeoutError as _FutureTimeout
 from typing import Any, Dict, Iterator, List, Optional, Sequence
@@ -34,6 +33,7 @@ from paddle_tpu.observability import spans as obs_spans
 from paddle_tpu.proto import DataConfig
 from paddle_tpu.resilience import BadSampleError, DataStallError
 from paddle_tpu.resilience.faultinject import fault_point
+from paddle_tpu.utils import concurrency as cc
 from paddle_tpu.utils.logging import logger
 from paddle_tpu.utils.retry import RetryPolicy
 
@@ -571,12 +571,12 @@ class DataProvider:
         timeout = self.stall_timeout
         if not timeout or timeout <= 0:
             return fetch(None)
-        wait_start = time.monotonic()
+        wait_start = cc.monotonic()
         while True:
             try:
                 return fetch(min(timeout / 4.0, 1.0))
             except (queue.Empty, TimeoutError, _FutureTimeout):
-                now = time.monotonic()
+                now = cc.monotonic()
                 # progress = a batch handed over (beat) OR a raw sample
                 # pulled (self._progress): pool-filling counts as
                 # progress, only true dead air trips
@@ -614,12 +614,12 @@ class DataProvider:
         budget (upstream in ``_samples``) keep their old semantics."""
         from concurrent.futures import ThreadPoolExecutor
 
-        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch_depth)
+        q = cc.Queue(maxsize=self.prefetch_depth)
         sentinel = object()
         err: List[BaseException] = []
-        beat = [time.monotonic()]
+        beat = [cc.monotonic()]
         busy = [0]
-        busy_lock = threading.Lock()
+        busy_lock = cc.Lock()
         busy_hist = obs.registry().histogram("data.pack_threads_busy")
 
         def pack(batch):
@@ -629,7 +629,7 @@ class DataProvider:
             try:
                 busy_hist.observe(float(n_busy))
                 out = self.assembler.assemble(batch)
-                beat[0] = time.monotonic()  # a finished pack IS progress
+                beat[0] = cc.monotonic()  # a finished pack IS progress
                 return out
             finally:
                 with busy_lock:
@@ -643,7 +643,7 @@ class DataProvider:
             try:
                 for batch in batch_lists:
                     fault_point("provider.stall")
-                    beat[0] = time.monotonic()
+                    beat[0] = cc.monotonic()
                     # the bounded put is the backpressure: at most
                     # prefetch_depth packed/packing batches run ahead
                     q.put(pool.submit(pack, batch))
@@ -652,7 +652,7 @@ class DataProvider:
             finally:
                 q.put(sentinel)
 
-        t = threading.Thread(
+        t = cc.Thread(
             target=dispatcher, daemon=True, name="pt-data-prefetch"
         )
         t.start()

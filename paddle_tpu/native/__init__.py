@@ -17,12 +17,13 @@ import os
 import subprocess
 import sys
 import threading
+from paddle_tpu.utils import concurrency as cc
 from typing import Optional
 
 _SRC = os.path.join(os.path.dirname(os.path.abspath(__file__)), "datapath.cc")
 _ABI_VERSION = 1
 
-_lock = threading.Lock()
+_lock = cc.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
 

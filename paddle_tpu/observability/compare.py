@@ -15,7 +15,11 @@ may be:
   total and per-rule NEW-finding counts from the ``lint_summary``
   record — all lower-is-better, zero-filled from the summary's rule
   list so a rule going 0 → N is judged (REGRESSION, exit 1) instead of
-  falling into ``only_b``.
+  falling into ``only_b``;
+- a **race artifact** (``paddle race --json`` output): same shape as
+  the lint diff — total and per-detector NEW-finding counts from the
+  ``race_summary`` record, zero-filled from its detector list, all
+  lower-is-better (a PR introducing a lock-order inversion regresses).
 
 Every shared metric gets a relative delta and a per-metric verdict
 against a noise threshold (``--threshold``, default 5%): metrics where
@@ -78,10 +82,10 @@ def _higher_is_better(name: str) -> bool:
     if name in _HIGHER_BETTER:
         return _HIGHER_BETTER[name]
     n = name.lower()
-    # lint metrics are finding counts: fewer is always better (and the
-    # bare rule ids would otherwise fall through to the throughput
-    # default below)
-    if n.startswith("lint"):
+    # lint/race metrics are finding counts: fewer is always better (and
+    # the bare rule/detector ids would otherwise fall through to the
+    # throughput default below)
+    if n.startswith(("lint", "race")):
         return False
     # serving metrics (doc/observability.md "Serving telemetry"):
     # goodput and the saturation knee are throughput-like; latency/TTFT/
@@ -275,17 +279,50 @@ def _lint_side(raw: str) -> Optional[Dict[str, float]]:
     return None
 
 
+def _race_side(raw: str) -> Optional[Dict[str, float]]:
+    """Comparable scalars of a ``paddle race --json`` artifact (None
+    when the text carries no race records): total + per-detector NEW
+    finding counts, zero-filled from the summary's detector list so
+    both sides share every key and 0 -> N drift gets a REGRESSION
+    verdict instead of landing in only_b — the exact shape of the lint
+    diff above, for the dynamic analyzer."""
+    recs = list(obs.parse_record_lines(raw))
+    summaries = [r for r in recs if r.get("kind") == "race_summary"]
+    if summaries:
+        s = summaries[-1]  # re-run appended to the same file: last wins
+        counts = s.get("counts") or {}
+        out = {"race_findings": float(s.get("findings") or 0)}
+        for det in (s.get("detectors") or sorted(counts)):
+            out[f"race.{det}"] = float(counts.get(det, 0))
+        return out
+    findings = [r for r in recs if r.get("kind") == "race_finding"]
+    if findings:
+        out = {"race_findings": 0.0}
+        for r in findings:
+            if r.get("baselined"):
+                continue
+            out["race_findings"] += 1.0
+            key = f"race.{r.get('detector', '?')}"
+            out[key] = out.get(key, 0.0) + 1.0
+        return out
+    return None
+
+
 def _probe_lint(path: str) -> bool:
-    """O(1) probe for a lint artifact — a multi-hundred-MB run stream
-    must NOT be read (let alone JSON-parsed) just to learn it is not
-    one (read_records streams it later). `paddle lint --json` writes a
-    lint record as its very first line, so the first 64 KB decide."""
+    """O(1) probe for a lint/race artifact — a multi-hundred-MB run
+    stream must NOT be read (let alone JSON-parsed) just to learn it is
+    not one (read_records streams it later). `paddle lint --json` and
+    `paddle race --json` write their record kinds in the very first
+    line, so the first 64 KB decide."""
     try:
         with open(path) as f:
             head = f.read(65536)
     except OSError:
         return False
-    return '"lint_summary"' in head or '"lint_finding"' in head
+    return any(marker in head for marker in (
+        '"lint_summary"', '"lint_finding"',
+        '"race_summary"', '"race_finding"',
+    ))
 
 
 def load_side(path: str) -> Dict[str, float]:
@@ -293,12 +330,16 @@ def load_side(path: str) -> Dict[str, float]:
         if path.endswith(".jsonl") and not _probe_lint(path):
             pass  # run stream: fall through to the streaming analyzer
         else:
-            # ONE read serves both file-artifact detectors (lint, bench)
+            # ONE read serves all file-artifact detectors (lint, race,
+            # bench)
             with open(path) as f:
                 raw = f.read()
             lint = _lint_side(raw)
             if lint is not None:
                 return lint
+            race = _race_side(raw)
+            if race is not None:
+                return race
             if not path.endswith(".jsonl"):
                 return _bench_side(path, raw)
     if not obs.metrics_files(path):

@@ -31,6 +31,7 @@ import math
 import os
 import socket
 import threading
+from paddle_tpu.utils import concurrency as cc
 import time
 from typing import Any, Dict, Iterator, List, Optional
 
@@ -90,6 +91,8 @@ KIND_REQUIRED = {
     "serve_window": ("rung", "offered_rps"),
     "lint_finding": ("rule", "path", "line"),
     "lint_summary": ("findings", "counts"),
+    "race_finding": ("detector", "spec"),
+    "race_summary": ("findings", "counts"),
 }
 
 
@@ -104,7 +107,7 @@ class Counter:
     def __init__(self, name: str):
         self.name = name
         self._value = 0.0
-        self._lock = threading.Lock()
+        self._lock = cc.Lock()
 
     def inc(self, n: float = 1.0) -> None:
         with self._lock:
@@ -154,7 +157,7 @@ class Histogram:
         self._sum = 0.0
         self._max = -math.inf
         self._min = math.inf
-        self._lock = threading.Lock()
+        self._lock = cc.Lock()
 
     def _index(self, v: float) -> int:
         if v <= self.min_value:
@@ -221,7 +224,7 @@ class MetricsRegistry:
 
     def __init__(self):
         self._metrics: Dict[str, Any] = {}
-        self._lock = threading.Lock()
+        self._lock = cc.Lock()
 
     def _get(self, name: str, cls, *args):
         with self._lock:
@@ -275,7 +278,7 @@ class MetricsWriter:
         self.host = int(host)
         self.buffer_limit = int(buffer_limit)
         self._buf: List[str] = []
-        self._lock = threading.Lock()
+        self._lock = cc.Lock()
         self._closed = False
         self._t0_mono = time.monotonic()
         os.makedirs(self.dir, exist_ok=True)

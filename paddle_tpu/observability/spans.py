@@ -26,6 +26,7 @@ import contextlib
 import json
 import os
 import threading
+from paddle_tpu.utils import concurrency as cc
 import time
 from typing import Iterator, List, Optional
 
@@ -39,7 +40,7 @@ class SpanCollector:
         self.max_events = int(max_events)
         self.dropped = 0
         self._events: List[dict] = []
-        self._lock = threading.Lock()
+        self._lock = cc.Lock()
         self._t0 = time.perf_counter()
 
     def now(self) -> float:
@@ -54,7 +55,7 @@ class SpanCollector:
             "ts": round(start_s * 1e6, 3),   # trace-event time unit: us
             "dur": round(dur_s * 1e6, 3),
             "pid": self.host,
-            "tid": threading.get_ident() % 2**31,
+            "tid": cc.get_ident() % 2**31,
         }
         with self._lock:
             if len(self._events) >= self.max_events:
@@ -70,7 +71,7 @@ class SpanCollector:
             "s": "t",
             "ts": round(self.now() * 1e6, 3),
             "pid": self.host,
-            "tid": threading.get_ident() % 2**31,
+            "tid": cc.get_ident() % 2**31,
         }
         if args:
             ev["args"] = args

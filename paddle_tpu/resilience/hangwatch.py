@@ -47,6 +47,7 @@ import traceback
 from typing import Any, Callable, Dict, Optional, Tuple
 
 from paddle_tpu.resilience import EXIT_HANG  # re-export for callers
+from paddle_tpu.utils import concurrency as cc
 from paddle_tpu.utils.logging import logger
 
 HANG_REPORT = "hang_report.json"
@@ -113,22 +114,24 @@ class HangWatch:
         timeout_s: float,
         report_dir: str = "",
         *,
-        clock: Callable[[], float] = time.monotonic,
+        clock: Optional[Callable[[], float]] = None,
         exit_fn: Callable[[int], None] = os._exit,
         poll_s: Optional[float] = None,
     ):
         assert timeout_s > 0, timeout_s
         self.timeout_s = float(timeout_s)
         self.report_dir = report_dir or "."
-        self.clock = clock
+        # resolved at construction through the concurrency seam: under
+        # `paddle race` the watch runs on the explorer's virtual clock
+        self.clock = clock if clock is not None else cc.monotonic
         self.exit_fn = exit_fn
         self.poll_s = float(poll_s) if poll_s else min(self.timeout_s / 4.0, 5.0)
-        self._lock = threading.Lock()
+        self._lock = cc.Lock()
         self._last = self.clock()
         self._where: Tuple[Optional[int], Optional[int]] = (None, None)
         self._max_age = 0.0
-        self._stop = threading.Event()
-        self._thread: Optional[threading.Thread] = None
+        self._stop = cc.Event()
+        self._thread = None
         self._fired = False
 
     # ------------------------------------------------------------ driven side
@@ -168,7 +171,7 @@ class HangWatch:
                 self._last = self.clock()
                 self._max_age = 0.0
             self._stop.clear()
-            self._thread = threading.Thread(
+            self._thread = cc.Thread(
                 target=self._run, name="hangwatch", daemon=True
             )
             self._thread.start()
@@ -236,7 +239,7 @@ class HangWatch:
         # filesystem whose death caused the hang — OSError would never
         # fire. The backstop guarantees exit 19 within
         # FORENSICS_DEADLINE_S no matter what the forensics do.
-        backstop = threading.Timer(
+        backstop = cc.Timer(
             FORENSICS_DEADLINE_S, self.exit_fn, args=(EXIT_HANG,)
         )
         backstop.daemon = True

@@ -30,9 +30,10 @@ from __future__ import annotations
 import json
 import os
 import socket
-import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from paddle_tpu.utils import concurrency as cc
 
 HEARTBEAT_FMT = "host-%d.json"
 # the monitor's default when --heartbeat_stale_after is unset: a beat
@@ -157,9 +158,9 @@ class HeartbeatWriter:
         # (start's synchronous first beat, stop's final one) — the seq
         # increment must not tear between them, and monitors rely on
         # seq to be strictly increasing per host
-        self._seq_lock = threading.Lock()
-        self._stop = threading.Event()
-        self._thread: Optional[threading.Thread] = None
+        self._seq_lock = cc.Lock()
+        self._stop = cc.Event()
+        self._thread = None
 
     def beat(self, **extra) -> None:
         from paddle_tpu.utils.logging import logger
@@ -199,7 +200,7 @@ class HeartbeatWriter:
         if self._thread is None:
             self.beat()  # first beat synchronously: monitors see us asap
             self._stop.clear()
-            self._thread = threading.Thread(
+            self._thread = cc.Thread(
                 target=self._run, name="heartbeat", daemon=True
             )
             self._thread.start()

@@ -324,9 +324,9 @@ class Supervisor:
         --save_on_preempt handler checkpoints; a forwarded SIGTERM also
         stops the restart loop. No-op off the main thread (library/test
         embedding), same degradation as the trainer's guard."""
-        import threading
+        from paddle_tpu.utils import concurrency as cc
 
-        if threading.current_thread() is not threading.main_thread():
+        if cc.current_thread() is not cc.main_thread():
             return None
 
         def fwd(signum, frame):

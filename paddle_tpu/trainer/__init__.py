@@ -1,5 +1,35 @@
-from paddle_tpu.trainer.trainer import Trainer, TrainerStats
-from paddle_tpu.trainer.evaluators import EvaluatorChain, evaluator_registry
-from paddle_tpu.trainer import checkpoint
+"""Training driver package.
 
-__all__ = ["Trainer", "TrainerStats", "EvaluatorChain", "evaluator_registry", "checkpoint"]
+Lazily resolved (PEP 562): ``paddle_tpu.trainer.async_ckpt``'s
+concurrency machinery is jax-free by design and `paddle race` (the
+deterministic schedule explorer) imports it on machines — and in CI
+lanes — where the accelerator runtime must not be paid for or even
+present. Importing the package therefore must not drag in
+``trainer.trainer`` (jax) as a side effect; ``from paddle_tpu.trainer
+import Trainer`` still works, resolving on first touch.
+"""
+
+import importlib
+from typing import Any
+
+__all__ = ["Trainer", "TrainerStats", "EvaluatorChain",
+           "evaluator_registry", "checkpoint"]
+
+# attribute -> the submodule that defines it. importlib.import_module
+# (NOT `from ... import ...`) — the from-import form re-probes this
+# package's __getattr__ for the submodule name mid-import and recurses.
+_HOMES = {
+    "Trainer": "paddle_tpu.trainer.trainer",
+    "TrainerStats": "paddle_tpu.trainer.trainer",
+    "EvaluatorChain": "paddle_tpu.trainer.evaluators",
+    "evaluator_registry": "paddle_tpu.trainer.evaluators",
+    "checkpoint": "paddle_tpu.trainer.checkpoint",
+}
+
+
+def __getattr__(name: str) -> Any:
+    home = _HOMES.get(name)
+    if home is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    mod = importlib.import_module(home)
+    return mod if name == "checkpoint" else getattr(mod, name)

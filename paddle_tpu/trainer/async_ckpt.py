@@ -80,18 +80,16 @@ sharded twin (doc/resilience.md "Elastic sharded checkpointing"):
 
 from __future__ import annotations
 
+import importlib
 import json
 import os
-import threading
-import time
 from typing import Any, Callable, Dict, List, Optional
 
-import jax
 import numpy as np
 
 from paddle_tpu.observability import metrics as obs
 from paddle_tpu.resilience import CheckpointError
-from paddle_tpu.trainer import checkpoint as ckpt
+from paddle_tpu.utils import concurrency as cc
 from paddle_tpu.utils.logging import logger
 
 __all__ = [
@@ -99,11 +97,35 @@ __all__ = [
 ]
 
 
+class _LazyModule:
+    """Import-on-first-attribute proxy. The concurrency machinery here
+    (queues, writer threads, the drain protocol) is jax-free by design
+    — `paddle race` drives it with injected write/snapshot/finalize
+    seams and must never pay (or depend on) the jax import — while the
+    production paths still reach the real checkpoint module the moment
+    they touch it. Attribute assignment works normally (tests
+    monkeypatch ``ac_mod.ckpt.finalize_sharded_pass``)."""
+
+    def __init__(self, name: str):
+        self._name = name
+
+    def __getattr__(self, attr):
+        if attr.startswith("__"):  # dunder probes (copy/pickle) stay cheap
+            raise AttributeError(attr)
+        return getattr(importlib.import_module(self._name), attr)
+
+
+#: the durable-protocol module (PR 1), resolved lazily — see _LazyModule
+ckpt: Any = _LazyModule("paddle_tpu.trainer.checkpoint")
+
+
 def snapshot_to_host(tree):
     """Device→host copy of a pytree: dispatch EVERY leaf's async copy
     first, then collect — the collection blocks only until the last DMA
     lands, not once per leaf. Host leaves (numpy scalars in a restored
     opt_state) pass through."""
+    import jax
+
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     for leaf in leaves:
         copy_async = getattr(leaf, "copy_to_host_async", None)
@@ -120,10 +142,14 @@ def snapshot_to_host(tree):
 
 class _Job:
     __slots__ = ("pass_id", "params", "opt_state", "extra_meta", "keep",
-                 "protect_pass", "on_durable", "snapshot", "meta")
+                 "protect_pass", "on_durable", "snapshot", "meta", "seq")
 
     def __init__(self, pass_id, params, opt_state, extra_meta, keep,
                  protect_pass, on_durable, snapshot=None, meta=None):
+        # seq: per-checkpointer monotonically increasing id, assigned at
+        # enqueue under the cv. drain()'s writer-progress signal keys on
+        # it — NOT on id(job), which the allocator can recycle
+        self.seq = -1
         self.pass_id = pass_id
         self.params = params
         self.opt_state = opt_state
@@ -152,16 +178,22 @@ class AsyncCheckpointer:
         hangwatch=None,
         *,
         write_fn: Optional[Callable[..., str]] = None,
+        snapshot_fn: Optional[Callable[[Any], Any]] = None,
     ):
         self.save_dir = save_dir
         self.inflight_limit = max(1, int(inflight_limit))
         self.hangwatch = hangwatch
-        self._write_fn = write_fn or ckpt.save_checkpoint
-        self._cv = threading.Condition()
+        # injectable seams: production uses the PR-1 durable protocol
+        # and the async device→host snapshot; unit tests and the race
+        # explorer substitute gated/jax-free fakes
+        self._write_fn = write_fn  # None -> ckpt.save_checkpoint, lazily
+        self._snapshot_fn = snapshot_fn or snapshot_to_host
+        self._cv = cc.Condition()
         self._pending: List[_Job] = []     # queued, oldest first
         self._active: Optional[_Job] = None
         self._error: Optional[BaseException] = None
-        self._thread: Optional[threading.Thread] = None
+        self._thread = None
+        self._job_seq = 0                  # next _Job.seq, under the cv
         self.dropped = 0
         self.completed = 0
 
@@ -182,12 +214,12 @@ class AsyncCheckpointer:
         ``ckpt.blocked_s`` accounts). Raises :class:`CheckpointError`
         first if a PREVIOUS background write failed."""
         self._raise_pending_error()
-        t0 = time.perf_counter()
+        t0 = cc.perf_counter()
         # ONE pytree so every leaf's async copy (params AND opt_state)
         # is dispatched before the first collection blocks — collecting
         # params first would serialize the two DMA trees
-        host_params, host_opt = snapshot_to_host((params, opt_state))
-        blocked = time.perf_counter() - t0
+        host_params, host_opt = self._snapshot_fn((params, opt_state))
+        blocked = cc.perf_counter() - t0
         job = _Job(pass_id, host_params, host_opt, dict(extra_meta or {}),
                    keep, protect_pass, on_durable)
         self._enqueue(job, blocked)
@@ -198,6 +230,8 @@ class AsyncCheckpointer:
         shared half of sync-tree and sharded saves): drop-oldest-pending
         beyond the limit, wake the writer, account the snapshot cost."""
         with self._cv:
+            job.seq = self._job_seq
+            self._job_seq += 1
             self._pending.append(job)
             # drop-oldest-pending: the active write cannot be revoked
             # mid-protocol and the newest state is the one worth keeping
@@ -226,27 +260,35 @@ class AsyncCheckpointer:
         seconds passed — then :class:`CheckpointError`). Pings the
         hangwatch while the writer is demonstrably live so a long write
         at a drain barrier is not misdiagnosed as a trainer hang."""
-        deadline = None if timeout is None else time.monotonic() + timeout
+        deadline = None if timeout is None else cc.monotonic() + timeout
         # a dead/never-started writer would leave the queue stuck: make
         # sure one is running before waiting on it
         self._ensure_thread()
         with self._cv:
             last_state = None
             while self._pending or self._active is not None:
-                # ping only when the writer DEMONSTRABLY progressed
-                # (a write completed / a new job was claimed) since the
+                # ping only when the WRITER demonstrably progressed (a
+                # write completed / a new job was claimed) since the
                 # last poll: an unconditional ping would keep a writer
                 # wedged forever on a dead fs from ever tripping the
-                # watchdog — the exact failure hangwatch exists for
-                state = (self.completed, len(self._pending),
-                         id(self._active))
+                # watchdog — the exact failure hangwatch exists for.
+                # Keyed on the claimed job's enqueue seq, NOT on queue
+                # shape or id(): a concurrent save()'s drop-oldest
+                # rearranging `_pending` is trainer-side motion (the
+                # wedged writer would look live and never trip the
+                # watchdog), and a recycled id() after a completed job
+                # would hide a real claim (a live writer tripping it) —
+                # both surfaced by the `paddle race` drain spec
+                state = (self.completed,
+                         self._active.seq if self._active is not None
+                         else None)
                 if (self.hangwatch is not None
                         and self._active is not None
                         and state != last_state):
                     self.hangwatch.ping(self._active.pass_id)
                 last_state = state
                 self._cv.wait(timeout=0.2)
-                if deadline is not None and time.monotonic() > deadline:
+                if deadline is not None and cc.monotonic() > deadline:
                     raise CheckpointError(
                         f"async checkpoint drain timed out after {timeout}s "
                         f"({len(self._pending)} pending, active="
@@ -270,7 +312,7 @@ class AsyncCheckpointer:
         with self._cv:
             if self._thread is not None and self._thread.is_alive():
                 return
-            self._thread = threading.Thread(
+            self._thread = cc.Thread(
                 target=self._run, name="pt-ckpt-writer", daemon=True
             )
             self._thread.start()
@@ -279,7 +321,12 @@ class AsyncCheckpointer:
         while True:
             with self._cv:
                 while not self._pending:
-                    self._cv.wait()
+                    # BOUNDED idle wait (lint rule PTL008): a daemon
+                    # thread parked forever on an uninstrumented
+                    # primitive cannot be reported forensically by the
+                    # hang-defense stack; waking to re-check the
+                    # predicate once a minute is free
+                    self._cv.wait(timeout=60.0)
                 self._active = self._pending.pop(0)
                 self._set_inflight_gauge_locked()
                 job = self._active
@@ -295,12 +342,15 @@ class AsyncCheckpointer:
                     self._set_inflight_gauge_locked()
                     self._cv.notify_all()
 
+    def _default_write_fn(self):
+        return ckpt.save_checkpoint
+
     def _write(self, job: _Job) -> None:
         if self.hangwatch is not None:
             self.hangwatch.ping(job.pass_id)
-        t0 = time.perf_counter()
+        t0 = cc.perf_counter()
         try:
-            path = self._write_fn(
+            path = (self._write_fn or self._default_write_fn())(
                 self.save_dir,
                 job.pass_id,
                 job.params,
@@ -321,7 +371,7 @@ class AsyncCheckpointer:
         finally:
             if self.hangwatch is not None:
                 self.hangwatch.ping(job.pass_id)
-        dt = time.perf_counter() - t0
+        dt = cc.perf_counter() - t0
         # under the cv: drain() reads `completed` (from the step-loop
         # thread) as its writer-progress signal — a torn increment would
         # read as "no progress" and misdiagnose a live drain as a hang
@@ -369,6 +419,8 @@ class _KvAgreement:
     process's round counter stays aligned."""
 
     def __init__(self, timeout_s: float = 600.0):
+        import jax
+
         from paddle_tpu.utils.barrier import distributed_client
 
         self.timeout_s = float(timeout_s)
@@ -422,13 +474,24 @@ class ShardedAsyncCheckpointer(AsyncCheckpointer):
         agreement=None,
         agree_timeout: float = 600.0,
         write_fn: Optional[Callable[..., None]] = None,
+        snapshot_fn: Optional[Callable[..., Any]] = None,
+        finalize_fn: Optional[Callable[..., str]] = None,
     ):
-        super().__init__(
-            save_dir, inflight_limit, hangwatch,
-            write_fn=write_fn or ckpt.write_sharded_host_trees,
-        )
-        self.pid = jax.process_index() if process_index is None else int(process_index)
-        self.count = jax.process_count() if process_count is None else int(process_count)
+        super().__init__(save_dir, inflight_limit, hangwatch,
+                         write_fn=write_fn)
+        # sharded snapshot contract differs from the base's one-tree
+        # copy: (pass_id, params, opt_state, extra_meta) -> (snapshot,
+        # meta); finalize_fn(pass_id, job, rotate) -> final path runs
+        # process 0's commit merge. Both injectable (race specs drive
+        # the REAL queue/commit protocol jax-free)
+        self._snapshot_fn = snapshot_fn or self._default_shard_snapshot
+        self._finalize_fn = finalize_fn or self._default_finalize
+        if process_index is None or process_count is None:
+            import jax
+        self.pid = (jax.process_index() if process_index is None
+                    else int(process_index))
+        self.count = (jax.process_count() if process_count is None
+                      else int(process_count))
         self.agreement = agreement or _KvAgreement(agree_timeout)
         # locally durable jobs awaiting the commit agreement
         self._durable: List[_Job] = []
@@ -455,12 +518,11 @@ class ShardedAsyncCheckpointer(AsyncCheckpointer):
         pending LOCAL writer error is NOT raised here — it travels
         through the next drain's agreement so every host fails together
         instead of this one desyncing the collective call sites."""
-        t0 = time.perf_counter()
-        trees, meta = ckpt.build_save_trees(
-            pass_id, params, opt_state, extra_meta, multihost=True
+        t0 = cc.perf_counter()
+        snapshot, meta = self._snapshot_fn(
+            pass_id, params, opt_state, extra_meta
         )
-        snapshot = ckpt.snapshot_owned_trees(trees, self.pid)
-        blocked = time.perf_counter() - t0
+        blocked = cc.perf_counter() - t0
         job = _Job(pass_id, None, None, dict(extra_meta or {}), keep,
                    protect_pass, on_durable, snapshot=snapshot, meta=meta)
         self._saves_since_drain += 1
@@ -529,21 +591,11 @@ class ShardedAsyncCheckpointer(AsyncCheckpointer):
         if self.pid == 0:
             try:
                 for i, p in enumerate(ordered):
-                    job = local[p]
-                    t0 = time.perf_counter()
-                    finals[p] = ckpt.finalize_sharded_pass(
-                        self.save_dir, p, job.snapshot.keys(), job.meta,
-                        keep=job.keep, protect_pass=job.protect_pass,
-                        expected_pids=range(self.count),
-                        # ONE rotation after the last commit: rotating
-                        # mid-batch would sweep the .tmp of the next pass
-                        # awaiting its own commit
-                        rotate=(i == len(ordered) - 1),
-                    )
-                    logger.info("saved checkpoint %s", finals[p])
-                    ckpt._ckpt_record(
-                        "save", finals[p], t0, pass_id=p, measure_bytes=True,
-                        step=job.extra_meta.get("batch_id"),
+                    # ONE rotation after the last commit: rotating
+                    # mid-batch would sweep the .tmp of the next pass
+                    # awaiting its own commit
+                    finals[p] = self._finalize_fn(
+                        p, local[p], i == len(ordered) - 1
                     )
             except BaseException as e:
                 # captured, not raised: the commit round below must still
@@ -568,10 +620,14 @@ class ShardedAsyncCheckpointer(AsyncCheckpointer):
         for p in ordered:
             job = local[p]
             if job.on_durable is not None:
+                final = finals.get(p)
+                if final is None:
+                    # non-zero pids never ran finalize; reconstruct the
+                    # path (this is the one place a peer host touches
+                    # the checkpoint module, and only lazily)
+                    final = os.path.join(self.save_dir, ckpt.PASS_FMT % p)
                 try:
-                    job.on_durable(
-                        p, finals.get(p, os.path.join(self.save_dir, ckpt.PASS_FMT % p))
-                    )
+                    job.on_durable(p, final)
                 except Exception:
                     logger.warning(
                         "async checkpoint: on_durable callback failed for "
@@ -580,12 +636,37 @@ class ShardedAsyncCheckpointer(AsyncCheckpointer):
 
     # --------------------------------------------------------- writer side
 
+    def _default_shard_snapshot(self, pass_id, params, opt_state, extra_meta):
+        trees, meta = ckpt.build_save_trees(
+            pass_id, params, opt_state, extra_meta, multihost=True
+        )
+        return ckpt.snapshot_owned_trees(trees, self.pid), meta
+
+    def _default_finalize(self, pass_id: int, job: _Job, rotate: bool) -> str:
+        t0 = cc.perf_counter()
+        final = ckpt.finalize_sharded_pass(
+            self.save_dir, pass_id, job.snapshot.keys(), job.meta,
+            keep=job.keep, protect_pass=job.protect_pass,
+            expected_pids=range(self.count), rotate=rotate,
+        )
+        logger.info("saved checkpoint %s", final)
+        ckpt._ckpt_record(
+            "save", final, t0, pass_id=pass_id, measure_bytes=True,
+            step=job.extra_meta.get("batch_id"),
+        )
+        return final
+
+    def _default_write_fn(self):
+        return ckpt.write_sharded_host_trees
+
     def _write(self, job: _Job) -> None:
         if self.hangwatch is not None:
             self.hangwatch.ping(job.pass_id)
-        t0 = time.perf_counter()
+        t0 = cc.perf_counter()
         try:
-            self._write_fn(self.save_dir, job.pass_id, job.snapshot, self.pid)
+            (self._write_fn or self._default_write_fn())(
+                self.save_dir, job.pass_id, job.snapshot, self.pid
+            )
         except BaseException as e:
             with self._cv:
                 self._error = e
@@ -599,7 +680,7 @@ class ShardedAsyncCheckpointer(AsyncCheckpointer):
         finally:
             if self.hangwatch is not None:
                 self.hangwatch.ping(job.pass_id)
-        dt = time.perf_counter() - t0
+        dt = cc.perf_counter() - t0
         obs.registry().counter("ckpt.write_s").inc(dt)
         # the written pieces are on disk now — keep only the tree bases
         # (what the commit merge needs), so a pass awaiting its

@@ -38,6 +38,7 @@ from paddle_tpu.trainer.evaluators import EvaluatorChain
 from paddle_tpu.observability import compile_log
 from paddle_tpu.observability import metrics as obs
 from paddle_tpu.observability import spans as obs_spans
+from paddle_tpu.utils import concurrency as cc
 from paddle_tpu.utils.flags import FLAGS
 from paddle_tpu.utils.logging import logger
 from paddle_tpu.utils.stats import global_stats, stat_timer
@@ -771,7 +772,6 @@ class Trainer:
         flags.save_on_preempt (default on; the handler itself is cheap)."""
         import contextlib
         import signal
-        import threading
 
         # Gates: flag off; non-main thread (signal API unavailable);
         # multi-process (the flag would be per-host and unsynchronized —
@@ -780,7 +780,7 @@ class Trainer:
         # on the deterministic periodic saves instead, doc/divergences.md)
         if (not getattr(self.flags, "save_on_preempt", True)
                 or self._multiproc
-                or threading.current_thread() is not threading.main_thread()):
+                or cc.current_thread() is not cc.main_thread()):
             return contextlib.nullcontext()
 
         @contextlib.contextmanager
@@ -1394,7 +1394,7 @@ class Trainer:
                             "SIGTERM received — no save_dir, nothing saved")
                 if profiling:
                     # the open trace would otherwise be abandoned mid-write
-                    jax.block_until_ready(self.params)
+                    jax.block_until_ready(self.params)  # lint: disable=PTL002 -- preemption exit: runs AT MOST ONCE per process (SIGTERM teardown), and the profiler trace must see the last launch land before stop_trace abandons it
                     jax.profiler.stop_trace()
                     logger.info("profiler trace written to %s",
                                 self.flags.profile_dir)
@@ -1412,7 +1412,7 @@ class Trainer:
             if profiling and batch_id >= (
                 self.flags.profile_start_batch + self.flags.profile_num_batches
             ):
-                jax.block_until_ready(self.params)
+                jax.block_until_ready(self.params)  # lint: disable=PTL002 -- profiler window close: runs ONCE per run (profiling flips false right below), and the trace must include the final profiled launch before stop_trace
                 jax.profiler.stop_trace()
                 profiling = False
                 profiled = True

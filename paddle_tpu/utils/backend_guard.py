@@ -100,6 +100,7 @@ def run_abandoning(cmd, timeout_s, env=None, signal_if=None):
     emitted before a later leg hung — are still salvaged)."""
     import subprocess
     import threading
+    from paddle_tpu.utils import concurrency as cc
 
     proc = subprocess.Popen(
         cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env
@@ -111,8 +112,8 @@ def run_abandoning(cmd, timeout_s, env=None, signal_if=None):
             bufs[key].append(line)
 
     threads = [
-        threading.Thread(target=_reader, args=(proc.stdout, "out"), daemon=True),
-        threading.Thread(target=_reader, args=(proc.stderr, "err"), daemon=True),
+        cc.Thread(target=_reader, args=(proc.stdout, "out"), daemon=True),
+        cc.Thread(target=_reader, args=(proc.stderr, "err"), daemon=True),
     ]
     for t in threads:
         t.start()

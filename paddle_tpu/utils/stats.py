@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import contextlib
 import threading
+from paddle_tpu.utils import concurrency as cc
 import time
 from dataclasses import dataclass, field
 from typing import Dict, Iterator
@@ -24,7 +25,7 @@ class Stat:
     total_s: float = 0.0
     count: int = 0
     max_s: float = 0.0
-    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+    _lock: object = field(default_factory=cc.Lock, repr=False)
 
     def add(self, dt: float) -> None:
         with self._lock:
@@ -42,7 +43,7 @@ class StatSet:
     def __init__(self, name: str = "global"):
         self.name = name
         self._stats: Dict[str, Stat] = {}
-        self._lock = threading.Lock()
+        self._lock = cc.Lock()
 
     def get(self, name: str) -> Stat:
         with self._lock:

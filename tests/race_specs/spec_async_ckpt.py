@@ -1,0 +1,132 @@
+"""Race spec: AsyncCheckpointer save / drain / drop-oldest.
+
+Drives the REAL single-process async checkpoint writer (PR 5) through
+its injectable seams — jax-free fakes for the snapshot and the durable
+write — under explored interleavings of:
+
+- the step-loop thread enqueueing saves (including a drop-oldest
+  overflow while the writer is busy),
+- a second saver thread racing the queue (the library contract: the
+  bounded queue + cv protect the queue, whoever calls),
+- the writer thread claiming/completing jobs,
+- a drain barrier with a hangwatch attached (the drain progress-signal
+  regression this PR fixed: a drop-oldest rearranging the queue is NOT
+  writer progress and must not ping the watchdog for it).
+
+Invariants asserted (schedule-independent, so any violating
+interleaving surfaces as a ``spec_error`` finding):
+
+- after drain: nothing in flight, and every enqueued save was either
+  completed or dropped (no lost jobs, no double counts);
+- completed writes arrive in enqueue order;
+- the watchdog was never pinged by a drain that observed no writer
+  progress (claim or completion) — pings during an idle-writer window
+  would mask a wedged writer forever.
+
+Watch list: the PTL005 static seed over trainer/async_ckpt.py
+(`completed`, `_active`, `_error`, `_pending`, ...), so the three
+PR-9 torn-write bugs, if ever reintroduced, fail here dynamically too.
+"""
+
+from paddle_tpu.trainer.async_ckpt import AsyncCheckpointer
+from paddle_tpu.utils import concurrency as cc
+
+NAME = "async_ckpt"
+
+
+class _Writes:
+    """Deterministic jax-free write_fn: records completions; the first
+    write stalls on a virtual gate so the queue demonstrably backs up
+    behind an ACTIVE writer (drop-oldest then has pending jobs to
+    drop)."""
+
+    def __init__(self, gate):
+        self.gate = gate
+        self.done = []
+
+    def __call__(self, save_dir, pass_id, params, opt_state=None, **kw):
+        if pass_id == 0:
+            self.gate.wait()
+        self.done.append(pass_id)
+        return f"pass-{pass_id}"
+
+
+class _PingLog:
+    """Fake hangwatch: records the full writer state at each DRAIN-side
+    ping — (pass_id, completed, active job seq). The fixed progress
+    signal pings at most once per distinct state, so a duplicate triple
+    proves drain credited something else (drop-oldest queue motion,
+    id() reuse) as writer progress — the wedged-writer-masking bug."""
+
+    def __init__(self):
+        self.drain_pings = []
+        self.ac = None
+
+    def ping(self, pass_id=None, step=None):
+        import threading
+
+        if "writer" in threading.current_thread().name:
+            return  # writer-side start/end pings are unconditional
+        active = self.ac._active
+        self.drain_pings.append(
+            (pass_id, self.ac.completed, active.seq if active else None)
+        )
+
+
+def run(ctx):
+    import logging
+
+    # drop-oldest warnings are the code under test, once per schedule
+    # that drops — bottled up so the analyzer report stays readable
+    logger = logging.getLogger("paddle_tpu")
+    prev_level = logger.level
+    logger.setLevel(logging.CRITICAL)
+    try:
+        _run(ctx)
+    finally:
+        logger.setLevel(prev_level)
+
+
+def _run(ctx):
+    gate = cc.Event()
+    writes = _Writes(gate)
+    hw = _PingLog()
+    ac = AsyncCheckpointer(
+        "", inflight_limit=1, hangwatch=hw,
+        write_fn=writes, snapshot_fn=lambda tree: tree,
+    )
+    hw.ac = ac
+    ctx.static_watch(ac)
+
+    def second_saver():
+        # races the main thread's saves against the same bounded queue
+        ac.save(2, {"w": 2})
+        gate.set()  # un-wedge the writer once the queue has backed up
+
+    t = cc.Thread(target=second_saver, name="saver2", daemon=False)
+    ac.save(0, {"w": 0})
+    t.start()
+    ac.save(1, {"w": 1})
+    t.join()
+    ac.drain()
+
+    # --- invariants (any schedule that breaks one becomes a finding) ---
+    assert ac.inflight() == 0, "drain returned with work in flight"
+    saves = 3
+    assert ac.completed + ac.dropped == saves, (
+        f"lost/duplicated jobs: completed={ac.completed} "
+        f"dropped={ac.dropped} of {saves} saves"
+    )
+    assert len(writes.done) == ac.completed, (writes.done, ac.completed)
+    assert writes.done == sorted(writes.done), (
+        f"writes out of enqueue order: {writes.done}"
+    )
+    # drain progress-signal contract: at most ONE ping per distinct
+    # (completed, active-seq) writer state — a duplicate means drain
+    # credited drop-oldest queue motion or id() reuse as writer
+    # progress, which would keep a wedged writer from ever tripping
+    # the watchdog (the bug this PR fixed; see _wait_idle)
+    states = [(c, s) for (_p, c, s) in hw.drain_pings]
+    assert len(states) == len(set(states)), (
+        f"drain pinged twice for one writer state: {hw.drain_pings}"
+    )

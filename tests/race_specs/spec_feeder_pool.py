@@ -1,0 +1,96 @@
+"""Race spec: the feeder packer pool's bounded future queue.
+
+The feeder's ``_pool_packed`` (PR 5) runs a dispatcher thread that
+submits pack jobs to a worker pool and hands ORDER-PRESERVING futures
+to the consumer through a queue bounded at ``--prefetch_depth``; the
+consumer double-waits (queue get, then future result) under the stall
+watchdog. The production pool is ``concurrent.futures``'s executor,
+whose internal threads the shim cannot gate — so this spec drives the
+same discipline with shim-visible parts: a dispatcher thread, a
+bounded ``cc.Queue`` of future-like cells, one packer worker, and a
+consumer double-wait, all on the virtual scheduler.
+
+What the exploration buys over tests/test_feeder_pool.py's wall-clock
+runs: every put/get/wait interleaving of the backpressure edge (queue
+full exactly when the dispatcher finishes; sentinel racing the last
+future) is exercised, and a lost-wakeup in the handoff discipline —
+e.g. a sentinel placed before the last future resolves, or a bounded
+put that nothing ever drains — quiesces a non-daemon thread and
+becomes a finding instead of a flaky timeout.
+
+Invariants: the consumer receives every batch exactly once, in
+submission order; both pipeline threads terminate.
+"""
+
+import queue as std_queue
+
+from paddle_tpu.utils import concurrency as cc
+
+NAME = "feeder_pool"
+
+DEPTH = 2
+BATCHES = 5
+
+
+class _Future:
+    """Order-preserving future cell: the packer sets the result, the
+    consumer waits — the same two-wait shape as Future.result()."""
+
+    def __init__(self):
+        self.done = cc.Event()
+        self.value = None
+
+    def set(self, value):
+        self.value = value
+        self.done.set()
+
+    def result(self, timeout=None):
+        if not self.done.wait(timeout=timeout):
+            raise TimeoutError()
+        return self.value
+
+
+def run(ctx):
+    out_q = cc.Queue(maxsize=DEPTH)   # bounded future queue (backpressure)
+    work_q = cc.Queue()               # dispatcher -> packer
+    sentinel = object()
+    received = []
+
+    def packer():
+        while True:
+            item = work_q.get()
+            if item is sentinel:
+                return
+            fut, batch = item
+            fut.set(("packed", batch))
+
+    def dispatcher():
+        try:
+            for batch in range(BATCHES):
+                fut = _Future()
+                work_q.put((fut, batch))
+                # the bounded put IS the backpressure: at most DEPTH
+                # packed/packing batches run ahead of the consumer
+                out_q.put(fut)
+        finally:
+            out_q.put(sentinel)
+            work_q.put(sentinel)
+
+    tp = cc.Thread(target=packer, name="packer", daemon=False)
+    td = cc.Thread(target=dispatcher, name="dispatcher", daemon=False)
+    tp.start()
+    td.start()
+
+    # the consumer's double-wait (bounded, like _watched_get's polls)
+    while True:
+        try:
+            fut = out_q.get(timeout=30.0)
+        except std_queue.Empty:
+            raise AssertionError("consumer starved: dispatcher stalled")
+        if fut is sentinel:
+            break
+        received.append(fut.result(timeout=30.0))
+
+    td.join()
+    tp.join()
+    assert received == [("packed", b) for b in range(BATCHES)], received

@@ -1,0 +1,73 @@
+"""Race spec: HangWatch ping / fire / backstop-timer.
+
+Drives the REAL in-process watchdog (PR 4) on the virtual clock:
+
+1. a progressing phase — the step loop pings faster than the timeout
+   while the monitor thread polls; no schedule may fire;
+2. a stall phase — pings stop, virtual time runs past the timeout, the
+   monitor must fire EXACTLY once (the PR-9 ``_fired`` test-and-set is
+   claimed under the lock; an unlocked reintroduction double-fires
+   under some schedule and torn-reads under all of them) and the
+   forensics backstop timer must be cancelled after a successful
+   report (a leaked backstop would exit a healthy process later);
+3. shutdown — stop() joins the monitor; no fire after stop.
+
+The report is written into the spec tmpdir (real, tiny file I/O); the
+exit_fn is a recorder, so "exactly one exit" is an assertable
+invariant rather than a dead process.
+"""
+
+import contextlib
+import io
+import logging
+import os
+
+from paddle_tpu.resilience.hangwatch import HANG_REPORT, HangWatch
+from paddle_tpu.utils import concurrency as cc
+
+NAME = "hangwatch"
+
+
+def run(ctx):
+    # the fire path's forensics (faulthandler stderr dump, logger.error)
+    # are the code under test and fire once per explored schedule —
+    # bottle them up so the analyzer's own report stays readable
+    logger = logging.getLogger("paddle_tpu")
+    prev_level = logger.level
+    logger.setLevel(logging.CRITICAL)
+    try:
+        with contextlib.redirect_stderr(io.StringIO()):
+            _run(ctx)
+    finally:
+        logger.setLevel(prev_level)
+
+
+def _run(ctx):
+    exits = []
+    hw = HangWatch(
+        timeout_s=5.0, report_dir=ctx.tmpdir,
+        exit_fn=lambda code: exits.append(code), poll_s=1.0,
+    )
+    ctx.static_watch(hw)
+    hw.start()
+
+    # phase 1: live progress — ping every virtual second for 8 ticks
+    # (past the 5 s timeout, so only the pings keep it alive)
+    for step in range(8):
+        hw.ping(0, step)
+        cc.sleep(1.0)
+    assert exits == [], f"fired while progressing: {exits}"
+
+    # phase 2: stall — no pings for 3x the timeout; the monitor's poll
+    # loop must fire exactly once even though check() keeps running
+    cc.sleep(15.0)
+    assert exits == [19], (
+        f"expected exactly one EXIT_HANG=19 fire, got {exits} "
+        "(0 = missed stall, >1 = double report: the _fired claim tore)"
+    )
+    assert os.path.exists(os.path.join(ctx.tmpdir, HANG_REPORT))
+
+    # phase 3: shutdown — no further fire, monitor joins
+    hw.stop()
+    cc.sleep(30.0)
+    assert exits == [19], f"fired after stop(): {exits}"

@@ -1,0 +1,64 @@
+"""Race spec: HeartbeatWriter beat / renew / stop.
+
+Drives the REAL cluster-heartbeat writer (PR 4) with its injectable
+clock on the virtual timeline. The contract under test is the one
+monitors rely on: the per-host ``seq`` is strictly increasing and
+every published beat file is well-formed — even when ``stop()``'s
+final synchronous beat overlaps an in-flight daemon-thread renewal
+(the exact overlap PR 9's ``_seq_lock`` exists for; an unlocked
+``_seq += 1`` reintroduction torn-reads here under every schedule and
+loses a seq under some).
+
+Beats land in the spec tmpdir as real (tiny) heartbeat files; the spec
+re-reads the final file like a monitor would.
+"""
+
+import paddle_tpu.resilience.heartbeat as hb_mod
+from paddle_tpu.resilience.heartbeat import HeartbeatWriter, read_beats
+from paddle_tpu.utils import concurrency as cc
+
+NAME = "heartbeat"
+
+
+def run(ctx):
+    # record every seq at the moment it is WRITTEN — inside beat()'s
+    # lock, so the recording carries the exact published values (an
+    # instance-side recorder would itself race the counter)
+    seen = []
+    orig_write = hb_mod.write_beat
+
+    def recording_write(dir_, host, *, seq=0, clock=None, extra=None):
+        seen.append(seq)
+        # a slow shared-fs write, on the virtual clock: this is the
+        # overlap window the bounded _seq_lock acquire exists for —
+        # stop()'s final beat must either serialize behind it or skip
+        # (never tear the counter)
+        cc.sleep(1.5)
+        return orig_write(dir_, host, seq=seq,
+                          clock=clock or (lambda: 0.0), extra=extra)
+
+    hb_mod.write_beat = recording_write
+    try:
+        hb = HeartbeatWriter(ctx.tmpdir, host=0, interval_s=1.0,
+                             clock=lambda: 1e9 + cc.monotonic())
+        ctx.static_watch(hb)
+
+        hb.start()       # synchronous first beat + daemon renewal thread
+        cc.sleep(3.5)    # ~3 renewals on the virtual clock
+        hb.stop()        # final stopped=True beat can overlap a renewal
+    finally:
+        hb_mod.write_beat = orig_write
+
+    beats = read_beats(ctx.tmpdir)
+    assert 0 in beats, "no readable heartbeat published"
+    final = beats[0]
+    # no seq published twice: a torn `_seq += 1` loses an increment
+    # and two beats share a number — the monitor's strictly-increasing
+    # contract breaks
+    assert len(seen) == len(set(seen)), f"duplicate seq published: {seen}"
+    # consecutive from 1: no increment skipped or double-applied
+    assert sorted(seen) == list(range(1, len(seen) + 1)), seen
+    # file writes are serialized under the SAME lock as the increment,
+    # so the beat on disk is the highest seq (a stale in-flight renewal
+    # can never overwrite a newer beat)
+    assert final["seq"] == max(seen), (final, seen)

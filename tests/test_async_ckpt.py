@@ -82,6 +82,14 @@ def test_save_never_blocks_on_write():
     # the write is gated shut: save() returning at all proves the step
     # loop side never waited on it
     w.events.append(("save_returned", 0))
+    # the claim race is real: with inflight_limit=1, a second save
+    # landing before the writer CLAIMS pass 0 drops it (drop-oldest-
+    # pending, per contract) — the `paddle race` async_ckpt spec
+    # explores that schedule deliberately. This test pins the write
+    # ordering, so wait out the claim instead of racing it.
+    deadline = time.monotonic() + 5
+    while ac._active is None and time.monotonic() < deadline:
+        time.sleep(0.001)
     ac.save(1, _params(1.0))
     w.events.append(("save_returned", 1))
     gate.set()

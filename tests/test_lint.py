@@ -293,6 +293,54 @@ def test_ptl007_registry_drift_pair(tmp_path):
     assert clean.findings == []
 
 
+def test_ptl008_unbounded_daemon_blocking_pair(tmp_path):
+    viol = lint_tree(tmp_path / "v", {"writer.py": """\
+        import threading
+
+        class Writer:
+            def start(self):
+                threading.Thread(target=self._run, daemon=True).start()
+
+            def _run(self):
+                while True:
+                    self._cv.wait()
+                    self.q.get()
+                    self.lock.acquire()
+                    self.lock.acquire(True)
+                    self.q.get(True)
+        """})
+    assert [f.rule for f in viol.findings] == ["PTL008"] * 5
+    msgs = " / ".join(f.message for f in viol.findings)
+    assert "wait" in msgs and "get" in msgs and "acquire" in msgs
+    # bounded waits, non-blocking forms, dict.get, and NON-daemon
+    # threads all pass
+    clean = lint_tree(tmp_path / "c", {"writer.py": """\
+        import threading
+
+        class Writer:
+            def start(self):
+                threading.Thread(target=self._run, daemon=True).start()
+                threading.Thread(target=self._join_side).start()
+
+            def _run(self):
+                while True:
+                    self._cv.wait(timeout=60.0)
+                    self.q.get(timeout=1.0)
+                    self.lock.acquire(timeout=1.0)
+                    self.lock.acquire(blocking=False)
+                    self.lock.acquire(False)
+                    self.q.get(False)
+                    self.q.get(block=False)
+                    self.opts.get("key")
+                    self.opts.get(self.key)
+                    self.q.get_nowait()
+
+            def _join_side(self):
+                self._cv.wait()
+        """})
+    assert clean.findings == []
+
+
 # ----------------------------------------------------------- suppressions
 
 
