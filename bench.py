@@ -196,6 +196,14 @@ def _time_steps(step, params, opt_state, batch, bs, steps, warmup, trace=False, 
         hit = hit_probe()
         if hit is not None:
             compile_info["compile_cache_hit"] = hit
+        # static memory plan of this leg's one launch group — BENCH_*.json
+        # carries a memory trajectory alongside throughput, and `paddle
+        # compare` judges footprint growth (doc/observability.md)
+        from paddle_tpu.observability.memory import memory_analysis_of
+
+        mem = memory_analysis_of(compiled)
+        if mem:
+            compile_info["static_mem_bytes"] = mem["mem_total_bytes"]
         if flops is None:
             flops = flops_of_compiled(compiled)
             if flops is not None and kernel_log:
@@ -227,6 +235,14 @@ def _time_steps(step, params, opt_state, batch, bs, steps, warmup, trace=False, 
             params, opt_state, loss = step(params, opt_state, batch, bs)
         float(loss)
         dt = time.perf_counter() - t0
+    # live HBM peak over the timed run (allocator cumulative peak —
+    # host-side C call, no device sync); absent on backends without
+    # allocator stats (CPU), same degradation as the kind=memory records
+    from paddle_tpu.observability.memory import device_memory_stats
+
+    stats = device_memory_stats()
+    if stats and stats.get("peak_bytes_in_use"):
+        compile_info["peak_hbm_bytes"] = stats["peak_bytes_in_use"]
     return dt, flops, compile_info
 
 
@@ -244,13 +260,17 @@ def _is_oom(e) -> bool:
     """True only for memory-exhaustion failures. Anything else (a shape
     bug, a bad rewrite, a lowering error) must FAIL the leg loudly rather
     than silently stepping the ladder down and reporting a healthy-looking
-    number for a different configuration."""
-    msg = f"{type(e).__name__}: {e}".lower()
-    return any(
-        s in msg
-        for s in ("resource_exhausted", "resource exhausted", "out of memory",
-                  "failed to allocate", "oom")
-    )
+    number for a different configuration.
+
+    The base classifier is the ONE shared OOM matcher
+    (observability/memory.py — what routes a training death to the
+    oom_report.json pre-mortem and EXIT_OOM); the bench ladder adds the
+    looser bare-'oom' token on top, acceptable only HERE because this
+    predicate runs inside a leg where memory exhaustion is the expected
+    failure mode — the trainer-wide catch must not inherit it."""
+    from paddle_tpu.observability.memory import is_oom_error
+
+    return is_oom_error(e) or "oom" in f"{type(e).__name__}: {e}".lower()
 
 
 def _pallas_on() -> bool:
@@ -745,6 +765,17 @@ def bench_serve(B=None, T=None, vocab=None, dim=None, beam_size=None,
         knee_rps=doc.get("knee_rps"), rungs=rungs, run_dir=run_dir,
         tokens="best-beam generated",
     )
+    # memory trajectory for the serve leg too: the sweep's live HBM
+    # peak (absent on stat-less backends) and the serve_gen group's
+    # static plan from its one compile
+    from paddle_tpu.observability.memory import device_memory_stats
+
+    stats = device_memory_stats()
+    if stats and stats.get("peak_bytes_in_use"):
+        extras["peak_hbm_bytes"] = stats["peak_bytes_in_use"]
+    static_rows = registry.static_memory_rows()
+    if static_rows:
+        extras["static_mem_bytes"] = static_rows[0]["mem_total_bytes"]
     return best, extras
 
 

@@ -1,5 +1,6 @@
 """`paddle` CLI — train / supervise / test / checkgrad / dump_config /
-merge_model / metrics / roofline / compare / serve-report / version.
+merge_model / metrics / memory / roofline / compare / serve-report /
+version.
 
 Role of the reference's TrainerMain + `paddle` shell dispatcher
 (/root/reference/paddle/trainer/TrainerMain.cpp:35-110,
@@ -26,7 +27,7 @@ def main(argv=None) -> int:
     if not argv or argv[0] in ("-h", "--help", "help"):
         print(__doc__)
         print("usage: paddle <train|supervise|test|gen|checkgrad|dump_config|"
-              "merge_model|check-checkpoint|metrics|roofline|compare|"
+              "merge_model|check-checkpoint|metrics|memory|roofline|compare|"
               "serve-report|lint|race|faults|version> [--flags]")
         return 0
     cmd, rest = argv[0], argv[1:]
@@ -53,6 +54,13 @@ def main(argv=None) -> int:
         from paddle_tpu.observability.analyze import main as metrics_main
 
         return metrics_main(rest)
+    if cmd == "memory":
+        # HBM accounting: per-launch-group static footprint, live
+        # peak/headroom, OOM pre-mortem rendering (doc/observability.md
+        # "Memory telemetry") — jax-free like `metrics`
+        from paddle_tpu.observability.memory import main as memory_main
+
+        return memory_main(rest)
     if cmd == "roofline":
         # per-launch-group cost attribution (doc/performance.md
         # "Roofline methodology") — jax-free like `metrics`
@@ -153,7 +161,23 @@ def _run_trainer_job(cmd, rest) -> int:
 
     trainer = Trainer(config, flags)
     if cmd == "train":
-        trainer.train()
+        try:
+            trainer.train()
+        except Exception as e:
+            from paddle_tpu.observability.memory import OOM_REPORT, is_oom_error
+
+            if is_oom_error(e):
+                # the trainer already wrote oom_report.json and flushed
+                # the kind=oom record; the distinct code tells
+                # supervisors the death is classified (and budgeted —
+                # an OOM loop is poison, not scheduling)
+                from paddle_tpu.resilience import EXIT_OOM
+
+                print(f"OOM: {e} (forensics: {OOM_REPORT} in the run "
+                      "dir; `paddle memory <run_dir>` renders them)",
+                      file=sys.stderr)
+                return EXIT_OOM
+            raise
         if getattr(trainer, "preempted", False):
             # distinct exit code: supervisors/launchers restart a
             # preempted run without consuming restart budget

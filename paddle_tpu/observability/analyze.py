@@ -87,6 +87,16 @@ def analyze(streams: Dict[int, List[Dict[str, Any]]]) -> Dict[str, Any]:
     hangs: List[Dict[str, Any]] = []
     restarts: List[Dict[str, Any]] = []
     compiles: List[Dict[str, Any]] = []
+    ooms: List[Dict[str, Any]] = []
+    # memory plane: per-pass worst HBM peak / host RSS (the `hbm pk`
+    # column) + the last live snapshot per host; numerics plane: layers
+    # that EVER produced a nonfinite gradient, per pass and overall
+    # (the `nf lyr` column and the compare surface)
+    mem_by_pass: Dict[int, Dict[str, float]] = {}
+    mem_last: Dict[int, Dict[str, Any]] = {}
+    numerics_count = 0
+    nf_layers_by_pass: Dict[int, set] = {}
+    nf_layers_all: set = set()
     # request records dedupe by (host, id) — the SAME latest-wins
     # discipline as the windows: a rerun appending to the default serve
     # run dir re-emits the same request ids, and counting every record
@@ -131,6 +141,25 @@ def analyze(streams: Dict[int, List[Dict[str, Any]]]) -> Dict[str, Any]:
                 restarts.append(rec)
             elif kind == "compile":
                 compiles.append(rec)
+            elif kind == "oom":
+                ooms.append(rec)
+            elif kind == "memory":
+                mem_last[host] = rec
+                p = rec.get("pass")
+                if isinstance(p, int):
+                    row = mem_by_pass.setdefault(p, {})
+                    for src in ("hbm_peak_bytes", "host_rss_bytes"):
+                        if isinstance(rec.get(src), (int, float)):
+                            row[src] = max(
+                                float(row.get(src, 0.0)), float(rec[src])
+                            )
+            elif kind == "numerics":
+                numerics_count += 1
+                p = rec.get("pass")
+                nf = set(rec.get("nonfinite_layers") or [])
+                nf_layers_all |= nf
+                if isinstance(p, int):
+                    nf_layers_by_pass.setdefault(p, set()).update(nf)
             elif kind == "request":
                 serve_request_ids.add((host, rec.get("id")))
                 if rec.get("rung", -1) >= 0:
@@ -212,6 +241,15 @@ def analyze(streams: Dict[int, List[Dict[str, Any]]]) -> Dict[str, Any]:
                     float(rec.get("step_time_p99_s", rec["step_time_mean_s"])),
                 )
         per_host_prev[host] = prev_counters
+
+    # fold the memory/numerics planes into the pass rows (worst host,
+    # like the step quantiles)
+    for p, mrow in mem_by_pass.items():
+        if p in passes:
+            passes[p].update(mrow)
+    for p, layer_set in nf_layers_by_pass.items():
+        if p in passes:
+            passes[p]["nf_layers"] = len(layer_set)
 
     # straggler attribution: feed the gathered per-host step stats of the
     # LAST pass with full coverage through the BarrierStat formatter
@@ -303,6 +341,18 @@ def analyze(streams: Dict[int, List[Dict[str, Any]]]) -> Dict[str, Any]:
             f"for {h.get('age_s', '?')}s (exit 19; forensics in "
             f"{h.get('report', 'hang_report.json')})"
         )
+    for o in ooms:
+        warnings.append(
+            f"OOM on host {o.get('host', '?')} at pass {o.get('pass', '?')} "
+            f"step {o.get('step', '?')} (exit 20; pre-mortem in "
+            f"{o.get('report', 'oom_report.json')} — "
+            "`paddle memory <run_dir>` renders it)"
+        )
+    if nf_layers_all:
+        warnings.append(
+            "nonfinite gradients observed in layer(s): "
+            + ", ".join(sorted(nf_layers_all))
+        )
     if last_skew is not None and last_skew.get("line"):
         warnings.append(f"barrier skew: {last_skew['line']}")
     # oneshot request records (the embedding API's SequenceGenerator —
@@ -355,6 +405,16 @@ def analyze(streams: Dict[int, List[Dict[str, Any]]]) -> Dict[str, Any]:
             "rungs": len({w.get("rung") for w in serve_windows}),
         }
 
+    # memory/numerics planes (doc/observability.md "Memory & numerics
+    # telemetry") — None when the run predates them, so old-run JSON
+    # output keeps its shape
+    memory = {"last": mem_last} if mem_last else None
+    numerics = (
+        {"records": numerics_count,
+         "nonfinite_layers": sorted(nf_layers_all)}
+        if numerics_count else None
+    )
+
     return {
         "hosts": hosts,
         "passes": [passes[p] for p in sorted(passes)],
@@ -363,6 +423,9 @@ def analyze(streams: Dict[int, List[Dict[str, Any]]]) -> Dict[str, Any]:
         "compile_totals": compile_totals,
         "restarts": restarts,
         "restart_latency": restart_latency,
+        "memory": memory,
+        "numerics": numerics,
+        "ooms": ooms,
         "serve": serve,
         "serve_windows": serve_windows,
         "counters": {h: per_host_prev.get(h, {}) for h in hosts},
@@ -385,6 +448,11 @@ def _fmt_table(doc: Dict[str, Any]) -> str:
     # the old table shape
     with_ckpt = any(r.get("ckpt_blocked_s", 0.0) > 0 for r in doc["passes"])
     with_pack = any("pack_busy_mean" in r for r in doc["passes"])
+    # memory/numerics columns: per-pass worst HBM peak (GB — absent on
+    # backends without allocator stats, where records carry RSS only)
+    # and the count of layers with nonfinite gradients that pass
+    with_hbm = any("hbm_peak_bytes" in r for r in doc["passes"])
+    with_nf_layers = any("nf_layers" in r for r in doc["passes"])
     header = (
         f"{'pass':>5} {'samples':>9} {'AvgCost':>10} {'p50 ms':>8} "
         f"{'p99 ms':>8} {'data-wait':>9} {'nf':>4} {'retry':>5} {'fault':>5}"
@@ -395,6 +463,10 @@ def _fmt_table(doc: Dict[str, Any]) -> str:
         header += f" {'ckpt blk s':>10}"
     if with_pack:
         header += f" {'pack busy':>9}"
+    if with_hbm:
+        header += f" {'hbm pk':>8}"
+    if with_nf_layers:
+        header += f" {'nf lyr':>6}"
     lines = [header]
     for row in doc["passes"]:
         line = (
@@ -413,6 +485,11 @@ def _fmt_table(doc: Dict[str, Any]) -> str:
             line += f" {row.get('ckpt_blocked_s', 0.0):>10.4f}"
         if with_pack:
             line += f" {row.get('pack_busy_mean', 0.0):>9.2f}"
+        if with_hbm:
+            hbm = row.get("hbm_peak_bytes")
+            line += f" {hbm / 1e9:>7.2f}G" if hbm is not None else f" {'-':>8}"
+        if with_nf_layers:
+            line += f" {int(row.get('nf_layers', 0)):>6}"
         lines.append(line)
     if doc["checkpoints"]:
         lines.append("")
@@ -476,6 +553,32 @@ def _fmt_table(doc: Dict[str, Any]) -> str:
                 f"{lat['rounds']} round(s) — tune --heartbeat_startup_grace "
                 "and crash-loop windows above the ttfs number"
             )
+    if doc.get("memory"):
+        lines.append("")
+        last = doc["memory"]["last"]
+        parts = []
+        for h in sorted(last):
+            rec = last[h]
+            peak = rec.get("hbm_peak_bytes")
+            parts.append(
+                f"host {h}: "
+                + (f"hbm peak {peak / 1e9:.2f} GB, " if peak is not None else "")
+                + f"rss {rec.get('host_rss_bytes', 0) / 1e9:.2f} GB"
+            )
+        lines.append(
+            "memory telemetry: " + "; ".join(parts)
+            + " — `paddle memory <run_dir>` for the per-launch-group table"
+        )
+    if doc.get("numerics"):
+        n = doc["numerics"]
+        lines.append("")
+        line = f"numerics telemetry: {n['records']} record(s)"
+        if n["nonfinite_layers"]:
+            line += (
+                f", nonfinite gradients in: "
+                + ", ".join(n["nonfinite_layers"])
+            )
+        lines.append(line)
     if doc.get("serve"):
         s = doc["serve"]
         lines.append("")

@@ -61,6 +61,12 @@ _HIGHER_BETTER = {
     "time_to_first_step_s": False,
     "restore_s": False,
     "cache_hits": True,
+    # memory plane: footprint growth is a regression (the headroom the
+    # next batch-size bump needs); numerics: a layer newly producing
+    # nonfinite gradients is a regression even when throughput held
+    "hbm_peak_bytes": False,
+    "static_mem_bytes": False,
+    "nonfinite_layers": False,
 }
 
 
@@ -95,7 +101,7 @@ def _higher_is_better(name: str) -> bool:
                             "knee")):
         return True
     if any(s in n for s in ("_s", "_ms", "latency", "wait", "blocked",
-                            "compile", "p50", "p99")):
+                            "compile", "p50", "p99", "_bytes")):
         return False
     return True  # bench values are throughput by convention
 
@@ -137,6 +143,24 @@ def _run_side(path: str) -> Dict[str, float]:
     if lat:
         out["time_to_first_step_s"] = float(lat["time_to_first_step_s_max"])
         out["restore_s"] = float(lat["restore_s_max"])
+    # memory plane: worst last-snapshot HBM peak across hosts (lower is
+    # better — footprint growth is the regression the OOM pre-mortem
+    # exists for). Host RSS deliberately stays OUT of the verdict
+    # surface: it moves a few percent between identical runs (allocator
+    # noise), and a flaky REGRESSION teaches people to ignore the tool.
+    # Numerics plane: distinct layers that produced a nonfinite
+    # gradient, zero-filled whenever numerics ran so 0 -> N gets a
+    # REGRESSION verdict instead of landing in only_b
+    mem_last = (doc.get("memory") or {}).get("last") or {}
+    peaks = [
+        float(r["hbm_peak_bytes"]) for r in mem_last.values()
+        if isinstance(r.get("hbm_peak_bytes"), (int, float))
+    ]
+    if peaks:
+        out["hbm_peak_bytes"] = max(peaks)
+    num = doc.get("numerics")
+    if num is not None:
+        out["nonfinite_layers"] = float(len(num.get("nonfinite_layers") or ()))
     # serve runs (doc/observability.md "Serving telemetry"): per-rung
     # latency/TTFT (lower is better) and goodput (higher), keyed by the
     # rung's OFFERED LOAD — not its index: two auto-calibrated sweeps
@@ -214,6 +238,16 @@ def _bench_side(path: str, raw: str) -> Dict[str, float]:
         out["compile_total_s"] = float(line["compile_s"]) + float(
             line.get("trace_s") or 0.0
         )
+    # memory trajectory: bench legs stamp static_mem_bytes (the leg's
+    # compiled plan — deterministic, comparable) AND peak_hbm_bytes
+    # (allocator peak). Only the static plan joins the verdict surface:
+    # the allocator peak is cumulative over the PROCESS, so a ladder
+    # leg that stepped down past an OOM'd larger attempt inherits that
+    # attempt's peak — diffing it against a straight-to-size baseline
+    # would manufacture a phantom footprint regression.
+    v = line.get("static_mem_bytes")
+    if isinstance(v, (int, float)) and not isinstance(v, bool):
+        out["static_mem_bytes"] = float(v)
     # serve-leg artifacts (doc/observability.md "Serving telemetry"):
     # the archived BENCH_*.json carries per-rung latency/TTFT/goodput
     # and the knee — comparable WITHOUT the telemetry run dir, under
@@ -235,7 +269,9 @@ def _bench_side(path: str, raw: str) -> Dict[str, float]:
             payload.get("value"), (int, float)
         ):
             out[leg] = float(payload["value"])
-            for key in ("mfu", "compile_s", "trace_s"):
+            # peak_hbm_bytes deliberately NOT copied — see the
+            # ladder-inheritance note above
+            for key in ("mfu", "compile_s", "trace_s", "static_mem_bytes"):
                 v = payload.get(key)
                 # bool is an int subclass — exclude it explicitly
                 if isinstance(v, (int, float)) and not isinstance(v, bool):

@@ -121,7 +121,7 @@ class _Entry:
     __slots__ = (
         "sig", "callable", "fallback_fn", "flops", "bytes_accessed",
         "flops_analytic", "exec_s", "calls", "batches", "compile_s_pending",
-        "degraded",
+        "degraded", "mem",
     )
 
     def __init__(self, sig: str, callable_, fallback_fn):
@@ -131,6 +131,9 @@ class _Entry:
         self.flops: Optional[float] = None
         self.bytes_accessed: Optional[float] = None
         self.flops_analytic: Optional[float] = None
+        # static memory plan (mem_*_bytes, observability/memory.py) —
+        # the OOM pre-mortem ranks launch groups from these
+        self.mem: Optional[Dict[str, int]] = None
         self.exec_s = 0.0
         self.calls = 0
         self.batches = 0
@@ -165,6 +168,10 @@ class CompileRegistry:
         # (group, sig) and the analyzers keep latest-wins, so losing
         # the pre-rollback totals would skew achieved FLOP/s upward
         self._carryover: Dict[Tuple[str, Any], Tuple[float, int, int]] = {}
+
+    @property
+    def device_kind(self) -> Optional[str]:
+        return self._device_kind
 
     # ------------------------------------------------------------- call
 
@@ -215,6 +222,7 @@ class CompileRegistry:
         out = None
         callable_ = fn
         cost = None
+        mem = None
         lower = getattr(fn, "lower", None)
         if lower is not None:
             try:
@@ -226,8 +234,15 @@ class CompileRegistry:
                 rec["trace_s"] = round(t1 - t0, 6)
                 rec["compile_s"] = round(t2 - t1, 6)
                 from paddle_tpu.observability.costs import cost_analysis_of
+                from paddle_tpu.observability.memory import memory_analysis_of
 
                 cost = cost_analysis_of(compiled)
+                # static HBM plan (argument/output/temp/generated
+                # bytes): joined onto the SAME compile record, so every
+                # launch group's planned footprint is on disk before
+                # the first step runs — the raw material of
+                # `paddle memory` and the OOM pre-mortem
+                mem = memory_analysis_of(compiled)
                 callable_ = compiled
             except Exception as e:
                 logger.debug(
@@ -248,6 +263,8 @@ class CompileRegistry:
             rec["cache_hit"] = hit
         if cost is not None:
             rec.update(cost)  # flops / bytes_accessed, whichever exist
+        if mem is not None:
+            rec.update(mem)  # mem_*_bytes static footprint, when known
         if analytic_flops:
             rec["flops_analytic"] = float(analytic_flops)
         self._cross_check(group, rec)
@@ -265,6 +282,7 @@ class CompileRegistry:
         ent.flops = rec.get("flops")
         ent.bytes_accessed = rec.get("bytes_accessed")
         ent.flops_analytic = rec.get("flops_analytic")
+        ent.mem = mem
         ent.compile_s_pending = rec.get("compile_s", 0.0) + rec.get("trace_s", 0.0)
         carried = self._carryover.pop((group, key), None)
         if carried is not None:
@@ -348,6 +366,22 @@ class CompileRegistry:
             if self._device_kind:
                 rec["device_kind"] = self._device_kind
             obs.emit("roofline", pass_id=pass_id, **rec)
+
+    def static_memory_rows(self) -> list:
+        """Per-launch-group static memory plan (mem_*_bytes), ranked by
+        total footprint — the OOM pre-mortem's group ranking. Groups
+        whose backend reported no memory analysis are absent (omitted,
+        never guessed)."""
+        rows = []
+        for (group, _key), ent in self._entries.items():
+            if not ent.mem:
+                continue
+            rows.append({
+                "group": group, "sig": ent.sig, "launches": ent.calls,
+                **ent.mem,
+            })
+        rows.sort(key=lambda r: -int(r.get("mem_total_bytes", 0)))
+        return rows
 
     def invalidate(self, *groups: str) -> None:
         """Drop the cached executables of the named groups (rollback

@@ -53,10 +53,18 @@ FILE_FMT = "metrics.host%d.jsonl"
 # Historical note: a "crash" kind rode here for five PRs without any
 # emitter — the supervisor writes crash_report.json, not a record —
 # and was removed when `paddle lint` (PTL007) flagged the drift.
+# `memory` rides here (pass boundaries only) and `oom` MUST (the
+# process dies right after — same evidence-before-death rule as fault/
+# hang); `numerics` deliberately does NOT: at --numerics_log_period=1
+# it is a per-batch kind like train_window, and a forced flush per
+# record would put file I/O back on the hot step loop. Its crash
+# durability is handled at the events that matter — the nonfinite
+# handler emits the health table alongside its (soon-flushed) evidence,
+# and ordinary aborts reach the atexit flush.
 FLUSH_KINDS = frozenset(
     {"run_start", "run_end", "pass_end", "checkpoint",
      "barrier_skew", "restart", "compile", "roofline",
-     "request", "serve_window"}
+     "request", "serve_window", "memory", "oom"}
 )
 
 # required keys of every record; kind-specific fields ride alongside
@@ -89,6 +97,15 @@ KIND_REQUIRED = {
     "roofline": ("group", "sig"),
     "request": ("id", "outcome"),
     "serve_window": ("rung", "offered_rps"),
+    # memory plane (observability/memory.py): host_rss_bytes is the one
+    # field every backend can supply — hbm_* fields are present exactly
+    # when the allocator reports stats (None on the CPU backend)
+    "memory": ("host_rss_bytes",),
+    # numerics plane (observability/numerics.py): the per-layer health
+    # table is the record's whole point
+    "numerics": ("layers",),
+    # OOM pre-mortem: flushed before the death, like fault/hang
+    "oom": ("error", "report"),
     "lint_finding": ("rule", "path", "line"),
     "lint_summary": ("findings", "counts"),
     "race_finding": ("detector", "spec"),

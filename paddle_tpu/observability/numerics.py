@@ -1,0 +1,248 @@
+"""Per-layer model-health telemetry + nonfinite blame
+(``--numerics_log_period``).
+
+``--nonfinite_policy=rollback`` (PR 2) recovers from divergence without
+ever naming the layer that diverged, and nothing in the telemetry stack
+answers "is this run *about* to diverge" — grad norms, update ratios,
+and nonfinite counts are the standard early-warning signals and they
+were simply not collected. Two pieces:
+
+- **in-step health** — :func:`step_health` computes, per layer group,
+  squared grad/param/update norms and a nonfinite-element count as one
+  extra aux pytree INSIDE the existing jitted step (the grads and both
+  parameter trees are already live there — no extra launch, no launch
+  signature churn, recompiles stay 0 after warmup). The trainer holds
+  the latest device tree and reads it back ONLY at
+  ``--numerics_log_period`` boundaries (a tiny [n_layers, 4] transfer),
+  emitting ``kind=numerics`` records with per-layer
+  grad-norm / param-norm / update-ratio / nonfinite derived host-side
+  (:func:`derive`).
+- **nonfinite blame** — when ``--nonfinite_policy`` trips,
+  :func:`blame_nonfinite` re-runs the poisoned batch in a per-layer
+  checking mode (params first — a NaN weight is the commonest poison —
+  then the forward layer by layer in topological order, then the
+  backward via per-parameter grads) and names the FIRST layer producing
+  a nonfinite value. The result rides the ``nonfinite`` record
+  (``blame_layer``/``blame_phase``), the abort error message, and —
+  through the metrics tail — the supervisor's ``crash_report.json``.
+
+Module import is jax-free (the analyzers read ``kind=numerics`` records
+without an accelerator runtime); jax is imported lazily inside the
+functions the trainer calls from its jitted step builder.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Tuple
+
+from paddle_tpu.utils.logging import logger
+
+# epsilon guarding the update-ratio division: an all-zero (freshly
+# zero-initialized) parameter block must read ratio ~||update||/eps,
+# huge but finite, not a schema-breaking inf
+EPS = 1e-12
+
+__all__ = ["layer_groups", "step_health", "derive", "blame_nonfinite"]
+
+
+# ------------------------------------------------------------- grouping
+
+
+def param_owners(model) -> Dict[str, str]:
+    """{parameter name: owning layer name} from the model config: a
+    layer owns the parameters its inputs reference plus its bias.
+    First-wins on shared parameters (``param_attr`` sharing) — the
+    earliest layer in topological order is the blame anchor."""
+    owner: Dict[str, str] = {}
+    for layer in model.layers:
+        for ic in layer.inputs:
+            if ic.input_parameter_name:
+                owner.setdefault(ic.input_parameter_name, layer.name)
+        if layer.bias_parameter_name:
+            owner.setdefault(layer.bias_parameter_name, layer.name)
+    for sub in getattr(model, "sub_models", []) or []:
+        for mem in getattr(sub, "memories", []) or []:
+            pn = getattr(mem, "boot_bias_parameter_name", "")
+            if pn:
+                owner.setdefault(pn, sub.name)
+    return owner
+
+
+def layer_groups(model, param_names) -> Dict[str, List[str]]:
+    """{layer name: [its parameter names]} over the given params.
+    Parameters no layer claims (state tensors, exotic projections)
+    group under their own name — param-level blame beats no blame.
+    Deterministic ordering throughout: the group dict is insertion-
+    ordered by the sorted param walk, so the health pytree's treedef is
+    a pure function of the model (no recompiles from dict order)."""
+    owner = param_owners(model)
+    groups: Dict[str, List[str]] = {}
+    for pn in sorted(param_names):
+        groups.setdefault(owner.get(pn, pn), []).append(pn)
+    return groups
+
+
+# ------------------------------------------------------- in-step health
+
+# component order of each layer's health vector (one [4] array per
+# layer; the fused scan stacks them to [k, 4])
+GRAD_SS, PARAM_SS, UPDATE_SS, NONFINITE = range(4)
+
+
+def _grad_arrays(g) -> List[Any]:
+    """The dense array views of one gradient leaf: the array itself, or
+    a RowSparseGrad's occurrence rows (O(batch·seq), the only part that
+    exists)."""
+    if g is None:
+        return []
+    if hasattr(g, "dtype") and hasattr(g, "shape"):
+        return [g]
+    rows = getattr(g, "rows", None)
+    if rows is not None:
+        return [rows]
+    vals = getattr(g, "values", None)
+    return [vals] if vals is not None else []
+
+
+def step_health(params, new_params, grads, groups):
+    """Per-layer health vectors, computed with jnp ops so the whole
+    thing fuses into the caller's jitted step: ``{layer: [grad_ss,
+    param_ss, update_ss, nonfinite_count]}`` (squared sums — the cheap
+    associative form; :func:`derive` takes the roots host-side). Shapes
+    are static per batch signature, so enabling this adds work to the
+    step but never a recompile."""
+    import jax.numpy as jnp
+
+    out = {}
+    for layer, pnames in groups.items():
+        gss = jnp.zeros((), jnp.float32)
+        pss = jnp.zeros((), jnp.float32)
+        uss = jnp.zeros((), jnp.float32)
+        nf = jnp.zeros((), jnp.float32)
+        for pn in pnames:
+            p = params.get(pn)
+            if p is None:
+                continue
+            pf = p.astype(jnp.float32)
+            pss = pss + jnp.sum(pf * pf)
+            np_ = new_params.get(pn)
+            if np_ is not None:
+                d = np_.astype(jnp.float32) - pf
+                uss = uss + jnp.sum(d * d)
+            for g in _grad_arrays(grads.get(pn)):
+                gf = g.astype(jnp.float32)
+                gss = gss + jnp.sum(gf * gf)
+                nf = nf + jnp.sum((~jnp.isfinite(gf)).astype(jnp.float32))
+        out[layer] = jnp.stack([gss, pss, uss, nf])
+    return out
+
+
+def derive(health: Dict[str, Any]) -> Tuple[Dict[str, Dict[str, float]],
+                                            List[str], float]:
+    """Host-side derivation from one device-fetched health tree:
+    (per-layer ``{grad_norm, param_norm, update_ratio, nonfinite}``,
+    layers with nonfinite gradients, global grad norm). Fused launches
+    hand stacked [k, 4] vectors — the LAST batch of the launch is the
+    reported one (the same batch the single-step path would report at
+    this boundary)."""
+    layers: Dict[str, Dict[str, float]] = {}
+    nf_layers: List[str] = []
+    total_gss = 0.0
+    for name in sorted(health):
+        v = health[name]
+        row = [float(x) for x in (v[-1] if getattr(v, "ndim", 1) > 1 else v)]
+        gss, pss, uss, nf = row[:4]
+        # a nonfinite grad poisons its own norm — keep the count honest
+        # and report the norm as-is (nan/inf serialize as strings)
+        pn = math.sqrt(pss) if pss >= 0 else float("nan")
+        layers[name] = {
+            "grad_norm": math.sqrt(gss) if gss >= 0 else float(gss),
+            "param_norm": pn,
+            "update_ratio": (
+                (math.sqrt(uss) if uss >= 0 else float(uss)) / (pn + EPS)
+                if math.isfinite(pn) else float("nan")
+            ),
+            "nonfinite": int(nf) if math.isfinite(nf) else -1,
+        }
+        if nf > 0 or not math.isfinite(nf):
+            nf_layers.append(name)
+        if math.isfinite(gss):
+            total_gss += gss
+    return layers, nf_layers, math.sqrt(total_gss)
+
+
+# ------------------------------------------------------ nonfinite blame
+
+
+def _nonfinite_count(a) -> int:
+    import numpy as np
+
+    arr = np.asarray(a)
+    if arr.dtype.kind not in "fc":
+        return 0
+    return int(arr.size - np.isfinite(arr).sum())
+
+
+def blame_nonfinite(gm, model, params, in_args, rng=None) -> Optional[Dict[str, Any]]:
+    """Re-run one poisoned batch in per-layer checking mode and name
+    the first layer producing a nonfinite value.
+
+    Three phases, cheapest-and-most-common first:
+
+    1. **params** — a NaN already resident in a weight (the previous
+       update applied a nonfinite grad) blames its owning layer without
+       any compute;
+    2. **forward** — run the graph eagerly and walk the layer outputs
+       in topological (config) order; the first nonfinite activation
+       names the layer;
+    3. **backward** — forward was clean, so the poison was born in the
+       gradient: per-parameter grads map back to layers, and the layer
+       LATEST in forward order (first reached by backprop) is blamed.
+
+    This is the cold recovery path (at most ``--max_nonfinite_steps``
+    times per run), so it runs eagerly — no jit cache pollution, no
+    recompile of the hot step. Never raises: blame that fails returns
+    None and the policy proceeds without it."""
+    try:
+        owner = param_owners(model)
+        layer_pos = {l.name: i for i, l in enumerate(model.layers)}
+        # phase 1: poisoned parameters
+        for pn in sorted(sorted(params),
+                         key=lambda n: layer_pos.get(owner.get(n, n), 1 << 30)):
+            bad = _nonfinite_count(params[pn])
+            if bad:
+                return {"layer": owner.get(pn, pn), "phase": "params",
+                        "param": pn, "nonfinite": bad}
+        # phase 2: forward activations, topological order
+        outputs, _ = gm.forward(params, in_args, pass_type="train", rng=rng)
+        for layer in model.layers:
+            arg = outputs.get(layer.name)
+            v = getattr(arg, "value", None)
+            if v is None:
+                continue
+            bad = _nonfinite_count(v)
+            if bad:
+                return {"layer": layer.name, "phase": "forward",
+                        "nonfinite": bad}
+        # phase 3: gradients (dense — sparse row sets don't matter for
+        # blame, and dense grads exist for every parameter)
+        _loss, grads, _outs, _updates = gm.grad_fn(sparse=False)(
+            params, in_args, rng
+        )
+        worst: Optional[Tuple[int, str, str, int]] = None
+        for pn, g in grads.items():
+            bad = sum(_nonfinite_count(a) for a in _grad_arrays(g))
+            if not bad:
+                continue
+            layer = owner.get(pn, pn)
+            pos = layer_pos.get(layer, -1)
+            if worst is None or pos > worst[0]:
+                worst = (pos, layer, pn, bad)
+        if worst is not None:
+            return {"layer": worst[1], "phase": "backward",
+                    "param": worst[2], "nonfinite": worst[3]}
+        return None
+    except Exception as e:
+        logger.debug("nonfinite blame re-run failed: %s", e, exc_info=True)
+        return None

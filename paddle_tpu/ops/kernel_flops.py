@@ -187,6 +187,24 @@ _PEAK_HBM_GBPS = [
 ]
 
 
+# substring (lowercased device_kind) -> HBM capacity GB per jax device
+# (same published specs; v2/v3 entries are per core). The memory
+# analyzer (observability/memory.py) computes peak-vs-capacity headroom
+# against this when the allocator reported no bytes_limit — same
+# omitted-never-guessed contract as the peak tables above.
+_PEAK_HBM_GB = [
+    ("v6e", 32.0),
+    ("v6 lite", 32.0),
+    ("v5p", 95.0),
+    ("v5e", 16.0),
+    ("v5 lite", 16.0),
+    ("v5litepod", 16.0),
+    ("v4", 32.0),
+    ("v3", 16.0),
+    ("v2", 8.0),
+]
+
+
 def _peak_of(table, device_kind: str):
     dk = device_kind.lower()
     for key, peak in table:
@@ -205,6 +223,12 @@ def peak_gbps(device_kind: str):
     """Peak HBM GB/s for a jax device kind; None when unknown (roofline
     buckets degrade to 'unknown', never guessed)."""
     return _peak_of(_PEAK_HBM_GBPS, device_kind)
+
+
+def peak_hbm_gb(device_kind: str):
+    """HBM capacity GB for a jax device kind; None when unknown (the
+    memory analyzer omits the headroom line, never guessed)."""
+    return _peak_of(_PEAK_HBM_GB, device_kind)
 
 
 # ------------------------------------------------------------- trace capture

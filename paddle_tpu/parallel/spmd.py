@@ -269,12 +269,17 @@ def globalize_batch(batch, mesh: Mesh):
     return jax.tree_util.tree_map(put, batch)
 
 
-def shard_train_step(step, mesh: Mesh, gm, donate: bool = True):
+def shard_train_step(step, mesh: Mesh, gm, donate: bool = True,
+                     extra_outs: int = 0):
     """Wrap a (params, opt_state, batch, rng, batch_size) step with mesh
     shardings. Shardings for the batch depend on its treedef, so the jit is
     built lazily per batch structure and cached. ``donate=False`` keeps the
     input buffers valid after the call (the trainer's skip/rollback
-    divergence policies must be able to discard a poisoned update)."""
+    divergence policies must be able to discard a poisoned update).
+    ``extra_outs``: trailing aux outputs beyond the canonical
+    (params, opt_state, loss, keep) — the numerics health pytree rides
+    this way; shardings for aux are left to jit (tiny replicated
+    scalars)."""
     param_shards = _param_shardings(mesh, gm)
     repl = NamedSharding(mesh, P())
     bs = batch_sharding(mesh)
@@ -292,7 +297,8 @@ def shard_train_step(step, mesh: Mesh, gm, donate: bool = True):
             fn = jax.jit(
                 step,
                 in_shardings=(p_spec, o_spec, b_spec, repl, repl),
-                out_shardings=(p_spec, o_spec, None, None),
+                out_shardings=(p_spec, o_spec, None, None)
+                + (None,) * extra_outs,
                 donate_argnums=(0, 1) if donate else (),
             )
             cache[treedef] = fn

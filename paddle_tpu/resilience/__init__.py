@@ -44,6 +44,13 @@ all distinct from each other and from ordinary crash codes):
 - ``EXIT_HANG`` (19) — hangwatch detected a stalled step loop, wrote
   ``hang_report.json``, and killed the process; counts as a real
   failure (budget consumed), with forensics attached.
+- ``EXIT_OOM`` (20) — a launch died of device-memory exhaustion
+  (RESOURCE_EXHAUSTED); the trainer wrote ``oom_report.json``
+  (per-launch-group static footprint ranked, last live memory
+  snapshot, telemetry tail — observability/memory.py) before exiting.
+  Budget-consuming like a hang: an OOM is deterministic poison (the
+  same model at the same batch size OOMs again), so an OOM loop must
+  never restart for free.
 
 The shared backoff machinery lives in ``paddle_tpu.utils.retry``
 (checkpoint I/O and data-provider iteration both use it). The
@@ -61,6 +68,7 @@ from __future__ import annotations
 EXIT_CRASH_LOOP = 17
 EXIT_PREEMPTED = 18
 EXIT_HANG = 19
+EXIT_OOM = 20
 
 
 class CheckpointError(RuntimeError):
@@ -111,6 +119,7 @@ __all__ = [
     "EXIT_CRASH_LOOP",
     "EXIT_PREEMPTED",
     "EXIT_HANG",
+    "EXIT_OOM",
     "CheckpointError",
     "CheckpointCorruptError",
     "DataStallError",

@@ -77,19 +77,33 @@ SITE_DOCS = {
         "at the per-batch loss check (raise = that batch's loss "
         "becomes NaN, the deterministic divergence for "
         "--nonfinite_policy drills)",
+    "trainer.oom":
+        "before each trained launch (raise = a synthetic "
+        "RESOURCE_EXHAUSTED at the launch boundary -> oom_report.json "
+        "+ exit 20, the OOM pre-mortem drill)",
+    "trainer.nonfinite_layer":
+        "before each trained launch (raise:LAYER = poison the named "
+        "layer's parameters with NaN, as a nonfinite gradient applied "
+        "by the optimizer would — the next loss goes NaN and the "
+        "per-layer blame re-run must name LAYER)",
 }
 
 KNOWN_SITES = tuple(SITE_DOCS)
 
 
 class FaultInjected(RuntimeError):
-    """Raised by the ``raise`` action at an injection site."""
+    """Raised by the ``raise`` action at an injection site. ``arg``
+    carries the rule's ``:arg`` payload, so sites can parameterize the
+    failure (e.g. ``trainer.nonfinite_layer=raise:output`` names which
+    layer to poison)."""
 
-    def __init__(self, site: str, hit: int, info: str = ""):
+    def __init__(self, site: str, hit: int, info: str = "",
+                 arg: Optional[str] = None):
         detail = f" ({info})" if info else ""
         super().__init__(f"injected fault at {site!r} hit #{hit}{detail}")
         self.site = site
         self.hit = hit
+        self.arg = arg
 
 
 _ENTRY_RE = re.compile(
@@ -129,7 +143,7 @@ class _Rule:
 
     def fire(self, site: str, hit: int, info: str) -> None:
         if self.action == "raise":
-            raise FaultInjected(site, hit, info)
+            raise FaultInjected(site, hit, info, arg=self.arg)
         if self.action == "oserror":
             import errno
 

@@ -53,7 +53,12 @@ import sys
 import time
 from typing import Callable, Dict, List, Optional
 
-from paddle_tpu.resilience import EXIT_CRASH_LOOP, EXIT_HANG, EXIT_PREEMPTED
+from paddle_tpu.resilience import (
+    EXIT_CRASH_LOOP,
+    EXIT_HANG,
+    EXIT_OOM,
+    EXIT_PREEMPTED,
+)
 from paddle_tpu.utils.logging import logger
 from paddle_tpu.utils.retry import RetryPolicy
 
@@ -374,30 +379,25 @@ class Supervisor:
 
         return tail_with_last_skew(self.metrics_dir, n=METRICS_TAIL_RECORDS)
 
-    def _hang_report(self):
-        """The child's hang forensics, when any attempt died of a
-        detected hang (EXIT_HANG): hangwatch writes hang_report.json
-        into the same run dir the metrics tail comes from. Parsed and
-        embedded so one crash_report.json carries the whole story.
-        A report older than THIS supervise invocation is a leftover
-        from a previous run in the same save_dir (e.g. the current
-        hang's own write failed on a flaky fs) — embedding it would
-        present another process's thread stacks as this run's
-        forensics, so it is rejected."""
+    def _forensics_report(self, filename: str):
+        """A child-written forensics JSON (hang_report.json /
+        oom_report.json) from the run dir, freshness-gated to THIS
+        supervise invocation: a report older than _t0_wall is a
+        leftover from a previous incident in the same save_dir and
+        embedding it would present another process's evidence as this
+        run's. The child stamps written_at on the same host (same
+        clock); the file mtime is only the parse-failure fallback —
+        an NFS-server-assigned mtime can skew by seconds."""
         if not self.metrics_dir:
             return None
-        from paddle_tpu.resilience.hangwatch import HANG_REPORT, run_dir_of
+        from paddle_tpu.resilience.hangwatch import run_dir_of
 
-        path = os.path.join(run_dir_of(self.metrics_dir), HANG_REPORT)
+        path = os.path.join(run_dir_of(self.metrics_dir), filename)
         try:
             with open(path) as f:
                 report = json.load(f)
         except (OSError, ValueError):
             return None
-        # freshness gate: prefer the report's own written_at (stamped by
-        # the child, which runs on THIS host — same clock as _t0_wall;
-        # an NFS-server-assigned mtime can skew by seconds and reject
-        # genuine forensics, the exact hazard heartbeat.py documents)
         written = None
         try:
             written = time.mktime(time.strptime(
@@ -415,6 +415,24 @@ class Supervisor:
             )
             return None
         return report
+
+    def _hang_report(self):
+        """The child's hang forensics, when any attempt died of a
+        detected hang (EXIT_HANG): hangwatch writes hang_report.json
+        into the same run dir the metrics tail comes from. Parsed and
+        embedded so one crash_report.json carries the whole story."""
+        from paddle_tpu.resilience.hangwatch import HANG_REPORT
+
+        return self._forensics_report(HANG_REPORT)
+
+    def _oom_report(self):
+        """The child's OOM pre-mortem (oom_report.json — per-group
+        static footprint, last live memory snapshot), when any attempt
+        died of device-memory exhaustion (EXIT_OOM). Same run dir, same
+        freshness gate as the hang forensics."""
+        from paddle_tpu.observability.memory import OOM_REPORT
+
+        return self._forensics_report(OOM_REPORT)
 
     def _crash_report(self, reason: str, log_path: str, detail: str) -> str:
         tail = self._log_tail(log_path)
@@ -442,6 +460,11 @@ class Supervisor:
         # a hung attempt left in-process forensics — attach them
         if any(a.get("exit_code") == EXIT_HANG for a in self.attempts):
             report["hang_report"] = self._hang_report()
+        # same for an OOM'd attempt's pre-mortem (exit 20: the child
+        # classified its own death and ranked the launch groups by
+        # static footprint before dying)
+        if any(a.get("exit_code") == EXIT_OOM for a in self.attempts):
+            report["oom_report"] = self._oom_report()
         path = os.path.join(self.dir, CRASH_REPORT)
         with open(path, "w") as f:
             json.dump(report, f, indent=2)

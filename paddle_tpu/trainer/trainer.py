@@ -36,7 +36,9 @@ from paddle_tpu.proto import TrainerConfig
 from paddle_tpu.trainer import checkpoint as ckpt
 from paddle_tpu.trainer.evaluators import EvaluatorChain
 from paddle_tpu.observability import compile_log
+from paddle_tpu.observability import memory as obs_mem
 from paddle_tpu.observability import metrics as obs
+from paddle_tpu.observability import numerics as obs_num
 from paddle_tpu.observability import spans as obs_spans
 from paddle_tpu.utils import concurrency as cc
 from paddle_tpu.utils.flags import FLAGS
@@ -310,6 +312,41 @@ class Trainer:
                 "will be no checkpoint to roll back to — the first "
                 "non-finite loss raises NonFiniteLossError"
             )
+        # per-layer model-health telemetry (--numerics_log_period,
+        # doc/observability.md "Numerics telemetry"): the jitted step
+        # grows one aux output — per-layer grad/param/update norms and
+        # nonfinite counts, computed on device where the grads already
+        # live. The launch signature is fixed at build time by the flag
+        # (never per step), so recompiles stay 0 after warmup; the host
+        # reads the tiny health tree back only at log-period boundaries.
+        self._numerics_period = max(
+            0, int(getattr(flags, "numerics_log_period", 0) or 0)
+        )
+        self._numerics_groups = None
+        self._numerics_last = None  # newest launch's device health tree
+        if self._numerics_period:
+            if (self._accum_n > 1 or self._async
+                    or self._batch_method is not None):
+                # honest degradation (the hangwatch precedent): these
+                # paths apply updates outside _one_batch_step, so the
+                # aux would misattribute — better absent than wrong
+                logger.warning(
+                    "--numerics_log_period is not supported under "
+                    "gradient accumulation / async_sgd / whole-data "
+                    "batch methods — numerics telemetry disabled for "
+                    "this run"
+                )
+                self._numerics_period = 0
+            else:
+                self._numerics_groups = obs_num.layer_groups(
+                    config.model_config, list(self.params)
+                )
+        # last live memory snapshot (pass-boundary sampling) — the OOM
+        # pre-mortem's "what did the allocator look like" fallback when
+        # sampling after the OOM itself fails — and the last launch
+        # position, so the pre-mortem can say WHERE the run died
+        self._mem_last = None
+        self._last_launch: Optional[Tuple[int, int]] = None
         # telemetry (doc/observability.md): per-host metrics.jsonl stream
         # (--metrics_path, defaulting to save_dir) + Chrome trace-event
         # spans (--trace_events_path). No-ops when neither is configured.
@@ -548,6 +585,7 @@ class Trainer:
         )
         updater = self.updater
         out_layers = self._kept_out_layers()
+        nm_groups = self._numerics_groups
 
         def step(params, opt_state, in_args, rng, batch_size):
             loss, grads, outputs, state_updates = grad_fn(params, in_args, rng)
@@ -555,7 +593,13 @@ class Trainer:
             for k, v in state_updates.items():
                 new_params[k] = v
             keep = {k: v for k, v in outputs.items() if k in out_layers}
-            return new_params, new_opt, loss, keep
+            if nm_groups is None:
+                return new_params, new_opt, loss, keep
+            # numerics aux: fused into THIS launch (grads and both
+            # parameter trees are already live on device) — one extra
+            # [4]-vector per layer in the outputs, zero extra launches
+            health = obs_num.step_health(params, new_params, grads, nm_groups)
+            return new_params, new_opt, loss, keep, health
 
         return step
 
@@ -574,7 +618,8 @@ class Trainer:
             from paddle_tpu.parallel.spmd import shard_train_step
 
             return shard_train_step(
-                step, self._mesh, self.gm, donate=self._donate_steps
+                step, self._mesh, self.gm, donate=self._donate_steps,
+                extra_outs=1 if self._numerics_groups is not None else 0,
             )
         return jax.jit(
             step, donate_argnums=(0, 1) if self._donate_steps else ()
@@ -632,13 +677,16 @@ class Trainer:
             def body(carry, xs):
                 p, o = carry
                 in_args, rng, n = xs
-                p2, o2, loss, keep = one(p, o, in_args, rng, n)
-                return (p2, o2), (loss, keep)
+                # 4-tuple, or 5 with the numerics health aux — the scan
+                # stacks whatever ys the body returns, so both shapes
+                # ride the same machinery
+                out = one(p, o, in_args, rng, n)
+                return (out[0], out[1]), tuple(out[2:])
 
-            (p, o), (losses, keeps) = jax.lax.scan(
+            (p, o), ys = jax.lax.scan(
                 body, (params, opt_state), (stacked, rngs, ns)
             )
-            return p, o, losses, keeps
+            return (p, o) + tuple(ys)
 
         return jax.jit(
             fstep, donate_argnums=(0, 1) if self._donate_steps else ()
@@ -907,6 +955,17 @@ class Trainer:
             obs.emit("run_end", status="completed")
             obs.flush()
             obs_spans.export()
+        except Exception as e:
+            # OOM pre-mortem (doc/resilience.md "OOM forensics"): a
+            # RESOURCE_EXHAUSTED death leaves oom_report.json — the
+            # per-group static footprint ranked, the last live memory
+            # snapshot, the telemetry tail — then re-raises; the CLI
+            # maps it to the distinct EXIT_OOM so supervisors classify
+            # the death (and charge budget — an OOM loop is
+            # deterministic poison, not scheduling)
+            if obs_mem.is_oom_error(e):
+                self._oom_premortem(e)
+            raise
         finally:
             if self._hangwatch is not None:
                 self._hangwatch.stop()
@@ -1160,6 +1219,7 @@ class Trainer:
             # replay must not be misdiagnosed as a hang mid-recovery.
             if self._hangwatch is not None:
                 self._hangwatch.ping(pass_id, batch_id)
+            self._last_launch = (pass_id, batch_id)
             if ff_until and batch_id < ff_until:
                 batch_id += len(group) if kind == "fused" else 1
                 continue
@@ -1174,6 +1234,30 @@ class Trainer:
             faultinject.fault_point(
                 "trainer.stall", info=f"pass={pass_id} batch={batch_id}"
             )
+            # `trainer.oom=raise@N` is a deterministic device OOM at the
+            # launch boundary — what the oom_report.json pre-mortem +
+            # exit-20 drills recover from (the synthetic error carries
+            # the canonical RESOURCE_EXHAUSTED marker, so the catch in
+            # train() classifies it exactly like the real thing)
+            try:
+                faultinject.fault_point(
+                    "trainer.oom", info=f"pass={pass_id} batch={batch_id}"
+                )
+            except faultinject.FaultInjected as e:
+                raise obs_mem.SyntheticOomError(
+                    f"pass={pass_id} batch={batch_id}"
+                ) from e
+            # `trainer.nonfinite_layer=raise:LAYER@N` poisons the named
+            # layer's parameters with NaN — the effect a nonfinite
+            # gradient applied by the optimizer has — so the next loss
+            # goes NaN and the per-layer blame re-run must name LAYER
+            try:
+                faultinject.fault_point(
+                    "trainer.nonfinite_layer",
+                    info=f"pass={pass_id} batch={batch_id}",
+                )
+            except faultinject.FaultInjected as e:
+                self._poison_layer(e.arg, pass_id, batch_id)
             launch_counts[kind] += 1
             if (
                 self.flags.profile_dir
@@ -1226,12 +1310,16 @@ class Trainer:
                 t_step = time.perf_counter() - prep_s
                 snap = self._nf_snapshot()
                 with stat_timer("train_step"):
-                    self.params, self.opt_state, losses, keeps = self._compiles.call(
+                    fused_out = self._compiles.call(
                         "fused_step", launch_key, self.fused_step,
                         self.params, self.opt_state, stacked, rngs, ns_arr,
                         analytic_flops=self._flops_cache.get(launch_key),
                         pass_id=pass_id, step=batch_id,
                     )
+                self.params, self.opt_state, losses, keeps = fused_out[:4]
+                if self._numerics_groups is not None:
+                    # stays on device: read back only at the log period
+                    self._numerics_last = fused_out[4]
                 # ONE device→host transfer per launch (losses + kept
                 # outputs together); numpy slicing below adds no further
                 # device dispatches
@@ -1251,6 +1339,13 @@ class Trainer:
                     if self._handle_nonfinite(
                         pass_id, batch_id + bad, float(losses_host[bad]),
                         snap, f"(launch of {kf}) ",
+                        # the poisoned batch, sliced out of the stacked
+                        # launch for the per-layer blame re-run (cold
+                        # path: this only ever runs on a NaN loss)
+                        batch=jax.tree_util.tree_map(
+                            lambda x, i=bad: x[i], stacked
+                        ),
+                        rng=rngs[bad],
                     ):
                         # poisoned launch discarded whole (skip policy):
                         # pre-launch params/opt_state are back in place.
@@ -1294,13 +1389,16 @@ class Trainer:
                     elif self._async:
                         loss, outputs = self._async_step(batch, step_rng, n)
                     else:
-                        self.params, self.opt_state, loss, outputs = self._compiles.call(
+                        step_out = self._compiles.call(
                             "train_step", launch_key, self.train_step,
                             self.params, self.opt_state, batch, step_rng,
                             jnp.asarray(float(n)),
                             analytic_flops=self._flops_cache.get(launch_key),
                             pass_id=pass_id, step=batch_id,
                         )
+                        self.params, self.opt_state, loss, outputs = step_out[:4]
+                        if self._numerics_groups is not None:
+                            self._numerics_last = step_out[4]
                 loss_f = self._poisoned_loss(float(loss), pass_id, batch_id)  # lint: disable=PTL002 -- single-step path: the per-launch loss read IS the nonfinite gate
                 step_dt = time.perf_counter() - t_step
                 self._pass_train_s += step_dt
@@ -1332,7 +1430,13 @@ class Trainer:
                     # restores a checkpoint. Fused launches were gated
                     # above; reaching here is the single-batch path. loss
                     # is already read back each batch, so the check is free.
-                    if self._handle_nonfinite(pass_id, batch_id, loss_f, snap):
+                    # (`batch` is only bound on the non-fused path —
+                    # fused launches were gated above and never get here)
+                    if self._handle_nonfinite(
+                        pass_id, batch_id, loss_f, snap,
+                        batch=batch if kind == "single" else None,
+                        rng=step_rng if kind == "single" else None,
+                    ):
                         batch_id += 1
                         continue
                 stats.add(loss_f * n, n)
@@ -1372,6 +1476,11 @@ class Trainer:
                 obs.emit("train_window", pass_id=pass_id, step=batch_id,
                          **stats.summary_dict())
                 stats.reset_window()
+            if crossed(self._numerics_period) and self._numerics_last is not None:
+                # the ONLY host readback of the health aux: a tiny
+                # [n_layers, 4] transfer at the numerics log period,
+                # inside a helper so the per-step loop stays sync-free
+                self._emit_numerics(pass_id, batch_id)
             # preemption (SIGTERM flag) saves through the SAME block as the
             # periodic save — one flush, one save, even when both fire on
             # this boundary (TPU pods preempt with a SIGTERM notice; the
@@ -1434,6 +1543,17 @@ class Trainer:
             jax.profiler.stop_trace()
             logger.info("profiler trace written to %s", self.flags.profile_dir)
         self._end_dot_line()
+        # pass-boundary telemetry for the two new planes: the last
+        # launch's numerics health (so every pass has at least one
+        # numerics record even when the period exceeds the pass), and a
+        # live memory snapshot (kind=memory record + mem.* gauges — the
+        # gauges land in the counters snapshot of the pass_end below)
+        if self._numerics_last is not None:
+            self._emit_numerics(pass_id, batch_id)
+        if obs.enabled():
+            self._mem_last = obs_mem.sample_and_emit(
+                pass_id=pass_id, step=batch_id
+            )
         dt = time.monotonic() - t0
         rate = stats.total_samples / max(dt, 1e-9)
         mfu_fields = self._mfu_fields()
@@ -1512,21 +1632,60 @@ class Trainer:
                 return float("nan")
         return loss_f
 
-    def _handle_nonfinite(self, pass_id, batch_id, value, snap, launch_note=""):
+    def _handle_nonfinite(self, pass_id, batch_id, value, snap,
+                          launch_note="", batch=None, rng=None):
         """Apply --nonfinite_policy to one non-finite loss. Returns True
         when the poisoned update was discarded (skip) and the caller
         should move on; raises NonFiniteLossError (abort / exhausted
-        budget) or _RollbackRequest (rollback) otherwise."""
+        budget) or _RollbackRequest (rollback) otherwise.
+
+        When the poisoned ``batch`` is available it is re-run in the
+        per-layer checking mode (observability/numerics.py) and the
+        first layer producing a nonfinite value rides the ``nonfinite``
+        record (``blame_layer``/``blame_phase``) and the abort message —
+        recovery that names its culprit instead of just surviving it."""
         base = (
             f"non-finite loss ({value}) at pass {pass_id} "
             f"batch {batch_id} {launch_note}"
         )
+        blame = None
+        if batch is not None:
+            # skip/rollback kept the pre-step state (donation disabled):
+            # blame re-runs the exact poisoned step. Abort donated the
+            # pre-step buffers, so the post-update params stand in —
+            # approximate, but a NaN born in the forward/backward still
+            # reproduces there.
+            params_src = snap[0] if snap is not None else self.params
+            blame = obs_num.blame_nonfinite(
+                self.gm, self.config.model_config, params_src, batch, rng
+            )
+        blame_fields = {}
+        blame_note = ""
+        if blame is not None:
+            blame_fields = {"blame_layer": blame["layer"],
+                            "blame_phase": blame["phase"]}
+            blame_note = (
+                f" [first nonfinite at layer {blame['layer']!r}, "
+                f"{blame['phase']} phase, {blame['nonfinite']} value(s)]"
+            )
+            logger.warning(
+                "nonfinite blame: first nonfinite value at layer %r "
+                "(%s phase, %d nonfinite value(s)%s)",
+                blame["layer"], blame["phase"], blame["nonfinite"],
+                f", param {blame['param']}" if blame.get("param") else "",
+            )
+        if self._numerics_last is not None:
+            # flush the poisoned launch's health table alongside the
+            # event: an abort must not die with the per-layer evidence
+            # still sitting on device awaiting the next log period
+            self._emit_numerics(pass_id, batch_id)
         obs.registry().counter("nonfinite.events").inc()
         obs.emit("nonfinite", pass_id=pass_id, step=batch_id,
-                 value=value, policy=self._nf_policy)
+                 value=value, policy=self._nf_policy, **blame_fields)
         if self._nf_policy == "abort" or snap is None:
             raise NonFiniteLossError(
-                base + "— aborting. Try --job=checkgrad, a lower learning "
+                base + blame_note
+                + "— aborting. Try --job=checkgrad, a lower learning "
                 "rate, or gradient clipping to locate the cause "
                 "(or --nonfinite_policy=skip/rollback to recover).",
                 value=value, pass_id=pass_id, batch_id=batch_id,
@@ -1534,7 +1693,7 @@ class Trainer:
         self._nf_count += 1
         if self._nf_count > self._nf_budget:
             raise NonFiniteLossError(
-                base + f"— non-finite budget exhausted "
+                base + blame_note + f"— non-finite budget exhausted "
                 f"(--max_nonfinite_steps={self._nf_budget}, "
                 f"{self._nf_count - 1} poisoned event(s) already recovered)",
                 value=value, pass_id=pass_id, batch_id=batch_id,
@@ -1548,6 +1707,85 @@ class Trainer:
             )
             return True
         raise _RollbackRequest(pass_id, batch_id)
+
+    def _emit_numerics(self, pass_id: int, batch_id: int) -> None:
+        """Read the newest launch's health aux back (the tiny
+        [n_layers, 4] tree — the ONLY readback the numerics plane ever
+        does, at --numerics_log_period boundaries and pass ends) and
+        emit the ``kind=numerics`` record."""
+        health = jax.device_get(self._numerics_last)
+        layers, nf_layers, grad_norm = obs_num.derive(health)
+        obs.emit(
+            "numerics", pass_id=pass_id, step=batch_id,
+            layers=layers, nonfinite_layers=nf_layers,
+            global_grad_norm=grad_norm,
+        )
+        r = obs.registry()
+        r.gauge("numerics.global_grad_norm").set(
+            grad_norm if math.isfinite(grad_norm) else -1.0
+        )
+        if nf_layers:
+            r.counter("numerics.nonfinite_layer_events").inc(len(nf_layers))
+
+    def _poison_layer(self, layer: Optional[str], pass_id: int,
+                      batch_id: int) -> None:
+        """`trainer.nonfinite_layer` injection: write one NaN into each
+        of the named layer's parameters — exactly what applying a
+        nonfinite gradient through the optimizer would leave behind —
+        so the next launch's loss goes NaN and the blame re-run has a
+        real poisoned layer to find (no shortcut: blame never consults
+        the injector)."""
+        groups = self._numerics_groups or obs_num.layer_groups(
+            self.config.model_config, list(self.params)
+        )
+        pnames = groups.get(layer or "")
+        if not pnames:
+            logger.warning(
+                "trainer.nonfinite_layer: no parameters belong to layer "
+                "%r (known: %s) — nothing poisoned",
+                layer, ", ".join(sorted(groups)),
+            )
+            return
+        for pn in pnames:
+            v = np.array(jax.device_get(self.params[pn]))
+            v.reshape(-1)[0] = float("nan")
+            self.params[pn] = jnp.asarray(v)
+        logger.warning(
+            "injected NaN into layer %r parameter(s) %s at pass %d "
+            "batch %d (trainer.nonfinite_layer)",
+            layer, pnames, pass_id, batch_id,
+        )
+
+    def _oom_premortem(self, err: BaseException) -> None:
+        """Write oom_report.json into the run dir before the OOM death
+        propagates: per-group static footprint (XLA's memory plans,
+        ranked), the freshest live snapshot the allocator will still
+        give us, and the telemetry tail. The backstop timer inside
+        trigger_oom_report guarantees exit EXIT_OOM even when the
+        forensics themselves wedge — same discipline as hangwatch."""
+        from paddle_tpu.resilience.hangwatch import run_dir_of
+
+        report_dir = run_dir_of(
+            getattr(self.flags, "metrics_path", "") or self.save_dir or "."
+        )
+        try:
+            # post-OOM sampling usually still works (the allocator is
+            # full, not gone) and is the most truthful evidence; the
+            # last pass-boundary snapshot is the fallback
+            live = obs_mem.sample_memory()
+        except Exception:
+            live = self._mem_last
+        obs_mem.trigger_oom_report(
+            report_dir, err,
+            groups=self._compiles.static_memory_rows(),
+            live=live or self._mem_last,
+            where=(
+                {"pass": self._last_launch[0], "step": self._last_launch[1]}
+                if self._last_launch is not None else None
+            ),
+            device_kind=self._compiles.device_kind or "",
+            exit_fn=os._exit,
+        )
 
     def _apply_rollback(self, rb: _RollbackRequest) -> int:
         """--nonfinite_policy=rollback: restore the newest verified

@@ -115,6 +115,12 @@ class _Flags:
     nonfinite_policy: str = "abort"      # abort | skip | rollback
     max_nonfinite_steps: int = 3
     rollback_lr_scale: float = 0.5
+    # per-layer model-health telemetry (observability/numerics.py):
+    # every N batches, read back the in-step health aux (grad norm /
+    # param norm / update ratio / nonfinite count per layer — computed
+    # inside the jitted step, so enabling it never recompiles) and emit
+    # a kind=numerics record. 0 disables (no aux, no readback).
+    numerics_log_period: int = 0
     # hang defense (resilience/hangwatch.py): no step-loop progress for
     # this many seconds dumps all thread stacks + telemetry tail into
     # hang_report.json and exits EXIT_HANG=19 (0 disables). Set it
