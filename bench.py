@@ -604,65 +604,16 @@ def bench_nmt_gen(B=None, T=32, vocab=30000, dim=512, beam_size=3,
     return _try_ladder(ladder, run_one)
 
 
-def bench_serve(B=None, T=None, vocab=None, dim=None, beam_size=None,
-                max_length=None, n_requests=None, rates=None, seed=None,
-                run_dir=None, timeout_s=None, queue_cap=None, dtype=None):
-    """Offered-load serving leg (doc/observability.md "Serving
-    telemetry"): a deterministic seeded open-loop arrival process at a
-    sweep of offered loads drives a dynamic micro-batch aggregator over
-    the jitted seqToseq beam-search generator — admit up to B queued
-    requests per launch, pad to ONE batch signature so the serve launch
-    group never recompiles after warmup. Emits per-request
-    ``kind=request`` records and per-rung ``kind=serve_window`` rollups
-    into ``run_dir`` (PADDLE_TPU_BENCH_SERVE_DIR), the run dir `paddle
-    serve-report` renders. Headline: best goodput (generated tok/s)
-    across rungs; extras carry per-rung p50/p99 latency and TTFT vs
-    offered load plus the saturation knee.
-
-    Without PADDLE_TPU_BENCH_SERVE_RATES (comma-separated req/s), the
-    rungs are calibrated from a measured full-batch launch: 0.25x /
-    0.5x / 1x / 2x the back-to-back capacity, so the sweep brackets the
-    knee on any backend."""
+def _serve_sweep_static(gm, params, registry, *, group, rates, B, T,
+                        n_requests, seed, timeout_s, queue_cap, beam_size,
+                        prompt_fn, budget_fn, make_seq):
+    """The PR-8 static engine: run-to-completion micro-batch cohorts
+    over the jitted full-generation launch, virtual-clock driver.
+    Returns (sweep doc, measured capacity req/s)."""
     import jax
     import numpy as np
 
-    from paddle_tpu.flagship import nmt_gen_config
-    from paddle_tpu.graph import GradientMachine, make_seq
-    from paddle_tpu.graph.machine import compute_dtype_of
-    from paddle_tpu.observability import metrics as obsm
     from paddle_tpu.observability import serving
-    from paddle_tpu.observability.compile_log import CompileRegistry
-
-    on_cpu = jax.default_backend() == "cpu"
-    env = os.environ.get
-    B = int(env("PADDLE_TPU_BENCH_SERVE_B", 0)) or B or (4 if on_cpu else 64)
-    T = T or (8 if on_cpu else 32)
-    vocab = vocab or (200 if on_cpu else 30000)
-    dim = dim or (32 if on_cpu else 512)
-    beam_size = beam_size or (2 if on_cpu else 3)
-    max_length = max_length or (8 if on_cpu else 32)
-    n_requests = (int(env("PADDLE_TPU_BENCH_SERVE_REQUESTS", 0))
-                  or n_requests or (32 if on_cpu else 256))
-    seed = int(env("PADDLE_TPU_BENCH_SERVE_SEED", "0")) if seed is None else seed
-    # 0 is a LEGAL deadline (drop everything not admitted immediately)
-    # — None, not falsiness, is the unset sentinel
-    if timeout_s is None:
-        t_env = env("PADDLE_TPU_BENCH_SERVE_TIMEOUT")
-        timeout_s = float(t_env) if t_env is not None else 60.0
-    queue_cap = (int(env("PADDLE_TPU_BENCH_SERVE_QUEUE_CAP", 0))
-                 if queue_cap is None else queue_cap)
-    run_dir = run_dir or env("PADDLE_TPU_BENCH_SERVE_DIR",
-                             os.path.join(REPO, "output", "bench_serve"))
-    obsm.configure(run_dir)
-
-    tc = nmt_gen_config(vocab=vocab, dim=dim, beam_size=beam_size,
-                        max_length=max_length, dtype=dtype or BENCH_DTYPE,
-                        batch_size=B)
-    gm = GradientMachine(tc.model_config,
-                         compute_dtype=compute_dtype_of(tc.opt_config))
-    params = gm.init_params(seed=1)
-    group = next(s.name for s in tc.model_config.sub_models
-                 if s.generator is not None)
 
     def fwd(params, batch):
         outputs, _ = gm.forward(params, batch, pass_type="gen", rng=None)
@@ -670,7 +621,6 @@ def bench_serve(B=None, T=None, vocab=None, dim=None, beam_size=None,
         return best.ids, best.seq_lengths
 
     fwd = jax.jit(fwd)
-    registry = CompileRegistry(device_kind=jax.devices()[0].device_kind)
     sig_key = (B, T)  # ONE signature: every cohort pads to it
 
     serving_now = [False]  # warmup/calibration launches stay out of the
@@ -697,10 +647,14 @@ def bench_serve(B=None, T=None, vocab=None, dim=None, beam_size=None,
         dt = time.perf_counter() - t0
         if serving_now[0]:
             registry.note_exec(serving.SERVE_GROUP, sig_key, dt)
-        return [int(lens_np[i]) for i in range(len(requests))], dt
-
-    def prompt_fn(rng, i):
-        return rng.randint(2, vocab, size=int(rng.randint(1, T + 1))).tolist()
+        # delivered tokens cap at the request's output budget (mixed-
+        # length workloads) — run-to-completion still PAID max_length
+        # decode steps for the whole cohort, which is the A/B's point
+        return [
+            int(lens_np[i]) if r.max_new is None
+            else min(int(lens_np[i]), r.max_new)
+            for i, r in enumerate(requests)
+        ], dt
 
     # warmup: the ONE compile (kind=compile record, recompiles=0), then
     # a clean measured launch to calibrate capacity for the rate ladder
@@ -713,20 +667,197 @@ def bench_serve(B=None, T=None, vocab=None, dim=None, beam_size=None,
     # discard the pending compile-cost deduction so it can't zero the
     # first RUNG launch's exec time instead
     registry.drop_pending(serving.SERVE_GROUP, sig_key)
-    _, service_s = launch_fn(warm)
+    # median of 3: one descheduled calibration launch would otherwise
+    # halve the whole auto-rate ladder (A/B runs pin rates anyway)
+    service_s = sorted(launch_fn(warm)[1] for _ in range(3))[1]
     capacity_rps = B / max(service_s, 1e-6)
     serving_now[0] = True
-    rates_env = env("PADDLE_TPU_BENCH_SERVE_RATES", "")
-    if rates_env:
-        rates = [float(r) for r in rates_env.split(",") if r.strip()]
-    elif not rates:
+    if not rates:
         rates = [round(f * capacity_rps, 4) for f in (0.25, 0.5, 1.0, 2.0)]
 
     doc = serving.run_sweep(
         launch_fn, rates, n_requests=n_requests, seed=seed, max_batch=B,
         timeout_s=timeout_s, queue_cap=queue_cap, beam_size=beam_size,
-        prompt_fn=prompt_fn,
+        prompt_fn=prompt_fn, budget_fn=budget_fn, engine="static",
     )
+    return doc, capacity_rps
+
+
+def _serve_sweep_continuous(gm, params, registry, *, rates, B, T,
+                            max_length, n_requests, seed, timeout_s,
+                            queue_cap, decode_block, prompt_fn, budget_fn):
+    """The continuous-batching engine (paddle_tpu/serving/) on the SAME
+    seeded workload, driven open-loop in wall-clock time. Returns
+    (sweep doc, measured capacity req/s)."""
+    import numpy as np
+
+    from paddle_tpu.observability import serving
+    from paddle_tpu.serving import Engine, drive_rung
+    from paddle_tpu.serving.jax_backend import JaxDecodeBackend
+
+    backend = JaxDecodeBackend(
+        gm, params, slots=B, prompt_tokens=T, max_length=max_length,
+        decode_block=decode_block, registry=registry,
+    )
+    backend.warmup()  # compiles land now; Engine.start()'s call re-runs
+    # two cheap no-slot launches (idempotent semantically)
+    # capacity calibration without request records OR roofline exec
+    # (the static leg's serving_now rule): drive the backend directly —
+    # B full-length sequences back to back, like the static leg's
+    # full-batch launch
+    backend.serving = False
+    prng = np.random.RandomState(seed)
+    warm = [serving.Request(rid=f"warm-{i}", t_enqueue=0.0,
+                            prompt=prompt_fn(prng, i))
+            for i in range(B)]
+    t0 = time.perf_counter()
+    backend.admit(list(range(B)), warm, [max_length] * B)
+    while not bool(backend.step().finished.all()):
+        pass
+    capacity_rps = B / max(time.perf_counter() - t0, 1e-6)
+    backend.serving = True
+    if not rates:
+        rates = [round(f * capacity_rps, 4) for f in (0.25, 0.5, 1.0, 2.0)]
+
+    engine = Engine(backend, queue_cap=queue_cap,
+                    request_timeout_s=timeout_s).start()
+    try:
+        windows = []
+        for i, rate in enumerate(rates):
+            reqs = serving.schedule_requests(
+                float(rate), n_requests, seed + i, rung=i,
+                prompt_fn=prompt_fn, budget_fn=budget_fn,
+            )
+            windows.append(drive_rung(engine, reqs, rate_rps=float(rate),
+                                      rung=i))
+    finally:
+        engine.drain(timeout=600.0)
+    return ({"rungs": windows, "knee_rps": serving.saturation_knee(windows)},
+            capacity_rps)
+
+
+def bench_serve(B=None, T=None, vocab=None, dim=None, beam_size=None,
+                max_length=None, n_requests=None, rates=None, seed=None,
+                run_dir=None, timeout_s=None, queue_cap=None, dtype=None,
+                engine=None, mixed_len=None, decode_block=None):
+    """Offered-load serving leg (doc/observability.md "Serving
+    telemetry"): a deterministic seeded open-loop arrival process at a
+    sweep of offered loads drives one of TWO engines over the seqToseq
+    generator (``--engine`` / PADDLE_TPU_BENCH_SERVE_ENGINE):
+
+    - ``static`` (default, the PR-8 path): a dynamic micro-batch
+      aggregator over the jitted full beam-search generation launch —
+      run-to-completion cohorts of up to B, padded to ONE signature so
+      the ``serve_gen`` launch group never recompiles after warmup.
+    - ``continuous``: the slot-based continuous-batching engine
+      (paddle_tpu/serving/, doc/serving.md) on the SAME seeded arrival
+      schedule, prompts and budgets — ``serve_prefill``/``serve_decode``
+      launch groups, one signature each, driven in wall-clock time.
+
+    Emits per-request ``kind=request`` records and per-rung
+    ``kind=serve_window`` rollups (``engine`` stamped on both) into
+    ``run_dir`` (PADDLE_TPU_BENCH_SERVE_DIR), the run dir `paddle
+    serve-report` renders. Headline: best goodput (generated tok/s)
+    across rungs; extras carry per-rung p50/p99 latency and TTFT vs
+    offered load plus the saturation knee. With
+    PADDLE_TPU_BENCH_SERVE_MIXED_LEN=1 every request draws a seeded
+    heavy-tailed output budget (most short, a tail at max_length) — the
+    mixed-length workload where run-to-completion batching pays
+    max_length for every cohort and iteration-level scheduling shows
+    its goodput win; `paddle compare` of a static vs a continuous run
+    on pinned PADDLE_TPU_BENCH_SERVE_RATES is the A/B.
+
+    Without PADDLE_TPU_BENCH_SERVE_RATES (comma-separated req/s), the
+    rungs are calibrated from a measured full-batch, full-length
+    serving pass: 0.25x / 0.5x / 1x / 2x the back-to-back capacity, so
+    the sweep brackets the knee on any backend."""
+    import jax
+    import numpy as np
+
+    from paddle_tpu.flagship import nmt_gen_config
+    from paddle_tpu.graph import GradientMachine, make_seq
+    from paddle_tpu.graph.machine import compute_dtype_of
+    from paddle_tpu.observability import metrics as obsm
+    from paddle_tpu.observability import serving
+    from paddle_tpu.observability.compile_log import CompileRegistry
+
+    on_cpu = jax.default_backend() == "cpu"
+    env = os.environ.get
+    engine = engine or env("PADDLE_TPU_BENCH_SERVE_ENGINE", "static")
+    if engine not in ("static", "continuous"):
+        raise ValueError(f"unknown serve engine {engine!r}: expected "
+                         "'static' or 'continuous'")
+    B = int(env("PADDLE_TPU_BENCH_SERVE_B", 0)) or B or (4 if on_cpu else 64)
+    T = T or (8 if on_cpu else 32)
+    vocab = vocab or (200 if on_cpu else 30000)
+    dim = dim or (32 if on_cpu else 512)
+    beam_size = beam_size or (2 if on_cpu else 3)
+    max_length = max_length or (8 if on_cpu else 32)
+    n_requests = (int(env("PADDLE_TPU_BENCH_SERVE_REQUESTS", 0))
+                  or n_requests or (32 if on_cpu else 256))
+    seed = int(env("PADDLE_TPU_BENCH_SERVE_SEED", "0")) if seed is None else seed
+    if mixed_len is None:
+        mixed_len = env("PADDLE_TPU_BENCH_SERVE_MIXED_LEN", "0") == "1"
+    if decode_block is None:
+        decode_block = (int(env("PADDLE_TPU_BENCH_SERVE_BLOCK", 0))
+                        or (4 if on_cpu else 1))
+    # 0 is a LEGAL deadline (drop everything not admitted immediately)
+    # — None, not falsiness, is the unset sentinel
+    if timeout_s is None:
+        t_env = env("PADDLE_TPU_BENCH_SERVE_TIMEOUT")
+        timeout_s = float(t_env) if t_env is not None else 60.0
+    queue_cap = (int(env("PADDLE_TPU_BENCH_SERVE_QUEUE_CAP", 0))
+                 if queue_cap is None else queue_cap)
+    run_dir = run_dir or env("PADDLE_TPU_BENCH_SERVE_DIR",
+                             os.path.join(REPO, "output", "bench_serve"))
+    obsm.configure(run_dir)
+
+    tc = nmt_gen_config(vocab=vocab, dim=dim, beam_size=beam_size,
+                        max_length=max_length, dtype=dtype or BENCH_DTYPE,
+                        batch_size=B)
+    gm = GradientMachine(tc.model_config,
+                         compute_dtype=compute_dtype_of(tc.opt_config))
+    params = gm.init_params(seed=1)
+    group = next(s.name for s in tc.model_config.sub_models
+                 if s.generator is not None)
+    registry = CompileRegistry(device_kind=jax.devices()[0].device_kind)
+
+    def prompt_fn(rng, i):
+        return rng.randint(2, vocab, size=int(rng.randint(1, T + 1))).tolist()
+
+    budget_fn = None
+    if mixed_len:
+        # heavy-tailed output budgets (real serving is mostly-short with
+        # a long tail): ~90% draw 1..max(L/8, 1) tokens, ~10% the full
+        # max_length — run-to-completion pays max_length for EVERY
+        # cohort regardless, which is exactly the A/B's subject
+        short = max(max_length // 8, 1)
+
+        def budget_fn(rng, i):
+            if rng.rand() < 0.1:
+                return max_length
+            return 1 + int(rng.randint(0, short))
+
+    rates_env = env("PADDLE_TPU_BENCH_SERVE_RATES", "")
+    if rates_env:
+        rates = [float(r) for r in rates_env.split(",") if r.strip()]
+
+    if engine == "continuous":
+        doc, capacity_rps = _serve_sweep_continuous(
+            gm, params, registry, rates=rates, B=B, T=T,
+            max_length=max_length, n_requests=n_requests, seed=seed,
+            timeout_s=timeout_s, queue_cap=queue_cap,
+            decode_block=decode_block, prompt_fn=prompt_fn,
+            budget_fn=budget_fn,
+        )
+        beam_size = 1  # the engine decodes greedily (doc/serving.md)
+    else:
+        doc, capacity_rps = _serve_sweep_static(
+            gm, params, registry, group=group, rates=rates, B=B, T=T,
+            n_requests=n_requests, seed=seed, timeout_s=timeout_s,
+            queue_cap=queue_cap, beam_size=beam_size, prompt_fn=prompt_fn,
+            budget_fn=budget_fn, make_seq=make_seq,
+        )
     registry.emit_roofline()
     # run_end must be the serve stream's LAST record (after the
     # kind=bench headline — doc/observability.md). When the bench-record
@@ -754,16 +885,18 @@ def bench_serve(B=None, T=None, vocab=None, dim=None, beam_size=None,
             "queue_wait_share": w.get("queue_wait_share"),
             "occupancy_mean": round((w.get("occupancy") or {}).get("mean", 0.0), 3),
             "goodput_tok_s": w.get("goodput_tok_s"),
+            "engine": w.get("engine", engine),
         }
         for w in doc["rungs"]
     ]
     best = max((w.get("goodput_tok_s", 0.0) for w in doc["rungs"]), default=0.0)
     extras = _leg_extras(
         batch=B, beam_size=beam_size, max_length=max_length,
-        dtype=tc.opt_config.dtype, n_requests=n_requests,
-        capacity_rps=round(capacity_rps, 3),
+        dtype=tc.opt_config.dtype, n_requests=n_requests, engine=engine,
+        mixed_len=bool(mixed_len), capacity_rps=round(capacity_rps, 3),
         knee_rps=doc.get("knee_rps"), rungs=rungs, run_dir=run_dir,
-        tokens="best-beam generated",
+        tokens=("greedy generated" if engine == "continuous"
+                else "best-beam generated"),
     )
     # memory trajectory for the serve leg too: the sweep's live HBM
     # peak (absent on stat-less backends) and the serve_gen group's
@@ -1000,8 +1133,17 @@ def main():
     elif which == "serve":
         # offered-load serving leg: CPU smoke shapes are bench_serve's
         # backend-aware defaults (tiny model, named so a toy run never
-        # masquerades as the flagship serving number)
-        value, extras = bench_serve(dtype=None if on_tpu else "float32")
+        # masquerades as the flagship serving number).
+        # `bench.py serve --engine={static,continuous}` picks the
+        # engine (PADDLE_TPU_BENCH_SERVE_ENGINE also works) — run one
+        # of each on pinned PADDLE_TPU_BENCH_SERVE_RATES and `paddle
+        # compare` the two artifacts for the A/B (doc/serving.md)
+        eng = None
+        for a in sys.argv[2:]:
+            if a.startswith("--engine="):
+                eng = a.split("=", 1)[1]
+        value, extras = bench_serve(dtype=None if on_tpu else "float32",
+                                    engine=eng)
         metric = ("serve_goodput_tokens_per_sec" if on_tpu
                   else "serve_cpu_smoke_goodput_tokens_per_sec")
         unit, tkey = "tokens/s", None

@@ -225,11 +225,16 @@ DEFAULT_CONFIG: Dict[str, Any] = {
         "data/feeder.py",
         "trainer/trainer.py",
         "trainer/async_ckpt.py",
+        # the serving engine's scheduler loop is a hot path: per-
+        # iteration wall-clock reads would tax every decode launch, and
+        # its timestamps must stay seam-virtualizable for `paddle race`
+        "serving/",
     ),
     # PTL002: (file pattern, function) pairs that ARE the hot loops
     "hot_loop_funcs": (
         ("trainer/trainer.py", "train_one_pass"),
         ("observability/serving.py", "run_rung"),
+        ("serving/engine.py", "_loop"),
     ),
     # PTL002: calls whose results live on device (taint sources)
     "device_source_res": (r"\.call$", r"_step$", r"^launch_fn$"),

@@ -219,6 +219,25 @@ class GradientMachine:
             max_length=max_length, beam_size=beam_size,
         )
 
+    def asDecodeEngine(self, slots: int = 8, prompt_tokens: int = 32,
+                       queue_cap: int = 0, request_timeout_s: float = 60.0,
+                       decode_block: int = 1, registry=None):
+        """The continuous-batching engine over this machine's generator
+        graph (doc/serving.md) — the concurrent-use superset of
+        :class:`SequenceGenerator`: submit() from any thread, slot-based
+        greedy decode (beam_size=1 semantics, token-for-token equal to
+        ``generate`` at beam 1), admission/eviction per iteration.
+        Returns an UNstarted :class:`paddle_tpu.serving.Engine`; call
+        ``.start()`` (pays the compiles) and ``.drain()`` when done."""
+        from paddle_tpu.serving.frontend import build_engine
+
+        return build_engine(
+            self._core, self.params, slots=slots,
+            prompt_tokens=prompt_tokens, queue_cap=queue_cap,
+            request_timeout_s=request_timeout_s, decode_block=decode_block,
+            registry=registry,
+        )
+
 
 def _feed_signature(in_args):
     """Best-effort batch-shape signature of a feed — what jit retraces
@@ -269,7 +288,15 @@ def _prompt_token_counts(in_args) -> List[int]:
 class SequenceGenerator:
     """Beam-search generation façade (ref: PaddleAPI.h:775 and
     ISequenceResults). Works on configs whose sub-model declares a
-    generator (beam_search in the DSL)."""
+    generator (beam_search in the DSL).
+
+    One call = one static run-to-completion cohort. For CONCURRENT use
+    — many callers, mixed lengths, latency targets — the continuous-
+    batching engine subsumes this API at beam_size=1:
+    ``machine.asDecodeEngine(...).start()`` then ``submit()`` per
+    request (doc/serving.md; greedy outputs are token-for-token equal,
+    pinned by tests/test_engine.py). This class keeps its PR-8
+    one-cohort request-record contract unchanged."""
 
     def __init__(
         self,

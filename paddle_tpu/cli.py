@@ -26,9 +26,10 @@ def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help", "help"):
         print(__doc__)
-        print("usage: paddle <train|supervise|test|gen|checkgrad|dump_config|"
-              "merge_model|check-checkpoint|metrics|memory|roofline|compare|"
-              "serve-report|lint|race|faults|version> [--flags]")
+        print("usage: paddle <train|supervise|test|gen|serve|checkgrad|"
+              "dump_config|merge_model|check-checkpoint|metrics|memory|"
+              "roofline|compare|serve-report|lint|race|faults|version> "
+              "[--flags]")
         return 0
     cmd, rest = argv[0], argv[1:]
     if cmd == "version":
@@ -72,6 +73,13 @@ def main(argv=None) -> int:
         from paddle_tpu.observability.compare import main as compare_main
 
         return compare_main(rest)
+    if cmd == "serve":
+        # continuous-batching generation server (doc/serving.md):
+        # stdin-JSONL requests through the slot-based decode engine,
+        # SIGTERM = graceful drain
+        from paddle_tpu.serving.frontend import main as serve_main
+
+        return serve_main(rest)
     if cmd in ("serve-report", "serve_report"):
         # per-offered-load serving report (request/serve_window records
         # from `bench.py serve`, doc/observability.md) — jax-free

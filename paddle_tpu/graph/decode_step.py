@@ -1,0 +1,187 @@
+"""Per-step decoder seam — slot-batched greedy decode for serving.
+
+``_generate`` (recurrent_group.py) runs the whole beam-search loop as
+one ``lax.while_loop`` launch: correct for single-shot generation, but
+a continuous-batching server (paddle_tpu/serving/) needs the loop OPEN
+— admit and evict sequences at every iteration boundary. This module
+is that opening:
+
+- :func:`plan_of` inspects a generation graph and returns a
+  :class:`GenPlan` when the generator group is *slot-decodable*:
+  statics-only conditioning (the seqToseq attention decoder shape —
+  encoder outputs as StaticInput, a GeneratedInput token feed), plain
+  (non-sequence) memories. Anything else returns the reason — the
+  engine refuses loudly and the static path stays available.
+- :func:`capture_prefill` runs the machine forward with the
+  ``gen_capture`` sink: the encoder executes normally, the generator
+  group stores its prepared decode inputs (static-link Arguments,
+  unexpanded memory boots) and skips the loop. This is the *prefill*:
+  everything a new sequence needs before its first decode step.
+- :func:`make_greedy_step` builds one decode step over a [B, ...] slot
+  batch: feed the previous tokens, run the group's step sub-network
+  once, take the argmax token, advance the memory carries with
+  finished-slot freezing. Shape-polymorphic in B; semantically the
+  K=1 path of ``_generate``'s beam step (pinned by the golden test in
+  tests/test_engine.py), so the engine subsumes ``SequenceGenerator``
+  for beam_size=1.
+
+The fused attention-GRU kernel exposes the matching single-step math as
+``ops.pallas_attention_gru.attention_gru_step`` — the seam a future
+TPU-fused serve_decode kernel plugs into without changing the engine.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.graph.argument import Argument
+from paddle_tpu.graph.recurrent_group import (
+    _memory_feed_arg,
+    _run_submodel_step,
+)
+from paddle_tpu.layers.base import LayerContext
+
+Array = jax.Array
+
+
+@dataclasses.dataclass
+class GenPlan:
+    """Static description of a slot-decodable generator group."""
+
+    group: str                 # the recurrent_layer_group layer name
+    sub: Any                   # SubModelConfig
+    predict_agent: str         # the generated-id feed agent
+    score_layer: str           # out-link producing the [B, V] probs
+    bos: int
+    eos: int
+    max_length: int            # generator max_num_frames
+    memories: List[Any]
+    static_links: List[str]    # link names, capture/state dict keys
+
+
+def plan_of(machine) -> Tuple[Optional[GenPlan], str]:
+    """(plan, "") when the machine's generation graph supports
+    slot-batched per-step decode, else (None, reason). Gates mirror the
+    state the engine can hold in fixed-shape slot buffers: statics-only
+    conditioning and flat (non-sequence) memory carries."""
+    subs = [s for s in machine.model.sub_models if s.generator is not None]
+    if not subs:
+        return None, "config declares no generator sub-model (beam_search)"
+    sub = subs[0]
+    if sub.in_links:
+        return None, (
+            "generator group has sequence in-links (per-step input "
+            "conditioning) — slot decode supports statics-only groups"
+        )
+    if any(m.is_sequence for m in sub.memories):
+        return None, (
+            "generator group carries a sequence-valued memory — slot "
+            "decode supports flat carries only"
+        )
+    cfg = machine.network.layer_map.get(sub.name)
+    if cfg is None:
+        return None, f"no group layer named {sub.name!r}"
+    predict_agent = f"__generated_id@{sub.name}"
+    if predict_agent not in machine.network.layer_map:
+        return None, "generation group missing the generated-id agent"
+    if not sub.out_links:
+        return None, "generator group has no out-links"
+    return GenPlan(
+        group=sub.name,
+        sub=sub,
+        predict_agent=predict_agent,
+        score_layer=sub.out_links[0].layer_name,
+        bos=int(cfg.bos_id),
+        eos=int(cfg.eos_id),
+        max_length=int(sub.generator.max_num_frames),
+        memories=list(sub.memories),
+        static_links=[l.link_name for l in sub.static_links],
+    ), ""
+
+
+def _static_tree(statics: Dict[str, Argument]) -> Dict[str, Dict[str, Array]]:
+    """Argument dict → a plain jax pytree (absent fields omitted, so the
+    tree structure is a pure function of the model, not of None leaves)."""
+    out: Dict[str, Dict[str, Array]] = {}
+    for name, arg in statics.items():
+        d: Dict[str, Array] = {}
+        for f in ("value", "ids", "seq_lengths", "sub_seq_lengths"):
+            v = getattr(arg, f)
+            if v is not None:
+                d[f] = v
+        out[name] = d
+    return out
+
+
+def _static_args(tree: Dict[str, Dict[str, Array]]) -> Dict[str, Argument]:
+    return {
+        name: Argument(
+            value=d.get("value"), ids=d.get("ids"),
+            seq_lengths=d.get("seq_lengths"),
+            sub_seq_lengths=d.get("sub_seq_lengths"),
+        )
+        for name, d in tree.items()
+    }
+
+
+def capture_prefill(machine, plan: GenPlan, params, in_args):
+    """Run the full graph (encoder + boots) in capture mode; returns
+    ``(statics_tree, carries)`` for the feed's batch — the per-sequence
+    decode state the engine scatters into free slots. jit-safe: the
+    captured values are tracers of the enclosing trace."""
+    cap: Dict[str, Any] = {}
+    machine.forward(params, in_args, pass_type="gen", rng=None,
+                    gen_capture=cap)
+    assert cap.get("group") == plan.group, (
+        f"capture ran group {cap.get('group')!r}, planned {plan.group!r}"
+    )
+    return _static_tree(cap["statics"]), tuple(cap["boots"])
+
+
+def make_greedy_step(machine, plan: GenPlan):
+    """Build ``step(params, statics_tree, carries, prev_tok, finished)
+    -> (new_carries, token, new_finished)`` — one greedy decode step for
+    every slot row. Finished rows freeze their carries and emit ``eos``
+    (score-free), exactly the K=1 semantics of ``_generate``'s beam
+    step, so greedy engine output matches ``SequenceGenerator`` with
+    beam_size=1 token for token."""
+    network = machine.network
+    eos = plan.eos
+
+    def step(params, statics_tree, carries, prev_tok, finished):
+        ctx = LayerContext(
+            params=params, model=machine.model, pass_type="gen", rng=None,
+            dtype=machine.dtype, compute_dtype=machine.compute_dtype,
+            no_cast_inputs=machine.no_cast_inputs,
+            scan_unroll=machine.scan_unroll,
+        )
+        fed: Dict[str, Argument] = {plan.predict_agent: Argument(ids=prev_tok)}
+        fed.update(_static_args(statics_tree))
+        for mem, carry in zip(plan.memories, carries):
+            fed[mem.link_name] = _memory_feed_arg(mem, carry)
+        outs = _run_submodel_step(network, plan.sub, ctx, fed, None)
+        probs = outs[plan.score_layer].value                      # [B, V]
+        # argmax of log-probs == argmax of probs; the clip only matters
+        # for the beam path's score arithmetic — kept for bit-parity of
+        # tie behavior with _generate's top_k(K=1)
+        logp = jnp.log(jnp.clip(probs, 1e-20, None))
+        token = jnp.argmax(logp, axis=-1).astype(jnp.int32)
+        token = jnp.where(finished, eos, token)
+        new_carries = []
+        for mem, old in zip(plan.memories, carries):
+            out_arg = outs[mem.layer_name]
+            new = (out_arg.ids
+                   if jnp.issubdtype(old.dtype, jnp.integer)
+                   else out_arg.value)
+            keep = finished.reshape((-1,) + (1,) * (new.ndim - 1))
+            new_carries.append(
+                jnp.where(keep if new.ndim > 1 else finished, old, new)
+            )
+        new_finished = finished | (token == eos)
+        return tuple(new_carries), token, new_finished
+
+    return step

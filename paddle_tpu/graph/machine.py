@@ -105,15 +105,20 @@ class GradientMachine:
         pass_type: str = "test",
         rng: Optional[Array] = None,
         table_overrides=None,
+        gen_capture=None,
     ) -> Tuple[Dict[str, Argument], Dict[str, Array]]:
-        """Run the graph; returns (all layer outputs, state updates)."""
+        """Run the graph; returns (all layer outputs, state updates).
+
+        ``gen_capture``: a dict sink making generator groups capture their
+        prepared decode inputs instead of running the beam loop — the
+        serving engine's prefill seam (graph/decode_step.py)."""
         ctx = LayerContext(
             params=params, model=self.model, pass_type=pass_type, rng=rng,
             dtype=self.dtype, mesh=self.mesh, table_overrides=table_overrides,
             compute_dtype=self.compute_dtype, no_cast_inputs=self.no_cast_inputs,
             scan_unroll=self.scan_unroll, pallas_rnn=self.pallas_rnn,
             conv_s2d=self.conv_s2d, conv_stats_mode=self.conv_stats_mode,
-            pallas_decoder=self.pallas_decoder,
+            pallas_decoder=self.pallas_decoder, gen_capture=gen_capture,
         )
         self.network.forward(ctx, in_args)
         return ctx.outputs, ctx.state_updates

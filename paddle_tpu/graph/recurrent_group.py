@@ -724,28 +724,63 @@ def _generate(network, cfg: LayerConfig, sub: SubModelConfig, ctx: LayerContext)
         for v in in_xs_v.values():
             gen_dtype = v.dtype
             break
-    # boot memories and expand them across beams: [B, ...] → [B*K, ...].
-    # Sequence-valued memories (seqFlag branch of createMemoryFrameInfo,
-    # ref RecurrentGradientMachine.cpp:740-744) carry a (padded sequence,
-    # lengths) pair so step s reads step s-1's FULL output sequence —
-    # hierarchical decoders at generation time.
-    carries0 = []
+    # boot memories (unexpanded [B, ...] first — the decode-step capture
+    # below wants them per SAMPLE, not per beam), then expand across
+    # beams: [B, ...] → [B*K, ...]. Sequence-valued memories (seqFlag
+    # branch of createMemoryFrameInfo, ref RecurrentGradientMachine.cpp:
+    # 740-744) carry a (padded sequence, lengths) pair so step s reads
+    # step s-1's FULL output sequence — hierarchical decoders at
+    # generation time.
+    boots = []
     seq_mem_T: Dict[int, int] = {}
     for i, mem in enumerate(memories):
         if mem.is_sequence:
             v, sl = _memory_boot_seq(network, mem, ctx, sub)
             seq_mem_T[i] = v.shape[1]
-            carries0.append((jnp.repeat(v, K, axis=0), jnp.repeat(sl, K, axis=0)))
+            boots.append((v, sl))
         else:
-            carries0.append(
-                jnp.repeat(_memory_boot(network, mem, ctx, B, gen_dtype, sub), K, axis=0)
-            )
-    carries0 = tuple(carries0)
+            boots.append(_memory_boot(network, mem, ctx, B, gen_dtype, sub))
 
     # the feed agent for previously generated ids (created by beam_search())
     predict_agent = f"__generated_id@{cfg.name}"
     assert predict_agent in network.layer_map, "generation group missing the generated-id agent"
     score_layer = sub.out_links[0].layer_name
+
+    if ctx.gen_capture is not None:
+        # per-step decoder seam (graph/decode_step.py): the serving
+        # engine's prefill runs the graph up to here — encoder outputs
+        # (static links) and memory boots, per sample — and takes over
+        # the decode loop itself, one slot-batched step per launch.
+        # Outputs are zero placeholders: a capture forward exists only
+        # for its captured side channel.
+        ctx.gen_capture.update(
+            group=cfg.name,
+            statics={link.link_name: _scope_lookup(ctx, link.layer_name)
+                     for link in sub.static_links},
+            boots=list(boots),
+            batch=B,
+            dtype=gen_dtype,
+        )
+        zeros = Argument(ids=jnp.zeros((B, L), jnp.int32),
+                         seq_lengths=jnp.zeros((B,), jnp.int32))
+        ctx.outputs[cfg.name] = zeros
+        ctx.outputs[f"{cfg.name}@beams"] = Argument(
+            ids=jnp.zeros((B, K, L), jnp.int32),
+            value=jnp.zeros((B, K), gen_dtype),
+            seq_lengths=jnp.full((B,), K, jnp.int32),
+            sub_seq_lengths=jnp.zeros((B, K), jnp.int32),
+        )
+        ctx.outputs[score_layer] = ctx.outputs[cfg.name]
+        return
+
+    carries0 = []
+    for mem, boot in zip(memories, boots):
+        if mem.is_sequence:
+            v, sl = boot
+            carries0.append((jnp.repeat(v, K, axis=0), jnp.repeat(sl, K, axis=0)))
+        else:
+            carries0.append(jnp.repeat(boot, K, axis=0))
+    carries0 = tuple(carries0)
 
     neg_inf = jnp.asarray(-1e30, gen_dtype)
     init_state = (

@@ -142,6 +142,13 @@ class LayerContext:
     # up this chain; ordinary layer-input lookup deliberately cannot, so
     # referencing an outer sequence without StaticInput stays an error
     parent: Optional["LayerContext"] = None
+    # generation-capture sink (graph/decode_step.py): when a dict is
+    # supplied, a generator recurrent group stores its prepared decode
+    # inputs (static-link Arguments, unexpanded memory boots) here and
+    # SKIPS the beam-search loop — the serving engine's prefill path,
+    # which scatters the captured state into slot buffers and then
+    # drives per-step decode launches itself
+    gen_capture: Optional[Dict[str, Any]] = None
 
     @property
     def is_training(self) -> bool:

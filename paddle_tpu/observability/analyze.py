@@ -107,8 +107,10 @@ def analyze(streams: Dict[int, List[Dict[str, Any]]]) -> Dict[str, Any]:
     # serve_window; oneshot records (rung -1, the embedding API) owe
     # nothing, and a superseded epoch's driver doesn't haunt the next
     serve_driver_hosts: set = set()
-    # serve_window rollups, latest-wins per (host, rung) like pass_end —
-    # a restarted serve driver re-emits its rungs into the same stream
+    # serve_window rollups, latest-wins per (host, engine, rung) like
+    # pass_end — a restarted serve driver re-emits its rungs into the
+    # same stream, while a stream carrying BOTH engines' sweeps (the
+    # A/B in one dir) must keep both ladders, not clobber the first
     serve_windows_by: Dict[tuple, Dict[str, Any]] = {}
 
     for host in hosts:
@@ -165,7 +167,9 @@ def analyze(streams: Dict[int, List[Dict[str, Any]]]) -> Dict[str, Any]:
                 if rec.get("rung", -1) >= 0:
                     serve_driver_hosts.add(host)
             elif kind == "serve_window":
-                serve_windows_by[(host, rec.get("rung"))] = rec
+                serve_windows_by[
+                    (host, rec.get("engine", "static"), rec.get("rung"))
+                ] = rec
             elif kind == "pass_end":
                 p = int(rec.get("pass", -1))
                 per_host_pass.setdefault(host, {})[p] = rec

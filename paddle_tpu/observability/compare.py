@@ -70,18 +70,44 @@ _HIGHER_BETTER = {
 }
 
 
-def _serve_key(offered_rps, qualifier, seen_pre: set) -> str:
+def _serve_key(offered_rps, qualifier, seen_pre: set,
+               engine: Optional[str] = None) -> str:
     """The ONE serve rung key format, shared by the run-dir and bench-
     artifact sides (a divergence would silently break their
     comparability): 6 significant digits of offered load — a slow
     backend's sub-1 req/s ladder must not collapse rungs into one key —
-    with later duplicates (variance-gauging repeated rates)
-    rung-qualified instead of silently overwritten."""
+    with later duplicates engine-qualified first (a both-engines
+    artifact repeats every rate once per engine; joining them as one
+    key would diff an engine against itself) and then rung-qualified
+    (variance-gauging repeated rates) instead of silently overwritten.
+
+    The rung join is therefore (engine, offered load): two sweeps of
+    the SAME engine join on offered load alone; mismatched ladders land
+    in only_a/only_b (visible, never a bogus verdict); and a pure
+    cross-engine A/B — one engine per artifact, pinned
+    PADDLE_TPU_BENCH_SERVE_RATES — joins on offered load, which is
+    exactly the static-vs-continuous comparison being asked for."""
     pre = f"serve.{format(float(offered_rps or 0.0), '.6g')}rps."
+    if pre in seen_pre and engine:
+        pre = f"serve.{engine}.{format(float(offered_rps or 0.0), '.6g')}rps."
     if pre in seen_pre:
         pre = f"{pre[:-1]}.r{qualifier}."
     seen_pre.add(pre)
     return pre
+
+
+def _engine_scoped(pre: str, engine: Optional[str], key: str) -> str:
+    """Key for SHARE-type rung metrics (queue_wait_share): a share of
+    e2e is only comparable when the latency regime is shared, so these
+    are engine-qualified unconditionally — same-engine A/Bs still join,
+    while a cross-engine join (where the denominator shrank with the
+    engine change) lands in only_a/only_b instead of minting a phantom
+    verdict."""
+    if not engine:
+        return pre + key
+    if pre.startswith(f"serve.{engine}."):
+        return pre + key  # already engine-qualified (both-engines side)
+    return f"serve.{engine}.{pre[len('serve.'):]}{key}"
 
 
 def _higher_is_better(name: str) -> bool:
@@ -173,8 +199,17 @@ def _run_side(path: str) -> Dict[str, float]:
     # serve telemetry — the key namespaces never collide.
     windows = doc.get("serve_windows") or []
     seen_pre: set = set()
-    for w in windows:
-        pre = _serve_key(w.get("offered_rps"), w.get("rung", 0), seen_pre)
+    # deterministic key assignment: iterate (engine, rung)-sorted so a
+    # both-engines stream always hands the SAME engine the unqualified
+    # keys regardless of which sweep was recorded first — two such
+    # artifacts then join engine-to-engine, never crosswise
+    for w in sorted(windows,
+                    key=lambda w: (str(w.get("engine") or ""),
+                                   w.get("rung") if isinstance(
+                                       w.get("rung"), int) else 0)):
+        engine = w.get("engine") if isinstance(w.get("engine"), str) else None
+        pre = _serve_key(w.get("offered_rps"), w.get("rung", 0), seen_pre,
+                         engine=engine)
         for snap_key, dst, scale in (
             ("latency", "p50_ms", 1e3), ("latency", "p99_ms", 1e3),
             ("ttft", "ttft_p50_ms", 1e3), ("ttft", "ttft_p99_ms", 1e3),
@@ -183,9 +218,11 @@ def _run_side(path: str) -> Dict[str, float]:
             v = (w.get(snap_key) or {}).get(q)
             if isinstance(v, (int, float)):
                 out[pre + dst] = float(v) * scale
-        for src in ("goodput_tok_s", "queue_wait_share"):
-            if isinstance(w.get(src), (int, float)):
-                out[pre + src] = float(w[src])
+        if isinstance(w.get("goodput_tok_s"), (int, float)):
+            out[pre + "goodput_tok_s"] = float(w["goodput_tok_s"])
+        if isinstance(w.get("queue_wait_share"), (int, float)):
+            out[_engine_scoped(pre, engine, "queue_wait_share")] = float(
+                w["queue_wait_share"])
     if windows:
         from paddle_tpu.observability.serving import saturation_knee
 
@@ -253,15 +290,22 @@ def _bench_side(path: str, raw: str) -> Dict[str, float]:
     # and the knee — comparable WITHOUT the telemetry run dir, under
     # the same offered-load-keyed join as the run-dir side
     seen_pre: set = set()
-    for i, r in enumerate(line.get("rungs") or []):
-        if not isinstance(r, dict):
-            continue
-        pre = _serve_key(r.get("offered_rps"), i, seen_pre)
+    rungs = [(i, r) for i, r in enumerate(line.get("rungs") or [])
+             if isinstance(r, dict)]
+    # (engine, index)-sorted for the same deterministic key assignment
+    # as the run-dir side (see _run_side)
+    rungs.sort(key=lambda p: (str(p[1].get("engine") or ""), p[0]))
+    for i, r in rungs:
+        engine = r.get("engine") if isinstance(r.get("engine"), str) else None
+        pre = _serve_key(r.get("offered_rps"), i, seen_pre, engine=engine)
         for key in ("p50_ms", "p99_ms", "ttft_p50_ms", "ttft_p99_ms",
-                    "goodput_tok_s", "queue_wait_share"):
+                    "goodput_tok_s"):
             v = r.get(key)
             if isinstance(v, (int, float)) and not isinstance(v, bool):
                 out[pre + key] = float(v)
+        v = r.get("queue_wait_share")
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            out[_engine_scoped(pre, engine, "queue_wait_share")] = float(v)
     if isinstance(line.get("knee_rps"), (int, float)):
         out["serve_knee_rps"] = float(line["knee_rps"])
     for leg, payload in (line.get("legs") or {}).items():

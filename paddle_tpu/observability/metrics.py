@@ -95,8 +95,12 @@ KIND_REQUIRED = {
     "restart": ("restore_s",),
     "compile": ("group", "sig"),
     "roofline": ("group", "sig"),
+    # request/serve_window (observability/serving.py + serving/engine.py):
+    # `engine` ("static" | "continuous") keys the compare join — two
+    # engines' rungs must never be mistaken for one ladder; request
+    # records carry it too (optional pre-PR-12 streams still validate)
     "request": ("id", "outcome"),
-    "serve_window": ("rung", "offered_rps"),
+    "serve_window": ("rung", "offered_rps", "engine"),
     # memory plane (observability/memory.py): host_rss_bytes is the one
     # field every backend can supply — hbm_* fields are present exactly
     # when the allocator reports stats (None on the CPU backend)

@@ -65,6 +65,12 @@ from paddle_tpu.observability.analyze import (
 # — serve-report joins compile/roofline records on it
 SERVE_GROUP = "serve_gen"
 
+# every serving launch group serve-report joins: the PR-8 static
+# engine's one-shot generation launch, and the continuous engine's
+# decode/prefill pair (paddle_tpu/serving/jax_backend.py) — all held to
+# the same recompiles=0-after-warmup contract
+SERVE_GROUPS = (SERVE_GROUP, "serve_decode", "serve_prefill")
+
 # mean exec seconds per launch at or below which a rung is classified
 # dispatch-bound: the launch is latency-floor sized (per-launch dispatch
 # overhead ~1-3ms through the runtime — doc/performance.md "Fused
@@ -98,18 +104,26 @@ def arrival_offsets(n: int, rate_rps: float, seed: int) -> np.ndarray:
 @dataclasses.dataclass
 class Request:
     """One request's lifecycle. Offsets are VIRTUAL seconds from rung
-    start (the envelope ``t`` stays the writer's monotonic offset)."""
+    start for the PR-8 static driver (the envelope ``t`` stays the
+    writer's monotonic offset); the continuous engine stamps real
+    wall-clock offsets from its window start. ``t_first_token`` differs
+    from ``t_finish`` only under per-step decode — single-shot launches
+    materialize the whole output at once and leave it unset (-1 →
+    first-token == finish in the emitted record). ``max_new`` is the
+    client's output-token budget (None = the graph's max_length)."""
 
     rid: str
     t_enqueue: float
     prompt: Any = None
     prompt_tokens: int = 0
     t_admit: float = -1.0
+    t_first_token: float = -1.0
     t_finish: float = -1.0
     gen_tokens: int = 0
     cohort: int = -1
     cohort_size: int = 0
     outcome: str = "pending"
+    max_new: Optional[int] = None
 
     @property
     def queue_wait_s(self) -> Optional[float]:
@@ -128,10 +142,16 @@ class RequestLog:
     kind from metrics.py — p50/p99 without storing samples."""
 
     def __init__(self, rung: int = 0, offered_rps: float = 0.0,
-                 beam_size: Optional[int] = None):
+                 beam_size: Optional[int] = None, engine: str = "static"):
         self.rung = int(rung)
         self.offered_rps = float(offered_rps)
         self.beam_size = beam_size
+        # which serving engine produced this window: "static" (the PR-8
+        # run-to-completion micro-batch driver / single-shot generate)
+        # or "continuous" (paddle_tpu/serving slot-based decode) —
+        # stamped on every request and serve_window record so `paddle
+        # compare` never joins rungs across engines by accident
+        self.engine = str(engine)
         self.latency = obs.Histogram("latency_s")
         self.ttft = obs.Histogram("ttft_s")
         self.queue_wait = obs.Histogram("queue_wait_s")
@@ -142,6 +162,7 @@ class RequestLog:
         self.completed = 0
         self.rejected = 0
         self.timeouts = 0
+        self.cancels = 0
         self.errors = 0
         self.launches = 0
         self.exec_s = 0.0
@@ -155,6 +176,7 @@ class RequestLog:
         rec: Dict[str, Any] = {
             "id": req.rid,
             "rung": self.rung,
+            "engine": self.engine,
             "outcome": req.outcome,
             "t_enqueue": round(req.t_enqueue, 6),
             "prompt_tokens": int(req.prompt_tokens),
@@ -168,32 +190,49 @@ class RequestLog:
             rec["t_admit"] = round(req.t_admit, 6)
             rec["queue_wait_s"] = round(req.queue_wait_s, 6)
         if req.t_finish >= 0:
-            # single-shot decode: the whole output materializes with the
-            # launch, so first-token == finish here; a continuous-
-            # batching server keeps the same fields and makes them differ
-            rec["t_first_token"] = round(req.t_finish, 6)
+            # single-shot decode materializes the whole output with the
+            # launch, so first-token == finish there (t_first_token
+            # unset); the continuous engine stamps the REAL wall-clock
+            # moment its first token left the device mid-sequence
+            tft = req.t_first_token if req.t_first_token >= 0 else req.t_finish
+            rec["t_first_token"] = round(tft, 6)
             rec["t_finish"] = round(req.t_finish, 6)
-            rec["ttft_s"] = round(req.t_finish - req.t_enqueue, 6)
+            rec["ttft_s"] = round(tft - req.t_enqueue, 6)
             rec["decode_s"] = round(req.t_finish - req.t_admit, 6)
             rec["e2e_s"] = round(req.e2e_s, 6)
             rec["gen_tokens"] = int(req.gen_tokens)
         rec.update(extra)
         obs.emit("request", **rec)
 
-    def reject(self, req: Request) -> None:
-        """Admission refused at arrival (queue over cap)."""
+    def reject(self, req: Request, arrived: bool = False) -> None:
+        """Admission refused. At submit time the request was never
+        enqueued — count its arrival here; a drain-path rejection of an
+        ALREADY-enqueued request passes ``arrived=True`` (its arrival
+        was counted by :meth:`enqueued` — double-counting would inflate
+        the window's completed/arrived ratios)."""
         req.outcome = "rejected"
-        self.arrived += 1
+        if not arrived:
+            self.arrived += 1
         self.rejected += 1
         obs.registry().counter("serve.rejected").inc()
         self._emit(req)
 
     def timeout(self, req: Request, vnow: float) -> None:
-        """Queued past the deadline without being admitted."""
+        """Past the wall deadline: queued (never admitted) or — under
+        the continuous engine — mid-decode, freeing the slot at the next
+        iteration boundary."""
         req.outcome = "timeout"
         self.timeouts += 1
         obs.registry().counter("serve.timeouts").inc()
         self._emit(req, queue_wait_s=round(vnow - req.t_enqueue, 6))
+
+    def cancel(self, req: Request, vnow: float) -> None:
+        """Client cancellation, applied at an iteration boundary —
+        frees the queue entry or the decode slot (continuous engine)."""
+        req.outcome = "cancelled"
+        self.cancels += 1
+        obs.registry().counter("serve.cancelled").inc()
+        self._emit(req, t_cancel=round(vnow, 6))
 
     def error(self, req: Request, service_s: Optional[float] = None,
               **extra) -> None:
@@ -228,12 +267,18 @@ class RequestLog:
         r.gauge("serve.queue_depth").set(depth_after)
         r.histogram("serve.batch_occupancy").observe(float(occupancy))
 
+    def note_exec(self, service_s: float) -> None:
+        """Device seconds outside :meth:`launch` (the continuous
+        engine's prefill writes) — keeps ``host_share`` honest."""
+        self.exec_s += float(service_s)
+
     def complete(self, req: Request, **extra) -> None:
         req.outcome = "ok"
         self.completed += 1
         self.gen_tokens += int(req.gen_tokens)
         self.latency.observe(req.e2e_s)
-        self.ttft.observe(req.t_finish - req.t_enqueue)
+        tft = req.t_first_token if req.t_first_token >= 0 else req.t_finish
+        self.ttft.observe(tft - req.t_enqueue)
         self.queue_wait.observe(req.queue_wait_s)
         self._wait_ok_s += req.queue_wait_s
         self._e2e_ok_s += req.e2e_s
@@ -250,6 +295,7 @@ class RequestLog:
         window_s = max(float(window_s), 1e-9)
         rec: Dict[str, Any] = {
             "rung": self.rung,
+            "engine": self.engine,
             "offered_rps": round(self.offered_rps, 6),
             "window_s": round(window_s, 6),
             "arrived": self.arrived,
@@ -257,6 +303,7 @@ class RequestLog:
             "completed": self.completed,
             "rejected": self.rejected,
             "timeouts": self.timeouts,
+            "cancelled": self.cancels,
             "errors": self.errors,
             "launches": self.launches,
             "exec_s": round(self.exec_s, 6),
@@ -323,6 +370,35 @@ def log_oneshot(prompt_tokens: Sequence[int], gen_tokens: Sequence[int],
 # --------------------------------------------------------------- driver
 
 
+def schedule_requests(
+    rate_rps: float,
+    n_requests: int,
+    seed: int,
+    rung: int = 0,
+    prompt_fn: Optional[Callable[[np.random.RandomState, int], Sequence[int]]] = None,
+    budget_fn: Optional[Callable[[np.random.RandomState, int], int]] = None,
+) -> List[Request]:
+    """The ONE workload builder both serving engines consume: arrival
+    offsets, prompts and per-request output budgets are all drawn from
+    the rung's seeded rngs in a fixed order, so the static driver and
+    the continuous engine (bench.py serve --engine=...) face the SAME
+    requests bit-for-bit — the A/B's whole validity. ``budget_fn(rng,
+    i)`` caps request ``i``'s generated tokens (``max_new``); None
+    leaves the graph's max_length in charge."""
+    arrivals = arrival_offsets(n_requests, rate_rps, seed)
+    rng = np.random.RandomState(seed + 0x5EED)
+    requests: List[Request] = []
+    for i in range(n_requests):
+        prompt = list(prompt_fn(rng, i)) if prompt_fn is not None else None
+        max_new = int(budget_fn(rng, i)) if budget_fn is not None else None
+        requests.append(Request(
+            rid=f"r{rung}-{i}", t_enqueue=float(arrivals[i]),
+            prompt=prompt, prompt_tokens=len(prompt) if prompt else 0,
+            max_new=max_new,
+        ))
+    return requests
+
+
 def run_rung(
     launch_fn: Callable[[List[Request]], Tuple[Sequence[int], Optional[float]]],
     *,
@@ -335,6 +411,8 @@ def run_rung(
     queue_cap: int = 0,
     beam_size: Optional[int] = None,
     prompt_fn: Optional[Callable[[np.random.RandomState, int], Sequence[int]]] = None,
+    budget_fn: Optional[Callable[[np.random.RandomState, int], int]] = None,
+    engine: str = "static",
 ) -> Tuple[Dict[str, Any], List[Request]]:
     """One offered-load rung: open-loop arrivals at ``rate_rps``, a
     dynamic micro-batch aggregator admitting up to ``max_batch`` queued
@@ -350,17 +428,16 @@ def run_rung(
     ``timeout_s`` drops queued requests never admitted in time. Both
     policies are evaluated at launch boundaries in virtual time, so the
     admitted-cohort assignment is a pure function of (seed, service
-    times)."""
-    arrivals = arrival_offsets(n_requests, rate_rps, seed)
-    rng = np.random.RandomState(seed + 0x5EED)
-    requests: List[Request] = []
-    for i in range(n_requests):
-        prompt = list(prompt_fn(rng, i)) if prompt_fn is not None else None
-        requests.append(Request(
-            rid=f"r{rung}-{i}", t_enqueue=float(arrivals[i]),
-            prompt=prompt, prompt_tokens=len(prompt) if prompt else 0,
-        ))
-    log = RequestLog(rung=rung, offered_rps=rate_rps, beam_size=beam_size)
+    times). ``budget_fn`` assigns per-request output budgets
+    (mixed-length workloads): run-to-completion launches still PAY the
+    graph's full max_length — that honesty is the continuous engine's
+    A/B case — so the budget only caps the tokens counted as delivered
+    (launch_fn's job, reading ``req.max_new``)."""
+    requests = schedule_requests(rate_rps, n_requests, seed, rung=rung,
+                                 prompt_fn=prompt_fn, budget_fn=budget_fn)
+    arrivals = [r.t_enqueue for r in requests]
+    log = RequestLog(rung=rung, offered_rps=rate_rps, beam_size=beam_size,
+                     engine=engine)
     # deque: a saturated unbounded queue reaches tens of thousands of
     # entries, and list.pop(0) purges would go quadratic — host time
     # that would then be charged to host_share
@@ -535,10 +612,15 @@ def serve_doc(streams: Dict[int, List[Dict[str, Any]]]) -> Dict[str, Any]:
         rec
         for host in sorted(epoch)
         for rec in epoch[host]
-        if rec.get("kind") == "compile" and rec.get("group") == SERVE_GROUP
+        if rec.get("kind") == "compile" and rec.get("group") in SERVE_GROUPS
     ]
+    # the decode-side group drives the bound classification: serve_gen
+    # for static runs, serve_decode for engine runs (prefill rides as a
+    # second compile line but isn't the steady-state launch)
+    rows = roofline_rows(epoch)
     roof = next(
-        (r for r in roofline_rows(epoch) if r.get("group") == SERVE_GROUP),
+        (r for g in (SERVE_GROUP, "serve_decode") for r in rows
+         if r.get("group") == g),
         None,
     )
     rungs = []
@@ -550,6 +632,8 @@ def serve_doc(streams: Dict[int, List[Dict[str, Any]]]) -> Dict[str, Any]:
     return {
         "rungs": rungs,
         "knee_rps": saturation_knee(windows),
+        "engines": sorted({w.get("engine", "static") for w in windows}),
+        "groups": sorted({c.get("group") for c in serve_compiles}),
         "requests": (doc.get("serve") or {}).get("requests", 0),
         "compiles": len(serve_compiles),
         "recompiles": recompiles,
@@ -597,8 +681,12 @@ def format_report(doc: Dict[str, Any]) -> str:
            if knee is not None else
            "none — every rung saturated (offered loads all exceed capacity)")
     )
+    groups = ", ".join(doc.get("groups") or [SERVE_GROUP])
+    engines = doc.get("engines") or []
+    if engines and engines != ["static"]:
+        lines.append(f"engine: {', '.join(engines)}")
     lines.append(
-        f"{SERVE_GROUP}: {doc['compiles']} compile(s), "
+        f"{groups or SERVE_GROUP}: {doc['compiles']} compile(s), "
         f"recompiles after warmup: {doc['recompiles']}"
         + ("" if doc["recompiles"] == 0 else
            "  ! signature instability — pad-to-signature is broken, every "
@@ -610,7 +698,8 @@ def format_report(doc: Dict[str, Any]) -> str:
                  f"exec {roof.get('exec_s', 0.0):.3f}s"]
         if roof.get("intensity") is not None:
             parts.append(f"intensity {roof['intensity']:.2f} FLOP/B")
-        lines.append(f"{SERVE_GROUP} roofline: " + ", ".join(parts))
+        lines.append(f"{roof.get('group', SERVE_GROUP)} roofline: "
+                     + ", ".join(parts))
     if doc.get("invalid_records"):
         lines.append(f"! {doc['invalid_records']} record(s) failed schema "
                      "validation")

@@ -148,6 +148,41 @@ def _gru(h_prev, din, wg, wc, act_in, act_gate, D):
     return u * h_prev + (1.0 - u) * c, u, r, c
 
 
+def attention_gru_step(h_prev, ep, ev, em, xw_t, wa, ba, v, wctx, wg,
+                       acts=("tanh", "sigmoid")):
+    """ONE decoder step of the fused attention-GRU math, as a plain jnp
+    function — the per-step seam for iteration-level (continuous-
+    batching) decode, where the time loop lives on the HOST scheduler
+    instead of inside a kernel grid or a ``lax.while_loop``.
+
+    Exactly the `_fwd_kernel` step body (attention transform → masked
+    softmax → sum-pooled context → mixed projection → GRU), so a future
+    TPU-fused ``serve_decode`` kernel and this reference cannot diverge;
+    pinned against `fused_attention_gru` in tests/test_engine.py.
+
+    Shapes: ``h_prev [B, D]``, ``ep [Te, B, D]`` (encoder projection),
+    ``ev [Te, B, E]`` (encoder values), ``em [Te, B, 1]`` (encoder
+    mask), ``xw_t [B, 3D]`` (the step's hoisted word-side projection,
+    biases folded), weights as in :func:`fused_attention_gru`. Returns
+    ``h_new [B, D]`` in f32."""
+    f32 = jnp.float32
+    act_in, act_gate = acts
+    D = h_prev.shape[-1]
+    m = jax.lax.dot(
+        h_prev.astype(wa.dtype), wa, preferred_element_type=f32
+    ) + ba.astype(f32)                                   # [B, D]
+    _, alpha = _attention(ep, em, v.reshape(1, D), m, ep.shape[0])
+    ctx = jnp.sum(alpha[:, :, None] * ev.astype(f32), axis=0)     # [B, E]
+    din = jax.lax.dot(
+        ctx.astype(wctx.dtype), wctx, preferred_element_type=f32
+    ) + xw_t.astype(f32)                                 # [B, 3D]
+    h_new, _, _, _ = _gru(
+        h_prev.astype(f32), din, wg[:, : 2 * D], wg[:, 2 * D:],
+        act_in, act_gate, D,
+    )
+    return h_new
+
+
 def _fwd_kernel(ep_ref, ev_ref, em_ref, xw_ref, dm_ref, h0_ref,
                 wa_ref, ba_ref, v_ref, wctx_ref, wg_ref,
                 y_ref, hprev_ref, acts_ref, alpha_ref, ctx_ref,

@@ -1,0 +1,37 @@
+"""paddle_tpu.serving — the continuous-batching generation engine.
+
+The first subsystem that *serves* rather than trains (ROADMAP item 1):
+an :class:`~paddle_tpu.serving.engine.Engine` holds fixed-shape donated
+device state for ``--serve_slots`` concurrent sequences and runs one
+jitted ``serve_decode`` launch over all slots per iteration; a
+scheduler loop evicts finished slots and admits queued requests at
+every iteration boundary, so a long sequence never holds short ones
+hostage (Orca-style iteration-level scheduling — see doc/serving.md).
+
+Layering (mirrors the analysis/resilience discipline):
+
+- ``engine.py`` — the jax-free core: thread-safe front-end queue on the
+  ``utils/concurrency`` seam, slot scheduler, request lifecycle
+  telemetry (PR-8 contract: ``kind=request``/``kind=serve_window``).
+- ``backend.py`` — the decode-seam protocol + a deterministic
+  :class:`FakeBackend` (tests and ``tests/race_specs/``).
+- ``jax_backend.py`` — the real thing: donated slot state, jitted
+  ``serve_prefill``/``serve_decode`` launch groups through the PR-7
+  CompileRegistry (one signature each — zero recompiles after warmup).
+- ``frontend.py`` — ``paddle serve``: stdin-JSONL with SIGTERM
+  graceful drain, and the in-process Python API.
+"""
+
+from paddle_tpu.serving.backend import FakeBackend, StepOut
+from paddle_tpu.serving.engine import (
+    Engine,
+    EngineRequest,
+    ResultFuture,
+    ServeResult,
+    drive_rung,
+)
+
+__all__ = [
+    "Engine", "EngineRequest", "ResultFuture", "ServeResult",
+    "FakeBackend", "StepOut", "drive_rung",
+]

@@ -1,0 +1,119 @@
+"""Decode-backend seam for the continuous-batching engine.
+
+The engine (engine.py) is jax-free and schedules *slots*; everything
+device-shaped hides behind this protocol:
+
+- ``slots`` / ``max_length`` — capacity and the decode-step bound.
+- ``admit(slot_ids, requests, budgets)`` — prefill: write the named
+  requests' decode state into the named slots (overwriting whatever a
+  previous occupant left there — eviction needs no separate call).
+- ``step() -> StepOut`` — ONE iteration: advance every slot by the
+  backend's decode block (``u`` micro-steps per launch, default 1) and
+  return the emitted tokens plus per-slot done flags.
+- ``warmup()`` — pay compiles before serving (so compile telemetry
+  shows recompiles=0 afterwards); ``reset()`` — discard all device
+  state after a failed launch (the engine errors the in-flight cohort
+  and keeps serving).
+
+:class:`FakeBackend` is the deterministic jax-free implementation the
+unit tests and ``tests/race_specs/spec_serve_engine.py`` drive the REAL
+engine with; :class:`~paddle_tpu.serving.jax_backend.JaxDecodeBackend`
+is the production one.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, List, Optional, Sequence
+
+import numpy as np
+
+from paddle_tpu.utils import concurrency as cc
+
+
+@dataclasses.dataclass
+class StepOut:
+    """One iteration's device readback.
+
+    ``tokens [u, B]`` int — the block's emitted tokens per slot;
+    ``live [u, B]`` bool — whether the slot was still generating at that
+    micro-step (False rows are frozen padding, not output);
+    ``finished [B]`` bool — slot hit EOS or its token budget and is free
+    for re-admission."""
+
+    tokens: np.ndarray
+    live: np.ndarray
+    finished: np.ndarray
+
+
+class FakeBackend:
+    """Deterministic, jax-free decode backend.
+
+    ``token_fn(rid, step_index)`` scripts the "model": it returns the
+    token the request emits at its ``step_index``-th decode step
+    (default: a stable hash — never EOS, so budgets do the finishing).
+    ``chunk`` mirrors the jax backend's decode block. ``step_delay_s``
+    burns (virtual, under the race shim) clock per launch.
+    ``fail_at_launch`` makes the N-th ``step()`` call raise — the chaos
+    seam for the engine's error path."""
+
+    def __init__(self, slots: int = 4, max_length: int = 8, eos: int = 1,
+                 token_fn: Optional[Callable[[str, int], int]] = None,
+                 chunk: int = 1, step_delay_s: float = 0.0,
+                 fail_at_launch: Optional[int] = None):
+        self.slots = int(slots)
+        self.max_length = int(max_length)
+        self.eos = int(eos)
+        self.chunk = max(int(chunk), 1)
+        self.step_delay_s = float(step_delay_s)
+        self.fail_at_launch = fail_at_launch
+        self.token_fn = token_fn or (
+            lambda rid, i: 2 + (hash((rid, i)) % 97)
+        )
+        self.launches = 0
+        self.admits: List[List[str]] = []   # admission waves, for tests
+        self._rows: List[Optional[dict]] = [None] * self.slots
+
+    # ------------------------------------------------------------ seam
+
+    def warmup(self) -> None:
+        pass
+
+    def reset(self) -> None:
+        self._rows = [None] * self.slots
+
+    def admit(self, slot_ids: Sequence[int], requests: Sequence[Any],
+              budgets: Sequence[int]) -> None:
+        self.admits.append([r.rid for r in requests])
+        for b, req, budget in zip(slot_ids, requests, budgets):
+            self._rows[b] = {
+                "rid": req.rid,
+                "budget": min(int(budget), self.max_length),
+                "emitted": 0,
+                "done": int(budget) <= 0,
+            }
+
+    def step(self) -> StepOut:
+        self.launches += 1
+        if self.fail_at_launch is not None and self.launches == self.fail_at_launch:
+            raise RuntimeError(f"injected decode fault at launch {self.launches}")
+        if self.step_delay_s:
+            cc.sleep(self.step_delay_s)
+        u, B = self.chunk, self.slots
+        tokens = np.zeros((u, B), np.int64)
+        live = np.zeros((u, B), bool)
+        finished = np.zeros((B,), bool)
+        for b, row in enumerate(self._rows):
+            if row is None:
+                continue
+            for i in range(u):
+                if row["done"]:
+                    break
+                tok = int(self.token_fn(row["rid"], row["emitted"]))
+                tokens[i, b] = tok
+                live[i, b] = True
+                row["emitted"] += 1
+                if tok == self.eos or row["emitted"] >= row["budget"]:
+                    row["done"] = True
+            finished[b] = row["done"]
+        return StepOut(tokens=tokens, live=live, finished=finished)

@@ -1,0 +1,467 @@
+"""The continuous-batching scheduler — slot admission/eviction at every
+iteration boundary.
+
+Run-to-completion batching (the PR-8 static driver, and every
+``SequenceGenerator.generate`` call) holds a whole cohort until its
+LONGEST sequence finishes: with mixed output lengths most slots spend
+most steps finished-but-occupied. Orca (OSDI '22) showed iteration-level
+scheduling — re-batching between decode steps — recovers that capacity.
+This engine is that loop:
+
+    while serving:
+        evict   — finished (EOS / budget / max_length), cancelled, or
+                  wall-deadline-expired slots free at the boundary
+        admit   — queued requests (strict FIFO) prefill into free slots
+        step    — ONE jitted launch advances every slot
+
+Everything here is jax-free and thread-safe strictly through the
+``utils/concurrency`` seam (``cc``): the scheduler runs on one
+``cc.Thread``; ``submit``/``cancel``/``drain`` are the only cross-
+thread entry points and every shared field is guarded by ``self._lock``
+— the ``paddle race`` spec (tests/race_specs/spec_serve_engine.py)
+explores exactly these interleavings. Device work hides behind the
+backend seam (backend.py): ``FakeBackend`` for tests,
+``JaxDecodeBackend`` for TPUs.
+
+Telemetry is the PR-8 contract unchanged — per-request ``kind=request``
+records (now with REAL wall-clock TTFT: the first token's readback
+timestamp, mid-sequence) and ``kind=serve_window`` rollups with
+``engine="continuous"`` — so ``paddle serve-report`` renders an engine
+run with zero new code.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import math
+import os
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from paddle_tpu.observability import serving as slog
+from paddle_tpu.utils import concurrency as cc
+from paddle_tpu.utils.logging import logger
+
+ENGINE_NAME = "continuous"
+
+# terminal request outcomes (race-spec invariant: every submitted
+# request's future resolves exactly once with one of these)
+OUTCOMES = ("ok", "rejected", "timeout", "cancelled", "error")
+
+
+@dataclasses.dataclass
+class ServeResult:
+    """What a resolved :class:`ResultFuture` carries."""
+
+    rid: str
+    outcome: str
+    tokens: List[int]
+    error: Optional[str] = None
+
+
+class ResultFuture:
+    """A one-shot, condition-backed result future (``cc`` seam)."""
+
+    def __init__(self) -> None:
+        self._cv = cc.Condition()
+        self._done = False
+        self._result: Optional[ServeResult] = None
+
+    def done(self) -> bool:
+        return self._done
+
+    def _resolve(self, result: ServeResult) -> bool:
+        """Exactly-once; a second resolution is dropped and reported
+        False (the race spec asserts it never happens)."""
+        with self._cv:
+            if self._done:
+                return False
+            self._result = result
+            self._done = True
+            self._cv.notify_all()
+            return True
+
+    def result(self, timeout: Optional[float] = None) -> ServeResult:
+        deadline = None if timeout is None else cc.monotonic() + float(timeout)
+        with self._cv:
+            while not self._done:
+                if deadline is None:
+                    self._cv.wait(timeout=60.0)
+                    continue
+                remaining = deadline - cc.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError("request result not ready")
+                self._cv.wait(timeout=remaining)
+        return self._result
+
+
+@dataclasses.dataclass
+class EngineRequest(slog.Request):
+    """A :class:`~paddle_tpu.observability.serving.Request` plus the
+    engine-side lifecycle: future, wall deadline, accumulated tokens,
+    the slot it occupies, cancellation and exactly-once bookkeeping."""
+
+    future: Optional[ResultFuture] = None
+    deadline: float = math.inf
+    cancelled: bool = False
+    queued: bool = False      # passed admission control (arrival counted)
+    done: bool = False
+    slot: int = -1
+    budget: int = 0
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    error: Optional[str] = None
+
+
+class Engine:
+    """Slot-based continuous-batching decode engine.
+
+    ``backend`` supplies capacity (``backend.slots``) and the device
+    work; ``queue_cap`` rejects submits past the bound (0 = unbounded);
+    ``request_timeout_s`` is the default wall-clock deadline from submit
+    — expiry frees the queue entry OR the decode slot at the next
+    iteration boundary with ``outcome=timeout``. ``clock`` is
+    injectable for tests (defaults to the ``cc`` seam's monotonic, so
+    ``paddle race`` virtualizes it automatically)."""
+
+    def __init__(self, backend, queue_cap: int = 0,
+                 request_timeout_s: float = 60.0,
+                 clock: Optional[Callable[[], float]] = None,
+                 idle_poll_s: float = 0.02):
+        self._backend = backend
+        self.queue_cap = int(queue_cap)
+        self.request_timeout_s = float(request_timeout_s)
+        self.idle_poll_s = float(idle_poll_s)
+        self._clock = clock or cc.monotonic
+        self._lock = cc.Lock()
+        self._wake = cc.Condition(self._lock)
+        self._queue: collections.deque = collections.deque()
+        self._slots: List[Optional[EngineRequest]] = [None] * backend.slots
+        # requests between queue-pop and slot placement (the prefill
+        # launch runs outside the lock): cancel() must still find them
+        self._admitting: List[EngineRequest] = []
+        self._log = slog.RequestLog(engine=ENGINE_NAME)
+        self._t0 = self._clock()
+        self._thread = None
+        self._started = False
+        self._draining = False
+        self._n_submitted = 0
+        self._pid = os.getpid()
+
+    # ----------------------------------------------------------- client
+
+    @property
+    def slots(self) -> int:
+        return self._backend.slots
+
+    @property
+    def max_length(self) -> int:
+        return self._backend.max_length
+
+    def start(self) -> "Engine":
+        """Warm the backend (all compiles land BEFORE serving — the
+        recompiles=0 acceptance) and spawn the scheduler thread."""
+        with self._lock:
+            if self._started:
+                return self
+            self._started = True
+        self._backend.warmup()
+        th = cc.Thread(target=self._loop, name="serve-engine", daemon=True)
+        with self._lock:
+            self._thread = th
+        th.start()
+        return self
+
+    def submit(self, prompt: Sequence[int],
+               max_new_tokens: Optional[int] = None,
+               rid: Optional[str] = None,
+               timeout_s: Optional[float] = None) -> ResultFuture:
+        """Enqueue one request; returns its future. Rejected immediately
+        (``outcome=rejected``) when draining, stopped, or past
+        ``queue_cap`` — a rejection is an answer, never an exception."""
+        fut = ResultFuture()
+        with self._lock:
+            now = self._now()
+            if rid is None:
+                rid = f"c{self._pid}-{self._n_submitted}"
+            self._n_submitted += 1
+            limit = timeout_s if timeout_s is not None else self.request_timeout_s
+            req = EngineRequest(
+                rid=rid, t_enqueue=now, prompt=list(prompt),
+                prompt_tokens=len(prompt), max_new=max_new_tokens,
+                future=fut, deadline=now + float(limit),
+            )
+            if self._draining or not self._started or self._thread is None:
+                self._finish_locked(req, "rejected", now)
+            elif self.queue_cap and len(self._queue) >= self.queue_cap:
+                self._finish_locked(req, "rejected", now)
+            elif max_new_tokens is not None and int(max_new_tokens) <= 0:
+                # 0 is a LEGAL budget, not an unset sentinel: the answer
+                # is the empty generation, no slot needed
+                req.queued = True
+                req.t_admit = now
+                self._log.enqueued(req)
+                self._log.admit(req)
+                self._finish_locked(req, "ok", now)
+            else:
+                req.queued = True
+                self._queue.append(req)
+                self._log.enqueued(req)
+                self._wake.notify_all()
+        return fut
+
+    def cancel(self, rid: str) -> bool:
+        """Request cancellation; applied at the next iteration boundary
+        (frees the queue entry or the slot, ``outcome=cancelled``).
+        False when the id is unknown or already finished."""
+        with self._lock:
+            for req in self._queue:
+                if req.rid == rid and not req.done:
+                    req.cancelled = True
+                    self._wake.notify_all()
+                    return True
+            for req in self._slots:
+                if req is not None and req.rid == rid and not req.done:
+                    req.cancelled = True
+                    return True
+            for req in self._admitting:
+                if req.rid == rid and not req.done:
+                    req.cancelled = True
+                    return True
+        return False
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Graceful shutdown: finish in-flight slots, reject the queue
+        and every later submit, stop the loop. True when the scheduler
+        thread exited within ``timeout``."""
+        with self._lock:
+            self._draining = True
+            self._wake.notify_all()
+            th = self._thread
+        if th is None:
+            return True
+        th.join(timeout if timeout is not None else 600.0)
+        return not th.is_alive()
+
+    close = drain
+
+    # -------------------------------------------------------- telemetry
+
+    def begin_window(self) -> None:
+        """Re-anchor the telemetry window (rung start). Caller must be
+        quiescent — in-flight requests would straddle the anchor."""
+        with self._lock:
+            self._log = slog.RequestLog(engine=ENGINE_NAME)
+            self._t0 = self._clock()
+
+    def window_roll(self, offered_rps: float = 0.0, rung: int = 0,
+                    window_s: Optional[float] = None) -> Dict[str, Any]:
+        """Emit the current window's ``kind=serve_window`` rollup and
+        start a fresh one; returns the record (sans envelope)."""
+        with self._lock:
+            now = self._now()
+            log = self._log
+            log.rung = int(rung)
+            log.offered_rps = float(offered_rps)
+            wall = max(now, 1e-9)
+            host_share = max(1.0 - log.exec_s / wall, 0.0)
+            rec = log.window_record(
+                max(window_s if window_s is not None else now, 1e-9),
+                host_share=host_share,
+            )
+            self._log = slog.RequestLog(engine=ENGINE_NAME)
+            self._t0 = self._clock()
+            return rec
+
+    # -------------------------------------------------------- scheduler
+
+    def _now(self) -> float:
+        return self._clock() - self._t0
+
+    def _finish_locked(self, req: EngineRequest, outcome: str,
+                       now: float, error: Optional[str] = None) -> None:
+        """Resolve one request exactly once: telemetry record + future."""
+        if req.done:
+            return
+        req.done = True
+        req.error = error
+        if outcome == "ok":
+            req.t_finish = now
+            req.gen_tokens = len(req.tokens)
+            self._log.complete(req)
+        elif outcome == "rejected":
+            # a drain-path rejection already counted its arrival at
+            # enqueue; a submit-time one never arrived in the window
+            self._log.reject(req, arrived=req.queued)
+        elif outcome == "timeout":
+            self._log.timeout(req, now)
+        elif outcome == "cancelled":
+            self._log.cancel(req, now)
+        else:
+            self._log.error(req, error=error or "decode failed")
+        req.future._resolve(ServeResult(
+            rid=req.rid, outcome=req.outcome,
+            tokens=list(req.tokens), error=error,
+        ))
+
+    def _sweep_locked(self, now: float) -> None:
+        """Iteration boundary policy: cancellations and wall deadlines,
+        queue entries first (FIFO — the oldest expire first), then
+        in-flight slots (the device row keeps decoding to its bounded
+        budget and is simply overwritten by the next admission)."""
+        for _ in range(len(self._queue)):
+            req = self._queue.popleft()
+            if req.cancelled:
+                self._finish_locked(req, "cancelled", now)
+            elif now > req.deadline:
+                self._finish_locked(req, "timeout", now)
+            else:
+                self._queue.append(req)  # full rotation keeps FIFO order
+        for b, req in enumerate(self._slots):
+            if req is None:
+                continue
+            if req.cancelled:
+                self._slots[b] = None
+                self._finish_locked(req, "cancelled", now)
+            elif now > req.deadline:
+                self._slots[b] = None
+                self._finish_locked(req, "timeout", now)
+
+    def _fail_inflight_locked(self, now: float, error: str) -> None:
+        for b, req in enumerate(self._slots):
+            if req is not None:
+                self._slots[b] = None
+                self._finish_locked(req, "error", now, error=error)
+
+    def _loop(self) -> None:
+        backend = self._backend
+        while True:
+            # --- boundary: evict, reject-on-drain, pick admissions
+            admit_slots: List[int] = []
+            admit_reqs: List[EngineRequest] = []
+            with self._lock:
+                now = self._now()
+                self._sweep_locked(now)
+                if self._draining:
+                    while self._queue:
+                        self._finish_locked(self._queue.popleft(),
+                                            "rejected", now)
+                free = [b for b, r in enumerate(self._slots) if r is None]
+                take = min(len(free), len(self._queue))
+                for j in range(take):
+                    admit_slots.append(free[j])
+                    admit_reqs.append(self._queue.popleft())
+                self._admitting = admit_reqs
+            # --- admit (prefill launch outside the lock: submit() must
+            # never block behind device work)
+            if admit_reqs:
+                budgets = [
+                    max(1, min(backend.max_length if r.max_new is None
+                               else r.max_new, backend.max_length))
+                    for r in admit_reqs
+                ]
+                t0 = self._clock()
+                try:
+                    backend.admit(admit_slots, admit_reqs, budgets)
+                except Exception as e:  # noqa: BLE001 — cohort gets the evidence
+                    err = f"{type(e).__name__}: {e}"
+                    logger.error("serve admit failed: %s", err)
+                    with self._lock:
+                        now = self._now()
+                        for req in admit_reqs:
+                            self._finish_locked(req, "error", now, error=err)
+                        self._admitting = []
+                        self._fail_inflight_locked(now, err)
+                    self._safe_reset()
+                    continue
+                dt = self._clock() - t0
+                with self._lock:
+                    now = self._now()
+                    for b, req, budget in zip(admit_slots, admit_reqs, budgets):
+                        req.slot = b
+                        req.budget = budget
+                        req.t_admit = now
+                        self._slots[b] = req
+                        self._log.admit(req)
+                    self._admitting = []
+                    self._log.note_exec(dt)
+            # --- step or idle
+            with self._lock:
+                occupancy = sum(1 for r in self._slots if r is not None)
+                if occupancy == 0:
+                    if self._draining and not self._queue:
+                        break
+                    if not self._queue:
+                        self._wake.wait(timeout=self.idle_poll_s)
+                    continue
+            t0 = self._clock()
+            try:
+                out = backend.step()
+            except Exception as e:  # noqa: BLE001 — engine survives a bad launch
+                err = f"{type(e).__name__}: {e}"
+                logger.error("serve decode launch failed: %s", err)
+                with self._lock:
+                    self._fail_inflight_locked(self._now(), err)
+                self._safe_reset()
+                continue
+            dt = self._clock() - t0
+            with self._lock:
+                self._apply_step_locked(out, dt, occupancy)
+
+    def _safe_reset(self) -> None:
+        try:
+            self._backend.reset()
+        except Exception as e:  # noqa: BLE001
+            logger.error("serve backend reset failed: %s", e)
+
+    def _apply_step_locked(self, out, service_s: float,
+                           occupancy: int) -> None:
+        """Fold one launch's readback into the request lifecycles."""
+        now = self._now()
+        tokens, live, finished = out.tokens, out.live, out.finished
+        u = tokens.shape[0]
+        for b, req in enumerate(self._slots):
+            if req is None:
+                continue
+            emitted = [int(tokens[i, b]) for i in range(u) if bool(live[i, b])]
+            if emitted:
+                if req.t_first_token < 0:
+                    # REAL wall-clock TTFT: this readback is the moment
+                    # the first token left the device — mid-sequence,
+                    # not at finish
+                    req.t_first_token = now
+                req.tokens.extend(emitted)
+            if bool(finished[b]):
+                self._slots[b] = None
+                self._finish_locked(req, "ok", now)
+        self._log.launch(len(self._queue), occupancy, service_s)
+
+
+# ------------------------------------------------------------- driver
+
+
+def drive_rung(engine: Engine, requests: Sequence[slog.Request], *,
+               rate_rps: float, rung: int = 0,
+               result_timeout_s: float = 300.0) -> Dict[str, Any]:
+    """Open-loop wall-clock driver for one offered-load rung against a
+    live engine — the continuous counterpart of the PR-8 virtual-clock
+    ``run_rung``, fed the SAME :func:`~paddle_tpu.observability.serving.
+    schedule_requests` workload. Submits each request at its scheduled
+    arrival offset (sleeping the gaps; a late submit stays late — open
+    loop never hides coordinated omission), waits for every future, and
+    rolls the window."""
+    engine.begin_window()
+    t0 = cc.monotonic()
+    futures = []
+    for req in requests:
+        delay = req.t_enqueue - (cc.monotonic() - t0)
+        if delay > 0:
+            cc.sleep(delay)
+        futures.append(engine.submit(
+            req.prompt or [], max_new_tokens=req.max_new, rid=req.rid,
+        ))
+    for fut in futures:
+        fut.result(timeout=result_timeout_s)
+    elapsed = cc.monotonic() - t0
+    window_s = max(elapsed, requests[-1].t_enqueue if requests else 0.0)
+    return engine.window_roll(offered_rps=rate_rps, rung=rung,
+                              window_s=window_s)

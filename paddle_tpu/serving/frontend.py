@@ -1,0 +1,195 @@
+"""``paddle serve`` — the engine's process front-end.
+
+stdin-JSONL in, JSONL out: each input line is a request
+(``{"id": ..., "prompt": [token ids...], "max_new_tokens": N}`` — or a
+bare JSON list as the prompt), each output line its result
+(``{"id", "outcome", "tokens"}``) in SUBMISSION order. SIGTERM (and
+SIGINT) trigger a graceful drain: in-flight sequences finish, queued
+and later requests are rejected, every pending result line is still
+printed, and when telemetry is on (``--metrics_path``/``--save_dir``)
+the stream closes with ``run_end status=completed`` as its LAST record.
+
+The in-process Python API is :func:`build_engine` + the returned
+:class:`~paddle_tpu.serving.engine.Engine`'s ``submit``/``result``
+(also reachable as ``api.GradientMachine.asDecodeEngine``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+from paddle_tpu.utils import concurrency as cc
+
+
+def build_engine(machine, params, *, slots: int = 8,
+                 prompt_tokens: int = 32, queue_cap: int = 0,
+                 request_timeout_s: float = 60.0, decode_block: int = 1,
+                 max_length: Optional[int] = None, registry=None):
+    """Wire a :class:`JaxDecodeBackend` + :class:`Engine` for a core
+    graph machine (the in-process serving API). Caller starts it."""
+    from paddle_tpu.serving.engine import Engine
+    from paddle_tpu.serving.jax_backend import JaxDecodeBackend
+
+    backend = JaxDecodeBackend(
+        machine, params, slots=slots, prompt_tokens=prompt_tokens,
+        max_length=max_length, decode_block=decode_block, registry=registry,
+    )
+    return Engine(backend, queue_cap=queue_cap,
+                  request_timeout_s=request_timeout_s)
+
+
+def _parse_line(line: str, n: int) -> Tuple[Optional[Dict[str, Any]], str]:
+    """One stdin line → (request dict, "") or (None, error)."""
+    try:
+        doc = json.loads(line)
+    except ValueError as e:
+        return None, f"bad JSON: {e}"
+    if isinstance(doc, list):
+        doc = {"prompt": doc}
+    if not isinstance(doc, dict):
+        return None, "expected a JSON object or token list"
+    prompt = doc.get("prompt")
+    if not isinstance(prompt, list) or not all(
+        isinstance(t, int) for t in prompt
+    ):
+        return None, "prompt must be a list of token ids"
+    doc.setdefault("id", f"req-{n}")
+    return doc, ""
+
+
+def main(rest: List[str]) -> int:
+    from paddle_tpu.utils.flags import FLAGS
+
+    leftover = FLAGS.parse(list(rest))
+    if leftover:
+        print(f"warning: unrecognized flags {leftover}", file=sys.stderr)
+    if not FLAGS.use_tpu:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if not FLAGS.config:
+        print("error: --config is required", file=sys.stderr)
+        return 2
+    from paddle_tpu.config import parse_config
+    from paddle_tpu.observability import metrics as obsm
+
+    config = parse_config(FLAGS.config, FLAGS.config_args)
+    obsm.configure_from_flags(FLAGS)
+
+    import jax
+
+    from paddle_tpu import api
+    from paddle_tpu.observability.compile_log import CompileRegistry
+    from paddle_tpu.serving.jax_backend import UnsupportedModelError
+
+    am = api.GradientMachine(config.model_config, seed=FLAGS.seed)
+    if FLAGS.init_model_path:
+        am.loadParameters(FLAGS.init_model_path)
+    else:
+        print("# serving randomly initialized parameters "
+              "(no --init_model_path)", file=sys.stderr)
+    registry = CompileRegistry(device_kind=jax.devices()[0].device_kind)
+    try:
+        engine = build_engine(
+            am._core, am.params,
+            slots=FLAGS.serve_slots,
+            prompt_tokens=FLAGS.serve_prompt_tokens,
+            queue_cap=FLAGS.serve_queue_cap,
+            request_timeout_s=FLAGS.serve_request_timeout,
+            decode_block=FLAGS.serve_decode_block,
+            registry=registry,
+        )
+    except UnsupportedModelError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    engine.start()
+    print(f"# paddle serve: {engine.slots} slot(s), max_length "
+          f"{engine.max_length}, decode block {FLAGS.serve_decode_block} — "
+          "reading JSONL requests from stdin", file=sys.stderr)
+
+    drain = cc.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: drain.set())
+
+    pending: List[Tuple[str, Any]] = []   # (id, future), submission order
+    plock = cc.Lock()
+    eof = cc.Event()
+    n_lines = [0]   # reader progress — the drain path waits for it to
+    # go quiet before the final flush (lines the client already piped
+    # may still sit in the reader's buffer when SIGTERM lands; their
+    # results — completed or rejected — must still be printed)
+
+    def _reader() -> None:
+        n = 0
+        for line in sys.stdin:
+            line = line.strip()
+            if line:
+                doc, err = _parse_line(line, n)
+                n += 1
+                if doc is None:
+                    print(json.dumps({"id": f"req-{n - 1}",
+                                      "outcome": "error", "tokens": [],
+                                      "error": err}), flush=True)
+                else:
+                    fut = engine.submit(
+                        doc["prompt"],
+                        max_new_tokens=doc.get("max_new_tokens"),
+                        rid=str(doc["id"]))
+                    with plock:
+                        pending.append((str(doc["id"]), fut))
+            with plock:
+                n_lines[0] += 1
+            if drain.is_set():
+                break
+        eof.set()
+
+    reader = cc.Thread(target=_reader, name="serve-stdin", daemon=True)
+    reader.start()
+
+    def _flush_pending(block: bool) -> None:
+        while True:
+            with plock:
+                if not pending:
+                    return
+                rid, fut = pending[0]
+                if not block and not fut.done():
+                    return
+                pending.pop(0)
+            res = fut.result(timeout=600.0)
+            out = {"id": rid, "outcome": res.outcome, "tokens": res.tokens}
+            if res.error:
+                out["error"] = res.error
+            print(json.dumps(out), flush=True)
+
+    while not (eof.is_set() or drain.is_set()):
+        _flush_pending(block=False)
+        eof.wait(timeout=0.05)
+    # graceful drain: finish in-flight, reject queued + new, then print
+    # every remaining result (rejections included — the client hears).
+    # First give the reader a bounded window to submit lines the client
+    # already piped: the whole serve cycle can fit inside one GIL switch
+    # interval, so at SIGTERM the reader may not have run yet even
+    # though its input buffer is full (post-drain submits come back
+    # outcome=rejected, which is exactly the answer those lines get).
+    deadline = cc.monotonic() + 3.0
+    quiet_at = cc.monotonic()
+    with plock:
+        seen = n_lines[0]
+    while cc.monotonic() < deadline and cc.monotonic() - quiet_at < 0.25:
+        eof.wait(timeout=0.05)
+        with plock:
+            if n_lines[0] != seen:
+                seen = n_lines[0]
+                quiet_at = cc.monotonic()
+        if eof.is_set():
+            break
+    engine.drain(timeout=600.0)
+    _flush_pending(block=True)
+    if obsm.enabled():
+        engine.window_roll()
+        obsm.emit("run_end", status="completed")
+        obsm.flush()
+    print("# paddle serve: drained", file=sys.stderr)
+    return 0

@@ -1,0 +1,251 @@
+"""The production decode backend: donated slot state on device, one
+jitted launch per engine iteration.
+
+Device state is a single pytree of fixed-shape ``[B, ...]`` buffers for
+``B = --serve_slots`` concurrent sequences — the captured static-link
+conditioning (seqToseq: encoder projection/values per slot), the decoder
+memory carries (the GRU hidden the fused attention-GRU path steps), the
+previous token, per-slot step counts, done flags and token budgets.
+Both launch fns take the state with ``donate_argnums``, so every
+iteration updates it in place (no per-step HBM churn), and both are
+routed through the PR-7 :class:`CompileRegistry`:
+
+- launch group ``serve_prefill`` — ONE ``[B, T]`` signature: the full
+  graph forward in gen-capture mode (graph/decode_step.py) over a
+  padded admission batch, scattered into the named slots (sentinel
+  indices drop, so partial admissions reuse the same signature).
+- launch group ``serve_decode`` — ONE ``[B, ...]`` signature: a
+  ``decode_block``-step ``fori_loop`` of the greedy per-step decoder,
+  with EOS / budget termination folded into the device ``finished``
+  flags. Zero recompiles after warmup is acceptance-checked like PR 8's
+  ``serve_gen``.
+
+Evicted-but-unreplaced slots need no device call: a finished (or
+abandoned) row's flag freezes it, an abandoned live row self-terminates
+at its bounded budget, and the next admission overwrites the slot
+wholesale.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+import numpy as np
+
+from paddle_tpu.serving.backend import StepOut
+from paddle_tpu.utils import concurrency as cc
+
+
+class UnsupportedModelError(RuntimeError):
+    """The generation graph cannot be slot-decoded (see plan_of gates);
+    the static path (`SequenceGenerator`, PR-8 driver) still works."""
+
+
+class JaxDecodeBackend:
+    GROUP_DECODE = "serve_decode"
+    GROUP_PREFILL = "serve_prefill"
+
+    def __init__(self, machine, params, slots: int, prompt_tokens: int,
+                 max_length: Optional[int] = None, decode_block: int = 1,
+                 registry=None, feed_name: Optional[str] = None):
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.graph.decode_step import (
+            capture_prefill, make_greedy_step, plan_of,
+        )
+
+        self._jax, self._jnp = jax, jnp
+        plan, reason = plan_of(machine)
+        if plan is None:
+            raise UnsupportedModelError(reason)
+        self._plan = plan
+        self._machine = machine
+        self.params = params
+        self.slots = int(slots)
+        self.prompt_tokens = int(prompt_tokens)
+        self.max_length = min(int(max_length or plan.max_length),
+                              plan.max_length)
+        self.decode_block = max(int(decode_block), 1)
+        self._registry = registry
+        # exec attribution gate: warmup flips it on; callers measuring
+        # calibration passes may toggle it off so those launches stay
+        # out of the serve roofline (the static leg's serving_now rule)
+        self.serving = False
+        names = list(machine.network.input_layer_names)
+        if feed_name is None:
+            if len(names) != 1:
+                raise UnsupportedModelError(
+                    f"model has {len(names)} input layers {names} — pass "
+                    "feed_name to choose the prompt sequence input"
+                )
+            feed_name = names[0]
+        self._feed_name = feed_name
+        self._capture = capture_prefill
+        self._step = make_greedy_step(machine, plan)
+        self._prefill_jit = jax.jit(self._prefill_write, donate_argnums=(1,))
+        self._decode_jit = jax.jit(self._decode, donate_argnums=(1,))
+        self._state = self._fresh_state()
+
+    # ------------------------------------------------------- jitted fns
+
+    def _feed(self, ids, lens):
+        from paddle_tpu.graph import make_seq
+
+        return {self._feed_name: make_seq(None, lens, ids=ids)}
+
+    def _prefill_write(self, params, state, ids, lens, slot_idx, budgets):
+        """Admission launch: full-graph capture forward over the padded
+        [B, T] admission batch, scattered into the slot rows named by
+        ``slot_idx`` (sentinel ``B`` rows drop — one signature for every
+        admission size)."""
+        jnp = self._jnp
+        statics, boots = self._capture(
+            self._machine, self._plan, params, self._feed(ids, lens)
+        )
+
+        def scatter(dst, src):
+            return dst.at[slot_idx].set(src.astype(dst.dtype), mode="drop")
+
+        new_statics = {
+            name: {f: scatter(state["statics"][name][f], statics[name][f])
+                   for f in state["statics"][name]}
+            for name in state["statics"]
+        }
+        new_carries = tuple(
+            scatter(old, boot) for old, boot in zip(state["carries"], boots)
+        )
+        return {
+            "statics": new_statics,
+            "carries": new_carries,
+            "prev_tok": state["prev_tok"].at[slot_idx].set(
+                self._plan.bos, mode="drop"),
+            "finished": state["finished"].at[slot_idx].set(False, mode="drop"),
+            "steps": state["steps"].at[slot_idx].set(0, mode="drop"),
+            "budget": state["budget"].at[slot_idx].set(
+                budgets.astype(jnp.int32), mode="drop"),
+        }
+
+    def _decode(self, params, state):
+        """One iteration: ``decode_block`` greedy micro-steps over all
+        slots, EOS/budget termination on device."""
+        jax, jnp = self._jax, self._jnp
+        u, B = self.decode_block, self.slots
+        statics, budget = state["statics"], state["budget"]
+
+        def body(i, acc):
+            carries, prev, fin, steps, toks, lives = acc
+            live = ~fin
+            carries, tok, fin = self._step(params, statics, carries, prev, fin)
+            steps = steps + live.astype(jnp.int32)
+            fin = fin | (steps >= budget)
+            return (carries, tok, fin, steps,
+                    toks.at[i].set(tok), lives.at[i].set(live))
+
+        init = (state["carries"], state["prev_tok"], state["finished"],
+                state["steps"], jnp.zeros((u, B), jnp.int32),
+                jnp.zeros((u, B), bool))
+        carries, prev, fin, steps, toks, lives = jax.lax.fori_loop(
+            0, u, body, init)
+        new_state = dict(state, carries=carries, prev_tok=prev,
+                         finished=fin, steps=steps)
+        return new_state, toks, lives, fin
+
+    # ------------------------------------------------------- fresh state
+
+    def _fresh_state(self):
+        """Zeroed slot buffers, every slot finished (frozen). Shapes come
+        from eval_shape of the capture — no compile, no launch."""
+        jax, jnp = self._jax, self._jnp
+        B, T = self.slots, self.prompt_tokens
+        ids = jnp.zeros((B, T), jnp.int32)
+        lens = jnp.ones((B,), jnp.int32)
+        statics_sd, boots_sd = jax.eval_shape(
+            lambda p, i, l: self._capture(self._machine, self._plan, p,
+                                          self._feed(i, l)),
+            self.params, ids, lens,
+        )
+        zeros = lambda sd: jnp.zeros(sd.shape, sd.dtype)
+        return {
+            "statics": jax.tree_util.tree_map(zeros, statics_sd),
+            "carries": tuple(zeros(sd) for sd in boots_sd),
+            "prev_tok": jnp.full((B,), self._plan.bos, jnp.int32),
+            "finished": jnp.ones((B,), bool),
+            "steps": jnp.zeros((B,), jnp.int32),
+            "budget": jnp.zeros((B,), jnp.int32),
+        }
+
+    # ------------------------------------------------------------- seam
+
+    def warmup(self) -> None:
+        """Pay both compiles before serving: a no-slot prefill (all
+        sentinel indices) and one decode launch over the all-finished
+        state — zero slot effects, so compile records land with
+        ``recompiles=0`` and serving never recompiles."""
+        jnp = self._jnp
+        B, T = self.slots, self.prompt_tokens
+        self._admit_call(
+            np.zeros((B, T), np.int32), np.ones((B,), np.int32),
+            np.full((B,), B, np.int32), np.zeros((B,), np.int32),
+        )
+        self._step_call()
+        self.serving = True
+
+    def reset(self) -> None:
+        self._state = self._fresh_state()
+
+    def admit(self, slot_ids: Sequence[int], requests: Sequence[Any],
+              budgets: Sequence[int]) -> None:
+        B, T = self.slots, self.prompt_tokens
+        ids = np.zeros((B, T), np.int32)
+        lens = np.ones((B,), np.int32)
+        idx = np.full((B,), B, np.int32)      # sentinel: row writes nothing
+        budg = np.zeros((B,), np.int32)
+        for j, (slot, req) in enumerate(zip(slot_ids, requests)):
+            p = np.asarray(list(req.prompt or ()), np.int32)[:T]
+            if p.size:
+                ids[j, : p.size] = p
+            lens[j] = max(int(p.size), 1)
+            idx[j] = int(slot)
+            budg[j] = min(int(budgets[j]), self.max_length)
+        self._admit_call(ids, lens, idx, budg)
+
+    def _admit_call(self, ids, lens, idx, budg) -> None:
+        jnp = self._jnp
+        t0 = cc.perf_counter()
+        args = (self.params, self._state, jnp.asarray(ids),
+                jnp.asarray(lens), jnp.asarray(idx), jnp.asarray(budg))
+        key = (self.slots, self.prompt_tokens)
+        if self._registry is not None:
+            self._state = self._registry.call(
+                self.GROUP_PREFILL, key, self._prefill_jit, *args)
+        else:
+            self._state = self._prefill_jit(*args)
+        self._jax.block_until_ready(self._state["steps"])
+        if self._registry is not None and self.serving:
+            self._registry.note_exec(self.GROUP_PREFILL, key,
+                                     cc.perf_counter() - t0)
+
+    def step(self) -> StepOut:
+        return self._step_call()
+
+    def _step_call(self) -> StepOut:
+        t0 = cc.perf_counter()
+        key = (self.slots, self.prompt_tokens, self.decode_block)
+        if self._registry is not None:
+            out = self._registry.call(
+                self.GROUP_DECODE, key, self._decode_jit,
+                self.params, self._state)
+        else:
+            out = self._decode_jit(self.params, self._state)
+        self._state, toks, lives, fin = out
+        # the one per-iteration device sync: the emitted tokens ARE the
+        # scheduler's input (EOS eviction, TTFT stamping)
+        toks_np = np.asarray(toks)
+        lives_np = np.asarray(lives)
+        fin_np = np.asarray(fin)
+        if self._registry is not None and self.serving:
+            self._registry.note_exec(self.GROUP_DECODE, key,
+                                     cc.perf_counter() - t0,
+                                     batches=self.decode_block)
+        return StepOut(tokens=toks_np, live=lives_np, finished=fin_np)

@@ -147,6 +147,22 @@ class _Flags:
     # print the child command + restart policy without launching
     # (`paddle supervise --dry_run`)
     dry_run: bool = False
+    # serving (`paddle serve`, paddle_tpu/serving/, doc/serving.md):
+    # the continuous-batching engine holds serve_slots concurrent
+    # decode sequences in donated device buffers; serve_queue_cap
+    # rejects submits past the bound (0 = unbounded queue);
+    # serve_request_timeout is each request's wall-clock deadline from
+    # submission — expiry frees the queue entry or the decode slot at
+    # the next iteration boundary (outcome=timeout);
+    # serve_prompt_tokens is the fixed prompt padding width (ONE
+    # prefill signature — longer prompts truncate); serve_decode_block
+    # is the number of decode micro-steps per launch (amortizes
+    # dispatch; admission/eviction happen at block boundaries)
+    serve_slots: int = 8
+    serve_queue_cap: int = 0
+    serve_request_timeout: float = 60.0
+    serve_prompt_tokens: int = 32
+    serve_decode_block: int = 1
     # rng
     seed: int = 1
     # distributed (multi-host jax)

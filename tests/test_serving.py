@@ -144,8 +144,14 @@ def test_request_and_serve_window_schema_registration():
     missing = obs.validate_record(dict(base, kind="request"))
     assert any("id" in p for p in missing) and any("outcome" in p for p in missing)
     assert obs.validate_record(
-        dict(base, kind="serve_window", rung=0, offered_rps=1.0)
+        dict(base, kind="serve_window", rung=0, offered_rps=1.0,
+             engine="static")
     ) == []
+    # the engine stamp became REQUIRED with the continuous engine: two
+    # engines' rungs in one stream must never be mistaken for one ladder
+    missing = obs.validate_record(
+        dict(base, kind="serve_window", rung=0, offered_rps=1.0))
+    assert any("engine" in p for p in missing)
     assert obs.validate_record(dict(base, kind="serve_window", rung=0))
     # a non-int rung is junk the analyzers must be able to SKIP (the
     # sort keys mix rungs across hosts), not crash on
@@ -199,7 +205,8 @@ def _write_serve_fixture(run_dir, *, recompiles=0, host_share=0.1,
     ]):
         snap = lambda v: {"count": 30, "mean": v, "p50": p50, "p99": p99,
                           "max": p99}
-        emit("serve_window", rung=rung, offered_rps=rate, window_s=3.0,
+        emit("serve_window", rung=rung, offered_rps=rate, engine="static",
+             window_s=3.0,
              arrived=30, admitted=30 if rung < 2 else 24,
              completed=30 if rung < 2 else 24,
              rejected=0 if rung < 2 else 4, timeouts=0 if rung < 2 else 2,
@@ -293,7 +300,8 @@ def test_rerun_with_shorter_ladder_leaves_no_ghost_rungs(tmp_path):
     later 1-rung sweep's report/knee/compare."""
     _write_serve_fixture(str(tmp_path))  # 3 rungs
     w = obs.MetricsWriter(str(tmp_path), host=0)  # new epoch: run_start
-    w.emit("serve_window", rung=0, offered_rps=5.0, window_s=1.0,
+    w.emit("serve_window", rung=0, offered_rps=5.0, engine="static",
+           window_s=1.0,
            arrived=4, admitted=4, completed=4, rejected=0, timeouts=0,
            errors=0, launches=2, exec_s=0.1, gen_tokens=40,
            goodput_tok_s=40.0,
