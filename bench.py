@@ -685,10 +685,13 @@ def _serve_sweep_static(gm, params, registry, *, group, rates, B, T,
 
 def _serve_sweep_continuous(gm, params, registry, *, rates, B, T,
                             max_length, n_requests, seed, timeout_s,
-                            queue_cap, decode_block, prompt_fn, budget_fn):
+                            queue_cap, decode_block, prompt_fn, budget_fn,
+                            pipeline=True, fused_step=False):
     """The continuous-batching engine (paddle_tpu/serving/) on the SAME
-    seeded workload, driven open-loop in wall-clock time. Returns
-    (sweep doc, measured capacity req/s)."""
+    seeded workload, driven open-loop in wall-clock time. ``pipeline``
+    selects the overlapped dispatch/collect loop vs the serial PR-12
+    loop (PADDLE_TPU_BENCH_SERVE_PIPELINE — the overlap A/B's subject).
+    Returns (sweep doc, measured capacity req/s)."""
     import numpy as np
 
     from paddle_tpu.observability import serving
@@ -697,7 +700,8 @@ def _serve_sweep_continuous(gm, params, registry, *, rates, B, T,
 
     backend = JaxDecodeBackend(
         gm, params, slots=B, prompt_tokens=T, max_length=max_length,
-        decode_block=decode_block, registry=registry,
+        decode_block=decode_block, registry=registry, pipeline=pipeline,
+        fused_step=fused_step,
     )
     backend.warmup()  # compiles land now; Engine.start()'s call re-runs
     # two cheap no-slot launches (idempotent semantically)
@@ -720,7 +724,7 @@ def _serve_sweep_continuous(gm, params, registry, *, rates, B, T,
         rates = [round(f * capacity_rps, 4) for f in (0.25, 0.5, 1.0, 2.0)]
 
     engine = Engine(backend, queue_cap=queue_cap,
-                    request_timeout_s=timeout_s).start()
+                    request_timeout_s=timeout_s, pipeline=pipeline).start()
     try:
         windows = []
         for i, rate in enumerate(rates):
@@ -739,7 +743,8 @@ def _serve_sweep_continuous(gm, params, registry, *, rates, B, T,
 def bench_serve(B=None, T=None, vocab=None, dim=None, beam_size=None,
                 max_length=None, n_requests=None, rates=None, seed=None,
                 run_dir=None, timeout_s=None, queue_cap=None, dtype=None,
-                engine=None, mixed_len=None, decode_block=None):
+                engine=None, mixed_len=None, decode_block=None,
+                pipeline=None, fused_step=None):
     """Offered-load serving leg (doc/observability.md "Serving
     telemetry"): a deterministic seeded open-loop arrival process at a
     sweep of offered loads drives one of TWO engines over the seqToseq
@@ -799,8 +804,30 @@ def bench_serve(B=None, T=None, vocab=None, dim=None, beam_size=None,
     if mixed_len is None:
         mixed_len = env("PADDLE_TPU_BENCH_SERVE_MIXED_LEN", "0") == "1"
     if decode_block is None:
-        decode_block = (int(env("PADDLE_TPU_BENCH_SERVE_BLOCK", 0))
-                        or (4 if on_cpu else 1))
+        # the decode-block LADDER (an int or "1,2,4,8"): one compiled
+        # serve_decode signature covers every rung, the engine's
+        # adaptive policy picks per iteration (doc/serving.md)
+        decode_block = (env("PADDLE_TPU_BENCH_SERVE_BLOCK", "")
+                        or ("1,2,4,8" if on_cpu else "1,2,4"))
+    if pipeline is None:
+        pip_env = env("PADDLE_TPU_BENCH_SERVE_PIPELINE", "")
+        if pip_env:
+            pipeline = pip_env != "off"
+        else:
+            # overlap needs somewhere to overlap INTO: on a TPU the
+            # device runs beside the host; on a CPU backend "device"
+            # work shares the host's cores, so a 1-core box can only
+            # lose to speculation+context-switching (measured −10..−27%
+            # goodput — doc/performance.md "Pipelined decode"). Count
+            # the cores this process may actually USE — a cgroup/
+            # affinity-limited container on a big host is still 1-core
+            try:
+                cores = len(os.sched_getaffinity(0))
+            except (AttributeError, OSError):
+                cores = os.cpu_count() or 1
+            pipeline = (not on_cpu) or cores > 1
+    if fused_step is None:
+        fused_step = env("PADDLE_TPU_BENCH_SERVE_FUSED", "0") == "1"
     # 0 is a LEGAL deadline (drop everything not admitted immediately)
     # — None, not falsiness, is the unset sentinel
     if timeout_s is None:
@@ -848,7 +875,8 @@ def bench_serve(B=None, T=None, vocab=None, dim=None, beam_size=None,
             max_length=max_length, n_requests=n_requests, seed=seed,
             timeout_s=timeout_s, queue_cap=queue_cap,
             decode_block=decode_block, prompt_fn=prompt_fn,
-            budget_fn=budget_fn,
+            budget_fn=budget_fn, pipeline=bool(pipeline),
+            fused_step=bool(fused_step),
         )
         beam_size = 1  # the engine decodes greedily (doc/serving.md)
     else:
@@ -886,6 +914,12 @@ def bench_serve(B=None, T=None, vocab=None, dim=None, beam_size=None,
             "occupancy_mean": round((w.get("occupancy") or {}).get("mean", 0.0), 3),
             "goodput_tok_s": w.get("goodput_tok_s"),
             "engine": w.get("engine", engine),
+            # pipeline mode rides every rung record (continuous engine
+            # only): `paddle compare` joins on (engine, pipeline,
+            # offered load), so a pipelined-vs-blocking A/B compares
+            # mode-to-mode instead of landing in only_a/only_b
+            **({"pipeline": w["pipeline"]}
+               if isinstance(w.get("pipeline"), str) else {}),
         }
         for w in doc["rungs"]
     ]
@@ -898,6 +932,13 @@ def bench_serve(B=None, T=None, vocab=None, dim=None, beam_size=None,
         tokens=("greedy generated" if engine == "continuous"
                 else "best-beam generated"),
     )
+    if engine == "continuous":
+        # the headline stamps the pipeline mode + ladder so an archived
+        # BENCH_*.json says WHAT was measured (and compare joins on it)
+        extras["pipeline"] = "on" if pipeline else "off"
+        extras["decode_blocks"] = str(decode_block)
+        if fused_step:
+            extras["fused_step"] = True
     # memory trajectory for the serve leg too: the sweep's live HBM
     # peak (absent on stat-less backends) and the serve_gen group's
     # static plan from its one compile

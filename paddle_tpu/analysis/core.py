@@ -235,6 +235,11 @@ DEFAULT_CONFIG: Dict[str, Any] = {
         ("trainer/trainer.py", "train_one_pass"),
         ("observability/serving.py", "run_rung"),
         ("serving/engine.py", "_loop"),
+        # the dispatch/collect split: both engine loop bodies stay
+        # sync-free — the ONE sanctioned readback lives in the
+        # backend's collect(), at the collect boundary by design
+        ("serving/engine.py", "_loop_pipelined"),
+        ("serving/engine.py", "_loop_blocking"),
     ),
     # PTL002: calls whose results live on device (taint sources)
     "device_source_res": (r"\.call$", r"_step$", r"^launch_fn$"),

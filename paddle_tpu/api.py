@@ -221,7 +221,8 @@ class GradientMachine:
 
     def asDecodeEngine(self, slots: int = 8, prompt_tokens: int = 32,
                        queue_cap: int = 0, request_timeout_s: float = 60.0,
-                       decode_block: int = 1, registry=None):
+                       decode_block=1, registry=None,
+                       pipeline: bool = True, fused_step: bool = False):
         """The continuous-batching engine over this machine's generator
         graph (doc/serving.md) — the concurrent-use superset of
         :class:`SequenceGenerator`: submit() from any thread, slot-based
@@ -235,7 +236,7 @@ class GradientMachine:
             self._core, self.params, slots=slots,
             prompt_tokens=prompt_tokens, queue_cap=queue_cap,
             request_timeout_s=request_timeout_s, decode_block=decode_block,
-            registry=registry,
+            registry=registry, pipeline=pipeline, fused_step=fused_step,
         )
 
 

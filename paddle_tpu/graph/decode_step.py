@@ -26,8 +26,16 @@ is that opening:
   for beam_size=1.
 
 The fused attention-GRU kernel exposes the matching single-step math as
-``ops.pallas_attention_gru.attention_gru_step`` — the seam a future
-TPU-fused serve_decode kernel plugs into without changing the engine.
+``ops.pallas_attention_gru.attention_gru_step`` — and behind
+``--serve_fused_step`` this module WIRES it in: :func:`plan_fused_step`
+template-matches the generation step graph against the attention-GRU
+decoder shape (the serve-side sibling of graph/fused_decoder.py's
+training matcher) and extracts the weights; :func:`make_greedy_step`
+then builds the step from ``attention_gru_step`` plus the embedding
+lookup and the output softmax — one tight function instead of a
+layer-by-layer graph walk, golden-pinned token-for-token against the
+unfused step (tests/test_engine.py). Any deviation from the template
+refuses with the reason; the unfused step is always a correct fallback.
 """
 
 from __future__ import annotations
@@ -142,13 +150,220 @@ def capture_prefill(machine, plan: GenPlan, params, in_args):
     return _static_tree(cap["statics"]), tuple(cap["boots"])
 
 
-def make_greedy_step(machine, plan: GenPlan):
+# layer types that are wiring, not computation, in a step submodel
+_AGENT_TYPES = ("agent", "sequence_agent", "scatter_agent", "gather_agent")
+
+
+def plan_fused_step(machine, plan: GenPlan):
+    """(extraction dict, "") when the generation step graph is EXACTLY
+    the attention-GRU decoder template (simple_attention + gru_step +
+    softmax out — the seqToseq shape graph/fused_decoder.py matches on
+    the training side), else (None, reason). The dict carries every
+    parameter name and static-link key the fused step needs; refusals
+    are loud because ``--serve_fused_step`` is an explicit request."""
+    sub = plan.sub
+    lm = machine.network.layer_map
+    # the fused step computes in f32; under a reduced compute dtype the
+    # unfused graph walk rounds differently per layer and near-tie
+    # argmax tokens could silently diverge from the parity contract —
+    # refuse instead (the flag is an explicit request, never a guess).
+    # compute_dtype=None means "everything in the model dtype"
+    eff = machine.compute_dtype if machine.compute_dtype is not None else (
+        machine.dtype)
+    if jnp.dtype(eff) != jnp.float32:
+        return None, (
+            f"fused step supports float32 compute only (model computes "
+            f"in {jnp.dtype(eff).name})"
+        )
+    if len(plan.memories) != 1:
+        return None, "fused step needs exactly one flat memory carry"
+    mem = plan.memories[0]
+    layers = [lm[n] for n in sub.layer_names if lm[n].type not in _AGENT_TYPES]
+    by_name = {l.name: l for l in layers}
+    if len(layers) != 10:
+        return None, (
+            f"step graph has {len(layers)} layers — not the attention-GRU "
+            "decoder template (embedding/attention/din/gru/out)"
+        )
+    if not all(l.drop_rate == 0.0 and l.error_clipping_threshold == 0
+               for l in layers):
+        return None, "step layers carry dropout/error-clipping"
+    gru = next((l for l in layers if l.type == "gru_step"), None)
+    if gru is None or gru.name != mem.layer_name or len(gru.inputs) != 2:
+        return None, "no gru_step layer owning the memory"
+    if gru.inputs[1].input_layer_name != mem.link_name:
+        return None, "gru_step's second input is not the memory link"
+    acts = (gru.active_type or "tanh", gru.active_gate_type or "sigmoid")
+    if acts != ("tanh", "sigmoid"):
+        return None, f"gru activations {acts} != ('tanh', 'sigmoid')"
+    D = gru.size
+    din = by_name.get(gru.inputs[0].input_layer_name)
+    if (din is None or din.type != "mixed" or din.size != 3 * D
+            or din.active_type not in ("", "linear") or len(din.inputs) != 2
+            or any(ic.proj_conf is None or ic.proj_conf.type != "fc"
+                   for ic in din.inputs)):
+        return None, "gru input is not a linear mixed of two fc projections"
+    emb = ctx_ic = word_ic = None
+    for ic in din.inputs:
+        src = by_name.get(ic.input_layer_name)
+        if (src is not None and src.type == "mixed" and len(src.inputs) == 1
+                and src.inputs[0].proj_conf is not None
+                and src.inputs[0].proj_conf.type == "table"
+                and src.inputs[0].input_layer_name == plan.predict_agent):
+            emb, word_ic = src, ic
+        else:
+            ctx_ic = ic
+    if emb is None or ctx_ic is None or emb.bias_parameter_name:
+        return None, "no bias-free generated-word embedding feeding the gru"
+    pooling = by_name.get(ctx_ic.input_layer_name)
+    if (pooling is None or pooling.type != "average"
+            or (pooling.average_strategy or "average") != "sum"
+            or pooling.active_type not in ("", "linear")
+            or len(pooling.inputs) != 1):
+        return None, "context is not a sum-pooled attention readout"
+    scaling = by_name.get(pooling.inputs[0].input_layer_name)
+    if scaling is None or scaling.type != "scaling" or len(scaling.inputs) != 2:
+        return None, "no attention scaling layer"
+    sm = by_name.get(scaling.inputs[0].input_layer_name)
+    ev_link = scaling.inputs[1].input_layer_name
+    if ev_link not in plan.static_links:
+        return None, "attention values are not a static link"
+    if (sm is None or sm.type != "fc" or sm.size != 1
+            or sm.active_type != "sequence_softmax"
+            or sm.bias_parameter_name or len(sm.inputs) != 1):
+        return None, "no sequence-softmax attention scorer"
+    combine = by_name.get(sm.inputs[0].input_layer_name)
+    if (combine is None or combine.type != "mixed"
+            or combine.active_type != "tanh" or combine.size != D
+            or len(combine.inputs) != 2
+            or any(ic.proj_conf is None or ic.proj_conf.type != "identity"
+                   for ic in combine.inputs)):
+        return None, "no tanh combine of expanded transform + projection"
+    comb_srcs = [ic.input_layer_name for ic in combine.inputs]
+    expand = next((by_name[n] for n in comb_srcs
+                   if n in by_name and by_name[n].type == "expand"), None)
+    ep_link = next((n for n in comb_srcs if n in plan.static_links), None)
+    if expand is None or ep_link is None or ep_link == ev_link:
+        return None, "combine does not mix an expand with a static link"
+    if not expand.inputs or expand.inputs[0].input_layer_name not in by_name:
+        return None, "expand input missing from the step graph"
+    transform = by_name.get(expand.inputs[0].input_layer_name)
+    if (transform is None or transform.type != "mixed"
+            or transform.active_type not in ("", "linear")
+            or transform.size != D or len(transform.inputs) != 1
+            or transform.inputs[0].proj_conf is None
+            or transform.inputs[0].proj_conf.type != "fc"
+            or transform.inputs[0].input_layer_name != mem.link_name):
+        return None, "attention transform is not an fc of the decoder memory"
+    out = by_name.get(plan.score_layer)
+    if (out is None or out.type != "mixed" or out.active_type != "softmax"
+            or len(out.inputs) != 1 or out.inputs[0].proj_conf is None
+            or out.inputs[0].proj_conf.type != "fc"
+            or out.inputs[0].input_layer_name != gru.name):
+        return None, "score layer is not a softmax fc of the gru output"
+    template = {gru.name, din.name, emb.name, pooling.name, scaling.name,
+                sm.name, combine.name, expand.name, transform.name, out.name}
+    if template != set(by_name):
+        return None, "extra layers outside the attention-GRU template"
+    return dict(
+        D=D, E=pooling.size, word_dim=emb.size, vocab=out.size,
+        ep_link=ep_link, ev_link=ev_link,
+        emb_param=emb.inputs[0].input_parameter_name,
+        word_param=word_ic.input_parameter_name,
+        wctx_param=ctx_ic.input_parameter_name,
+        wa_param=transform.inputs[0].input_parameter_name,
+        v_param=sm.inputs[0].input_parameter_name,
+        wg_param=gru.inputs[0].input_parameter_name,
+        out_w_param=out.inputs[0].input_parameter_name,
+        out_b_param=out.bias_parameter_name or "",
+        ba_params=[p for p in (transform.bias_parameter_name,
+                               combine.bias_parameter_name) if p],
+        xw_bias_params=[p for p in (din.bias_parameter_name,
+                                    gru.bias_parameter_name) if p],
+    ), ""
+
+
+def _make_fused_step(machine, plan: GenPlan, fp: Dict[str, Any]):
+    """The ``--serve_fused_step`` step body: the parity-tested
+    ``ops.pallas_attention_gru.attention_gru_step`` math plus the
+    embedding lookup and the output softmax, from the weights
+    :func:`plan_fused_step` extracted. Finished-row semantics are
+    identical to the unfused step (eos emission, frozen carries)."""
+    from paddle_tpu.ops.pallas_attention_gru import attention_gru_step
+
+    eos = plan.eos
+    D, E, W, V = fp["D"], fp["E"], fp["word_dim"], fp["vocab"]
+
+    def step(params, statics_tree, carries, prev_tok, finished):
+        ctx = LayerContext(
+            params=params, model=machine.model, pass_type="gen", rng=None,
+            dtype=machine.dtype, compute_dtype=machine.compute_dtype,
+            no_cast_inputs=machine.no_cast_inputs,
+            scan_unroll=machine.scan_unroll,
+        )
+        f32 = jnp.float32
+        p = ctx.param
+        ep_d = statics_tree[fp["ep_link"]]
+        ev_d = statics_tree[fp["ev_link"]]
+        ep = jnp.swapaxes(ep_d["value"], 0, 1).astype(f32)   # [Te, B, D]
+        ev = jnp.swapaxes(ev_d["value"], 0, 1).astype(f32)   # [Te, B, E]
+        Te = ep.shape[0]
+        lens = ep_d.get("seq_lengths")
+        if lens is None:
+            em = jnp.ones(ep.shape[:2] + (1,), f32)
+        else:
+            em = (jnp.arange(Te)[:, None] < lens[None, :]).astype(f32)[
+                :, :, None]                                  # [Te, B, 1]
+        emb = p(fp["emb_param"]).reshape(-1, W)[prev_tok]    # [B, W]
+        xw = jax.lax.dot(
+            emb.astype(f32), p(fp["word_param"]).reshape(W, 3 * D).astype(f32)
+        )
+        for name in fp["xw_bias_params"]:
+            xw = xw + p(name).reshape(1, 3 * D).astype(f32)
+        ba = jnp.zeros((1, D), f32)
+        for name in fp["ba_params"]:
+            ba = ba + p(name).reshape(1, D).astype(f32)
+        (h,) = carries
+        h_new = attention_gru_step(
+            h.astype(f32), ep, ev, em, xw,
+            p(fp["wa_param"]).reshape(D, D).astype(f32), ba,
+            p(fp["v_param"]).reshape(1, D).astype(f32),
+            p(fp["wctx_param"]).reshape(E, 3 * D).astype(f32),
+            p(fp["wg_param"]).reshape(D, 3 * D).astype(f32),
+        )                                                    # [B, D] f32
+        logits = jax.lax.dot(
+            h_new, p(fp["out_w_param"]).reshape(D, V).astype(f32)
+        )
+        if fp["out_b_param"]:
+            logits = logits + p(fp["out_b_param"]).reshape(1, V).astype(f32)
+        probs = jax.nn.softmax(logits, axis=-1)
+        # same argmax arithmetic as the unfused step — tie behavior and
+        # the clip floor must not diverge between the two paths
+        logp = jnp.log(jnp.clip(probs, 1e-20, None))
+        token = jnp.argmax(logp, axis=-1).astype(jnp.int32)
+        token = jnp.where(finished, eos, token)
+        old = carries[0]
+        keep = finished.reshape((-1,) + (1,) * (h_new.ndim - 1))
+        new_h = jnp.where(keep, old, h_new.astype(old.dtype))
+        new_finished = finished | (token == eos)
+        return (new_h,), token, new_finished
+
+    return step
+
+
+def make_greedy_step(machine, plan: GenPlan,
+                     fused_plan: Optional[Dict[str, Any]] = None):
     """Build ``step(params, statics_tree, carries, prev_tok, finished)
     -> (new_carries, token, new_finished)`` — one greedy decode step for
     every slot row. Finished rows freeze their carries and emit ``eos``
     (score-free), exactly the K=1 semantics of ``_generate``'s beam
     step, so greedy engine output matches ``SequenceGenerator`` with
-    beam_size=1 token for token."""
+    beam_size=1 token for token. With ``fused_plan`` (from
+    :func:`plan_fused_step`, the ``--serve_fused_step`` path) the step
+    is the extracted attention-GRU math instead of the graph walk —
+    token-parity-pinned against this default."""
+    if fused_plan is not None:
+        return _make_fused_step(machine, plan, fused_plan)
     network = machine.network
     eos = plan.eos
 

@@ -167,15 +167,21 @@ def analyze(streams: Dict[int, List[Dict[str, Any]]]) -> Dict[str, Any]:
                 if rec.get("rung", -1) >= 0:
                     serve_driver_hosts.add(host)
             elif kind == "serve_window":
+                # pipeline joins the key: a one-dir pipelined-vs-
+                # blocking A/B re-runs the same (engine, rung) ladder
+                # and must keep BOTH sweeps, like the both-engines case
                 serve_windows_by[
-                    (host, rec.get("engine", "static"), rec.get("rung"))
+                    (host, rec.get("engine", "static"),
+                     str(rec.get("pipeline") or ""), rec.get("rung"))
                 ] = rec
             elif kind == "pass_end":
                 p = int(rec.get("pass", -1))
                 per_host_pass.setdefault(host, {})[p] = rec
     serve_windows = [
         serve_windows_by[k] for k in sorted(
-            serve_windows_by, key=lambda k: (k[1] if k[1] is not None else -1, k[0])
+            serve_windows_by,
+            key=lambda k: (k[1] if k[1] is not None else -1, k[2],
+                           k[3] if isinstance(k[3], int) else -1, k[0]),
         )
     ]
 

@@ -71,25 +71,32 @@ _HIGHER_BETTER = {
 
 
 def _serve_key(offered_rps, qualifier, seen_pre: set,
-               engine: Optional[str] = None) -> str:
+               engine: Optional[str] = None,
+               pipeline: Optional[str] = None) -> str:
     """The ONE serve rung key format, shared by the run-dir and bench-
     artifact sides (a divergence would silently break their
     comparability): 6 significant digits of offered load — a slow
     backend's sub-1 req/s ladder must not collapse rungs into one key —
     with later duplicates engine-qualified first (a both-engines
     artifact repeats every rate once per engine; joining them as one
-    key would diff an engine against itself) and then rung-qualified
-    (variance-gauging repeated rates) instead of silently overwritten.
+    key would diff an engine against itself), then PIPELINE-qualified
+    (a one-artifact pipelined-vs-blocking sweep repeats every (engine,
+    rate) once per mode), and finally rung-qualified (variance-gauging
+    repeated rates) instead of silently overwritten.
 
-    The rung join is therefore (engine, offered load): two sweeps of
-    the SAME engine join on offered load alone; mismatched ladders land
-    in only_a/only_b (visible, never a bogus verdict); and a pure
-    cross-engine A/B — one engine per artifact, pinned
-    PADDLE_TPU_BENCH_SERVE_RATES — joins on offered load, which is
-    exactly the static-vs-continuous comparison being asked for."""
-    pre = f"serve.{format(float(offered_rps or 0.0), '.6g')}rps."
+    The rung join is therefore (engine, pipeline, offered load): two
+    sweeps of the SAME configuration join on offered load alone;
+    mismatched ladders land in only_a/only_b (visible, never a bogus
+    verdict); and a pure A/B — one engine (or one pipeline mode) per
+    artifact, pinned PADDLE_TPU_BENCH_SERVE_RATES — joins on offered
+    load, which is exactly the static-vs-continuous (or pipelined-vs-
+    blocking) comparison being asked for."""
+    rate = format(float(offered_rps or 0.0), ".6g")
+    pre = f"serve.{rate}rps."
     if pre in seen_pre and engine:
-        pre = f"serve.{engine}.{format(float(offered_rps or 0.0), '.6g')}rps."
+        pre = f"serve.{engine}.{rate}rps."
+    if pre in seen_pre and engine and pipeline:
+        pre = f"serve.{engine}.pipe-{pipeline}.{rate}rps."
     if pre in seen_pre:
         pre = f"{pre[:-1]}.r{qualifier}."
     seen_pre.add(pre)
@@ -205,11 +212,13 @@ def _run_side(path: str) -> Dict[str, float]:
     # artifacts then join engine-to-engine, never crosswise
     for w in sorted(windows,
                     key=lambda w: (str(w.get("engine") or ""),
+                                   str(w.get("pipeline") or ""),
                                    w.get("rung") if isinstance(
                                        w.get("rung"), int) else 0)):
         engine = w.get("engine") if isinstance(w.get("engine"), str) else None
+        pipe = w.get("pipeline") if isinstance(w.get("pipeline"), str) else None
         pre = _serve_key(w.get("offered_rps"), w.get("rung", 0), seen_pre,
-                         engine=engine)
+                         engine=engine, pipeline=pipe)
         for snap_key, dst, scale in (
             ("latency", "p50_ms", 1e3), ("latency", "p99_ms", 1e3),
             ("ttft", "ttft_p50_ms", 1e3), ("ttft", "ttft_p99_ms", 1e3),
@@ -292,12 +301,15 @@ def _bench_side(path: str, raw: str) -> Dict[str, float]:
     seen_pre: set = set()
     rungs = [(i, r) for i, r in enumerate(line.get("rungs") or [])
              if isinstance(r, dict)]
-    # (engine, index)-sorted for the same deterministic key assignment
-    # as the run-dir side (see _run_side)
-    rungs.sort(key=lambda p: (str(p[1].get("engine") or ""), p[0]))
+    # (engine, pipeline, index)-sorted for the same deterministic key
+    # assignment as the run-dir side (see _run_side)
+    rungs.sort(key=lambda p: (str(p[1].get("engine") or ""),
+                              str(p[1].get("pipeline") or ""), p[0]))
     for i, r in rungs:
         engine = r.get("engine") if isinstance(r.get("engine"), str) else None
-        pre = _serve_key(r.get("offered_rps"), i, seen_pre, engine=engine)
+        pipe = r.get("pipeline") if isinstance(r.get("pipeline"), str) else None
+        pre = _serve_key(r.get("offered_rps"), i, seen_pre, engine=engine,
+                         pipeline=pipe)
         for key in ("p50_ms", "p99_ms", "ttft_p50_ms", "ttft_p99_ms",
                     "goodput_tok_s"):
             v = r.get(key)

@@ -142,7 +142,8 @@ class RequestLog:
     kind from metrics.py — p50/p99 without storing samples."""
 
     def __init__(self, rung: int = 0, offered_rps: float = 0.0,
-                 beam_size: Optional[int] = None, engine: str = "static"):
+                 beam_size: Optional[int] = None, engine: str = "static",
+                 pipeline: Optional[str] = None):
         self.rung = int(rung)
         self.offered_rps = float(offered_rps)
         self.beam_size = beam_size
@@ -152,6 +153,15 @@ class RequestLog:
         # stamped on every request and serve_window record so `paddle
         # compare` never joins rungs across engines by accident
         self.engine = str(engine)
+        # "on" | "off": whether the continuous engine ran the pipelined
+        # dispatch/collect loop — part of the compare join key ((engine,
+        # pipeline, offered load)) so a one-dir pipelined-vs-blocking
+        # A/B keeps both ladders apart. None (the static driver) leaves
+        # the field off the records
+        self.pipeline = None if pipeline is None else str(pipeline)
+        # host seconds spent scheduling while a decode launch was in
+        # flight (the pipelined loop's dispatch->collect-entry gaps)
+        self.overlap_s = 0.0
         self.latency = obs.Histogram("latency_s")
         self.ttft = obs.Histogram("ttft_s")
         self.queue_wait = obs.Histogram("queue_wait_s")
@@ -178,6 +188,8 @@ class RequestLog:
             "rung": self.rung,
             "engine": self.engine,
             "outcome": req.outcome,
+            **({"pipeline": self.pipeline} if self.pipeline is not None
+               else {}),
             "t_enqueue": round(req.t_enqueue, 6),
             "prompt_tokens": int(req.prompt_tokens),
         }
@@ -272,6 +284,21 @@ class RequestLog:
         engine's prefill writes) — keeps ``host_share`` honest."""
         self.exec_s += float(service_s)
 
+    def note_overlap(self, seconds: float) -> None:
+        """Host seconds that ran concurrently with an in-flight launch
+        (pipelined loop: dispatch to collect-entry). Rides the window
+        record and the cumulative ``serve.overlap_s`` counter — the
+        direct measure of what the dispatch/collect split bought."""
+        s = max(float(seconds), 0.0)
+        self.overlap_s += s
+        obs.registry().counter("serve.overlap_s").inc(s)
+
+    def note_dispatch(self, depth: int) -> None:
+        """Launches dispatched but not yet collected (``serve.
+        dispatch_depth`` gauge): 0 = the serial loop's steady state,
+        >=1 = the device has queued work while the host schedules."""
+        obs.registry().gauge("serve.dispatch_depth").set(int(depth))
+
     def complete(self, req: Request, **extra) -> None:
         req.outcome = "ok"
         self.completed += 1
@@ -318,6 +345,10 @@ class RequestLog:
         }
         if self.beam_size is not None:
             rec["beam_size"] = int(self.beam_size)
+        if self.pipeline is not None:
+            rec["pipeline"] = self.pipeline
+        if self.overlap_s > 0:
+            rec["overlap_s"] = round(self.overlap_s, 6)
         if self._e2e_ok_s > 0:
             rec["queue_wait_share"] = round(self._wait_ok_s / self._e2e_ok_s, 4)
         if host_share is not None:
@@ -633,6 +664,8 @@ def serve_doc(streams: Dict[int, List[Dict[str, Any]]]) -> Dict[str, Any]:
         "rungs": rungs,
         "knee_rps": saturation_knee(windows),
         "engines": sorted({w.get("engine", "static") for w in windows}),
+        "pipelines": sorted({w["pipeline"] for w in windows
+                             if isinstance(w.get("pipeline"), str)}),
         "groups": sorted({c.get("group") for c in serve_compiles}),
         "requests": (doc.get("serve") or {}).get("requests", 0),
         "compiles": len(serve_compiles),
@@ -685,6 +718,9 @@ def format_report(doc: Dict[str, Any]) -> str:
     engines = doc.get("engines") or []
     if engines and engines != ["static"]:
         lines.append(f"engine: {', '.join(engines)}")
+    pipelines = doc.get("pipelines") or []
+    if pipelines:
+        lines.append(f"pipelined decode: {', '.join(pipelines)}")
     lines.append(
         f"{groups or SERVE_GROUP}: {doc['compiles']} compile(s), "
         f"recompiles after warmup: {doc['recompiles']}"

@@ -153,12 +153,16 @@ def attention_gru_step(h_prev, ep, ev, em, xw_t, wa, ba, v, wctx, wg,
     """ONE decoder step of the fused attention-GRU math, as a plain jnp
     function — the per-step seam for iteration-level (continuous-
     batching) decode, where the time loop lives on the HOST scheduler
-    instead of inside a kernel grid or a ``lax.while_loop``.
+    instead of inside a kernel grid or a ``lax.while_loop``. The
+    serving engine wires it in behind ``--serve_fused_step``
+    (graph/decode_step.plan_fused_step template-matches the generation
+    step graph and feeds this function the extracted weights); a
+    TPU-fused ``serve_decode`` kernel plugs into the same seam.
 
     Exactly the `_fwd_kernel` step body (attention transform → masked
-    softmax → sum-pooled context → mixed projection → GRU), so a future
-    TPU-fused ``serve_decode`` kernel and this reference cannot diverge;
-    pinned against `fused_attention_gru` in tests/test_engine.py.
+    softmax → sum-pooled context → mixed projection → GRU), so the
+    serve-side step and the training kernel cannot diverge; pinned
+    against `fused_attention_gru` in tests/test_engine.py.
 
     Shapes: ``h_prev [B, D]``, ``ep [Te, B, D]`` (encoder projection),
     ``ev [Te, B, E]`` (encoder values), ``em [Te, B, 1]`` (encoder

@@ -22,16 +22,22 @@ Layering (mirrors the analysis/resilience discipline):
   graceful drain, and the in-process Python API.
 """
 
-from paddle_tpu.serving.backend import FakeBackend, StepOut
+from paddle_tpu.serving.backend import (
+    FakeBackend,
+    StepOut,
+    parse_decode_blocks,
+)
 from paddle_tpu.serving.engine import (
     Engine,
     EngineRequest,
     ResultFuture,
     ServeResult,
     drive_rung,
+    pick_block,
 )
 
 __all__ = [
     "Engine", "EngineRequest", "ResultFuture", "ServeResult",
-    "FakeBackend", "StepOut", "drive_rung",
+    "FakeBackend", "StepOut", "drive_rung", "pick_block",
+    "parse_decode_blocks",
 ]

@@ -4,31 +4,61 @@ The engine (engine.py) is jax-free and schedules *slots*; everything
 device-shaped hides behind this protocol:
 
 - ``slots`` / ``max_length`` — capacity and the decode-step bound.
+- ``decode_blocks`` — the pre-warmed decode-block ladder (micro-steps
+  per launch the engine's :func:`~paddle_tpu.serving.engine.pick_block`
+  policy may choose from).
 - ``admit(slot_ids, requests, budgets)`` — prefill: write the named
   requests' decode state into the named slots (overwriting whatever a
   previous occupant left there — eviction needs no separate call).
-- ``step() -> StepOut`` — ONE iteration: advance every slot by the
-  backend's decode block (``u`` micro-steps per launch, default 1) and
-  return the emitted tokens plus per-slot done flags.
+- ``dispatch(block=None)`` — enqueue ONE decode launch advancing every
+  slot by ``block`` micro-steps, without waiting for its results (the
+  pipelined loop's first half); ``collect() -> StepOut`` — gather the
+  OLDEST in-flight launch's results (blocking); ``inflight`` — how many
+  launches are dispatched-but-uncollected.
+- ``step(block=None) -> StepOut`` — dispatch + collect in one call (the
+  blocking loop and one-shot callers).
 - ``warmup()`` — pay compiles before serving (so compile telemetry
   shows recompiles=0 afterwards); ``reset()`` — discard all device
-  state after a failed launch (the engine errors the in-flight cohort
-  and keeps serving).
+  state AND the in-flight queue after a failed launch (the engine
+  errors every in-flight cohort and keeps serving).
 
 :class:`FakeBackend` is the deterministic jax-free implementation the
 unit tests and ``tests/race_specs/spec_serve_engine.py`` drive the REAL
-engine with; :class:`~paddle_tpu.serving.jax_backend.JaxDecodeBackend`
-is the production one.
+engine with. It models the in-flight pipeline faithfully: ``dispatch``
+advances the scripted rows immediately but parks the ``StepOut`` (or
+the injected fault) in a FIFO that only ``collect`` drains — matching
+jax async dispatch, where results AND errors surface at readback.
+:class:`~paddle_tpu.serving.jax_backend.JaxDecodeBackend` is the
+production implementation.
 """
 
 from __future__ import annotations
 
+import collections
 import dataclasses
-from typing import Any, Callable, List, Optional, Sequence
+from typing import Any, Callable, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
 from paddle_tpu.utils import concurrency as cc
+
+
+def parse_decode_blocks(spec: Union[int, str, Sequence[int], None]) -> Tuple[int, ...]:
+    """The decode-block ladder from its flag/env spelling: an int, an
+    int sequence, or a comma list like ``"1,2,4,8"`` — sorted, deduped,
+    every rung >= 1. The single-int form is the PR-12 flag unchanged (a
+    one-rung ladder)."""
+    if spec is None:
+        return (1,)
+    if isinstance(spec, str):
+        parts = [p for p in spec.replace(" ", "").split(",") if p]
+        blocks = [int(p) for p in parts] or [1]
+    elif isinstance(spec, (list, tuple)):
+        blocks = [int(u) for u in spec] or [1]
+    else:
+        blocks = [int(spec)]
+    out = tuple(sorted({max(u, 1) for u in blocks}))
+    return out or (1,)
 
 
 @dataclasses.dataclass
@@ -52,19 +82,23 @@ class FakeBackend:
     ``token_fn(rid, step_index)`` scripts the "model": it returns the
     token the request emits at its ``step_index``-th decode step
     (default: a stable hash — never EOS, so budgets do the finishing).
-    ``chunk`` mirrors the jax backend's decode block. ``step_delay_s``
-    burns (virtual, under the race shim) clock per launch.
-    ``fail_at_launch`` makes the N-th ``step()`` call raise — the chaos
-    seam for the engine's error path."""
+    ``chunk`` mirrors the jax backend's decode-block ladder (an int or
+    a ladder spec). ``step_delay_s`` burns (virtual, under the race
+    shim) clock per launch at dispatch — where the modeled device does
+    its work. ``fail_at_launch`` makes the N-th dispatched launch
+    fault; like the real backend, the fault surfaces at ``collect()``
+    (the chaos seam for the engine's error path, pipelined included)."""
 
     def __init__(self, slots: int = 4, max_length: int = 8, eos: int = 1,
                  token_fn: Optional[Callable[[str, int], int]] = None,
-                 chunk: int = 1, step_delay_s: float = 0.0,
+                 chunk: Union[int, str, Sequence[int]] = 1,
+                 step_delay_s: float = 0.0,
                  fail_at_launch: Optional[int] = None):
         self.slots = int(slots)
         self.max_length = int(max_length)
         self.eos = int(eos)
-        self.chunk = max(int(chunk), 1)
+        self.decode_blocks = parse_decode_blocks(chunk)
+        self.chunk = self.decode_blocks[-1]
         self.step_delay_s = float(step_delay_s)
         self.fail_at_launch = fail_at_launch
         self.token_fn = token_fn or (
@@ -73,14 +107,22 @@ class FakeBackend:
         self.launches = 0
         self.admits: List[List[str]] = []   # admission waves, for tests
         self._rows: List[Optional[dict]] = [None] * self.slots
+        # dispatched-but-uncollected results (or faults): StepOut |
+        # Exception, drained FIFO by collect()
+        self._pending: collections.deque = collections.deque()
 
     # ------------------------------------------------------------ seam
+
+    @property
+    def inflight(self) -> int:
+        return len(self._pending)
 
     def warmup(self) -> None:
         pass
 
     def reset(self) -> None:
         self._rows = [None] * self.slots
+        self._pending.clear()
 
     def admit(self, slot_ids: Sequence[int], requests: Sequence[Any],
               budgets: Sequence[int]) -> None:
@@ -93,13 +135,19 @@ class FakeBackend:
                 "done": int(budget) <= 0,
             }
 
-    def step(self) -> StepOut:
+    def dispatch(self, block: Optional[int] = None) -> None:
+        """Advance the scripted rows now, surface the results (or the
+        injected fault) only at collect — the jax async-dispatch
+        contract the pipelined engine is written against."""
         self.launches += 1
         if self.fail_at_launch is not None and self.launches == self.fail_at_launch:
-            raise RuntimeError(f"injected decode fault at launch {self.launches}")
+            self._pending.append(RuntimeError(
+                f"injected decode fault at launch {self.launches}"))
+            return
         if self.step_delay_s:
             cc.sleep(self.step_delay_s)
-        u, B = self.chunk, self.slots
+        u = max(int(block), 1) if block else self.chunk
+        B = self.slots
         tokens = np.zeros((u, B), np.int64)
         live = np.zeros((u, B), bool)
         finished = np.zeros((B,), bool)
@@ -116,4 +164,16 @@ class FakeBackend:
                 if tok == self.eos or row["emitted"] >= row["budget"]:
                     row["done"] = True
             finished[b] = row["done"]
-        return StepOut(tokens=tokens, live=live, finished=finished)
+        self._pending.append(StepOut(tokens=tokens, live=live,
+                                     finished=finished))
+
+    def collect(self) -> StepOut:
+        assert self._pending, "collect() with no launch in flight"
+        out = self._pending.popleft()
+        if isinstance(out, Exception):
+            raise out
+        return out
+
+    def step(self, block: Optional[int] = None) -> StepOut:
+        self.dispatch(block=block)
+        return self.collect()

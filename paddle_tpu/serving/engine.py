@@ -1,5 +1,5 @@
 """The continuous-batching scheduler — slot admission/eviction at every
-iteration boundary.
+iteration boundary, with the device/host pipeline kept full.
 
 Run-to-completion batching (the PR-8 static driver, and every
 ``SequenceGenerator.generate`` call) holds a whole cohort until its
@@ -14,20 +14,50 @@ This engine is that loop:
         admit   — queued requests (strict FIFO) prefill into free slots
         step    — ONE jitted launch advances every slot
 
+The PR-12 loop ran those phases strictly serially: every decode launch
+was followed by a blocking readback, and every admission blocked on the
+prefill — so the device idled during host scheduling and the host idled
+during every launch. The **pipelined** loop (default) splits the
+backend step into ``dispatch()`` (enqueue launch N+1; the backend
+starts ``copy_to_host_async`` on launch N's outputs before the first
+collect — the PR-5 snapshot discipline) and ``collect()`` (gather N's
+results), and schedules/evicts/admits on iteration N's results WHILE
+the device runs N+1. Consequences, all deliberate:
+
+- admissions decided from launch N's results prefill between launches
+  N+1 and N+2 — a one-iteration admission lag (doc/serving.md
+  "Pipelined decode");
+- each in-flight launch carries a SNAPSHOT of its slot cohort; collect
+  applies tokens to that snapshot (a slot evicted mid-flight is simply
+  skipped — its device row self-terminates at its bounded budget);
+- deadlines, TTFT, and exec attribution are all judged at collect
+  boundaries — the only place results exist under overlap;
+- a faulted in-flight launch surfaces at collect: it errors its cohort
+  (and every other in-flight request), the device state resets, and the
+  engine keeps serving — exactly the blocking loop's fault contract.
+
+``pipeline=False`` keeps the PR-12 serial loop (the A/B baseline:
+``PADDLE_TPU_BENCH_SERVE_PIPELINE=off``). Both loops share the
+boundary/admission/apply code and the adaptive decode-block ladder
+(:func:`pick_block`), so the pipeline is the ONLY delta in that A/B.
+
 Everything here is jax-free and thread-safe strictly through the
 ``utils/concurrency`` seam (``cc``): the scheduler runs on one
 ``cc.Thread``; ``submit``/``cancel``/``drain`` are the only cross-
 thread entry points and every shared field is guarded by ``self._lock``
 — the ``paddle race`` spec (tests/race_specs/spec_serve_engine.py)
-explores exactly these interleavings. Device work hides behind the
-backend seam (backend.py): ``FakeBackend`` for tests,
-``JaxDecodeBackend`` for TPUs.
+explores exactly these interleavings, pipelined and blocking. Device
+work hides behind the backend seam (backend.py): ``FakeBackend`` for
+tests, ``JaxDecodeBackend`` for TPUs.
 
 Telemetry is the PR-8 contract unchanged — per-request ``kind=request``
-records (now with REAL wall-clock TTFT: the first token's readback
-timestamp, mid-sequence) and ``kind=serve_window`` rollups with
-``engine="continuous"`` — so ``paddle serve-report`` renders an engine
-run with zero new code.
+records (REAL wall-clock TTFT: the first token's readback timestamp,
+mid-sequence) and ``kind=serve_window`` rollups with
+``engine="continuous"`` — plus the overlap plane: ``serve.
+dispatch_depth`` (gauge), ``serve.overlap_s`` (counter), and a window
+``host_share`` whose exec side is the UNION of dispatch→collect spans,
+so overlap shows up as host_share going to ~0 instead of exec_s
+double-counting past the wall clock.
 """
 
 from __future__ import annotations
@@ -36,7 +66,7 @@ import collections
 import dataclasses
 import math
 import os
-from typing import Any, Callable, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from paddle_tpu.observability import serving as slog
 from paddle_tpu.utils import concurrency as cc
@@ -47,6 +77,50 @@ ENGINE_NAME = "continuous"
 # terminal request outcomes (race-spec invariant: every submitted
 # request's future resolves exactly once with one of these)
 OUTCOMES = ("ok", "rejected", "timeout", "cancelled", "error")
+
+# a launch whose measured host-side cost exceeds this share of its
+# device time is dispatch-dominated — the ladder steps up a rung
+BLOCK_OVERHEAD_SHARE = 0.5
+
+# EMA smoothing for the ladder's host/device time estimates
+_EMA = 0.3
+
+
+def pick_block(ladder: Sequence[int], cap: int, pressed: bool,
+               host_s: float, step_s: float) -> int:
+    """The adaptive decode-block policy: how many greedy micro-steps the
+    next launch should run (doc/serving.md "The decode-block ladder").
+
+    ``ladder`` — the pre-warmed rungs, ascending; ``cap`` — the smallest
+    remaining token budget among live slots (running past it buys only
+    frozen micro-steps); ``pressed`` — queue/TTFT pressure: requests are
+    waiting to be admitted, or a live slot has not yet delivered its
+    first token (both only resolve at a collect boundary, so boundaries
+    should come sooner); ``host_s`` — measured host+dispatch seconds per
+    iteration (EMA); ``step_s`` — measured device seconds per micro-step
+    (EMA).
+
+    Under pressure: the SMALLEST rung whose device time still keeps the
+    measured launch overhead under :data:`BLOCK_OVERHEAD_SHARE` — pay
+    for boundaries only what they cost. No pressure: the largest rung
+    the budget cap allows — boundaries buy nothing, overhead
+    amortization is free. With no measurements yet (warmup), pressure
+    picks the bottom rung and quiet picks the top."""
+    if not ladder:
+        return 1
+    if len(ladder) == 1:
+        return int(ladder[0])
+    cands = [u for u in ladder if u <= max(int(cap), int(ladder[0]))]
+    if not cands:
+        cands = [int(ladder[0])]
+    if not pressed:
+        return int(cands[-1])
+    if host_s > 0 and step_s > 0:
+        for u in cands:
+            if host_s <= BLOCK_OVERHEAD_SHARE * u * step_s:
+                return int(u)
+        return int(cands[-1])
+    return int(cands[0])
 
 
 @dataclasses.dataclass
@@ -119,18 +193,23 @@ class Engine:
     work; ``queue_cap`` rejects submits past the bound (0 = unbounded);
     ``request_timeout_s`` is the default wall-clock deadline from submit
     — expiry frees the queue entry OR the decode slot at the next
-    iteration boundary with ``outcome=timeout``. ``clock`` is
-    injectable for tests (defaults to the ``cc`` seam's monotonic, so
-    ``paddle race`` virtualizes it automatically)."""
+    iteration boundary with ``outcome=timeout``. ``pipeline`` selects
+    the overlapped dispatch/collect loop (default) vs the PR-12 serial
+    loop — identical request semantics, pinned by the golden
+    pipelined==blocking test. ``clock`` is injectable for tests
+    (defaults to the ``cc`` seam's monotonic, so ``paddle race``
+    virtualizes it automatically)."""
 
     def __init__(self, backend, queue_cap: int = 0,
                  request_timeout_s: float = 60.0,
                  clock: Optional[Callable[[], float]] = None,
-                 idle_poll_s: float = 0.02):
+                 idle_poll_s: float = 0.02,
+                 pipeline: bool = True):
         self._backend = backend
         self.queue_cap = int(queue_cap)
         self.request_timeout_s = float(request_timeout_s)
         self.idle_poll_s = float(idle_poll_s)
+        self.pipeline = bool(pipeline)
         self._clock = clock or cc.monotonic
         self._lock = cc.Lock()
         self._wake = cc.Condition(self._lock)
@@ -139,13 +218,18 @@ class Engine:
         # requests between queue-pop and slot placement (the prefill
         # launch runs outside the lock): cancel() must still find them
         self._admitting: List[EngineRequest] = []
-        self._log = slog.RequestLog(engine=ENGINE_NAME)
+        self._ladder = tuple(sorted(set(
+            int(u) for u in (getattr(backend, "decode_blocks", None)
+                             or (getattr(backend, "chunk", 1),))
+        ))) or (1,)
+        self._log = self._fresh_log()
         self._t0 = self._clock()
         self._thread = None
         self._started = False
         self._draining = False
         self._n_submitted = 0
         self._pid = os.getpid()
+        self.warmup_s: Optional[float] = None
 
     # ----------------------------------------------------------- client
 
@@ -157,14 +241,26 @@ class Engine:
     def max_length(self) -> int:
         return self._backend.max_length
 
+    def _fresh_log(self) -> slog.RequestLog:
+        return slog.RequestLog(engine=ENGINE_NAME,
+                               pipeline="on" if self.pipeline else "off")
+
     def start(self) -> "Engine":
         """Warm the backend (all compiles land BEFORE serving — the
-        recompiles=0 acceptance) and spawn the scheduler thread."""
+        recompiles=0 acceptance; every ladder rung is exercised) and
+        spawn the scheduler thread. ``warmup_s`` records the wall cost —
+        with ``--compile_cache_dir`` a warm restart's figure drops to
+        trace time (the time-to-first-token-ready satellite)."""
         with self._lock:
             if self._started:
                 return self
             self._started = True
+        t0 = cc.perf_counter()
         self._backend.warmup()
+        self.warmup_s = cc.perf_counter() - t0
+        from paddle_tpu.observability import metrics as obs
+
+        obs.registry().gauge("serve.warmup_s").set(round(self.warmup_s, 6))
         th = cc.Thread(target=self._loop, name="serve-engine", daemon=True)
         with self._lock:
             self._thread = th
@@ -250,7 +346,7 @@ class Engine:
         """Re-anchor the telemetry window (rung start). Caller must be
         quiescent — in-flight requests would straddle the anchor."""
         with self._lock:
-            self._log = slog.RequestLog(engine=ENGINE_NAME)
+            self._log = self._fresh_log()
             self._t0 = self._clock()
 
     def window_roll(self, offered_rps: float = 0.0, rung: int = 0,
@@ -268,7 +364,7 @@ class Engine:
                 max(window_s if window_s is not None else now, 1e-9),
                 host_share=host_share,
             )
-            self._log = slog.RequestLog(engine=ENGINE_NAME)
+            self._log = self._fresh_log()
             self._t0 = self._clock()
             return rec
 
@@ -332,80 +428,74 @@ class Engine:
                 self._slots[b] = None
                 self._finish_locked(req, "error", now, error=error)
 
-    def _loop(self) -> None:
+    # --------------------------------------------- shared loop phases
+
+    def _boundary(self) -> Tuple[List[int], List[EngineRequest]]:
+        """One iteration boundary under the lock: sweep cancellations
+        and deadlines, reject the queue when draining, pick the FIFO
+        admissions for the free slots."""
+        admit_slots: List[int] = []
+        admit_reqs: List[EngineRequest] = []
+        with self._lock:
+            now = self._now()
+            self._sweep_locked(now)
+            if self._draining:
+                while self._queue:
+                    self._finish_locked(self._queue.popleft(),
+                                        "rejected", now)
+            free = [b for b, r in enumerate(self._slots) if r is None]
+            take = min(len(free), len(self._queue))
+            for j in range(take):
+                admit_slots.append(free[j])
+                admit_reqs.append(self._queue.popleft())
+            self._admitting = admit_reqs
+        return admit_slots, admit_reqs
+
+    def _do_admit(self, admit_slots: List[int],
+                  admit_reqs: List[EngineRequest]) -> bool:
+        """Prefill launch outside the lock (submit() must never block
+        behind device work); place the cohort on success. In pipelined
+        mode the backend dispatches without syncing, so the measured
+        time is enqueue cost — the prefill's device time surfaces at
+        the next collect boundary (doc/serving.md). False = the cohort
+        (and everything in flight) was errored; caller resets."""
         backend = self._backend
-        while True:
-            # --- boundary: evict, reject-on-drain, pick admissions
-            admit_slots: List[int] = []
-            admit_reqs: List[EngineRequest] = []
+        budgets = [
+            max(1, min(backend.max_length if r.max_new is None
+                       else r.max_new, backend.max_length))
+            for r in admit_reqs
+        ]
+        t0 = self._clock()
+        try:
+            backend.admit(admit_slots, admit_reqs, budgets)
+        except Exception as e:  # noqa: BLE001 — cohort gets the evidence
+            err = f"{type(e).__name__}: {e}"
+            logger.error("serve admit failed: %s", err)
             with self._lock:
                 now = self._now()
-                self._sweep_locked(now)
-                if self._draining:
-                    while self._queue:
-                        self._finish_locked(self._queue.popleft(),
-                                            "rejected", now)
-                free = [b for b, r in enumerate(self._slots) if r is None]
-                take = min(len(free), len(self._queue))
-                for j in range(take):
-                    admit_slots.append(free[j])
-                    admit_reqs.append(self._queue.popleft())
-                self._admitting = admit_reqs
-            # --- admit (prefill launch outside the lock: submit() must
-            # never block behind device work)
-            if admit_reqs:
-                budgets = [
-                    max(1, min(backend.max_length if r.max_new is None
-                               else r.max_new, backend.max_length))
-                    for r in admit_reqs
-                ]
-                t0 = self._clock()
-                try:
-                    backend.admit(admit_slots, admit_reqs, budgets)
-                except Exception as e:  # noqa: BLE001 — cohort gets the evidence
-                    err = f"{type(e).__name__}: {e}"
-                    logger.error("serve admit failed: %s", err)
-                    with self._lock:
-                        now = self._now()
-                        for req in admit_reqs:
-                            self._finish_locked(req, "error", now, error=err)
-                        self._admitting = []
-                        self._fail_inflight_locked(now, err)
-                    self._safe_reset()
-                    continue
-                dt = self._clock() - t0
-                with self._lock:
-                    now = self._now()
-                    for b, req, budget in zip(admit_slots, admit_reqs, budgets):
-                        req.slot = b
-                        req.budget = budget
-                        req.t_admit = now
-                        self._slots[b] = req
-                        self._log.admit(req)
-                    self._admitting = []
-                    self._log.note_exec(dt)
-            # --- step or idle
-            with self._lock:
-                occupancy = sum(1 for r in self._slots if r is not None)
-                if occupancy == 0:
-                    if self._draining and not self._queue:
-                        break
-                    if not self._queue:
-                        self._wake.wait(timeout=self.idle_poll_s)
-                    continue
-            t0 = self._clock()
-            try:
-                out = backend.step()
-            except Exception as e:  # noqa: BLE001 — engine survives a bad launch
-                err = f"{type(e).__name__}: {e}"
-                logger.error("serve decode launch failed: %s", err)
-                with self._lock:
-                    self._fail_inflight_locked(self._now(), err)
-                self._safe_reset()
-                continue
-            dt = self._clock() - t0
-            with self._lock:
-                self._apply_step_locked(out, dt, occupancy)
+                for req in admit_reqs:
+                    self._finish_locked(req, "error", now, error=err)
+                self._admitting = []
+                self._fail_inflight_locked(now, err)
+            return False
+        dt = self._clock() - t0
+        with self._lock:
+            now = self._now()
+            for b, req, budget in zip(admit_slots, admit_reqs, budgets):
+                req.slot = b
+                req.budget = budget
+                req.t_admit = now
+                self._slots[b] = req
+                self._log.admit(req)
+            self._admitting = []
+            self._log.note_exec(dt)
+        return True
+
+    def _loop(self) -> None:
+        if self.pipeline:
+            self._loop_pipelined()
+        else:
+            self._loop_blocking()
 
     def _safe_reset(self) -> None:
         try:
@@ -413,27 +503,236 @@ class Engine:
         except Exception as e:  # noqa: BLE001
             logger.error("serve backend reset failed: %s", e)
 
-    def _apply_step_locked(self, out, service_s: float,
-                           occupancy: int) -> None:
-        """Fold one launch's readback into the request lifecycles."""
+    def _block_inputs_locked(self) -> Tuple[int, bool]:
+        """(budget cap, pressure) for :func:`pick_block`, from the
+        engine's view of the slots — under pipelining this lags the
+        device by the in-flight launches, which only over-runs into
+        frozen micro-steps (bounded, harmless)."""
+        cap = 0
+        pressed = bool(self._queue)
+        for req in self._slots:
+            if req is None:
+                continue
+            left = max(req.budget - len(req.tokens), 1)
+            cap = left if cap == 0 else min(cap, left)
+            if req.t_first_token < 0:
+                pressed = True  # a slot still owes its first token
+        return (cap or self._ladder[-1]), pressed
+
+    # ------------------------------------------------- the PR-12 loop
+
+    def _loop_blocking(self) -> None:
+        """The serial loop: boundary → admit (sync) → one blocking
+        step() → apply. Kept verbatim as the pipeline A/B baseline
+        (``pipeline=False`` / PADDLE_TPU_BENCH_SERVE_PIPELINE=off)."""
+        backend = self._backend
+        host_ema = 0.0
+        step_ema = 0.0
+        t_host0 = self._clock()
+        while True:
+            admit_slots, admit_reqs = self._boundary()
+            if admit_reqs and not self._do_admit(admit_slots, admit_reqs):
+                self._safe_reset()
+                t_host0 = self._clock()
+                continue
+            with self._lock:
+                occupancy = sum(1 for r in self._slots if r is not None)
+                if occupancy == 0:
+                    if self._draining and not self._queue:
+                        break
+                    if not self._queue:
+                        self._wake.wait(timeout=self.idle_poll_s)
+                    # idle time is not host overhead: a stale anchor
+                    # here would dump the whole idle stretch into
+                    # host_ema and push pick_block to the top rung
+                    # exactly when a fresh request wants a fast first
+                    # boundary
+                    t_host0 = self._clock()
+                    continue
+                cap, pressed = self._block_inputs_locked()
+            u = pick_block(self._ladder, cap, pressed, host_ema, step_ema)
+            t0 = self._clock()
+            host_ema = (1 - _EMA) * host_ema + _EMA * (t0 - t_host0)
+            try:
+                out = backend.step(block=u)
+            except Exception as e:  # noqa: BLE001 — engine survives a bad launch
+                err = f"{type(e).__name__}: {e}"
+                logger.error("serve decode launch failed: %s", err)
+                with self._lock:
+                    self._fail_inflight_locked(self._now(), err)
+                self._safe_reset()
+                t_host0 = self._clock()
+                continue
+            dt = self._clock() - t0
+            t_host0 = self._clock()
+            step_ema = (1 - _EMA) * step_ema + _EMA * (dt / max(u, 1))
+            with self._lock:
+                self._apply_step_locked(out, dt, occupancy)
+
+    # ----------------------------------------------- the pipelined loop
+
+    def _loop_pipelined(self) -> None:
+        """Boundary and apply work overlap the in-flight launch: each
+        iteration dispatches launch N+1 BEFORE collecting launch N, so
+        the device never waits for host scheduling and the host never
+        waits for a launch it has nothing to say about. ``inflight``
+        holds (cohort snapshot, dispatch time) per launch — loop-local:
+        the only cross-thread state stays the lock-guarded slots/queue."""
+        backend = self._backend
+        inflight: collections.deque = collections.deque()
+        host_ema = 0.0
+        step_ema = 0.0
+        union_end = self._clock()   # union of dispatch->collect spans
+        t_host0 = self._clock()
+        while True:
+            admit_slots, admit_reqs = self._boundary()
+            if admit_reqs and not self._do_admit(admit_slots, admit_reqs):
+                inflight = self._abort_inflight(inflight)
+                # failure handling (logging, reset, device realloc) is
+                # not host overhead — same stale-anchor rule as idle
+                t_host0 = self._clock()
+                continue
+            # --- dispatch launch N+1 (device-ordered after the prefill)
+            with self._lock:
+                occupancy = sum(1 for r in self._slots if r is not None)
+                cohort = [(b, r) for b, r in enumerate(self._slots)
+                          if r is not None]
+                cap, pressed = self._block_inputs_locked()
+                # speculate only when it can pay: if every live slot's
+                # remaining budget is already covered by in-flight
+                # micro-steps, launch N+1 would run all-frozen rows —
+                # pure waste (the short-budget regime) — so collect
+                # first and let the boundary see the finishes. EOS
+                # finishes stay unknowable ahead of time; budgets are
+                # the bound we do know.
+                pending_steps = sum(u for _c, u, _t, _lg in inflight)
+                live_next = any(
+                    r.budget - len(r.tokens) - pending_steps > 0
+                    for _b, r in cohort
+                )
+            dispatched = False
+            if occupancy and (live_next or not inflight):
+                dispatched = True
+                u = pick_block(self._ladder, cap, pressed, host_ema, step_ema)
+                t_disp = self._clock()
+                host_ema = (1 - _EMA) * host_ema + _EMA * (t_disp - t_host0)
+                try:
+                    backend.dispatch(block=u)
+                except Exception as e:  # noqa: BLE001
+                    err = f"{type(e).__name__}: {e}"
+                    logger.error("serve decode dispatch failed: %s", err)
+                    with self._lock:
+                        self._fail_inflight_locked(self._now(), err)
+                    inflight = self._abort_inflight(inflight, err)
+                    t_host0 = self._clock()
+                    continue
+                with self._lock:
+                    # the launch belongs to the CURRENT telemetry
+                    # window: a window_roll between this dispatch and
+                    # its collect closes that window, and the stray
+                    # launch must not leak its exec/overlap into the
+                    # next one (begin_window's quiescence note)
+                    inflight.append((cohort, u, t_disp, self._log))
+                    self._log.note_dispatch(len(inflight))
+            # --- collect launch N while N+1 runs; collect immediately
+            # when nothing was dispatched ahead (tail / no-speculation)
+            if inflight and (len(inflight) > 1 or not dispatched):
+                cohort, u, t_disp, disp_log = inflight[0]
+                t_wait = self._clock()
+                try:
+                    out = backend.collect()
+                except Exception as e:  # noqa: BLE001 — fault surfaces HERE
+                    err = f"{type(e).__name__}: {e}"
+                    logger.error("serve decode launch failed: %s", err)
+                    with self._lock:
+                        self._fail_inflight_locked(self._now(), err)
+                    inflight = self._abort_inflight(inflight, err)
+                    t_host0 = self._clock()
+                    continue
+                inflight.popleft()
+                t_done = self._clock()
+                # exec side of host_share: the UNION of dispatch->done
+                # spans — overlapping spans must not double-count
+                service = max(t_done - max(t_disp, union_end), 0.0)
+                union_end = max(union_end, t_done)
+                # the ladder's device estimate uses the DE-OVERLAPPED
+                # span: the raw dispatch->done time of launch N+1 also
+                # contains its wait behind launch N, which would read
+                # as ~2x the true per-micro-step cost under steady
+                # pipelining and skew pick_block a rung low
+                step_ema = (1 - _EMA) * step_ema + _EMA * (service / max(u, 1))
+                with self._lock:
+                    stale = disp_log is not self._log
+                    if not stale:
+                        self._log.note_overlap(max(t_wait - t_disp, 0.0))
+                    self._log.note_dispatch(len(inflight))
+                    # tokens/finishes always apply (requests legally
+                    # span windows); the launch/overlap/exec accounting
+                    # is skipped when the dispatching window has rolled
+                    # closed — its record is already emitted
+                    self._apply_step_locked(out, service, len(cohort),
+                                            cohort=cohort,
+                                            count_launch=not stale)
+                t_host0 = self._clock()
+                continue
+            # --- idle / exit
+            with self._lock:
+                if not inflight and not any(
+                    r is not None for r in self._slots
+                ):
+                    if self._draining and not self._queue:
+                        break
+                    if not self._queue:
+                        self._wake.wait(timeout=self.idle_poll_s)
+            # anchored AFTER any idle wait: idle seconds are not host
+            # overhead and must not inflate the ladder's host_ema
+            t_host0 = self._clock()
+
+    def _abort_inflight(self, inflight: collections.deque,
+                        error: str = "decode failed") -> collections.deque:
+        """A faulted launch takes every in-flight cohort with it: their
+        results are unrecoverable once the device state resets. Each
+        snapshot request resolves exactly once (`done` guards repeats
+        across overlapping snapshots and the slot sweep)."""
+        with self._lock:
+            now = self._now()
+            for cohort, _u, _t, _lg in inflight:
+                for _b, req in cohort:
+                    self._finish_locked(req, "error", now, error=error)
+            self._log.note_dispatch(0)
+        self._safe_reset()
+        return collections.deque()
+
+    def _apply_step_locked(self, out, service_s: float, occupancy: int,
+                           cohort=None, count_launch: bool = True) -> None:
+        """Fold one launch's readback into the request lifecycles.
+        ``cohort`` (pipelined) is the slot snapshot taken at dispatch:
+        tokens belong to THOSE requests — a slot re-assigned between
+        dispatch and collect must not leak a previous occupant's tokens
+        to the new one (the snapshot discipline); evicted (done)
+        requests just skip."""
         now = self._now()
         tokens, live, finished = out.tokens, out.live, out.finished
         u = tokens.shape[0]
-        for b, req in enumerate(self._slots):
-            if req is None:
+        rows = (cohort if cohort is not None
+                else enumerate(self._slots))
+        for b, req in rows:
+            if req is None or req.done:
                 continue
             emitted = [int(tokens[i, b]) for i in range(u) if bool(live[i, b])]
             if emitted:
                 if req.t_first_token < 0:
                     # REAL wall-clock TTFT: this readback is the moment
                     # the first token left the device — mid-sequence,
-                    # not at finish
+                    # not at finish (and, pipelined, at the COLLECT
+                    # boundary: the earliest the host can know)
                     req.t_first_token = now
                 req.tokens.extend(emitted)
-            if bool(finished[b]):
+            if bool(finished[b]) and self._slots[b] is req:
                 self._slots[b] = None
                 self._finish_locked(req, "ok", now)
-        self._log.launch(len(self._queue), occupancy, service_s)
+        if count_launch:
+            self._log.launch(len(self._queue), occupancy, service_s)
 
 
 # ------------------------------------------------------------- driver

@@ -3,11 +3,14 @@
 stdin-JSONL in, JSONL out: each input line is a request
 (``{"id": ..., "prompt": [token ids...], "max_new_tokens": N}`` — or a
 bare JSON list as the prompt), each output line its result
-(``{"id", "outcome", "tokens"}``) in SUBMISSION order. SIGTERM (and
-SIGINT) trigger a graceful drain: in-flight sequences finish, queued
-and later requests are rejected, every pending result line is still
-printed, and when telemetry is on (``--metrics_path``/``--save_dir``)
-the stream closes with ``run_end status=completed`` as its LAST record.
+(``{"id", "outcome", "tokens"}``) in SUBMISSION order. Plain stdin EOF
+is a BATCH: every accepted request completes and gets its result line
+before exit (``paddle serve < requests.jsonl`` answers the whole
+file). SIGTERM (and SIGINT) trigger a graceful drain instead:
+in-flight sequences finish, queued and later requests are rejected,
+every pending result line is still printed. Either way, when
+telemetry is on (``--metrics_path``/``--save_dir``) the stream closes
+with ``run_end status=completed`` as its LAST record.
 
 The in-process Python API is :func:`build_engine` + the returned
 :class:`~paddle_tpu.serving.engine.Engine`'s ``submit``/``result``
@@ -27,19 +30,24 @@ from paddle_tpu.utils import concurrency as cc
 
 def build_engine(machine, params, *, slots: int = 8,
                  prompt_tokens: int = 32, queue_cap: int = 0,
-                 request_timeout_s: float = 60.0, decode_block: int = 1,
-                 max_length: Optional[int] = None, registry=None):
+                 request_timeout_s: float = 60.0, decode_block=1,
+                 max_length: Optional[int] = None, registry=None,
+                 pipeline: bool = True, fused_step: bool = False):
     """Wire a :class:`JaxDecodeBackend` + :class:`Engine` for a core
-    graph machine (the in-process serving API). Caller starts it."""
+    graph machine (the in-process serving API). Caller starts it.
+    ``decode_block`` takes the ladder spelling ("1,2,4,8" or an int);
+    ``pipeline`` selects the overlapped dispatch/collect loop;
+    ``fused_step`` the extracted attention-GRU step (doc/serving.md)."""
     from paddle_tpu.serving.engine import Engine
     from paddle_tpu.serving.jax_backend import JaxDecodeBackend
 
     backend = JaxDecodeBackend(
         machine, params, slots=slots, prompt_tokens=prompt_tokens,
         max_length=max_length, decode_block=decode_block, registry=registry,
+        pipeline=pipeline, fused_step=fused_step,
     )
     return Engine(backend, queue_cap=queue_cap,
-                  request_timeout_s=request_timeout_s)
+                  request_timeout_s=request_timeout_s, pipeline=pipeline)
 
 
 def _parse_line(line: str, n: int) -> Tuple[Optional[Dict[str, Any]], str]:
@@ -68,7 +76,17 @@ def main(rest: List[str]) -> int:
     if leftover:
         print(f"warning: unrecognized flags {leftover}", file=sys.stderr)
     if not FLAGS.use_tpu:
+        # before ANYTHING imports jax (jax reads JAX_PLATFORMS once at
+        # import), and therefore before the compile-cache block below
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if FLAGS.compile_cache_dir:
+        # warm serve restarts skip the XLA backend compile of
+        # serve_prefill/serve_decode — the compile records land with
+        # cache_hit=true and Engine.start()'s warmup (time-to-first-
+        # token-ready) drops to trace time (ROADMAP item 5 for serving)
+        from paddle_tpu.observability.compile_log import enable_compile_cache
+
+        enable_compile_cache(FLAGS.compile_cache_dir)
     if not FLAGS.config:
         print("error: --config is required", file=sys.stderr)
         return 2
@@ -100,13 +118,17 @@ def main(rest: List[str]) -> int:
             request_timeout_s=FLAGS.serve_request_timeout,
             decode_block=FLAGS.serve_decode_block,
             registry=registry,
+            pipeline=FLAGS.serve_pipeline,
+            fused_step=FLAGS.serve_fused_step,
         )
     except UnsupportedModelError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
     engine.start()
     print(f"# paddle serve: {engine.slots} slot(s), max_length "
-          f"{engine.max_length}, decode block {FLAGS.serve_decode_block} — "
+          f"{engine.max_length}, decode blocks {FLAGS.serve_decode_block}, "
+          f"pipeline {'on' if FLAGS.serve_pipeline else 'off'}"
+          f"{', fused step' if FLAGS.serve_fused_step else ''} — "
           "reading JSONL requests from stdin", file=sys.stderr)
 
     drain = cc.Event()
@@ -166,6 +188,26 @@ def main(rest: List[str]) -> int:
     while not (eof.is_set() or drain.is_set()):
         _flush_pending(block=False)
         eof.wait(timeout=0.05)
+    # plain EOF is a BATCH, not an abort: the client piped its whole
+    # request file (`paddle serve < requests.jsonl`) and every accepted
+    # request owes a real answer — wait the pending futures out while
+    # the engine works the queue down. A signal arriving mid-batch
+    # falls through to the drain below (in-flight finish, queued
+    # reject), so SIGTERM semantics are unchanged.
+    while not drain.is_set():
+        with plock:
+            if not pending:
+                break
+            fut = pending[0][1]
+        if fut.done():
+            _flush_pending(block=False)
+        elif engine._thread is None or not engine._thread.is_alive():
+            # a dead scheduler can never resolve these futures: fall
+            # through to the drain + bounded blocking flush, which
+            # fails loudly instead of spinning here forever
+            break
+        else:
+            drain.wait(timeout=0.05)
     # graceful drain: finish in-flight, reject queued + new, then print
     # every remaining result (rejections included — the client hears).
     # First give the reader a bounded window to submit lines the client

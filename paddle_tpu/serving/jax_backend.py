@@ -1,5 +1,6 @@
 """The production decode backend: donated slot state on device, one
-jitted launch per engine iteration.
+jitted launch per engine iteration — dispatched and collected as two
+halves so the device never waits for the host.
 
 Device state is a single pytree of fixed-shape ``[B, ...]`` buffers for
 ``B = --serve_slots`` concurrent sequences — the captured static-link
@@ -13,12 +14,26 @@ routed through the PR-7 :class:`CompileRegistry`:
 - launch group ``serve_prefill`` — ONE ``[B, T]`` signature: the full
   graph forward in gen-capture mode (graph/decode_step.py) over a
   padded admission batch, scattered into the named slots (sentinel
-  indices drop, so partial admissions reuse the same signature).
-- launch group ``serve_decode`` — ONE ``[B, ...]`` signature: a
-  ``decode_block``-step ``fori_loop`` of the greedy per-step decoder,
-  with EOS / budget termination folded into the device ``finished``
-  flags. Zero recompiles after warmup is acceptance-checked like PR 8's
-  ``serve_gen``.
+  indices drop, so partial admissions reuse the same signature). In
+  pipelined mode the admission launch is dispatch-only — the PR-12
+  ``block_until_ready`` is gone, so admitting never stalls an in-flight
+  decode; its device time surfaces inside the next decode collect span.
+- launch group ``serve_decode`` — ONE ``[B, ...]`` signature for the
+  WHOLE decode-block ladder: the block size ``u`` is a traced scalar
+  bound on the device ``fori_loop`` (token/live buffers are sized to
+  the ladder's top rung), so every rung shares one compiled executable
+  and recompiles stay 0 across the ladder by construction — stronger
+  than one pre-warmed signature per rung, which would show up as
+  ``recompiles>0`` group churn in the compile telemetry.
+
+``dispatch()`` enqueues the decode launch and immediately starts
+``copy_to_host_async`` on its token/live/finished outputs — the PR-5
+snapshot discipline: every transfer is on the wire before the first
+``collect()`` blocks. ``collect()`` gathers the oldest in-flight
+launch; exec time is attributed THERE, as the union of dispatch→done
+spans (overlapping spans must not double-count device seconds), and a
+launch fault also surfaces there — exactly where the engine's
+cohort-error path expects it.
 
 Evicted-but-unreplaced slots need no device call: a finished (or
 abandoned) row's flag freezes it, an abandoned live row self-terminates
@@ -28,11 +43,12 @@ wholesale.
 
 from __future__ import annotations
 
-from typing import Any, List, Optional, Sequence
+import collections
+from typing import Any, List, Optional, Sequence, Union
 
 import numpy as np
 
-from paddle_tpu.serving.backend import StepOut
+from paddle_tpu.serving.backend import StepOut, parse_decode_blocks
 from paddle_tpu.utils import concurrency as cc
 
 
@@ -46,13 +62,15 @@ class JaxDecodeBackend:
     GROUP_PREFILL = "serve_prefill"
 
     def __init__(self, machine, params, slots: int, prompt_tokens: int,
-                 max_length: Optional[int] = None, decode_block: int = 1,
-                 registry=None, feed_name: Optional[str] = None):
+                 max_length: Optional[int] = None,
+                 decode_block: Union[int, str, Sequence[int]] = 1,
+                 registry=None, feed_name: Optional[str] = None,
+                 pipeline: bool = True, fused_step: bool = False):
         import jax
         import jax.numpy as jnp
 
         from paddle_tpu.graph.decode_step import (
-            capture_prefill, make_greedy_step, plan_of,
+            capture_prefill, make_greedy_step, plan_fused_step, plan_of,
         )
 
         self._jax, self._jnp = jax, jnp
@@ -66,12 +84,15 @@ class JaxDecodeBackend:
         self.prompt_tokens = int(prompt_tokens)
         self.max_length = min(int(max_length or plan.max_length),
                               plan.max_length)
-        self.decode_block = max(int(decode_block), 1)
+        self.decode_blocks = parse_decode_blocks(decode_block)
+        self.max_block = self.decode_blocks[-1]
+        self.pipeline = bool(pipeline)
         self._registry = registry
         # exec attribution gate: warmup flips it on; callers measuring
         # calibration passes may toggle it off so those launches stay
         # out of the serve roofline (the static leg's serving_now rule)
         self.serving = False
+        self._warmed = False
         names = list(machine.network.input_layer_names)
         if feed_name is None:
             if len(names) != 1:
@@ -82,10 +103,26 @@ class JaxDecodeBackend:
             feed_name = names[0]
         self._feed_name = feed_name
         self._capture = capture_prefill
-        self._step = make_greedy_step(machine, plan)
+        fused_plan = None
+        if fused_step:
+            fused_plan, why = plan_fused_step(machine, plan)
+            if fused_plan is None:
+                raise UnsupportedModelError(
+                    f"--serve_fused_step: {why} (the unfused per-step "
+                    "decoder still serves this model)"
+                )
+        self.fused_step = fused_plan is not None
+        self._step = make_greedy_step(machine, plan, fused_plan=fused_plan)
         self._prefill_jit = jax.jit(self._prefill_write, donate_argnums=(1,))
         self._decode_jit = jax.jit(self._decode, donate_argnums=(1,))
         self._state = self._fresh_state()
+        # dispatched-but-uncollected decode launches: (device arrays
+        # with host copies in flight, block, dispatch wall time)
+        self._inflight: collections.deque = collections.deque()
+        # union-of-spans anchor: exec seconds must not double-count
+        # overlapping dispatch->done spans (doc/performance.md
+        # "Pipelined decode")
+        self._exec_anchor = cc.perf_counter()
 
     # ------------------------------------------------------- jitted fns
 
@@ -126,27 +163,30 @@ class JaxDecodeBackend:
                 budgets.astype(jnp.int32), mode="drop"),
         }
 
-    def _decode(self, params, state):
-        """One iteration: ``decode_block`` greedy micro-steps over all
-        slots, EOS/budget termination on device."""
+    def _decode(self, params, state, u):
+        """One iteration: ``u`` greedy micro-steps over all slots,
+        EOS/budget termination on device. ``u`` is a TRACED scalar: the
+        ladder's rungs all run through this one compiled executable
+        (buffers sized to the top rung; rows past ``u`` stay dead)."""
         jax, jnp = self._jax, self._jnp
-        u, B = self.decode_block, self.slots
-        statics, budget = state["statics"], state["budget"]
+        um, B = self.max_block, self.slots
+        budget = state["budget"]
 
         def body(i, acc):
             carries, prev, fin, steps, toks, lives = acc
             live = ~fin
-            carries, tok, fin = self._step(params, statics, carries, prev, fin)
+            carries, tok, fin = self._step(params, state["statics"], carries,
+                                           prev, fin)
             steps = steps + live.astype(jnp.int32)
             fin = fin | (steps >= budget)
             return (carries, tok, fin, steps,
                     toks.at[i].set(tok), lives.at[i].set(live))
 
         init = (state["carries"], state["prev_tok"], state["finished"],
-                state["steps"], jnp.zeros((u, B), jnp.int32),
-                jnp.zeros((u, B), bool))
+                state["steps"], jnp.zeros((um, B), jnp.int32),
+                jnp.zeros((um, B), bool))
         carries, prev, fin, steps, toks, lives = jax.lax.fori_loop(
-            0, u, body, init)
+            0, jnp.minimum(u, um), body, init)
         new_state = dict(state, carries=carries, prev_tok=prev,
                          finished=fin, steps=steps)
         return new_state, toks, lives, fin
@@ -179,20 +219,45 @@ class JaxDecodeBackend:
 
     def warmup(self) -> None:
         """Pay both compiles before serving: a no-slot prefill (all
-        sentinel indices) and one decode launch over the all-finished
-        state — zero slot effects, so compile records land with
-        ``recompiles=0`` and serving never recompiles."""
-        jnp = self._jnp
+        sentinel indices) and one decode launch PER LADDER RUNG over the
+        all-finished state — zero slot effects. The block bound is a
+        traced scalar, so the rung launches all hit the one compiled
+        ``serve_decode`` signature: compile records land with
+        ``recompiles=0`` and serving never recompiles, whatever rung
+        the adaptive policy picks. Idempotent: a second call (bench
+        warms the backend itself before ``Engine.start()`` re-runs it,
+        possibly with ``serving`` already flipped on) is a no-op — the
+        rung launches must never land in the serve roofline as real
+        exec."""
+        if self._warmed:
+            self.serving = True
+            return
+        self.serving = False
         B, T = self.slots, self.prompt_tokens
         self._admit_call(
             np.zeros((B, T), np.int32), np.ones((B,), np.int32),
             np.full((B,), B, np.int32), np.zeros((B,), np.int32),
         )
-        self._step_call()
+        for u in self.decode_blocks:
+            self.step(block=u)
+        if self._registry is not None:
+            # warmup launches never reach note_exec (serving is off), so
+            # the registry's pending compile-cost deduction would zero
+            # the FIRST real launch's exec time instead — discard it
+            self._registry.drop_pending(self.GROUP_PREFILL, self._sig_prefill())
+            self._registry.drop_pending(self.GROUP_DECODE, self._sig_decode())
+        self._warmed = True
         self.serving = True
 
     def reset(self) -> None:
         self._state = self._fresh_state()
+        self._inflight.clear()
+
+    def _sig_prefill(self):
+        return (self.slots, self.prompt_tokens)
+
+    def _sig_decode(self):
+        return (self.slots, self.prompt_tokens, self.max_block)
 
     def admit(self, slot_ids: Sequence[int], requests: Sequence[Any],
               budgets: Sequence[int]) -> None:
@@ -215,37 +280,71 @@ class JaxDecodeBackend:
         t0 = cc.perf_counter()
         args = (self.params, self._state, jnp.asarray(ids),
                 jnp.asarray(lens), jnp.asarray(idx), jnp.asarray(budg))
-        key = (self.slots, self.prompt_tokens)
+        key = self._sig_prefill()
         if self._registry is not None:
             self._state = self._registry.call(
                 self.GROUP_PREFILL, key, self._prefill_jit, *args)
         else:
             self._state = self._prefill_jit(*args)
-        self._jax.block_until_ready(self._state["steps"])
+        if not self.pipeline:
+            # the PR-12 serial path: admission waits for the prefill, so
+            # its measured span IS device time. Pipelined mode never
+            # syncs here — the admission must not stall an in-flight
+            # decode; the prefill's device time surfaces inside the next
+            # decode collect span instead (doc/serving.md)
+            self._jax.block_until_ready(self._state["steps"])
         if self._registry is not None and self.serving:
             self._registry.note_exec(self.GROUP_PREFILL, key,
                                      cc.perf_counter() - t0)
 
-    def step(self) -> StepOut:
-        return self._step_call()
-
-    def _step_call(self) -> StepOut:
+    def dispatch(self, block: Optional[int] = None) -> None:
+        """Enqueue one decode launch and start the device->host copies
+        of its outputs — no waiting. Every output's copy is on the wire
+        before anyone collects (the PR-5 all-dispatch-then-collect
+        snapshot discipline)."""
+        jnp = self._jnp
+        u = int(block) if block else self.max_block
         t0 = cc.perf_counter()
-        key = (self.slots, self.prompt_tokens, self.decode_block)
+        args = (self.params, self._state, jnp.asarray(u, jnp.int32))
         if self._registry is not None:
             out = self._registry.call(
-                self.GROUP_DECODE, key, self._decode_jit,
-                self.params, self._state)
+                self.GROUP_DECODE, self._sig_decode(), self._decode_jit,
+                *args)
         else:
-            out = self._decode_jit(self.params, self._state)
+            out = self._decode_jit(*args)
         self._state, toks, lives, fin = out
-        # the one per-iteration device sync: the emitted tokens ARE the
-        # scheduler's input (EOS eviction, TTFT stamping)
+        for arr in (toks, lives, fin):
+            try:
+                arr.copy_to_host_async()
+            except AttributeError:  # non-PJRT array stand-ins (tests)
+                break
+        self._inflight.append((toks, lives, fin, u, t0))
+
+    @property
+    def inflight(self) -> int:
+        return len(self._inflight)
+
+    def collect(self) -> StepOut:
+        """Gather the oldest in-flight launch. The np.asarray readbacks
+        are the one sanctioned device sync of the serve loop: the
+        emitted tokens ARE the scheduler's input (EOS eviction, TTFT
+        stamping), and exec/TTFT attribution happens at THIS boundary —
+        the only honest place under overlap."""
+        toks, lives, fin, u, t_disp = self._inflight.popleft()
         toks_np = np.asarray(toks)
         lives_np = np.asarray(lives)
         fin_np = np.asarray(fin)
+        t_done = cc.perf_counter()
         if self._registry is not None and self.serving:
-            self._registry.note_exec(self.GROUP_DECODE, key,
-                                     cc.perf_counter() - t0,
-                                     batches=self.decode_block)
+            # union of dispatch->done spans: launch N+1 was dispatched
+            # while N ran, so anchoring at max(dispatch, previous done)
+            # keeps summed exec seconds <= wall seconds
+            span = max(t_done - max(t_disp, self._exec_anchor), 0.0)
+            self._registry.note_exec(self.GROUP_DECODE, self._sig_decode(),
+                                     span, batches=u)
+        self._exec_anchor = max(self._exec_anchor, t_done)
         return StepOut(tokens=toks_np, live=lives_np, finished=fin_np)
+
+    def step(self, block: Optional[int] = None) -> StepOut:
+        self.dispatch(block=block)
+        return self.collect()

@@ -156,13 +156,23 @@ class _Flags:
     # the next iteration boundary (outcome=timeout);
     # serve_prompt_tokens is the fixed prompt padding width (ONE
     # prefill signature — longer prompts truncate); serve_decode_block
-    # is the number of decode micro-steps per launch (amortizes
-    # dispatch; admission/eviction happen at block boundaries)
+    # is the decode-block LADDER — decode micro-steps per launch, a
+    # single int or a comma list like "1,2,4,8" the engine's adaptive
+    # policy picks from per iteration (amortizes dispatch; admission/
+    # eviction happen at block boundaries; one compiled signature
+    # covers the whole ladder); serve_pipeline overlaps host scheduling
+    # with the in-flight decode launch (dispatch/collect split — off =
+    # the serial PR-12 loop, the A/B baseline); serve_fused_step swaps
+    # the per-step graph walk for the extracted attention-GRU step math
+    # (ops/pallas_attention_gru.attention_gru_step; template-matched,
+    # token-parity-pinned, refuses non-matching models loudly)
     serve_slots: int = 8
     serve_queue_cap: int = 0
     serve_request_timeout: float = 60.0
     serve_prompt_tokens: int = 32
-    serve_decode_block: int = 1
+    serve_decode_block: str = "1"
+    serve_pipeline: bool = True
+    serve_fused_step: bool = False
     # rng
     seed: int = 1
     # distributed (multi-host jax)

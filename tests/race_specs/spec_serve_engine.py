@@ -1,4 +1,6 @@
-"""Race spec: serve-engine submit / cancel / evict / drain.
+"""Race spec: serve-engine submit / cancel / evict / drain — explored
+over BOTH scheduler loops (pipelined dispatch/collect and the serial
+baseline).
 
 Drives the REAL continuous-batching engine (paddle_tpu/serving/engine)
 over the deterministic FakeBackend under explored interleavings:
@@ -11,14 +13,23 @@ over the deterministic FakeBackend under explored interleavings:
    reject everything, and leave no future unresolved;
 3. a second engine whose first decode launch faults — the in-flight
    cohort resolves ``outcome=error``, the engine stays alive, later
-   requests complete, drain terminates.
+   requests complete, drain terminates. Pipelined, the fault surfaces
+   at COLLECT (jax async-dispatch semantics, modeled by FakeBackend)
+   and must also error every other in-flight snapshot exactly once.
+
+The pipelined loop adds a new shared hand-off: each dispatched launch
+carries a SNAPSHOT of its slot cohort, applied at collect while
+``submit``/``cancel``/``drain`` callers mutate the same request objects
+— the schedules explore cancels and drains landing between a dispatch
+and its collect (the snapshot must skip ``done`` requests, never
+double-resolve, never lose one).
 
 Invariants (the no-lost / no-double-completed contract):
 - every submitted request's future resolves EXACTLY once (a second
   ``_resolve`` would return False and is asserted against),
 - every outcome is terminal and legal,
 - an ``ok`` result carries exactly its budgeted token count,
-- both drains return within the schedule.
+- every drain returns within the schedule.
 """
 
 import logging
@@ -37,7 +48,11 @@ def run(ctx):
     prev_level = logger.level
     logger.setLevel(logging.CRITICAL)
     try:
-        _run(ctx)
+        # both scheduler loops under the same schedules: the pipelined
+        # one exercises the in-flight-cohort snapshot hand-off, the
+        # blocking one pins the PR-12 baseline unchanged
+        _run(ctx, pipeline=True)
+        _run(ctx, pipeline=False)
     finally:
         logger.setLevel(prev_level)
 
@@ -76,11 +91,11 @@ def _check_all(futs, doubles):
     assert not doubles, f"double-completed requests: {doubles}"
 
 
-def _run(ctx):
+def _run(ctx, pipeline=True):
     # --- phase 1+2: concurrent submit/cancel, then drain-under-load
     backend = FakeBackend(slots=2, max_length=4, step_delay_s=0.05)
     engine = Engine(backend, queue_cap=0, request_timeout_s=30.0,
-                    idle_poll_s=0.2)
+                    idle_poll_s=0.2, pipeline=pipeline)
     ctx.static_watch(engine)
     doubles = _watchful_futures(ctx, engine)
     engine.start()
@@ -111,7 +126,8 @@ def _run(ctx):
 
     # --- phase 3: decode fault mid-load — error the cohort, survive
     backend2 = FakeBackend(slots=2, max_length=4, fail_at_launch=1)
-    engine2 = Engine(backend2, request_timeout_s=30.0, idle_poll_s=0.2)
+    engine2 = Engine(backend2, request_timeout_s=30.0, idle_poll_s=0.2,
+                     pipeline=pipeline)
     ctx.static_watch(engine2)
     doubles2 = _watchful_futures(ctx, engine2)
     engine2.start()

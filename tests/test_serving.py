@@ -486,6 +486,45 @@ def test_compare_serve_bench_artifacts(tmp_path):
     assert doc["verdict"] == "REGRESSION"
 
 
+def test_compare_pipeline_modes_never_cross_join(tmp_path):
+    """The rung join is (engine, pipeline, offered load): one artifact
+    carrying BOTH a blocking and a pipelined sweep of the same rate
+    ladder keeps the modes apart (pipeline-qualified keys, never a
+    blocking-vs-pipelined rung diffed against itself), and two such
+    artifacts join mode-to-mode regardless of sweep order."""
+    from paddle_tpu.observability.compare import compare, load_side
+
+    def artifact(name, order):
+        rungs = []
+        for mode in order:
+            rungs.append({
+                "offered_rps": 50.0, "p50_ms": 2.0, "p99_ms": 5.0,
+                "goodput_tok_s": 4000.0 if mode == "off" else 5000.0,
+                "engine": "continuous", "pipeline": mode,
+            })
+        p = tmp_path / name
+        p.write_text(json.dumps({
+            "metric": "serve_cpu_smoke_goodput_tokens_per_sec",
+            "value": 5000.0, "unit": "tokens/s", "vs_baseline": 1.0,
+            "rungs": rungs,
+        }))
+        return str(p)
+
+    # sweep order differs between the artifacts — the deterministic
+    # (engine, pipeline)-sorted key assignment must still join
+    # off-to-off and on-to-on
+    doc = compare(load_side(artifact("a.json", ("off", "on"))),
+                  load_side(artifact("b.json", ("on", "off"))))
+    by = {m["metric"]: m["verdict"] for m in doc["metrics"]}
+    joined = [k for k in by if k.startswith("serve.") and "rps." in k]
+    assert len(joined) >= 4, by
+    # identical values mode-to-mode: every joined rung metric is SAME —
+    # a crosswise join would read the structural off-vs-on goodput gap
+    # (4000 vs 5000, 25%) as a verdict
+    assert all(by[k] == "SAME" for k in joined), by
+    assert not doc["only_a"] and not doc["only_b"], doc
+
+
 # ------------------------------------------------------- embedding API
 
 
