@@ -686,7 +686,8 @@ def _serve_sweep_static(gm, params, registry, *, group, rates, B, T,
 def _serve_sweep_continuous(gm, params, registry, *, rates, B, T,
                             max_length, n_requests, seed, timeout_s,
                             queue_cap, decode_block, prompt_fn, budget_fn,
-                            pipeline=True, fused_step=False):
+                            pipeline=True, fused_step=False,
+                            shed_policy="off"):
     """The continuous-batching engine (paddle_tpu/serving/) on the SAME
     seeded workload, driven open-loop in wall-clock time. ``pipeline``
     selects the overlapped dispatch/collect loop vs the serial PR-12
@@ -724,7 +725,8 @@ def _serve_sweep_continuous(gm, params, registry, *, rates, B, T,
         rates = [round(f * capacity_rps, 4) for f in (0.25, 0.5, 1.0, 2.0)]
 
     engine = Engine(backend, queue_cap=queue_cap,
-                    request_timeout_s=timeout_s, pipeline=pipeline).start()
+                    request_timeout_s=timeout_s, pipeline=pipeline,
+                    shed_policy=shed_policy).start()
     try:
         windows = []
         for i, rate in enumerate(rates):
@@ -828,6 +830,10 @@ def bench_serve(B=None, T=None, vocab=None, dim=None, beam_size=None,
             pipeline = (not on_cpu) or cores > 1
     if fused_step is None:
         fused_step = env("PADDLE_TPU_BENCH_SERVE_FUSED", "0") == "1"
+    # overload defense for the shed-on-vs-off A/B
+    # (PADDLE_TPU_BENCH_SERVE_SHED=off|deadline|brownout, continuous
+    # engine only — the static driver has no admission estimator)
+    shed_policy = env("PADDLE_TPU_BENCH_SERVE_SHED", "off")
     # 0 is a LEGAL deadline (drop everything not admitted immediately)
     # — None, not falsiness, is the unset sentinel
     if timeout_s is None:
@@ -876,7 +882,7 @@ def bench_serve(B=None, T=None, vocab=None, dim=None, beam_size=None,
             timeout_s=timeout_s, queue_cap=queue_cap,
             decode_block=decode_block, prompt_fn=prompt_fn,
             budget_fn=budget_fn, pipeline=bool(pipeline),
-            fused_step=bool(fused_step),
+            fused_step=bool(fused_step), shed_policy=shed_policy,
         )
         beam_size = 1  # the engine decodes greedily (doc/serving.md)
     else:
@@ -903,9 +909,21 @@ def bench_serve(B=None, T=None, vocab=None, dim=None, beam_size=None,
     rungs = [
         {
             "offered_rps": w.get("offered_rps"),
+            "arrived": w.get("arrived"),
             "completed": w.get("completed"),
             "rejected": w.get("rejected"),
             "timeouts": w.get("timeouts"),
+            "shed": w.get("shed", 0),
+            "errors": w.get("errors", 0),
+            # overload-defense rates ride the archived artifact so
+            # `paddle compare` can judge shed/error growth without the
+            # telemetry run dir (zero-filled there for older artifacts)
+            "shed_rate": (round((w.get("shed", 0) or 0)
+                                / float(w["arrived"]), 6)
+                          if w.get("arrived") else 0.0),
+            "error_rate": (round((w.get("errors", 0) or 0)
+                                 / float(w["arrived"]), 6)
+                           if w.get("arrived") else 0.0),
             "p50_ms": round((w.get("latency") or {}).get("p50", 0.0) * 1e3, 3),
             "p99_ms": round((w.get("latency") or {}).get("p99", 0.0) * 1e3, 3),
             "ttft_p50_ms": round((w.get("ttft") or {}).get("p50", 0.0) * 1e3, 3),
@@ -939,6 +957,8 @@ def bench_serve(B=None, T=None, vocab=None, dim=None, beam_size=None,
         extras["decode_blocks"] = str(decode_block)
         if fused_step:
             extras["fused_step"] = True
+        if shed_policy != "off":
+            extras["shed_policy"] = shed_policy
     # memory trajectory for the serve leg too: the sweep's live HBM
     # peak (absent on stat-less backends) and the serve_gen group's
     # static plan from its one compile

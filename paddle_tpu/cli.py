@@ -28,8 +28,8 @@ def main(argv=None) -> int:
         print(__doc__)
         print("usage: paddle <train|supervise|test|gen|serve|checkgrad|"
               "dump_config|merge_model|check-checkpoint|metrics|memory|"
-              "roofline|compare|serve-report|lint|race|faults|version> "
-              "[--flags]")
+              "roofline|compare|serve-report|serve-status|lint|race|"
+              "faults|version> [--flags]")
         return 0
     cmd, rest = argv[0], argv[1:]
     if cmd == "version":
@@ -80,6 +80,13 @@ def main(argv=None) -> int:
         from paddle_tpu.serving.frontend import main as serve_main
 
         return serve_main(rest)
+    if cmd in ("serve-status", "serve_status"):
+        # render a `paddle serve --status_path` health snapshot
+        # (queue depth, occupancy, last-collect age, shed/error totals,
+        # draining flag) — jax-free: the probe side runs anywhere
+        from paddle_tpu.serving.resilience import status_main
+
+        return status_main(rest)
     if cmd in ("serve-report", "serve_report"):
         # per-offered-load serving report (request/serve_window records
         # from `bench.py serve`, doc/observability.md) — jax-free
@@ -207,11 +214,14 @@ def _run_trainer_job(cmd, rest) -> int:
 
 
 def _supervise(rest) -> int:
-    """`paddle supervise <train flags>` — run `paddle train` as a
-    supervised child: restart with backoff + `--init_model_path=auto` on
-    nonzero exit (bounded by --restart_budget), stop with a JSON crash
-    report on a crash loop, forward SIGTERM so preemption still
-    checkpoints. `--dry_run` prints the child command and policy.
+    """`paddle supervise <train flags>` — run `paddle train` (or, with
+    `--supervise_job=serve`, `paddle serve`) as a supervised child:
+    restart with backoff on nonzero exit (bounded by --restart_budget;
+    train children resume via `--init_model_path=auto`, serve children
+    re-offer their `--serve_journal_path` queue themselves), stop with
+    a JSON crash report on a crash loop, forward SIGTERM so preemption
+    still checkpoints/drains. `--dry_run` prints the child command and
+    policy.
 
     The supervisor itself never initializes jax (a dead child must be
     restartable even when the accelerator runtime is what killed it), so
@@ -222,6 +232,10 @@ def _supervise(rest) -> int:
     leftover = FLAGS.parse(list(rest))
     if leftover:
         print(f"warning: unrecognized flags {leftover}", file=sys.stderr)
+    if FLAGS.supervise_job not in ("train", "serve"):
+        print(f"error: --supervise_job={FLAGS.supervise_job!r} (expected "
+              "train or serve)", file=sys.stderr)
+        return 2
     from paddle_tpu.resilience.supervisor import Supervisor
 
     return Supervisor(rest, FLAGS).run()

@@ -121,6 +121,12 @@ def _higher_is_better(name: str) -> bool:
     if name in _HIGHER_BETTER:
         return _HIGHER_BETTER[name]
     n = name.lower()
+    # per-rung overload-defense rates (shed = policy refusals, error =
+    # failed launches): growth is a serving regression. Checked before
+    # the generic suffix rules — neither matches "_s"/"latency", and
+    # the throughput default would judge them backwards
+    if n.endswith(("shed_rate", "error_rate")):
+        return False
     # lint/race metrics are finding counts: fewer is always better (and
     # the bare rule/detector ids would otherwise fall through to the
     # throughput default below)
@@ -232,6 +238,16 @@ def _run_side(path: str) -> Dict[str, float]:
         if isinstance(w.get("queue_wait_share"), (int, float)):
             out[_engine_scoped(pre, engine, "queue_wait_share")] = float(
                 w["queue_wait_share"])
+        # overload-defense rates, ZERO-FILLED when the window predates
+        # them (pre-shed artifacts carry no `shed` field): both sides
+        # then share the keys, and 0 -> N shed/error growth gets a
+        # REGRESSION verdict instead of landing invisibly in only_b
+        arrived = w.get("arrived")
+        if isinstance(arrived, (int, float)) and arrived > 0:
+            out[pre + "shed_rate"] = round(
+                float(w.get("shed", 0) or 0) / float(arrived), 6)
+            out[pre + "error_rate"] = round(
+                float(w.get("errors", 0) or 0) / float(arrived), 6)
     if windows:
         from paddle_tpu.observability.serving import saturation_knee
 
@@ -318,6 +334,15 @@ def _bench_side(path: str, raw: str) -> Dict[str, float]:
         v = r.get("queue_wait_share")
         if isinstance(v, (int, float)) and not isinstance(v, bool):
             out[_engine_scoped(pre, engine, "queue_wait_share")] = float(v)
+        # zero-filled like the run-dir side: pre-shed bench artifacts
+        # (no shed_rate field) still join, with 0 -> N judged
+        for key in ("shed_rate", "error_rate"):
+            v = r.get(key)
+            out[pre + key] = (
+                float(v)
+                if isinstance(v, (int, float)) and not isinstance(v, bool)
+                else 0.0
+            )
     if isinstance(line.get("knee_rps"), (int, float)):
         out["serve_knee_rps"] = float(line["knee_rps"])
     for leg, payload in (line.get("legs") or {}).items():

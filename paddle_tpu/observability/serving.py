@@ -174,6 +174,8 @@ class RequestLog:
         self.timeouts = 0
         self.cancels = 0
         self.errors = 0
+        self.sheds = 0
+        self.breaker_opens = 0
         self.launches = 0
         self.exec_s = 0.0
         self.gen_tokens = 0
@@ -258,6 +260,33 @@ class RequestLog:
             extra["service_s"] = round(float(service_s), 6)
         self._emit(req, **extra)
 
+    def shed(self, req: Request, vnow: float, arrived: bool = False,
+             retry_after_s: Optional[float] = None) -> None:
+        """Overload shedding (doc/resilience.md "Serving resilience"):
+        the server refused this request as a POLICY decision — brownout
+        pressure, an open launch-failure breaker, or a deadline the
+        admission estimate proves unmeetable — distinct from
+        ``rejected`` (a hard structural bound: queue cap, draining).
+        The answer lands within one collect boundary instead of the
+        client waiting out its own timeout; ``retry_after_s`` hints
+        when capacity is expected back. ``arrived`` mirrors
+        :meth:`reject`'s double-count rule for already-enqueued sheds."""
+        req.outcome = "shed"
+        if not arrived:
+            self.arrived += 1
+        self.sheds += 1
+        obs.registry().counter("serve.shed").inc()
+        extra: Dict[str, Any] = {"t_shed": round(vnow, 6)}
+        if retry_after_s is not None:
+            extra["retry_after_s"] = round(float(retry_after_s), 3)
+        self._emit(req, **extra)
+
+    def note_breaker_open(self) -> None:
+        """The launch-failure circuit breaker opened (consecutive
+        collect faults hit its threshold) during this window."""
+        self.breaker_opens += 1
+        obs.registry().counter("serve.breaker_opened").inc()
+
     def enqueued(self, req: Request) -> None:
         self.arrived += 1
         obs.registry().counter("serve.enqueued").inc()
@@ -332,6 +361,8 @@ class RequestLog:
             "timeouts": self.timeouts,
             "cancelled": self.cancels,
             "errors": self.errors,
+            "shed": self.sheds,
+            "breaker_open": self.breaker_opens,
             "launches": self.launches,
             "exec_s": round(self.exec_s, 6),
             "gen_tokens": self.gen_tokens,
@@ -684,9 +715,9 @@ def _q(snap: Optional[Dict[str, Any]], key: str) -> Optional[float]:
 def format_report(doc: Dict[str, Any]) -> str:
     lines = [
         f"{'rung':>4} {'offered r/s':>11} {'reqs':>5} {'ok':>5} {'rej':>4} "
-        f"{'t/o':>4} {'p50 ms':>8} {'p99 ms':>8} {'ttft p50':>8} "
-        f"{'ttft p99':>8} {'q-wait':>6} {'occ':>5} {'goodput tok/s':>13} "
-        f"{'bound':>14}"
+        f"{'shed':>4} {'t/o':>4} {'err':>4} {'p50 ms':>8} {'p99 ms':>8} "
+        f"{'ttft p50':>8} {'ttft p99':>8} {'q-wait':>6} {'occ':>5} "
+        f"{'goodput tok/s':>13} {'bound':>14}"
     ]
     for r in doc["rungs"]:
         p50 = _q(r.get("latency"), "p50")
@@ -697,7 +728,8 @@ def format_report(doc: Dict[str, Any]) -> str:
         lines.append(
             f"{r.get('rung', 0):>4} {r.get('offered_rps', 0.0):>11.2f} "
             f"{r.get('arrived', 0):>5} {r.get('completed', 0):>5} "
-            f"{r.get('rejected', 0):>4} {r.get('timeouts', 0):>4} "
+            f"{r.get('rejected', 0):>4} {r.get('shed', 0):>4} "
+            f"{r.get('timeouts', 0):>4} {r.get('errors', 0):>4} "
             f"{(p50 or 0.0) * 1e3:>8.2f} {(p99 or 0.0) * 1e3:>8.2f} "
             f"{(t50 or 0.0) * 1e3:>8.2f} {(t99 or 0.0) * 1e3:>8.2f} "
             f"{(r.get('queue_wait_share') or 0.0) * 100:>5.1f}% "
@@ -714,6 +746,13 @@ def format_report(doc: Dict[str, Any]) -> str:
            if knee is not None else
            "none — every rung saturated (offered loads all exceed capacity)")
     )
+    opens = sum(int(r.get("breaker_open", 0) or 0) for r in doc["rungs"])
+    if opens:
+        lines.append(
+            f"! launch-failure breaker opened {opens} time(s) — cohorts "
+            "were shed fast during the cooldown(s) (doc/resilience.md "
+            "\"Serving resilience\")"
+        )
     groups = ", ".join(doc.get("groups") or [SERVE_GROUP])
     engines = doc.get("engines") or []
     if engines and engines != ["static"]:

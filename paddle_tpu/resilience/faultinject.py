@@ -86,6 +86,25 @@ SITE_DOCS = {
         "layer's parameters with NaN, as a nonfinite gradient applied "
         "by the optimizer would — the next loss goes NaN and the "
         "per-layer blame re-run must name LAYER)",
+    "serve.crash":
+        "at each serve collect boundary (exit = mid-serve process "
+        "death for `paddle supervise --supervise_job=serve` drills — "
+        "the request journal re-offers the queue on restart)",
+    "serve.stall":
+        "at each serve collect boundary (sleep = wedged serve_decode "
+        "launch, trips the --serve_hang_timeout hangwatch -> "
+        "serve_hang_report.json + in-flight answered outcome=error + "
+        "exit 19)",
+    "serve.oom":
+        "at each serve collect boundary (raise = synthetic "
+        "RESOURCE_EXHAUSTED in the serve loop -> everything answered "
+        "outcome=error, oom_report.json + exit 20, budget-consuming "
+        "under supervision)",
+    "serve.launch_fault":
+        "at each serve collect boundary (raise = one decode launch "
+        "faults: the in-flight cohort resolves outcome=error and "
+        "consecutive faults trip the --serve_breaker_threshold "
+        "circuit breaker)",
 }
 
 KNOWN_SITES = tuple(SITE_DOCS)

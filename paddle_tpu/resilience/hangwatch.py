@@ -107,7 +107,19 @@ class HangWatch:
     fake-clock unit tests; production uses monotonic time and
     ``os._exit`` (a wedged main thread cannot run atexit handlers — the
     telemetry layer flushes explicitly before exit, exactly like an
-    ``exit``-action fault)."""
+    ``exit``-action fault).
+
+    Subclass seams (the serving watch,
+    ``paddle_tpu/serving/resilience.py``): ``REPORT_NAME``/``REASON``
+    name the forensics file and its ``reason`` field;
+    :meth:`_pre_exit` runs after the report + telemetry flush and
+    before the exit — the hook where a server answers what it still
+    can (the backstop timer does NOT wait for it, so a wedged hook can
+    only delay the exit up to its own bounded waits, never past
+    :data:`FORENSICS_DEADLINE_S`)."""
+
+    REPORT_NAME = HANG_REPORT
+    REASON = "step_hang"
 
     def __init__(
         self,
@@ -220,11 +232,11 @@ class HangWatch:
     def _trigger(self, age: float, where) -> None:
         pass_id, step = where
         logger.error(
-            "hangwatch: no step progress for %.1fs (> --step_hang_timeout=%g) "
+            "hangwatch: no step progress for %.1fs (> timeout=%g) "
             "— last progress at pass=%s step=%s; dumping thread stacks and "
             "writing %s, then exiting %d",
             age, self.timeout_s, pass_id, step,
-            os.path.join(self.report_dir, HANG_REPORT), EXIT_HANG,
+            os.path.join(self.report_dir, self.REPORT_NAME), EXIT_HANG,
         )
         try:
             import faulthandler
@@ -252,13 +264,24 @@ class HangWatch:
         obs.emit("hang", pass_id=pass_id, step=step, age_s=round(age, 3),
                  timeout_s=self.timeout_s, report=path)
         obs.flush()  # os._exit skips atexit — same discipline as exit faults
+        try:
+            # subclass hook: the serving watch resolves every in-flight
+            # request with outcome=error here, so clients hear "the
+            # server hung" instead of waiting out their own timeouts.
+            # Best-effort — the hang must exit regardless.
+            self._pre_exit()
+        except Exception:
+            pass
         backstop.cancel()  # forensics completed: exit on the normal path
         self.exit_fn(EXIT_HANG)
+
+    def _pre_exit(self) -> None:
+        """Hook between forensics and exit (see class docstring)."""
 
     def build_report(self, age: float, where) -> Dict[str, Any]:
         pass_id, step = where
         report: Dict[str, Any] = {
-            "reason": "step_hang",
+            "reason": self.REASON,
             "age_s": round(age, 3),
             "timeout_s": self.timeout_s,
             "last_progress": {"pass": pass_id, "step": step},
@@ -281,7 +304,7 @@ class HangWatch:
         return report
 
     def write_report(self, report: Dict[str, Any]) -> str:
-        path = os.path.join(self.report_dir, HANG_REPORT)
+        path = os.path.join(self.report_dir, self.REPORT_NAME)
         try:
             os.makedirs(self.report_dir, exist_ok=True)
             tmp = path + ".tmp"

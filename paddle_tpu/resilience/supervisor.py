@@ -117,6 +117,14 @@ class Supervisor:
         self.train_args = list(train_args)
         self.flags = flags
         self._child_cmd_override = child_cmd
+        # which child this supervises: `paddle train` (default) or
+        # `paddle serve` (--supervise_job=serve). The exit-code
+        # discipline is identical (17/18/19/20, preemption free); the
+        # deltas are restart args (a serve child keeps its own — the
+        # request journal, not a checkpoint, is its resume state) and
+        # the crash-loop progress probe (journal answered-count instead
+        # of restorable passes).
+        self.job = getattr(flags, "supervise_job", "train") or "train"
         self.save_dir = getattr(flags, "save_dir", "") or ""
         # where the child's telemetry lands (observability/metrics.py
         # resolves the same way: --metrics_path wins, save_dir doubles
@@ -139,7 +147,13 @@ class Supervisor:
             name="supervise-restart",
             sleep=sleep,
         )
-        self._probe = probe or (lambda: probe_restorable(self.save_dir))
+        if probe is not None:
+            self._probe = probe
+        elif self.job == "serve":
+            journal = getattr(flags, "serve_journal_path", "") or ""
+            self._probe = lambda: self._probe_serve(journal)
+        else:
+            self._probe = lambda: probe_restorable(self.save_dir)
         # wall-clock birth of this supervise invocation: the staleness
         # gate for hang_report.json (see _hang_report)
         self._t0_wall = time.time()
@@ -150,25 +164,40 @@ class Supervisor:
 
     # ------------------------------------------------------------ child
 
+    @staticmethod
+    def _probe_serve(journal_path: str):
+        """Serve-child progress = the request journal's answered count
+        (jax-free, like the manifest probe): consecutive deaths with an
+        identical fingerprint served nothing between them — the crash
+        loop a restart would only replay."""
+        from paddle_tpu.serving.resilience import journal_progress
+
+        return journal_progress(journal_path)
+
     def child_cmd(self, restart: bool) -> List[str]:
         if self._child_cmd_override is not None:
             return list(self._child_cmd_override)
+        from paddle_tpu.utils.flags import strip_flag
+
         # --dry_run is the supervisor's own; the trainer would ignore it,
-        # but forwarding it makes the printed plan misleading to copy
+        # but forwarding it makes the printed plan misleading to copy.
+        # --supervise_job likewise: the child would warn on it
         args = [
             a for a in self.train_args
             if a != "--dry_run" and not a.startswith("--dry_run=")
         ]
-        if restart:
+        args = strip_flag(args, "supervise_job")
+        if restart and self.job != "serve":
             # every restart resumes from the newest verified checkpoint;
             # the user's own --init_model_path only applies to the first
             # launch (an explicit pretrained init must not clobber the
-            # progress the run made before dying)
-            from paddle_tpu.utils.flags import strip_flag
-
+            # progress the run made before dying). A serve child keeps
+            # its args untouched — its resume state is the request
+            # journal (--serve_journal_path), re-offered by the child
+            # itself at startup.
             args = strip_flag(args, "init_model_path")
             args.append("--init_model_path=auto")
-        return [sys.executable, "-m", "paddle_tpu.cli", "train", *args]
+        return [sys.executable, "-m", "paddle_tpu.cli", self.job, *args]
 
     def describe(self) -> str:
         q = lambda cmd: " ".join(shlex.quote(c) for c in cmd)
@@ -419,11 +448,18 @@ class Supervisor:
     def _hang_report(self):
         """The child's hang forensics, when any attempt died of a
         detected hang (EXIT_HANG): hangwatch writes hang_report.json
-        into the same run dir the metrics tail comes from. Parsed and
+        into the same run dir the metrics tail comes from — a serve
+        child's hangwatch writes serve_hang_report.json (thread stacks
+        PLUS the in-flight cohort snapshot) instead. Parsed and
         embedded so one crash_report.json carries the whole story."""
         from paddle_tpu.resilience.hangwatch import HANG_REPORT
 
-        return self._forensics_report(HANG_REPORT)
+        report = self._forensics_report(HANG_REPORT)
+        if report is None:
+            from paddle_tpu.serving.resilience import SERVE_HANG_REPORT
+
+            report = self._forensics_report(SERVE_HANG_REPORT)
+        return report
 
     def _oom_report(self):
         """The child's OOM pre-mortem (oom_report.json — per-group

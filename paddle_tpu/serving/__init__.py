@@ -20,6 +20,11 @@ Layering (mirrors the analysis/resilience discipline):
   CompileRegistry (one signature each — zero recompiles after warmup).
 - ``frontend.py`` — ``paddle serve``: stdin-JSONL with SIGTERM
   graceful drain, and the in-process Python API.
+- ``resilience.py`` — the serving resilience plane (doc/resilience.md
+  "Serving resilience"): engine hangwatch (serve_hang_report.json +
+  exit 19), launch-failure circuit breaker, durable request journal
+  (at-least-once restart recovery), and the ``--status_path`` health
+  probe + `paddle serve-status`.
 """
 
 from paddle_tpu.serving.backend import (
@@ -35,9 +40,17 @@ from paddle_tpu.serving.engine import (
     drive_rung,
     pick_block,
 )
+from paddle_tpu.serving.resilience import (
+    SERVE_HANG_REPORT,
+    CircuitBreaker,
+    RequestJournal,
+    ServeHangWatch,
+    StatusWriter,
+)
 
 __all__ = [
     "Engine", "EngineRequest", "ResultFuture", "ServeResult",
     "FakeBackend", "StepOut", "drive_rung", "pick_block",
-    "parse_decode_blocks",
+    "parse_decode_blocks", "CircuitBreaker", "RequestJournal",
+    "ServeHangWatch", "StatusWriter", "SERVE_HANG_REPORT",
 ]

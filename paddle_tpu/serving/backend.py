@@ -85,22 +85,33 @@ class FakeBackend:
     ``chunk`` mirrors the jax backend's decode-block ladder (an int or
     a ladder spec). ``step_delay_s`` burns (virtual, under the race
     shim) clock per launch at dispatch — where the modeled device does
-    its work. ``fail_at_launch`` makes the N-th dispatched launch
-    fault; like the real backend, the fault surfaces at ``collect()``
-    (the chaos seam for the engine's error path, pipelined included)."""
+    its work. ``fail_at_launch`` makes the named dispatched launch(es)
+    fault — an int or a collection of launch ordinals (consecutive
+    faults are what the circuit breaker counts); like the real backend,
+    the fault surfaces at ``collect()`` (the chaos seam for the
+    engine's error path, pipelined included). ``fail_with`` overrides
+    the injected exception — e.g. a RESOURCE_EXHAUSTED-marked error to
+    drive the engine's OOM shutdown path."""
 
     def __init__(self, slots: int = 4, max_length: int = 8, eos: int = 1,
                  token_fn: Optional[Callable[[str, int], int]] = None,
                  chunk: Union[int, str, Sequence[int]] = 1,
                  step_delay_s: float = 0.0,
-                 fail_at_launch: Optional[int] = None):
+                 fail_at_launch: Union[int, Sequence[int], None] = None,
+                 fail_with: Optional[Callable[[int], Exception]] = None):
         self.slots = int(slots)
         self.max_length = int(max_length)
         self.eos = int(eos)
         self.decode_blocks = parse_decode_blocks(chunk)
         self.chunk = self.decode_blocks[-1]
         self.step_delay_s = float(step_delay_s)
-        self.fail_at_launch = fail_at_launch
+        if fail_at_launch is None:
+            self.fail_at_launch = frozenset()
+        elif isinstance(fail_at_launch, int):
+            self.fail_at_launch = frozenset((fail_at_launch,))
+        else:
+            self.fail_at_launch = frozenset(int(n) for n in fail_at_launch)
+        self.fail_with = fail_with
         self.token_fn = token_fn or (
             lambda rid, i: 2 + (hash((rid, i)) % 97)
         )
@@ -140,9 +151,12 @@ class FakeBackend:
         injected fault) only at collect — the jax async-dispatch
         contract the pipelined engine is written against."""
         self.launches += 1
-        if self.fail_at_launch is not None and self.launches == self.fail_at_launch:
-            self._pending.append(RuntimeError(
-                f"injected decode fault at launch {self.launches}"))
+        if self.launches in self.fail_at_launch:
+            if self.fail_with is not None:
+                self._pending.append(self.fail_with(self.launches))
+            else:
+                self._pending.append(RuntimeError(
+                    f"injected decode fault at launch {self.launches}"))
             return
         if self.step_delay_s:
             cc.sleep(self.step_delay_s)

@@ -69,14 +69,42 @@ import os
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from paddle_tpu.observability import serving as slog
+from paddle_tpu.resilience import faultinject
 from paddle_tpu.utils import concurrency as cc
 from paddle_tpu.utils.logging import logger
 
 ENGINE_NAME = "continuous"
 
 # terminal request outcomes (race-spec invariant: every submitted
-# request's future resolves exactly once with one of these)
-OUTCOMES = ("ok", "rejected", "timeout", "cancelled", "error")
+# request's future resolves exactly once with one of these). `shed` is
+# the overload-defense answer (doc/resilience.md "Serving resilience"):
+# a POLICY refusal — brownout pressure, open breaker, or an admission
+# estimate proving the deadline unmeetable — delivered within one
+# collect boundary, with a retry-after hint where one exists; distinct
+# from `rejected` (structural: queue cap, draining, not started)
+OUTCOMES = ("ok", "rejected", "timeout", "cancelled", "error", "shed")
+
+# valid --serve_shed_policy values: "off" = the PR-13 behavior
+# (overload resolves through queue caps and timeouts only); "deadline"
+# adds deadline-aware admission shedding; "brownout" additionally caps
+# output budgets and sheds new arrivals under sustained queue pressure
+SHED_POLICIES = ("off", "deadline", "brownout")
+
+# queue-pressure EMA (queue depth / slots) thresholds for entering and
+# leaving brownout — hysteresis, so one bursty boundary can't flap the
+# degraded mode on and off
+BROWNOUT_ON = 1.0
+BROWNOUT_OFF = 0.5
+
+# engaged brownout caps every admission's token budget to this share of
+# max_length (floor 1): shorter answers for everyone beats no answers
+# for the tail — the "degrade, don't die" half of the shed policy
+BROWNOUT_BUDGET_SHARE = 0.25
+
+# the shed retry-after hint while the prefill/step EMAs are still
+# unmeasured (a burst before the first collect boundary): a fixed
+# conservative backoff, never the idle-poll interval
+UNMEASURED_RETRY_S = 1.0
 
 # a launch whose measured host-side cost exceeds this share of its
 # device time is dispatch-dominated — the ladder steps up a rung
@@ -125,12 +153,18 @@ def pick_block(ladder: Sequence[int], cap: int, pressed: bool,
 
 @dataclasses.dataclass
 class ServeResult:
-    """What a resolved :class:`ResultFuture` carries."""
+    """What a resolved :class:`ResultFuture` carries.
+
+    ``retry_after_s`` rides ``outcome=shed`` answers when the engine
+    can estimate when capacity returns (breaker cooldown remaining,
+    queue-drain ETA); None means "don't bother retrying" (a deadline
+    the admission estimate proved unmeetable)."""
 
     rid: str
     outcome: str
     tokens: List[int]
     error: Optional[str] = None
+    retry_after_s: Optional[float] = None
 
 
 class ResultFuture:
@@ -204,7 +238,16 @@ class Engine:
                  request_timeout_s: float = 60.0,
                  clock: Optional[Callable[[], float]] = None,
                  idle_poll_s: float = 0.02,
-                 pipeline: bool = True):
+                 pipeline: bool = True,
+                 shed_policy: str = "off",
+                 breaker=None,
+                 hangwatch=None,
+                 on_oom: Optional[Callable[[BaseException], None]] = None):
+        if shed_policy not in SHED_POLICIES:
+            raise ValueError(
+                f"unknown shed policy {shed_policy!r}: expected one of "
+                f"{SHED_POLICIES}"
+            )
         self._backend = backend
         self.queue_cap = int(queue_cap)
         self.request_timeout_s = float(request_timeout_s)
@@ -230,6 +273,29 @@ class Engine:
         self._n_submitted = 0
         self._pid = os.getpid()
         self.warmup_s: Optional[float] = None
+        # --- resilience plane (doc/resilience.md "Serving resilience")
+        self.shed_policy = str(shed_policy)
+        # the launch-failure CircuitBreaker (serving/resilience.py) —
+        # consulted and mutated ONLY with self._lock held, so it needs
+        # no lock of its own
+        self._breaker = breaker
+        self._hangwatch = hangwatch     # ServeHangWatch or None
+        self._on_oom = on_oom           # `paddle serve`: pre-mortem + exit 20
+        # measured EMAs the shed policy estimates from — mirrored from
+        # the scheduler's loop-locals under the lock at every collect
+        # boundary (pick_block keeps reading the hot locals): device
+        # seconds per decode micro-step, host+dispatch seconds per
+        # iteration, prefill seconds per admission cohort
+        self._step_ema = 0.0
+        self._host_ema = 0.0
+        self._prefill_ema = 0.0
+        # queue-pressure EMA (depth / slots) + brownout engagement
+        self._pressure_ema = 0.0
+        self._brownout = False
+        # lifetime outcome totals + liveness timestamps for status()
+        self._totals: Dict[str, int] = {o: 0 for o in OUTCOMES}
+        self._last_collect = self._clock()   # last collect/step result
+        self._last_loop = self._clock()      # last scheduler-loop beat
 
     # ----------------------------------------------------------- client
 
@@ -261,6 +327,11 @@ class Engine:
         from paddle_tpu.observability import metrics as obs
 
         obs.registry().gauge("serve.warmup_s").set(round(self.warmup_s, 6))
+        hw = self._hangwatch
+        if hw is not None:
+            # started AFTER warmup: compile time is startup, not a hang
+            hw.attach(self)
+            hw.start()
         th = cc.Thread(target=self._loop, name="serve-engine", daemon=True)
         with self._lock:
             self._thread = th
@@ -270,10 +341,14 @@ class Engine:
     def submit(self, prompt: Sequence[int],
                max_new_tokens: Optional[int] = None,
                rid: Optional[str] = None,
-               timeout_s: Optional[float] = None) -> ResultFuture:
+               timeout_s: Optional[float] = None,
+               replay: bool = False) -> ResultFuture:
         """Enqueue one request; returns its future. Rejected immediately
         (``outcome=rejected``) when draining, stopped, or past
-        ``queue_cap`` — a rejection is an answer, never an exception."""
+        ``queue_cap`` — a rejection is an answer, never an exception.
+        ``replay=True`` re-offers a durably journaled backlog after a
+        restart: arrival control (``queue_cap``, brownout arrival shed)
+        governs new arrivals, not the already-accepted queue."""
         fut = ResultFuture()
         with self._lock:
             now = self._now()
@@ -288,16 +363,34 @@ class Engine:
             )
             if self._draining or not self._started or self._thread is None:
                 self._finish_locked(req, "rejected", now)
-            elif self.queue_cap and len(self._queue) >= self.queue_cap:
-                self._finish_locked(req, "rejected", now)
             elif max_new_tokens is not None and int(max_new_tokens) <= 0:
                 # 0 is a LEGAL budget, not an unset sentinel: the answer
-                # is the empty generation, no slot needed
+                # is the empty generation, no slot needed (and no device
+                # — an open breaker doesn't stop it either)
                 req.queued = True
                 req.t_admit = now
                 self._log.enqueued(req)
                 self._log.admit(req)
                 self._finish_locked(req, "ok", now)
+            elif self._breaker is not None and not self._breaker.allow_submit():
+                # reject-fast while the launch-failure breaker cools:
+                # queueing behind a faulting device only converts this
+                # request into a slower error/timeout
+                self._finish_locked(
+                    req, "shed", now,
+                    retry_after=self._breaker.retry_after_s(),
+                )
+            elif (not replay and self.queue_cap
+                  and len(self._queue) >= self.queue_cap):
+                self._finish_locked(req, "rejected", now)
+            elif (not replay and self._brownout
+                  and len(self._queue) >= max(self.slots, 1)):
+                # engaged brownout sheds arrivals past one full slot
+                # wave: the queue stays bounded by policy, and the
+                # client gets a drain-ETA hint instead of a timeout
+                self._finish_locked(
+                    req, "shed", now, retry_after=self._drain_eta_locked(),
+                )
             else:
                 req.queued = True
                 self._queue.append(req)
@@ -374,7 +467,8 @@ class Engine:
         return self._clock() - self._t0
 
     def _finish_locked(self, req: EngineRequest, outcome: str,
-                       now: float, error: Optional[str] = None) -> None:
+                       now: float, error: Optional[str] = None,
+                       retry_after: Optional[float] = None) -> None:
         """Resolve one request exactly once: telemetry record + future."""
         if req.done:
             return
@@ -392,11 +486,16 @@ class Engine:
             self._log.timeout(req, now)
         elif outcome == "cancelled":
             self._log.cancel(req, now)
+        elif outcome == "shed":
+            self._log.shed(req, now, arrived=req.queued,
+                           retry_after_s=retry_after)
         else:
             self._log.error(req, error=error or "decode failed")
+        self._totals[req.outcome] = self._totals.get(req.outcome, 0) + 1
         req.future._resolve(ServeResult(
             rid=req.rid, outcome=req.outcome,
             tokens=list(req.tokens), error=error,
+            retry_after_s=retry_after,
         ))
 
     def _sweep_locked(self, now: float) -> None:
@@ -428,43 +527,314 @@ class Engine:
                 self._slots[b] = None
                 self._finish_locked(req, "error", now, error=error)
 
-    # --------------------------------------------- shared loop phases
+    # ------------------------------------------------ resilience plane
 
-    def _boundary(self) -> Tuple[List[int], List[EngineRequest]]:
-        """One iteration boundary under the lock: sweep cancellations
-        and deadlines, reject the queue when draining, pick the FIFO
-        admissions for the free slots."""
-        admit_slots: List[int] = []
-        admit_reqs: List[EngineRequest] = []
+    def _note_launch_fault_locked(self) -> None:
+        """One failed launch toward the circuit breaker; counts the
+        window's breaker_open when this fault tripped it."""
+        if self._breaker is not None and self._breaker.record_fault():
+            self._log.note_breaker_open()
+            logger.error(
+                "serve launch-failure breaker OPEN after %d consecutive "
+                "fault(s): shedding submits for %.1fs, no cohorts "
+                "launched until the half-open probe",
+                self._breaker.threshold, self._breaker.cooldown_s,
+            )
+
+    def _chaos_boundary(self) -> None:
+        """The serve-tier chaos sites, one hit per collect boundary —
+        the serving twins of trainer.{crash,stall,oom} (`paddle faults`;
+        doc/resilience.md "Serving resilience"). Raise-action faults
+        deliberately fire INSIDE the loops' launch try-blocks, so they
+        travel the same error/breaker/OOM paths a real device fault
+        would."""
+        faultinject.fault_point("serve.crash")
+        faultinject.fault_point("serve.stall")
+        try:
+            faultinject.fault_point("serve.oom")
+        except faultinject.FaultInjected as e:
+            from paddle_tpu.observability.memory import SyntheticOomError
+
+            # the canonical RESOURCE_EXHAUSTED marker, so is_oom_error
+            # (and the pre-mortem path) classify it like the real thing
+            raise SyntheticOomError("serve decode launch") from e
+        faultinject.fault_point("serve.launch_fault")
+
+    def _oom_check(self, e: BaseException) -> bool:
+        """RESOURCE_EXHAUSTED escaping a serve launch is deterministic
+        poison — the same slots at the same signature OOM again, so
+        "error the cohort and keep serving" would burn every future
+        cohort. With an ``on_oom`` handler installed (`paddle serve`:
+        trigger_oom_report → exit EXIT_OOM=20) the engine answers
+        everything it holds with outcome=error, stops, and hands the
+        error over; without one (library embeddings, unit tests) the
+        generic fault path stands. True = OOM handled, loop must exit."""
+        if self._on_oom is None:
+            return False
+        from paddle_tpu.observability.memory import is_oom_error
+
+        if not is_oom_error(e):
+            return False
+        err = f"oom: {type(e).__name__}: {e}"
+        logger.error("serve launch OOM — answering %s and stopping: %s",
+                     "everything queued/in-flight", err)
         with self._lock:
             now = self._now()
+            self._fail_inflight_locked(now, err)
+            for req in self._admitting:
+                self._finish_locked(req, "error", now, error=err)
+            self._admitting = []
+            while self._queue:
+                self._finish_locked(self._queue.popleft(), "error", now,
+                                    error=err)
+            self._draining = True
+            self._wake.notify_all()
+        handler = self._on_oom
+        try:
+            handler(e)  # `paddle serve`: oom_report.json + os._exit(20)
+        except Exception as he:  # noqa: BLE001 — never mask the OOM
+            logger.error("serve on_oom handler failed: %s", he)
+        return True
+
+    def _ping(self) -> None:
+        """Scheduler-loop liveness beat for the hangwatch, called once
+        per loop iteration (idle polls included — an idle server is
+        alive, not hung). The status file's loop-age stamp rides in
+        :meth:`_boundary`'s existing critical section instead of taking
+        the engine lock here a second time per iteration."""
+        hw = self._hangwatch
+        if hw is not None:
+            hw.ping()
+
+    def _note_collect_locked(self) -> None:
+        """Caller holds self._lock (the collect-boundary beat is
+        written there, lexically under the lock, for PTL005)."""
+        if self._breaker is not None:
+            self._breaker.record_success()
+
+    # hang_snapshot/hang_fail_all run on the HANGWATCH MONITOR thread
+    # while the scheduler is wedged mid-collect: bounded lock acquires —
+    # if the scheduler wedged while holding the lock, a degraded answer
+    # beats joining the hang (the backstop timer caps everything anyway)
+
+    def hang_snapshot(self) -> Dict[str, Any]:
+        """The in-flight cohort snapshot for serve_hang_report.json."""
+        if not self._lock.acquire(timeout=2.0):
+            return {"lock": "unavailable — scheduler may hold it"}
+        try:
+            return {
+                "queue_depth": len(self._queue),
+                "queued": [r.rid for r in list(self._queue)[:32]],
+                "slots": [
+                    None if r is None else {
+                        "rid": r.rid, "tokens": len(r.tokens),
+                        "budget": r.budget,
+                        "deadline_in_s": round(r.deadline - self._now(), 3),
+                    }
+                    for r in self._slots
+                ],
+                "admitting": [r.rid for r in self._admitting],
+                "inflight_launches": getattr(self._backend, "inflight",
+                                             None),
+                "draining": self._draining,
+                "breaker": (self._breaker.state
+                            if self._breaker is not None else None),
+                "brownout": self._brownout,
+                "totals": dict(self._totals),
+            }
+        finally:
+            self._lock.release()
+
+    def hang_fail_all(self, error: str) -> int:
+        """Answer what we can before the hang exit: every queued,
+        admitting, and in-flight request resolves outcome=error (the
+        client hears "the server hung" now instead of timing out
+        later). Returns how many were answered. Also sets draining so
+        any submit racing this exit resolves outcome=rejected
+        immediately — the frontend's pre-exit answer flush must never
+        find an unresolvable future."""
+        if not self._lock.acquire(timeout=2.0):
+            return 0
+        try:
+            now = self._now()
+            self._draining = True
+            n = 0
+            while self._queue:
+                self._finish_locked(self._queue.popleft(), "error", now,
+                                    error=error)
+                n += 1
+            for req in self._admitting:
+                if not req.done:
+                    self._finish_locked(req, "error", now, error=error)
+                    n += 1
+            self._admitting = []
+            for b, req in enumerate(self._slots):
+                if req is not None:
+                    self._slots[b] = None
+                    if not req.done:
+                        self._finish_locked(req, "error", now, error=error)
+                        n += 1
+            return n
+        finally:
+            self._lock.release()
+
+    def status(self) -> Dict[str, Any]:
+        """The ``--status_path`` health document (serving/resilience.
+        StatusWriter): queue depth, slot occupancy, last-collect age,
+        outcome totals, draining flag, breaker/brownout state. Bounded
+        lock — a wedged scheduler yields a stale-but-honest snapshot."""
+        now = self._clock()
+        if not self._lock.acquire(timeout=0.5):
+            return {"stale": True,
+                    "detail": "engine lock unavailable (scheduler busy "
+                              "or wedged — see the hangwatch)"}
+        try:
+            return {
+                "started": self._started,
+                "draining": self._draining,
+                "queue_depth": len(self._queue),
+                "slots": self.slots,
+                "occupancy": sum(1 for r in self._slots if r is not None),
+                "inflight": getattr(self._backend, "inflight", None),
+                "last_collect_age_s": round(now - self._last_collect, 3),
+                "loop_age_s": round(now - self._last_loop, 3),
+                "breaker": (self._breaker.state
+                            if self._breaker is not None else "disabled"),
+                "breaker_opens": (self._breaker.opened_total
+                                  if self._breaker is not None else 0),
+                "brownout": self._brownout,
+                "shed_policy": self.shed_policy,
+                "pipeline": "on" if self.pipeline else "off",
+                "warmup_s": self.warmup_s,
+                "totals": dict(self._totals),
+            }
+        finally:
+            self._lock.release()
+
+    # --------------------------------------------- shared loop phases
+
+    def _effective_budget_locked(self, req: EngineRequest) -> int:
+        budget = max(1, min(
+            self._backend.max_length if req.max_new is None else req.max_new,
+            self._backend.max_length,
+        ))
+        if self._brownout:
+            # degraded mode: everyone gets a shorter answer rather than
+            # the tail getting none (doc/resilience.md)
+            budget = min(budget, max(
+                1, int(self._backend.max_length * BROWNOUT_BUDGET_SHARE)))
+        return budget
+
+    def _eta_s_locked(self, budget: int) -> Optional[float]:
+        """Estimated seconds to serve a request admitted NOW: measured
+        prefill + budget decode micro-steps. None while unmeasured
+        (warmup) — the shed policy never guesses."""
+        if self._step_ema <= 0.0:
+            return None
+        return self._prefill_ema + float(budget) * self._step_ema
+
+    def _drain_eta_locked(self) -> float:
+        """Rough queue-drain ETA for shed retry-after hints: how long
+        the queued waves ahead take at the measured per-step rate.
+        While unmeasured (a burst before the first collect boundary)
+        the hint is a fixed conservative backoff — echoing the 20 ms
+        idle poll would invite near-immediate retries into the same
+        overloaded queue."""
+        if self._step_ema <= 0.0:
+            return UNMEASURED_RETRY_S
+        waves = (len(self._queue) / max(self.slots, 1)) + 1.0
+        per_wave = self._prefill_ema + self._step_ema * max(
+            1, int(self._backend.max_length * BROWNOUT_BUDGET_SHARE))
+        return max(waves * per_wave, self.idle_poll_s)
+
+    def _boundary(self) -> Tuple[List[int], List[EngineRequest], List[int]]:
+        """One iteration boundary under the lock: sweep cancellations
+        and deadlines, reject the queue when draining, update the
+        queue-pressure EMA (brownout engage/disengage), gate on the
+        launch-failure breaker, then pick the FIFO admissions for the
+        free slots — shedding, under a deadline-aware policy, any
+        candidate whose remaining deadline the measured prefill+decode
+        estimate already proves unmeetable (shed at admission, not
+        after wasting a slot on a request that times out mid-decode)."""
+        admit_slots: List[int] = []
+        admit_reqs: List[EngineRequest] = []
+        budgets: List[int] = []
+        with self._lock:
+            now = self._now()
+            self._last_loop = self._clock()  # status loop-age beat
             self._sweep_locked(now)
             if self._draining:
                 while self._queue:
                     self._finish_locked(self._queue.popleft(),
                                         "rejected", now)
+            if self.shed_policy == "brownout":
+                pressure = len(self._queue) / max(self.slots, 1)
+                self._pressure_ema = (
+                    (1 - _EMA) * self._pressure_ema + _EMA * pressure
+                )
+                if not self._brownout and self._pressure_ema >= BROWNOUT_ON:
+                    self._brownout = True
+                    self._set_brownout_gauge(1)
+                    logger.warning(
+                        "serve brownout ENGAGED (queue-pressure EMA %.2f "
+                        ">= %g): output budgets capped to %d%% of "
+                        "max_length, excess arrivals shed",
+                        self._pressure_ema, BROWNOUT_ON,
+                        int(BROWNOUT_BUDGET_SHARE * 100),
+                    )
+                elif self._brownout and self._pressure_ema <= BROWNOUT_OFF:
+                    self._brownout = False
+                    self._set_brownout_gauge(0)
+                    logger.info(
+                        "serve brownout released (queue-pressure EMA %.2f)",
+                        self._pressure_ema,
+                    )
+            if self._breaker is not None and not self._breaker.allow_launch():
+                # open breaker: no admissions, no launches — queued
+                # requests wait out the cooldown (their deadlines still
+                # sweep above); the half-open probe re-enters here
+                self._admitting = []
+                return [], [], []
             free = [b for b, r in enumerate(self._slots) if r is None]
-            take = min(len(free), len(self._queue))
-            for j in range(take):
-                admit_slots.append(free[j])
-                admit_reqs.append(self._queue.popleft())
+            fi = 0
+            while fi < len(free) and self._queue:
+                req = self._queue.popleft()
+                budget = self._effective_budget_locked(req)
+                if self.shed_policy != "off":
+                    eta = self._eta_s_locked(budget)
+                    if eta is not None and now + eta > req.deadline:
+                        # unmeetable deadline: answer now (no retry
+                        # hint — more time won't fit this budget either)
+                        self._finish_locked(req, "shed", now)
+                        continue
+                admit_slots.append(free[fi])
+                admit_reqs.append(req)
+                budgets.append(budget)
+                fi += 1
+            if admit_reqs and self._breaker is not None:
+                # a half-open breaker lets exactly ONE cohort probe:
+                # latch it so later boundaries wait out its collect
+                # verdict instead of launching more (no-op when closed)
+                self._breaker.note_probe()
             self._admitting = admit_reqs
-        return admit_slots, admit_reqs
+        return admit_slots, admit_reqs, budgets
+
+    def _set_brownout_gauge(self, v: int) -> None:
+        from paddle_tpu.observability import metrics as obs
+
+        obs.registry().gauge("serve.brownout").set(v)
 
     def _do_admit(self, admit_slots: List[int],
-                  admit_reqs: List[EngineRequest]) -> bool:
+                  admit_reqs: List[EngineRequest],
+                  budgets: List[int]) -> bool:
         """Prefill launch outside the lock (submit() must never block
         behind device work); place the cohort on success. In pipelined
         mode the backend dispatches without syncing, so the measured
         time is enqueue cost — the prefill's device time surfaces at
         the next collect boundary (doc/serving.md). False = the cohort
-        (and everything in flight) was errored; caller resets."""
+        (and everything in flight) was errored; caller resets. Budgets
+        come from the boundary (brownout caps applied there)."""
         backend = self._backend
-        budgets = [
-            max(1, min(backend.max_length if r.max_new is None
-                       else r.max_new, backend.max_length))
-            for r in admit_reqs
-        ]
         t0 = self._clock()
         try:
             backend.admit(admit_slots, admit_reqs, budgets)
@@ -477,6 +847,8 @@ class Engine:
                     self._finish_locked(req, "error", now, error=err)
                 self._admitting = []
                 self._fail_inflight_locked(now, err)
+                self._note_launch_fault_locked()
+            self._oom_check(e)
             return False
         dt = self._clock() - t0
         with self._lock:
@@ -489,13 +861,19 @@ class Engine:
                 self._log.admit(req)
             self._admitting = []
             self._log.note_exec(dt)
+            self._prefill_ema = (1 - _EMA) * self._prefill_ema + _EMA * dt
         return True
 
     def _loop(self) -> None:
-        if self.pipeline:
-            self._loop_pipelined()
-        else:
-            self._loop_blocking()
+        try:
+            if self.pipeline:
+                self._loop_pipelined()
+            else:
+                self._loop_blocking()
+        finally:
+            hw = self._hangwatch
+            if hw is not None:
+                hw.stop()  # a drained engine stops pinging — not a hang
 
     def _safe_reset(self) -> None:
         try:
@@ -526,12 +904,17 @@ class Engine:
         step() → apply. Kept verbatim as the pipeline A/B baseline
         (``pipeline=False`` / PADDLE_TPU_BENCH_SERVE_PIPELINE=off)."""
         backend = self._backend
-        host_ema = 0.0
-        step_ema = 0.0
+        host_ema = self._host_ema
+        step_ema = self._step_ema
         t_host0 = self._clock()
         while True:
-            admit_slots, admit_reqs = self._boundary()
-            if admit_reqs and not self._do_admit(admit_slots, admit_reqs):
+            self._ping()
+            admit_slots, admit_reqs, budgets = self._boundary()
+            if admit_reqs and not self._do_admit(admit_slots, admit_reqs,
+                                                 budgets):
+                # an OOM admit additionally emptied the queue and set
+                # draining inside _oom_check — the idle branch below
+                # then exits the loop
                 self._safe_reset()
                 t_host0 = self._clock()
                 continue
@@ -540,7 +923,13 @@ class Engine:
                 if occupancy == 0:
                     if self._draining and not self._queue:
                         break
-                    if not self._queue:
+                    if not self._queue or (
+                        self._breaker is not None
+                        and not self._breaker.allow_launch()
+                    ):
+                        # nothing admittable: empty queue, or the open
+                        # breaker refused admissions at the boundary —
+                        # poll instead of spinning the cooldown down
                         self._wake.wait(timeout=self.idle_poll_s)
                     # idle time is not host overhead: a stale anchor
                     # here would dump the whole idle stretch into
@@ -554,19 +943,28 @@ class Engine:
             t0 = self._clock()
             host_ema = (1 - _EMA) * host_ema + _EMA * (t0 - t_host0)
             try:
+                self._chaos_boundary()
                 out = backend.step(block=u)
             except Exception as e:  # noqa: BLE001 — engine survives a bad launch
                 err = f"{type(e).__name__}: {e}"
                 logger.error("serve decode launch failed: %s", err)
                 with self._lock:
                     self._fail_inflight_locked(self._now(), err)
+                    self._note_launch_fault_locked()
                 self._safe_reset()
+                if self._oom_check(e):
+                    continue  # queue emptied + draining: exit via idle
                 t_host0 = self._clock()
                 continue
             dt = self._clock() - t0
             t_host0 = self._clock()
             step_ema = (1 - _EMA) * step_ema + _EMA * (dt / max(u, 1))
             with self._lock:
+                # mirror the hot-loop EMAs for the shed policy + status
+                self._host_ema = host_ema
+                self._step_ema = step_ema
+                self._last_collect = self._clock()
+                self._note_collect_locked()
                 self._apply_step_locked(out, dt, occupancy)
 
     # ----------------------------------------------- the pipelined loop
@@ -580,13 +978,15 @@ class Engine:
         the only cross-thread state stays the lock-guarded slots/queue."""
         backend = self._backend
         inflight: collections.deque = collections.deque()
-        host_ema = 0.0
-        step_ema = 0.0
+        host_ema = self._host_ema
+        step_ema = self._step_ema
         union_end = self._clock()   # union of dispatch->collect spans
         t_host0 = self._clock()
         while True:
-            admit_slots, admit_reqs = self._boundary()
-            if admit_reqs and not self._do_admit(admit_slots, admit_reqs):
+            self._ping()
+            admit_slots, admit_reqs, budgets = self._boundary()
+            if admit_reqs and not self._do_admit(admit_slots, admit_reqs,
+                                                 budgets):
                 inflight = self._abort_inflight(inflight)
                 # failure handling (logging, reset, device realloc) is
                 # not host overhead — same stale-anchor rule as idle
@@ -623,7 +1023,9 @@ class Engine:
                     logger.error("serve decode dispatch failed: %s", err)
                     with self._lock:
                         self._fail_inflight_locked(self._now(), err)
+                        self._note_launch_fault_locked()
                     inflight = self._abort_inflight(inflight, err)
+                    self._oom_check(e)
                     t_host0 = self._clock()
                     continue
                 with self._lock:
@@ -640,13 +1042,16 @@ class Engine:
                 cohort, u, t_disp, disp_log = inflight[0]
                 t_wait = self._clock()
                 try:
+                    self._chaos_boundary()
                     out = backend.collect()
                 except Exception as e:  # noqa: BLE001 — fault surfaces HERE
                     err = f"{type(e).__name__}: {e}"
                     logger.error("serve decode launch failed: %s", err)
                     with self._lock:
                         self._fail_inflight_locked(self._now(), err)
+                        self._note_launch_fault_locked()
                     inflight = self._abort_inflight(inflight, err)
+                    self._oom_check(e)
                     t_host0 = self._clock()
                     continue
                 inflight.popleft()
@@ -662,6 +1067,12 @@ class Engine:
                 # pipelining and skew pick_block a rung low
                 step_ema = (1 - _EMA) * step_ema + _EMA * (service / max(u, 1))
                 with self._lock:
+                    # mirror the hot-loop EMAs for the shed policy +
+                    # status, and beat the collect-liveness clock
+                    self._host_ema = host_ema
+                    self._step_ema = step_ema
+                    self._last_collect = self._clock()
+                    self._note_collect_locked()
                     stale = disp_log is not self._log
                     if not stale:
                         self._log.note_overlap(max(t_wait - t_disp, 0.0))
@@ -682,7 +1093,12 @@ class Engine:
                 ):
                     if self._draining and not self._queue:
                         break
-                    if not self._queue:
+                    if not self._queue or (
+                        self._breaker is not None
+                        and not self._breaker.allow_launch()
+                    ):
+                        # empty queue, or the open breaker refused
+                        # admissions — poll, don't spin the cooldown
                         self._wake.wait(timeout=self.idle_poll_s)
             # anchored AFTER any idle wait: idle seconds are not host
             # overhead and must not inflate the ladder's host_ema

@@ -32,41 +32,67 @@ def build_engine(machine, params, *, slots: int = 8,
                  prompt_tokens: int = 32, queue_cap: int = 0,
                  request_timeout_s: float = 60.0, decode_block=1,
                  max_length: Optional[int] = None, registry=None,
-                 pipeline: bool = True, fused_step: bool = False):
+                 pipeline: bool = True, fused_step: bool = False,
+                 shed_policy: str = "off", breaker_threshold: int = 0,
+                 breaker_cooldown_s: float = 30.0, hangwatch=None,
+                 on_oom=None):
     """Wire a :class:`JaxDecodeBackend` + :class:`Engine` for a core
     graph machine (the in-process serving API). Caller starts it.
     ``decode_block`` takes the ladder spelling ("1,2,4,8" or an int);
     ``pipeline`` selects the overlapped dispatch/collect loop;
-    ``fused_step`` the extracted attention-GRU step (doc/serving.md)."""
+    ``fused_step`` the extracted attention-GRU step (doc/serving.md).
+    The resilience plane (doc/resilience.md "Serving resilience"):
+    ``shed_policy`` off|deadline|brownout, ``breaker_threshold``/
+    ``breaker_cooldown_s`` the launch-failure circuit breaker (0
+    disables), ``hangwatch`` a started-by-the-engine
+    :class:`~paddle_tpu.serving.resilience.ServeHangWatch`, ``on_oom``
+    the RESOURCE_EXHAUSTED handler (`paddle serve` installs the
+    pre-mortem + exit-20 one)."""
     from paddle_tpu.serving.engine import Engine
     from paddle_tpu.serving.jax_backend import JaxDecodeBackend
+    from paddle_tpu.serving.resilience import CircuitBreaker
 
     backend = JaxDecodeBackend(
         machine, params, slots=slots, prompt_tokens=prompt_tokens,
         max_length=max_length, decode_block=decode_block, registry=registry,
         pipeline=pipeline, fused_step=fused_step,
     )
+    breaker = (CircuitBreaker(breaker_threshold, breaker_cooldown_s)
+               if breaker_threshold > 0 else None)
     return Engine(backend, queue_cap=queue_cap,
-                  request_timeout_s=request_timeout_s, pipeline=pipeline)
+                  request_timeout_s=request_timeout_s, pipeline=pipeline,
+                  shed_policy=shed_policy, breaker=breaker,
+                  hangwatch=hangwatch, on_oom=on_oom)
 
 
-def _parse_line(line: str, n: int) -> Tuple[Optional[Dict[str, Any]], str]:
-    """One stdin line → (request dict, "") or (None, error)."""
+def _parse_line(
+    line: str, n: int
+) -> Tuple[Optional[Dict[str, Any]], str, str]:
+    """One stdin line → (request dict, "", id) or (None, error, id).
+    The returned id is the client's own whenever one was parseable —
+    an error answer under a synthetic id is uncorrelatable — falling
+    back to a pid-salted auto id: the line counter restarts at 0 every
+    incarnation, and a journaled ``req-0`` from a previous run must
+    not make a FRESH id-less request look like a duplicate after a
+    supervised restart."""
+    rid = f"req-{os.getpid()}-{n}"
     try:
         doc = json.loads(line)
     except ValueError as e:
-        return None, f"bad JSON: {e}"
+        return None, f"bad JSON: {e}", rid
     if isinstance(doc, list):
         doc = {"prompt": doc}
     if not isinstance(doc, dict):
-        return None, "expected a JSON object or token list"
+        return None, "expected a JSON object or token list", rid
+    if "id" in doc:
+        rid = str(doc["id"])
     prompt = doc.get("prompt")
     if not isinstance(prompt, list) or not all(
         isinstance(t, int) for t in prompt
     ):
-        return None, "prompt must be a list of token ids"
-    doc.setdefault("id", f"req-{n}")
-    return doc, ""
+        return None, "prompt must be a list of token ids", rid
+    doc["id"] = rid
+    return doc, "", rid
 
 
 def main(rest: List[str]) -> int:
@@ -95,12 +121,24 @@ def main(rest: List[str]) -> int:
 
     config = parse_config(FLAGS.config, FLAGS.config_args)
     obsm.configure_from_flags(FLAGS)
+    if FLAGS.fault_spec:
+        # serve.* chaos sites (doc/resilience.md "Serving resilience")
+        from paddle_tpu.resilience import faultinject
+
+        faultinject.configure(FLAGS.fault_spec, FLAGS.fault_seed)
 
     import jax
 
     from paddle_tpu import api
     from paddle_tpu.observability.compile_log import CompileRegistry
+    from paddle_tpu.resilience import EXIT_OOM
+    from paddle_tpu.resilience.hangwatch import run_dir_of
     from paddle_tpu.serving.jax_backend import UnsupportedModelError
+    from paddle_tpu.serving.resilience import (
+        RequestJournal,
+        ServeHangWatch,
+        StatusWriter,
+    )
 
     am = api.GradientMachine(config.model_config, seed=FLAGS.seed)
     if FLAGS.init_model_path:
@@ -109,6 +147,30 @@ def main(rest: List[str]) -> int:
         print("# serving randomly initialized parameters "
               "(no --init_model_path)", file=sys.stderr)
     registry = CompileRegistry(device_kind=jax.devices()[0].device_kind)
+    # forensics land next to the telemetry (or the cwd, telemetry-less):
+    # serve_hang_report.json / oom_report.json — where `paddle
+    # supervise` looks for them
+    report_dir = run_dir_of(FLAGS.metrics_path or FLAGS.save_dir or ".")
+    hangwatch = (ServeHangWatch(FLAGS.serve_hang_timeout, report_dir)
+                 if FLAGS.serve_hang_timeout > 0 else None)
+
+    def _on_oom(e: BaseException) -> None:
+        # the engine already answered everything outcome=error; classify
+        # the death for the supervisor: pre-mortem (ranked static plans,
+        # telemetry tail, 30s backstop) + the distinct exit code. An OOM
+        # loop is deterministic poison — `paddle supervise` charges it
+        # to the restart budget, never restarts it for free.
+        from paddle_tpu.observability.memory import trigger_oom_report
+
+        trigger_oom_report(
+            report_dir, e, groups=registry.static_memory_rows(),
+            live=None, where=None,
+            device_kind=registry.device_kind or "",
+            exit_fn=os._exit,
+        )
+        obsm.flush()
+        os._exit(EXIT_OOM)
+
     try:
         engine = build_engine(
             am._core, am.params,
@@ -120,11 +182,21 @@ def main(rest: List[str]) -> int:
             registry=registry,
             pipeline=FLAGS.serve_pipeline,
             fused_step=FLAGS.serve_fused_step,
+            shed_policy=FLAGS.serve_shed_policy,
+            breaker_threshold=FLAGS.serve_breaker_threshold,
+            breaker_cooldown_s=FLAGS.serve_breaker_cooldown,
+            hangwatch=hangwatch,
+            on_oom=_on_oom,
         )
-    except UnsupportedModelError as e:
+    except (UnsupportedModelError, ValueError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
+    journal = (RequestJournal(FLAGS.serve_journal_path)
+               if FLAGS.serve_journal_path else None)
     engine.start()
+    status = None
+    if FLAGS.status_path:
+        status = StatusWriter(FLAGS.status_path, engine).start()
     print(f"# paddle serve: {engine.slots} slot(s), max_length "
           f"{engine.max_length}, decode blocks {FLAGS.serve_decode_block}, "
           f"pipeline {'on' if FLAGS.serve_pipeline else 'off'}"
@@ -143,18 +215,52 @@ def main(rest: List[str]) -> int:
     # may still sit in the reader's buffer when SIGTERM lands; their
     # results — completed or rejected — must still be printed)
 
+    # a restarted server re-offers every accepted-but-unanswered journal
+    # entry FIRST (acceptance order), before reading fresh stdin: a
+    # crash loses a process, not a queue (at-least-once — a request
+    # whose result line printed but whose done-mark didn't land is
+    # answered again; consumers dedupe by id, doc/resilience.md)
+    if journal is not None:
+        replay = journal.pending()
+        if replay:
+            print(f"# paddle serve: re-offering {len(replay)} journaled "
+                  "request(s) from a previous run", file=sys.stderr)
+        for doc in replay:
+            # replay=True: this backlog was durably accepted by a
+            # previous incarnation — queue_cap governs NEW arrivals;
+            # capping the re-offer would reject-and-done-mark the
+            # tail, permanently truncating the very queue the journal
+            # exists to preserve
+            fut = engine.submit(
+                doc.get("prompt") or [],
+                max_new_tokens=doc.get("max_new_tokens"),
+                rid=str(doc["id"]), replay=True)
+            with plock:
+                pending.append((str(doc["id"]), fut))
+
     def _reader() -> None:
         n = 0
         for line in sys.stdin:
             line = line.strip()
             if line:
-                doc, err = _parse_line(line, n)
+                doc, err, rid = _parse_line(line, n)
                 n += 1
                 if doc is None:
-                    print(json.dumps({"id": f"req-{n - 1}",
+                    print(json.dumps({"id": rid,
                                       "outcome": "error", "tokens": [],
                                       "error": err}), flush=True)
+                elif journal is not None and not journal.accept(doc):
+                    # this id is already journaled: answered in a
+                    # previous incarnation, or re-offered above — a
+                    # replayed stdin after a supervised restart must
+                    # not double-submit (dedupe by request id)
+                    print(f"# paddle serve: duplicate request id "
+                          f"{doc['id']!r} skipped (journal)",
+                          file=sys.stderr)
                 else:
+                    # the journal accept above was flushed+fsynced
+                    # BEFORE this submit — crash-ordered ahead of any
+                    # accept effect
                     fut = engine.submit(
                         doc["prompt"],
                         max_new_tokens=doc.get("max_new_tokens"),
@@ -183,7 +289,28 @@ def main(rest: List[str]) -> int:
             out = {"id": rid, "outcome": res.outcome, "tokens": res.tokens}
             if res.error:
                 out["error"] = res.error
+            if res.retry_after_s is not None:
+                # shed answers hint when capacity is expected back
+                out["retry_after_s"] = res.retry_after_s
             print(json.dumps(out), flush=True)
+            if journal is not None:
+                # done-mark AFTER the print: a crash in between re-
+                # answers this request on restart (at-least-once)
+                journal.answer(rid, res.outcome)
+
+    if hangwatch is not None:
+        # the hang exit (monitor thread) resolves every future
+        # outcome=error and then os._exit(19)s — without this hook the
+        # main-thread printer never wakes, the error lines never reach
+        # stdout, and journal-less clients hear NOTHING. hang_fail_all
+        # resolved (or draining-rejects) every future first, so the
+        # blocking flush cannot wedge on an unresolved one; a wedged
+        # stdout is capped by the hangwatch's forensics backstop.
+        def _hang_answer_flush() -> None:
+            _flush_pending(block=True)
+            sys.stdout.flush()
+
+        hangwatch.answer_flush = _hang_answer_flush
 
     while not (eof.is_set() or drain.is_set()):
         _flush_pending(block=False)
@@ -229,6 +356,10 @@ def main(rest: List[str]) -> int:
             break
     engine.drain(timeout=600.0)
     _flush_pending(block=True)
+    if status is not None:
+        status.stop()  # final snapshot carries draining=True
+    if journal is not None:
+        journal.close()
     if obsm.enabled():
         engine.window_roll()
         obsm.emit("run_end", status="completed")

@@ -252,6 +252,9 @@ class JaxDecodeBackend:
     def reset(self) -> None:
         self._state = self._fresh_state()
         self._inflight.clear()
+        # a post-fault epoch must not union its first collect span
+        # against the dead epoch's anchor
+        self._exec_anchor = cc.perf_counter()
 
     def _sig_prefill(self):
         return (self.slots, self.prompt_tokens)
@@ -330,6 +333,13 @@ class JaxDecodeBackend:
         emitted tokens ARE the scheduler's input (EOS eviction, TTFT
         stamping), and exec/TTFT attribution happens at THIS boundary —
         the only honest place under overlap."""
+        if not self._inflight:
+            # a scheduler bug, not a device fault: fail loudly with the
+            # state instead of an opaque IndexError from popleft
+            raise RuntimeError(
+                "serve_decode collect() with no launch in flight "
+                "(dispatch/collect pairing broken)"
+            )
         toks, lives, fin, u, t_disp = self._inflight.popleft()
         toks_np = np.asarray(toks)
         lives_np = np.asarray(lives)

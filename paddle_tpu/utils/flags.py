@@ -173,6 +173,34 @@ class _Flags:
     serve_decode_block: str = "1"
     serve_pipeline: bool = True
     serve_fused_step: bool = False
+    # serving resilience (doc/resilience.md "Serving resilience"):
+    # serve_hang_timeout — no collect-boundary progress for this many
+    # seconds dumps serve_hang_report.json (thread stacks + in-flight
+    # cohort), answers in-flight requests outcome=error, exits 19
+    # (0 disables); serve_shed_policy — off | deadline (shed queued
+    # requests whose deadline the measured prefill+decode estimate
+    # can't cover, at admission) | brownout (deadline + sustained
+    # queue-pressure EMA caps output budgets and sheds new arrivals
+    # with a retry-after hint); serve_breaker_threshold — N consecutive
+    # launch faults open a reject-fast circuit breaker for
+    # serve_breaker_cooldown seconds (0 disables);
+    # serve_journal_path — durable JSONL request journal: accepted
+    # requests are fsynced before submission and re-offered on restart
+    # (at-least-once, dedupe by id); status_path — atomic health JSON
+    # renewed every second (queue depth, occupancy, last-collect age,
+    # shed/error totals, draining) for load-balancer probes and
+    # `paddle serve-status`
+    serve_hang_timeout: float = 0.0
+    serve_shed_policy: str = "off"
+    serve_breaker_threshold: int = 0
+    serve_breaker_cooldown: float = 30.0
+    serve_journal_path: str = ""
+    status_path: str = ""
+    # `paddle supervise` child job: train (default) or serve — a serve
+    # child keeps its args on restart (no --init_model_path=auto
+    # injection; the request journal is its resume state) and its
+    # crash-loop probe reads journal progress instead of checkpoints
+    supervise_job: str = "train"
     # rng
     seed: int = 1
     # distributed (multi-host jax)

@@ -1,6 +1,6 @@
-"""Race spec: serve-engine submit / cancel / evict / drain — explored
-over BOTH scheduler loops (pipelined dispatch/collect and the serial
-baseline).
+"""Race spec: serve-engine submit / cancel / evict / drain / shed /
+breaker — explored over BOTH scheduler loops (pipelined dispatch/
+collect and the serial baseline).
 
 Drives the REAL continuous-batching engine (paddle_tpu/serving/engine)
 over the deterministic FakeBackend under explored interleavings:
@@ -15,7 +15,20 @@ over the deterministic FakeBackend under explored interleavings:
    cohort resolves ``outcome=error``, the engine stays alive, later
    requests complete, drain terminates. Pipelined, the fault surfaces
    at COLLECT (jax async-dispatch semantics, modeled by FakeBackend)
-   and must also error every other in-flight snapshot exactly once.
+   and must also error every other in-flight snapshot exactly once;
+4. breaker-trip interleavings (PR-15): an engine with a one-fault
+   circuit breaker takes a collect fault while clients submit
+   concurrently — submits racing the open/half-open/closed transitions
+   may legally resolve ok, error, or shed (with a retry-after hint),
+   but never twice and never not at all, and the post-fault drain
+   terminates;
+5. shed-under-drain (PR-15): a brownout-primed engine sheds arrivals
+   while a concurrent drain rejects them — the shed/reject decision
+   races the draining flag, and whichever wins, each future resolves
+   exactly once with a legal terminal outcome. The frontend's journal
+   discipline rides along: every submitted rid has its journal accept
+   line appended (flushed + fsynced) BEFORE the submit — read back and
+   asserted after the drain.
 
 The pipelined loop adds a new shared hand-off: each dispatched launch
 carries a SNAPSHOT of its slot cohort, applied at collect while
@@ -32,10 +45,14 @@ Invariants (the no-lost / no-double-completed contract):
 - every drain returns within the schedule.
 """
 
+import json
 import logging
+import os
+import tempfile
 
 from paddle_tpu.serving.backend import FakeBackend
 from paddle_tpu.serving.engine import OUTCOMES, Engine
+from paddle_tpu.serving.resilience import CircuitBreaker, RequestJournal
 from paddle_tpu.utils import concurrency as cc
 
 NAME = "serve_engine"
@@ -151,3 +168,80 @@ def _run(ctx, pipeline=True):
     # drain: the engine must have completed them (alive after a failed
     # launch)
     assert outcomes["y0"] == "ok" and outcomes["y1"] == "ok", outcomes
+
+    # --- phase 4: breaker trip — submits race open/half-open/closed
+    backend3 = FakeBackend(slots=1, max_length=4, fail_at_launch=1)
+    engine3 = Engine(backend3, request_timeout_s=30.0, idle_poll_s=0.2,
+                     pipeline=pipeline,
+                     breaker=CircuitBreaker(1, 0.05))
+    ctx.static_watch(engine3)
+    doubles3 = _watchful_futures(ctx, engine3)
+    engine3.start()
+    futs3 = {}
+
+    def breaker_client(tag, n):
+        for i in range(n):
+            rid = f"{tag}{i}"
+            fut = engine3.submit([7], max_new_tokens=1, rid=rid)
+            with flock:
+                futs3[rid] = (fut, 1)
+            cc.sleep(0.02)  # spread submits across the breaker states
+
+    t_c = cc.Thread(target=breaker_client, args=("p", 2))
+    t_d = cc.Thread(target=breaker_client, args=("q", 2))
+    t_c.start()
+    t_d.start()
+    t_c.join()
+    t_d.join()
+    # every outcome is legal whatever the interleaving: the faulted
+    # cohort errors, open-window submits shed (with a retry hint),
+    # half-open/closed ones complete
+    for rid, (fut, _budget) in list(futs3.items()):
+        res = fut.result(timeout=120.0)
+        assert res.outcome in OUTCOMES, (rid, res.outcome)
+        if res.outcome == "shed":
+            assert res.retry_after_s is None or res.retry_after_s >= 0.0
+    assert engine3.drain(timeout=120.0), "breaker drain did not terminate"
+    _check_all(futs3, doubles3)
+
+    # --- phase 5: shed-under-drain + the journal accept ordering
+    backend4 = FakeBackend(slots=1, max_length=4, step_delay_s=0.01)
+    engine4 = Engine(backend4, request_timeout_s=30.0, idle_poll_s=0.2,
+                     pipeline=pipeline, shed_policy="brownout")
+    ctx.static_watch(engine4)
+    doubles4 = _watchful_futures(ctx, engine4)
+    # prime the brownout (the EMA would need sustained boundaries the
+    # schedule budget can't afford): arrivals past one slot wave now
+    # shed — racing the drain's draining flag below
+    with engine4._lock:
+        engine4._brownout = True
+        engine4._pressure_ema = 5.0
+    engine4.start()
+    futs4 = {}
+    jpath = os.path.join(tempfile.mkdtemp(prefix="race-journal-"), "j.jsonl")
+    journal = RequestJournal(jpath)
+
+    def shed_client(tag, n):
+        for i in range(n):
+            rid = f"{tag}{i}"
+            # the frontend's discipline, modeled: durable accept line
+            # BEFORE the submit (crash-ordered ahead of any effect)
+            journal.accept({"id": rid, "prompt": [8],
+                            "max_new_tokens": 1})
+            fut = engine4.submit([8], max_new_tokens=1, rid=rid)
+            with flock:
+                futs4[rid] = (fut, 1)
+
+    t_e = cc.Thread(target=shed_client, args=("s", 3))
+    t_e.start()
+    engine4.drain(timeout=120.0)  # races the submits: shed vs reject
+    t_e.join()
+    assert engine4.drain(timeout=120.0), "shed drain did not terminate"
+    _check_all(futs4, doubles4)
+    journal.close()
+    # the accept line for EVERY submitted rid is durably on disk —
+    # whatever the interleaving, no request was submitted unjournaled
+    with open(jpath) as f:
+        accepted = {json.loads(l)["id"] for l in f if l.strip()
+                    and json.loads(l).get("op") == "accept"}
+    assert set(futs4) <= accepted, (set(futs4), accepted)
