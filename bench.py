@@ -687,18 +687,26 @@ def _serve_sweep_continuous(gm, params, registry, *, rates, B, T,
                             max_length, n_requests, seed, timeout_s,
                             queue_cap, decode_block, prompt_fn, budget_fn,
                             pipeline=True, fused_step=False,
-                            shed_policy="off"):
+                            shed_policy="off", replicas=(1,)):
     """The continuous-batching engine (paddle_tpu/serving/) on the SAME
     seeded workload, driven open-loop in wall-clock time. ``pipeline``
     selects the overlapped dispatch/collect loop vs the serial PR-12
     loop (PADDLE_TPU_BENCH_SERVE_PIPELINE — the overlap A/B's subject).
-    Returns (sweep doc, measured capacity req/s)."""
+    ``replicas`` is the fleet-size LADDER (PADDLE_TPU_BENCH_SERVE_
+    REPLICAS): each size N > 1 runs the whole rate sweep through
+    ``drive_fleet_rung`` — N engines behind the router's own
+    least-loaded scoring — so the scaling curve (goodput vs replicas,
+    router overhead share) is measured, not assumed. Returns (sweep
+    doc, measured capacity req/s of ONE replica)."""
     import numpy as np
 
     from paddle_tpu.observability import serving
     from paddle_tpu.serving import Engine, drive_rung
+    from paddle_tpu.serving.fleet import drive_fleet_rung
     from paddle_tpu.serving.jax_backend import JaxDecodeBackend
 
+    replicas = tuple(replicas) or (1,)
+    n_max = max(replicas)
     backend = JaxDecodeBackend(
         gm, params, slots=B, prompt_tokens=T, max_length=max_length,
         decode_block=decode_block, registry=registry, pipeline=pipeline,
@@ -724,21 +732,56 @@ def _serve_sweep_continuous(gm, params, registry, *, rates, B, T,
     if not rates:
         rates = [round(f * capacity_rps, 4) for f in (0.25, 0.5, 1.0, 2.0)]
 
-    engine = Engine(backend, queue_cap=queue_cap,
-                    request_timeout_s=timeout_s, pipeline=pipeline,
-                    shed_policy=shed_policy).start()
+    # replica 0 owns the shared CompileRegistry; the extra fleet
+    # backends compile identical signatures and would only double-count
+    # the compile/roofline telemetry
+    backends = [backend] + [
+        JaxDecodeBackend(
+            gm, params, slots=B, prompt_tokens=T, max_length=max_length,
+            decode_block=decode_block, registry=None, pipeline=pipeline,
+            fused_step=fused_step,
+        )
+        for _ in range(1, n_max)
+    ]
+    engines = [
+        Engine(b, queue_cap=queue_cap, request_timeout_s=timeout_s,
+               pipeline=pipeline, shed_policy=shed_policy,
+               replica=(f"replica-{i}" if n_max > 1 else "")).start()
+        for i, b in enumerate(backends)
+    ]
     try:
         windows = []
-        for i, rate in enumerate(rates):
-            reqs = serving.schedule_requests(
-                float(rate), n_requests, seed + i, rung=i,
-                prompt_fn=prompt_fn, budget_fn=budget_fn,
-            )
-            windows.append(drive_rung(engine, reqs, rate_rps=float(rate),
-                                      rung=i))
+        rung = 0
+        for n in replicas:
+            for rate in rates:
+                reqs = serving.schedule_requests(
+                    float(rate), n_requests, seed + rung, rung=rung,
+                    prompt_fn=prompt_fn, budget_fn=budget_fn,
+                )
+                if n_max <= 1:
+                    # no fleet anywhere in the ladder: the PR-13 single-
+                    # engine path, byte-identical records
+                    w = drive_rung(engines[0], reqs, rate_rps=float(rate),
+                                   rung=rung)
+                else:
+                    # n == 1 rungs also go through the fleet driver so
+                    # the baseline carries replicas=1 (and pays the
+                    # same routing overhead) — the scaling curve's x=1
+                    # point must be measured under the same discipline
+                    w = drive_fleet_rung(engines[:n], reqs,
+                                         rate_rps=float(rate), rung=rung)
+                windows.append(w)
+                rung += 1
     finally:
-        engine.drain(timeout=600.0)
-    return ({"rungs": windows, "knee_rps": serving.saturation_knee(windows)},
+        for e in engines:
+            e.drain(timeout=600.0)
+    # the knee belongs to ONE ladder: with a fleet-size sweep, report
+    # the LARGEST fleet's (its capacity is the headline the sweep asks
+    # about); mixed-size windows would fake an early knee
+    knee_windows = [w for w in windows
+                    if int(w.get("replicas") or 1) == n_max]
+    return ({"rungs": windows,
+             "knee_rps": serving.saturation_knee(knee_windows)},
             capacity_rps)
 
 
@@ -746,7 +789,7 @@ def bench_serve(B=None, T=None, vocab=None, dim=None, beam_size=None,
                 max_length=None, n_requests=None, rates=None, seed=None,
                 run_dir=None, timeout_s=None, queue_cap=None, dtype=None,
                 engine=None, mixed_len=None, decode_block=None,
-                pipeline=None, fused_step=None):
+                pipeline=None, fused_step=None, replicas=None):
     """Offered-load serving leg (doc/observability.md "Serving
     telemetry"): a deterministic seeded open-loop arrival process at a
     sweep of offered loads drives one of TWO engines over the seqToseq
@@ -874,6 +917,22 @@ def bench_serve(B=None, T=None, vocab=None, dim=None, beam_size=None,
     rates_env = env("PADDLE_TPU_BENCH_SERVE_RATES", "")
     if rates_env:
         rates = [float(r) for r in rates_env.split(",") if r.strip()]
+    # the fleet-size ladder (--replicas=N or "1,2,4"): each size runs
+    # the whole rate sweep through the in-process fleet driver
+    # (serving/fleet.drive_fleet_rung), continuous engine only — the
+    # static driver has no router seam to measure
+    if replicas is None:
+        rep_env = env("PADDLE_TPU_BENCH_SERVE_REPLICAS", "")
+        replicas = ([int(r) for r in rep_env.split(",") if r.strip()]
+                    if rep_env else [1])
+    elif isinstance(replicas, int):
+        replicas = [replicas]
+    replicas = [max(int(n), 1) for n in replicas] or [1]
+    if max(replicas) > 1 and engine != "continuous":
+        raise ValueError(
+            "PADDLE_TPU_BENCH_SERVE_REPLICAS needs "
+            "PADDLE_TPU_BENCH_SERVE_ENGINE=continuous (the static "
+            "driver has no fleet)")
 
     if engine == "continuous":
         doc, capacity_rps = _serve_sweep_continuous(
@@ -883,6 +942,7 @@ def bench_serve(B=None, T=None, vocab=None, dim=None, beam_size=None,
             decode_block=decode_block, prompt_fn=prompt_fn,
             budget_fn=budget_fn, pipeline=bool(pipeline),
             fused_step=bool(fused_step), shed_policy=shed_policy,
+            replicas=tuple(replicas),
         )
         beam_size = 1  # the engine decodes greedily (doc/serving.md)
     else:
@@ -938,6 +998,13 @@ def bench_serve(B=None, T=None, vocab=None, dim=None, beam_size=None,
             # mode-to-mode instead of landing in only_a/only_b
             **({"pipeline": w["pipeline"]}
                if isinstance(w.get("pipeline"), str) else {}),
+            # fleet rungs: the size joins the compare key ((engine,
+            # pipeline, replicas, offered load)) and the measured
+            # router overhead share rides the artifact
+            **({"replicas": int(w["replicas"])}
+               if isinstance(w.get("replicas"), int) else {}),
+            **({"router_share": w["router_share"]}
+               if isinstance(w.get("router_share"), (int, float)) else {}),
         }
         for w in doc["rungs"]
     ]
@@ -955,6 +1022,8 @@ def bench_serve(B=None, T=None, vocab=None, dim=None, beam_size=None,
         # BENCH_*.json says WHAT was measured (and compare joins on it)
         extras["pipeline"] = "on" if pipeline else "off"
         extras["decode_blocks"] = str(decode_block)
+        if max(replicas) > 1:
+            extras["replicas"] = ",".join(str(n) for n in replicas)
         if fused_step:
             extras["fused_step"] = True
         if shed_policy != "off":

@@ -240,6 +240,10 @@ DEFAULT_CONFIG: Dict[str, Any] = {
         # backend's collect(), at the collect boundary by design
         ("serving/engine.py", "_loop_pipelined"),
         ("serving/engine.py", "_loop_blocking"),
+        # the fleet router's scheduling loop: routes every admitted
+        # request, so a device sync or unbounded wait here stalls the
+        # whole fleet
+        ("serving/fleet.py", "run"),
     ),
     # PTL002: calls whose results live on device (taint sources)
     "device_source_res": (r"\.call$", r"_step$", r"^launch_fn$"),

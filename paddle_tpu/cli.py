@@ -26,9 +26,9 @@ def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help", "help"):
         print(__doc__)
-        print("usage: paddle <train|supervise|test|gen|serve|checkgrad|"
-              "dump_config|merge_model|check-checkpoint|metrics|memory|"
-              "roofline|compare|serve-report|serve-status|lint|race|"
+        print("usage: paddle <train|supervise|test|gen|serve|serve-fleet|"
+              "checkgrad|dump_config|merge_model|check-checkpoint|metrics|"
+              "memory|roofline|compare|serve-report|serve-status|lint|race|"
               "faults|version> [--flags]")
         return 0
     cmd, rest = argv[0], argv[1:]
@@ -80,6 +80,14 @@ def main(argv=None) -> int:
         from paddle_tpu.serving.frontend import main as serve_main
 
         return serve_main(rest)
+    if cmd in ("serve-fleet", "serve_fleet"):
+        # multi-replica serving: a jax-free router supervises
+        # --fleet_replicas `paddle serve` children, balances on their
+        # health JSON, fails over via journal replay, restarts on
+        # budget (doc/serving.md "Serving fleet")
+        from paddle_tpu.serving.fleet import main as fleet_main
+
+        return fleet_main(rest)
     if cmd in ("serve-status", "serve_status"):
         # render a `paddle serve --status_path` health snapshot
         # (queue depth, occupancy, last-collect age, shed/error totals,

@@ -169,10 +169,15 @@ def analyze(streams: Dict[int, List[Dict[str, Any]]]) -> Dict[str, Any]:
             elif kind == "serve_window":
                 # pipeline joins the key: a one-dir pipelined-vs-
                 # blocking A/B re-runs the same (engine, rung) ladder
-                # and must keep BOTH sweeps, like the both-engines case
+                # and must keep BOTH sweeps, like the both-engines case;
+                # replica/replicas join it too — a fleet rung carries N
+                # per-replica windows PLUS their merged (replicas=N)
+                # rollup, all legitimately at the same (engine, rung)
                 serve_windows_by[
                     (host, rec.get("engine", "static"),
-                     str(rec.get("pipeline") or ""), rec.get("rung"))
+                     str(rec.get("pipeline") or ""),
+                     str(rec.get("replica") or ""),
+                     int(rec.get("replicas") or 0), rec.get("rung"))
                 ] = rec
             elif kind == "pass_end":
                 p = int(rec.get("pass", -1))
@@ -181,7 +186,8 @@ def analyze(streams: Dict[int, List[Dict[str, Any]]]) -> Dict[str, Any]:
         serve_windows_by[k] for k in sorted(
             serve_windows_by,
             key=lambda k: (k[1] if k[1] is not None else -1, k[2],
-                           k[3] if isinstance(k[3], int) else -1, k[0]),
+                           k[4], k[3],
+                           k[5] if isinstance(k[5], int) else -1, k[0]),
         )
     ]
 

@@ -72,7 +72,8 @@ _HIGHER_BETTER = {
 
 def _serve_key(offered_rps, qualifier, seen_pre: set,
                engine: Optional[str] = None,
-               pipeline: Optional[str] = None) -> str:
+               pipeline: Optional[str] = None,
+               replicas: Any = None) -> str:
     """The ONE serve rung key format, shared by the run-dir and bench-
     artifact sides (a divergence would silently break their
     comparability): 6 significant digits of offered load — a slow
@@ -84,19 +85,27 @@ def _serve_key(offered_rps, qualifier, seen_pre: set,
     rate) once per mode), and finally rung-qualified (variance-gauging
     repeated rates) instead of silently overwritten.
 
-    The rung join is therefore (engine, pipeline, offered load): two
-    sweeps of the SAME configuration join on offered load alone;
-    mismatched ladders land in only_a/only_b (visible, never a bogus
-    verdict); and a pure A/B — one engine (or one pipeline mode) per
-    artifact, pinned PADDLE_TPU_BENCH_SERVE_RATES — joins on offered
-    load, which is exactly the static-vs-continuous (or pipelined-vs-
-    blocking) comparison being asked for."""
+    The rung join is therefore (engine, pipeline, replicas, offered
+    load): two sweeps of the SAME configuration join on offered load
+    alone; mismatched ladders land in only_a/only_b (visible, never a
+    bogus verdict); and a pure A/B — one engine (or one pipeline mode)
+    per artifact, pinned PADDLE_TPU_BENCH_SERVE_RATES — joins on
+    offered load, which is exactly the static-vs-continuous (or
+    pipelined-vs-blocking) comparison being asked for.
+
+    Fleet rungs (``--replicas=N``, N > 1) carry an unconditional
+    ``xN`` qualifier: a replicas ladder repeats every (engine, rate)
+    once per fleet size IN ONE artifact, and the scaling curve
+    (goodput vs replicas, router overhead share) is read by joining
+    same-x rungs across artifacts — an x2 rung must never diff against
+    an x4 one."""
     rate = format(float(offered_rps or 0.0), ".6g")
-    pre = f"serve.{rate}rps."
+    x = f"x{int(replicas)}." if replicas and int(replicas) > 1 else ""
+    pre = f"serve.{x}{rate}rps."
     if pre in seen_pre and engine:
-        pre = f"serve.{engine}.{rate}rps."
+        pre = f"serve.{engine}.{x}{rate}rps."
     if pre in seen_pre and engine and pipeline:
-        pre = f"serve.{engine}.pipe-{pipeline}.{rate}rps."
+        pre = f"serve.{engine}.pipe-{pipeline}.{x}{rate}rps."
     if pre in seen_pre:
         pre = f"{pre[:-1]}.r{qualifier}."
     seen_pre.add(pre)
@@ -210,7 +219,13 @@ def _run_side(path: str) -> Dict[str, float]:
     # PADDLE_TPU_BENCH_SERVE_RATES for A/B runs. The knee rides as one
     # headline number either way. A run dir can carry both training and
     # serve telemetry — the key namespaces never collide.
-    windows = doc.get("serve_windows") or []
+    # per-replica fleet windows (carrying `replica`) are diagnostics,
+    # not comparison units: N of them share one (engine, pipeline,
+    # rate) per rung, and the MERGED replicas=N rollup is the record
+    # the scaling curve joins on — keying the parts would mint
+    # nondeterministic .rN qualifiers and bogus cross-replica diffs
+    windows = [w for w in (doc.get("serve_windows") or [])
+               if not w.get("replica")]
     seen_pre: set = set()
     # deterministic key assignment: iterate (engine, rung)-sorted so a
     # both-engines stream always hands the SAME engine the unqualified
@@ -219,12 +234,14 @@ def _run_side(path: str) -> Dict[str, float]:
     for w in sorted(windows,
                     key=lambda w: (str(w.get("engine") or ""),
                                    str(w.get("pipeline") or ""),
+                                   int(w.get("replicas") or 0),
                                    w.get("rung") if isinstance(
                                        w.get("rung"), int) else 0)):
         engine = w.get("engine") if isinstance(w.get("engine"), str) else None
         pipe = w.get("pipeline") if isinstance(w.get("pipeline"), str) else None
         pre = _serve_key(w.get("offered_rps"), w.get("rung", 0), seen_pre,
-                         engine=engine, pipeline=pipe)
+                         engine=engine, pipeline=pipe,
+                         replicas=w.get("replicas"))
         for snap_key, dst, scale in (
             ("latency", "p50_ms", 1e3), ("latency", "p99_ms", 1e3),
             ("ttft", "ttft_p50_ms", 1e3), ("ttft", "ttft_p99_ms", 1e3),
@@ -238,6 +255,11 @@ def _run_side(path: str) -> Dict[str, float]:
         if isinstance(w.get("queue_wait_share"), (int, float)):
             out[_engine_scoped(pre, engine, "queue_wait_share")] = float(
                 w["queue_wait_share"])
+        if isinstance(w.get("router_share"), (int, float)):
+            # fleet rungs: the router's measured host-seconds share of
+            # the window — the scaling curve's overhead axis
+            out[_engine_scoped(pre, engine, "router_share")] = float(
+                w["router_share"])
         # overload-defense rates, ZERO-FILLED when the window predates
         # them (pre-shed artifacts carry no `shed` field): both sides
         # then share the keys, and 0 -> N shed/error growth gets a
@@ -317,15 +339,16 @@ def _bench_side(path: str, raw: str) -> Dict[str, float]:
     seen_pre: set = set()
     rungs = [(i, r) for i, r in enumerate(line.get("rungs") or [])
              if isinstance(r, dict)]
-    # (engine, pipeline, index)-sorted for the same deterministic key
-    # assignment as the run-dir side (see _run_side)
+    # (engine, pipeline, replicas, index)-sorted for the same
+    # deterministic key assignment as the run-dir side (see _run_side)
     rungs.sort(key=lambda p: (str(p[1].get("engine") or ""),
-                              str(p[1].get("pipeline") or ""), p[0]))
+                              str(p[1].get("pipeline") or ""),
+                              int(p[1].get("replicas") or 0), p[0]))
     for i, r in rungs:
         engine = r.get("engine") if isinstance(r.get("engine"), str) else None
         pipe = r.get("pipeline") if isinstance(r.get("pipeline"), str) else None
         pre = _serve_key(r.get("offered_rps"), i, seen_pre, engine=engine,
-                         pipeline=pipe)
+                         pipeline=pipe, replicas=r.get("replicas"))
         for key in ("p50_ms", "p99_ms", "ttft_p50_ms", "ttft_p99_ms",
                     "goodput_tok_s"):
             v = r.get(key)
@@ -334,6 +357,10 @@ def _bench_side(path: str, raw: str) -> Dict[str, float]:
         v = r.get("queue_wait_share")
         if isinstance(v, (int, float)) and not isinstance(v, bool):
             out[_engine_scoped(pre, engine, "queue_wait_share")] = float(v)
+        v = r.get("router_share")
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            # fleet rungs: measured router overhead share of the window
+            out[_engine_scoped(pre, engine, "router_share")] = float(v)
         # zero-filled like the run-dir side: pre-shed bench artifacts
         # (no shed_rate field) still join, with 0 -> N judged
         for key in ("shed_rate", "error_rate"):

@@ -64,7 +64,7 @@ FILE_FMT = "metrics.host%d.jsonl"
 FLUSH_KINDS = frozenset(
     {"run_start", "run_end", "pass_end", "checkpoint",
      "barrier_skew", "restart", "compile", "roofline",
-     "request", "serve_window", "memory", "oom"}
+     "request", "serve_window", "memory", "oom", "reload"}
 )
 
 # required keys of every record; kind-specific fields ride alongside
@@ -101,6 +101,11 @@ KIND_REQUIRED = {
     # records carry it too (optional pre-PR-12 streams still validate)
     "request": ("id", "outcome"),
     "serve_window": ("rung", "offered_rps", "engine"),
+    # hot weight reload (serving/engine.py _apply_reload_locked): one
+    # record per boundary swap — `path` names the checkpoint that went
+    # live; rare and load-bearing (the train→serve loop's visible
+    # seam), so it rides FLUSH_KINDS
+    "reload": ("path",),
     # memory plane (observability/memory.py): host_rss_bytes is the one
     # field every backend can supply — hbm_* fields are present exactly
     # when the allocator reports stats (None on the CPU backend)

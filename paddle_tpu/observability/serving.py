@@ -143,7 +143,7 @@ class RequestLog:
 
     def __init__(self, rung: int = 0, offered_rps: float = 0.0,
                  beam_size: Optional[int] = None, engine: str = "static",
-                 pipeline: Optional[str] = None):
+                 pipeline: Optional[str] = None, replica: str = ""):
         self.rung = int(rung)
         self.offered_rps = float(offered_rps)
         self.beam_size = beam_size
@@ -159,6 +159,11 @@ class RequestLog:
         # A/B keeps both ladders apart. None (the static driver) leaves
         # the field off the records
         self.pipeline = None if pipeline is None else str(pipeline)
+        # fleet identity ("" outside a fleet): which replica's engine
+        # produced this window — keeps N replicas' records apart in one
+        # stream; the MERGED fleet window instead carries `replicas=N`
+        # (serving/fleet.py merge_windows)
+        self.replica = str(replica)
         # host seconds spent scheduling while a decode launch was in
         # flight (the pipelined loop's dispatch->collect-entry gaps)
         self.overlap_s = 0.0
@@ -192,6 +197,7 @@ class RequestLog:
             "outcome": req.outcome,
             **({"pipeline": self.pipeline} if self.pipeline is not None
                else {}),
+            **({"replica": self.replica} if self.replica else {}),
             "t_enqueue": round(req.t_enqueue, 6),
             "prompt_tokens": int(req.prompt_tokens),
         }
@@ -378,6 +384,8 @@ class RequestLog:
             rec["beam_size"] = int(self.beam_size)
         if self.pipeline is not None:
             rec["pipeline"] = self.pipeline
+        if self.replica:
+            rec["replica"] = self.replica
         if self.overlap_s > 0:
             rec["overlap_s"] = round(self.overlap_s, 6)
         if self._e2e_ok_s > 0:

@@ -105,6 +105,19 @@ SITE_DOCS = {
         "faults: the in-flight cohort resolves outcome=error and "
         "consecutive faults trip the --serve_breaker_threshold "
         "circuit breaker)",
+    "fleet.replica_crash":
+        "at each serve-fleet router supervision poll (raise:K = "
+        "hard-kill replica index K — the journal re-offer/failover "
+        "drill: its unanswered requests replay onto survivors)",
+    "fleet.status_stale":
+        "at each serve-fleet health probe (raise = that replica's "
+        "status reads as stale — the router must route around it, "
+        "never crash, and only kill it past the persistence bound)",
+    "fleet.reload_torn":
+        "in the weight-reload watcher between the durability probe "
+        "and the checkpoint load (raise = the checkpoint became "
+        "durable mid-swap — abort the attempt, keep serving old "
+        "weights, retry next poll)",
 }
 
 KNOWN_SITES = tuple(SITE_DOCS)

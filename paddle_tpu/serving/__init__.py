@@ -23,8 +23,12 @@ Layering (mirrors the analysis/resilience discipline):
 - ``resilience.py`` — the serving resilience plane (doc/resilience.md
   "Serving resilience"): engine hangwatch (serve_hang_report.json +
   exit 19), launch-failure circuit breaker, durable request journal
-  (at-least-once restart recovery), and the ``--status_path`` health
-  probe + `paddle serve-status`.
+  (at-least-once restart recovery), the ``--status_path`` health
+  probe + `paddle serve-status`, and the hot weight-reload watcher.
+- ``fleet.py`` — ``paddle serve-fleet``: the multi-replica router
+  (health-based least-loaded balancing, journal-replay failover under
+  at-least-once dedupe, fleet-wide graceful drain — doc/serving.md
+  "Serving fleet").
 """
 
 from paddle_tpu.serving.backend import (
@@ -40,12 +44,19 @@ from paddle_tpu.serving.engine import (
     drive_rung,
     pick_block,
 )
+from paddle_tpu.serving.fleet import (
+    FleetRouter,
+    drive_fleet_rung,
+    replica_score,
+)
 from paddle_tpu.serving.resilience import (
     SERVE_HANG_REPORT,
     CircuitBreaker,
     RequestJournal,
     ServeHangWatch,
     StatusWriter,
+    WeightReloader,
+    read_status,
 )
 
 __all__ = [
@@ -53,4 +64,6 @@ __all__ = [
     "FakeBackend", "StepOut", "drive_rung", "pick_block",
     "parse_decode_blocks", "CircuitBreaker", "RequestJournal",
     "ServeHangWatch", "StatusWriter", "SERVE_HANG_REPORT",
+    "FleetRouter", "drive_fleet_rung", "replica_score",
+    "WeightReloader", "read_status",
 ]

@@ -21,6 +21,11 @@ device-shaped hides behind this protocol:
   shows recompiles=0 afterwards); ``reset()`` — discard all device
   state AND the in-flight queue after a failed launch (the engine
   errors every in-flight cohort and keeps serving).
+- ``reload(params)`` — hot weight swap, called by the engine ONLY at
+  an iteration boundary (between a collect and the next dispatch): an
+  O(1) reference replacement of the weights the next launch reads.
+  Same shapes → no recompile; already-dispatched launches snapshotted
+  the old reference and are unaffected.
 
 :class:`FakeBackend` is the deterministic jax-free implementation the
 unit tests and ``tests/race_specs/spec_serve_engine.py`` drive the REAL
@@ -116,6 +121,7 @@ class FakeBackend:
             lambda rid, i: 2 + (hash((rid, i)) % 97)
         )
         self.launches = 0
+        self.reloads = 0                    # reload() calls, for tests
         self.admits: List[List[str]] = []   # admission waves, for tests
         self._rows: List[Optional[dict]] = [None] * self.slots
         # dispatched-but-uncollected results (or faults): StepOut |
@@ -134,6 +140,16 @@ class FakeBackend:
     def reset(self) -> None:
         self._rows = [None] * self.slots
         self._pending.clear()
+
+    def reload(self, params: Any) -> None:
+        """Hot weight swap, modeled: a callable payload replaces
+        ``token_fn`` (the fake's "weights" — tests observe the scripted
+        output change at exactly the next launch); anything else just
+        counts. Raising here must leave the old behavior serving —
+        engine._apply_reload_locked's contract."""
+        if callable(params):
+            self.token_fn = params
+        self.reloads += 1
 
     def admit(self, slot_ids: Sequence[int], requests: Sequence[Any],
               budgets: Sequence[int]) -> None:

@@ -242,7 +242,8 @@ class Engine:
                  shed_policy: str = "off",
                  breaker=None,
                  hangwatch=None,
-                 on_oom: Optional[Callable[[BaseException], None]] = None):
+                 on_oom: Optional[Callable[[BaseException], None]] = None,
+                 replica: str = ""):
         if shed_policy not in SHED_POLICIES:
             raise ValueError(
                 f"unknown shed policy {shed_policy!r}: expected one of "
@@ -253,6 +254,10 @@ class Engine:
         self.request_timeout_s = float(request_timeout_s)
         self.idle_poll_s = float(idle_poll_s)
         self.pipeline = bool(pipeline)
+        # fleet identity: stamped on every request/serve_window record
+        # this engine emits, so N in-process replicas (bench --replicas)
+        # stay distinguishable in one telemetry stream
+        self.replica = str(replica)
         self._clock = clock or cc.monotonic
         self._lock = cc.Lock()
         self._wake = cc.Condition(self._lock)
@@ -296,6 +301,14 @@ class Engine:
         self._totals: Dict[str, int] = {o: 0 for o in OUTCOMES}
         self._last_collect = self._clock()   # last collect/step result
         self._last_loop = self._clock()      # last scheduler-loop beat
+        # --- hot weight reload (doc/serving.md "Serving fleet"): a
+        # pending (params, tag) pair set by request_reload() and
+        # applied by the scheduler at the NEXT iteration boundary —
+        # dispatched launches snapshot their arguments, so swapping
+        # between boundaries never tears an in-flight decode
+        self._pending_reload: Optional[Tuple[Any, str]] = None
+        self._reloads = 0
+        self._reload_tag = ""
 
     # ----------------------------------------------------------- client
 
@@ -309,7 +322,8 @@ class Engine:
 
     def _fresh_log(self) -> slog.RequestLog:
         return slog.RequestLog(engine=ENGINE_NAME,
-                               pipeline="on" if self.pipeline else "off")
+                               pipeline="on" if self.pipeline else "off",
+                               replica=self.replica)
 
     def start(self) -> "Engine":
         """Warm the backend (all compiles land BEFORE serving — the
@@ -417,6 +431,18 @@ class Engine:
                     req.cancelled = True
                     return True
         return False
+
+    def request_reload(self, params, tag: str = "") -> None:
+        """Stage a hot weight swap: the scheduler applies ``params`` via
+        ``backend.reload`` at the next iteration boundary, so requests
+        admitted before the swap finish on the OLD weights (their
+        dispatched launches already snapshotted them) and everything
+        after decodes on the new ones — nothing is dropped, nothing is
+        stranded. A second call before the boundary supersedes the
+        first (only the newest checkpoint matters)."""
+        with self._lock:
+            self._pending_reload = (params, str(tag))
+            self._wake.notify_all()
 
     def drain(self, timeout: Optional[float] = None) -> bool:
         """Graceful shutdown: finish in-flight slots, reject the queue
@@ -706,6 +732,8 @@ class Engine:
                 "shed_policy": self.shed_policy,
                 "pipeline": "on" if self.pipeline else "off",
                 "warmup_s": self.warmup_s,
+                "reloads": self._reloads,
+                "reload_tag": self._reload_tag,
                 "totals": dict(self._totals),
             }
         finally:
@@ -762,6 +790,13 @@ class Engine:
         with self._lock:
             now = self._now()
             self._last_loop = self._clock()  # status loop-age beat
+            if self._pending_reload is not None:
+                params, tag = self._pending_reload
+                self._pending_reload = None
+                if self._swap_weights(params, tag):
+                    self._reloads += 1
+                    self._reload_tag = tag
+                    self._note_reload(tag)
             self._sweep_locked(now)
             if self._draining:
                 while self._queue:
@@ -823,6 +858,30 @@ class Engine:
         from paddle_tpu.observability import metrics as obs
 
         obs.registry().gauge("serve.brownout").set(v)
+
+    def _swap_weights(self, params, tag: str) -> bool:
+        """The iteration-boundary weight swap. The backend assignment
+        is an O(1) reference swap (same shapes → no recompile; the
+        NON-donated params argument means no dispatched launch can be
+        torn by it). A failing swap keeps the old weights serving —
+        reload is an upgrade, never an outage. Caller (the boundary,
+        under the engine lock) bumps the reload bookkeeping on True."""
+        try:
+            self._backend.reload(params)
+        except Exception as e:  # noqa: BLE001 — old weights keep serving
+            logger.error("serve weight reload %r failed: %s — keeping "
+                         "current weights", tag, e)
+            return False
+        return True
+
+    def _note_reload(self, tag: str) -> None:
+        from paddle_tpu.observability import metrics as obs
+
+        obs.registry().counter("serve.reloads").inc()
+        obs.emit("reload", path=tag, engine=ENGINE_NAME,
+                 **({"replica": self.replica} if self.replica else {}))
+        logger.info("serve weights hot-reloaded at iteration boundary "
+                    "(%s, reload #%d)", tag or "<untagged>", self._reloads)
 
     def _do_admit(self, admit_slots: List[int],
                   admit_reqs: List[EngineRequest],

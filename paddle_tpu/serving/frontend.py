@@ -138,6 +138,7 @@ def main(rest: List[str]) -> int:
         RequestJournal,
         ServeHangWatch,
         StatusWriter,
+        WeightReloader,
     )
 
     am = api.GradientMachine(config.model_config, seed=FLAGS.seed)
@@ -197,6 +198,21 @@ def main(rest: List[str]) -> int:
     status = None
     if FLAGS.status_path:
         status = StatusWriter(FLAGS.status_path, engine).start()
+    reloader = None
+    if FLAGS.serve_reload_watch:
+        # hot weight reload (doc/serving.md "Serving fleet"): when a
+        # NEWER durable checkpoint lands under the watch dir, load it
+        # through the same loadParameters path the startup weights took
+        # and stage it for the next iteration boundary — in-flight and
+        # queued requests are untouched
+        def _load_ckpt(path: str):
+            am.loadParameters(path)
+            return am.params
+
+        reloader = WeightReloader(FLAGS.serve_reload_watch, engine,
+                                  _load_ckpt).start()
+        print(f"# paddle serve: watching {FLAGS.serve_reload_watch} for "
+              "durable checkpoints (hot weight reload)", file=sys.stderr)
     print(f"# paddle serve: {engine.slots} slot(s), max_length "
           f"{engine.max_length}, decode blocks {FLAGS.serve_decode_block}, "
           f"pipeline {'on' if FLAGS.serve_pipeline else 'off'}"
@@ -354,6 +370,8 @@ def main(rest: List[str]) -> int:
                 quiet_at = cc.monotonic()
         if eof.is_set():
             break
+    if reloader is not None:
+        reloader.stop()  # no swap may race the drain's final windows
     engine.drain(timeout=600.0)
     _flush_pending(block=True)
     if status is not None:

@@ -256,6 +256,15 @@ class JaxDecodeBackend:
         # against the dead epoch's anchor
         self._exec_anchor = cc.perf_counter()
 
+    def reload(self, params: Any) -> None:
+        """Hot weight swap at an iteration boundary (the engine's
+        ``_apply_reload_locked`` is the only caller). Params are the
+        NON-donated first argument of both launch fns — dispatched
+        launches already captured the old reference, so this reference
+        replacement cannot tear them; same shapes/dtypes hit the same
+        jit cache, so the swap costs no recompile."""
+        self.params = params
+
     def _sig_prefill(self):
         return (self.slots, self.prompt_tokens)
 

@@ -39,6 +39,15 @@ reinventing them:
   for serve children (the serving analog of ``probe_restorable``):
   answered-request count between deaths distinguishes a crash loop
   from a run that is working its queue down.
+- :class:`WeightReloader` — hot weight reload (doc/serving.md "Serving
+  fleet"): a daemon thread polls a checkpoint dir with the
+  supervisor's durability probe (``probe_restorable`` — manifests
+  gate, torn saves never load) and, when a NEWER durable checkpoint
+  lands, loads it and stages it via ``Engine.request_reload`` for the
+  next iteration boundary. Requests admitted before the swap finish on
+  the old weights; nothing is dropped or stranded. The
+  ``fleet.reload_torn`` chaos site fires between the durability probe
+  and the load — the checkpoint-becomes-durable-mid-swap drill.
 
 Everything here is jax-free and, like the engine, reads clocks only
 through the ``utils/concurrency`` seam (PTL001: the one wall-clock
@@ -54,6 +63,7 @@ import os
 import sys
 from typing import Any, Callable, Dict, List, Optional
 
+from paddle_tpu.resilience import faultinject
 from paddle_tpu.resilience.hangwatch import HangWatch
 from paddle_tpu.utils import concurrency as cc
 from paddle_tpu.utils.logging import logger
@@ -62,7 +72,8 @@ SERVE_HANG_REPORT = "serve_hang_report.json"
 
 __all__ = [
     "SERVE_HANG_REPORT", "ServeHangWatch", "CircuitBreaker",
-    "RequestJournal", "StatusWriter", "journal_progress", "status_main",
+    "RequestJournal", "StatusWriter", "WeightReloader", "read_status",
+    "journal_progress", "status_main",
 ]
 
 
@@ -418,6 +429,177 @@ class StatusWriter:
         self.write_now()  # final snapshot carries the draining flag
 
 
+def read_status(path: str) -> Optional[Dict[str, Any]]:
+    """Tolerant ``--status_path`` reader: the document, or ``None`` on
+    any missing/torn/non-object file. The fleet router and the fleet
+    status view call this per poll — an unreadable probe is a health
+    verdict over there, never an exception here."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return doc if isinstance(doc, dict) else None
+
+
+# ------------------------------------------------------------- reload
+
+
+class WeightReloader:
+    """Hot weight reload: watch a checkpoint dir, stage newer durable
+    checkpoints into a live engine (doc/serving.md "Serving fleet").
+
+    The durability probe is the supervisor's ``probe_restorable`` —
+    only manifest-verified saves qualify, so a checkpoint still being
+    written (or torn by a trainer crash) never loads. The baseline is
+    whatever is newest at START: serving begins on the weights it was
+    launched with, and only checkpoints landing AFTER that trigger a
+    swap. ``loader(path)`` turns a checkpoint path into backend params
+    (the front-end passes ``GradientMachine.loadParameters`` + a device
+    re-shard); it runs on the watcher thread, off the scheduler — the
+    engine only sees the O(1) ``request_reload`` staging.
+
+    Failure posture: a probe or load error logs and keeps the current
+    weights serving (a poison checkpoint is skipped permanently, not
+    retried in a hot loop); the ``fleet.reload_torn`` chaos site aborts
+    the attempt and retries next poll."""
+
+    def __init__(self, watch_dir: str, engine, loader, *,
+                 interval_s: float = 2.0, probe=None):
+        if probe is None:
+            from paddle_tpu.resilience.supervisor import probe_restorable
+            probe = probe_restorable
+        self.watch_dir = watch_dir
+        self.interval_s = float(interval_s)
+        self._engine = engine
+        self._loader = loader
+        self._probe = probe
+        self._lock = cc.Lock()
+        self._stop = cc.Event()
+        self._thread = None
+        self.reloads = 0
+        try:
+            baseline = probe(watch_dir)
+        except Exception:
+            baseline = None
+        self._last = baseline
+
+    def check_once(self) -> bool:
+        """One poll: True iff a new checkpoint was staged."""
+        try:
+            path = self._probe(self.watch_dir)
+        except Exception as e:  # probe trouble = no news, not a crash
+            logger.warning("weight reload probe failed (%s): %s",
+                           self.watch_dir, e)
+            return False
+        with self._lock:
+            if not path or path == self._last:
+                return False
+        try:
+            # chaos: the checkpoint became durable mid-swap — abort this
+            # attempt, retry next poll (doc/resilience.md)
+            faultinject.fault_point("fleet.reload_torn", info=path)
+            params = self._loader(path)
+        except faultinject.FaultInjected as e:
+            logger.warning("weight reload of %s aborted (%s) — retrying "
+                           "next poll", path, e)
+            return False
+        except Exception as e:  # noqa: BLE001 — poison ckpt: skip, serve on
+            logger.error("weight reload of %s failed (%s) — keeping "
+                         "current weights, will not retry this one",
+                         path, e)
+            with self._lock:
+                self._last = path
+            return False
+        self._engine.request_reload(params, tag=path)
+        with self._lock:
+            self._last = path
+            self.reloads += 1
+        logger.info("weight reload staged: %s (swap lands at the next "
+                    "iteration boundary)", path)
+        return True
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.check_once()
+
+    def start(self) -> "WeightReloader":
+        if self._thread is None:
+            self._stop.clear()
+            t = cc.Thread(target=self._run, name="serve-reload",
+                          daemon=True)
+            self._thread = t
+            t.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=max(self.interval_s * 2, 1.0))
+
+
+def _fleet_status(dirpath: str, as_json: bool) -> int:
+    """``paddle serve-status <fleet_status_dir>`` — the aggregate view
+    over every replica's status JSON in one directory (the layout
+    ``paddle serve-fleet`` maintains). Missing or torn documents render
+    as a STALE row, never an error — mid-rewrite snapshots are normal
+    under load."""
+    try:
+        names = sorted(n for n in os.listdir(dirpath)
+                       if n.endswith(".json") and not n.endswith(".tmp"))
+    except OSError as e:
+        print(f"error: cannot list {dirpath!r}: {e}", file=sys.stderr)
+        return 1
+    docs = {n[:-len(".json")]: read_status(os.path.join(dirpath, n))
+            for n in names}
+    if as_json:
+        print(json.dumps(
+            {name: (doc if doc is not None else {"stale": True})
+             for name, doc in docs.items()}, indent=2))
+        return 0
+    if not docs:
+        print(f"(no replica status files in {dirpath})")
+        return 0
+    header = ("replica", "state", "queue", "slots", "breaker",
+              "collect age", "ok", "err")
+    rows = [header]
+    tot_queue = tot_ok = tot_err = tot_occ = tot_slots = up = 0
+    for name, doc in docs.items():
+        if doc is None or doc.get("stale") or doc.get("error"):
+            detail = ("torn/missing" if doc is None
+                      else doc.get("detail") or doc.get("error") or "stale")
+            rows.append((name, f"STALE ({detail})", "-", "-", "-", "-",
+                         "-", "-"))
+            continue
+        totals = doc.get("totals") or {}
+        state = ("draining" if doc.get("draining")
+                 else ("up" if doc.get("started") else "starting"))
+        if state == "up":
+            up += 1
+        occ, slots = int(doc.get("occupancy") or 0), int(doc.get("slots") or 0)
+        q = int(doc.get("queue_depth") or 0)
+        ok, err = int(totals.get("ok") or 0), int(totals.get("error") or 0)
+        rows.append((name, state, str(q), f"{occ}/{slots}",
+                     str(doc.get("breaker", "disabled")),
+                     f"{float(doc.get('last_collect_age_s') or 0.0):.3f}s",
+                     str(ok), str(err)))
+        tot_queue += q
+        tot_ok += ok
+        tot_err += err
+        tot_occ += occ
+        tot_slots += slots
+    rows.append(("fleet", f"{up}/{len(docs)} up", str(tot_queue),
+                 f"{tot_occ}/{tot_slots}", "-", "-", str(tot_ok),
+                 str(tot_err)))
+    widths = [max(len(r[i]) for r in rows) for i in range(len(header))]
+    for i, r in enumerate(rows):
+        print("  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip())
+        if i == 0:
+            print("  ".join("-" * w for w in widths))
+    return 0
+
+
 def status_main(argv=None) -> int:
     """``paddle serve-status <path>`` — render a ``--status_path``
     snapshot. jax-free: the probe side runs anywhere."""
@@ -426,10 +608,14 @@ def status_main(argv=None) -> int:
         description="render a `paddle serve --status_path` health "
                     "snapshot (doc/serving.md \"Serving resilience\")",
     )
-    p.add_argument("path", help="the --status_path JSON file")
+    p.add_argument("path", help="a --status_path JSON file, or a fleet "
+                                "status DIRECTORY (--fleet_status_dir) "
+                                "for the aggregate per-replica view")
     p.add_argument("--json", action="store_true", dest="as_json",
-                   help="print the raw document")
+                   help="print the raw document(s)")
     args = p.parse_args(argv)
+    if os.path.isdir(args.path):
+        return _fleet_status(args.path, args.as_json)
     try:
         with open(args.path, encoding="utf-8") as f:
             doc = json.load(f)

@@ -196,6 +196,19 @@ class _Flags:
     serve_breaker_cooldown: float = 30.0
     serve_journal_path: str = ""
     status_path: str = ""
+    # serving fleet (`paddle serve-fleet`, serving/fleet.py, doc/
+    # serving.md "Serving fleet"): fleet_replicas `paddle serve`
+    # children behind one stdin-JSONL router balancing on each
+    # replica's health JSON; fleet_status_dir holds the per-replica
+    # status/journal/metrics files (default <save_dir>/fleet_status) —
+    # also what `paddle serve-status <dir>` aggregates;
+    # serve_reload_watch — a checkpoint save_dir each replica watches:
+    # when a NEWER durable (manifest-verified) checkpoint lands there,
+    # weights hot-swap at the next iteration boundary without dropping
+    # in-flight or queued requests ("" disables)
+    fleet_replicas: int = 2
+    fleet_status_dir: str = ""
+    serve_reload_watch: str = ""
     # `paddle supervise` child job: train (default) or serve — a serve
     # child keeps its args on restart (no --init_model_path=auto
     # injection; the request journal is its resume state) and its

@@ -1,0 +1,338 @@
+"""Race spec: serve-fleet router — route / death / re-offer / drain
+interleavings over the REAL :class:`FleetRouter` and in-process fake
+replica handles (the duck-typed protocol ProcReplica implements).
+
+The router's contract is the fleet-level exactly-once claim: whatever
+the interleaving of the stdin submitter, the per-replica answer
+threads, a replica death (journal re-offer to survivors + restart
+replay) and a drain, every submitted request id is emitted EXACTLY
+once, in submission order, with a legal terminal outcome. The fakes
+keep the at-least-once hazard real: a restarted replica replays its
+accepted-but-unanswered journal, so the same id can be answered by the
+re-offer target AND the replayer — the router must emit the first and
+count the duplicate.
+
+Phases:
+
+1. two client threads submit concurrently while the router loop routes
+   across two live replicas — EOF batch completes, all answers in
+   submission order;
+2. death-mid-load: replica-0 is killed (exit 17, the budgeted class)
+   after accepting work; its journal pending re-offers to replica-1
+   while its restart replays the same entries — no lost id, no double
+   emission, ``deaths``/``reoffers`` observed;
+3. drain racing a submitting client: whichever side of the draining
+   flag each submit lands on, the outcome is ok (in-flight completed),
+   rejected (queued/new at drain) or error (owed by a child that
+   exited mid-drain) — and the drain TERMINATES with every child down;
+4. budget exhaustion: a one-replica fleet with ``restart_budget=0``
+   takes a kill — the router answers everything ``outcome=error``
+   instead of hanging the client, and ``run()`` returns 1.
+
+Invariants (the no-lost / no-double-answered contract):
+- every submitted id appears in the emit stream exactly once,
+- emission respects submission order,
+- ``run()`` terminates within the schedule,
+- duplicate replica answers are absorbed (counted, never re-emitted).
+"""
+
+import collections
+import logging
+
+from paddle_tpu.serving.fleet import FleetRouter
+from paddle_tpu.utils import concurrency as cc
+
+NAME = "serve_fleet"
+
+LEGAL = ("ok", "rejected", "error")
+
+
+class FakeReplica:
+    """In-process replica handle: a worker thread answers each routed
+    request after a short delay, an in-memory journal records
+    accept/done with the frontend's ordering (done only after the
+    answer is delivered), and ``start()`` replays accepted-but-undone
+    entries — the single server's at-least-once restart recovery."""
+
+    def __init__(self, name, delay_s=0.01):
+        self.name = name
+        self.delay_s = delay_s
+        self.deliver = None  # wired to router.deliver by the harness
+        self._lock = cc.Lock()
+        self._cv = cc.Condition(self._lock)
+        self._queue = collections.deque()
+        self._accepted = {}  # rid -> doc, acceptance order
+        self._done = set()
+        self._exit = None
+        self._alive = False
+        self._draining = False
+        self._worker = None
+        self.incarnations = 0
+
+    # -------------------------------------------------- handle protocol
+
+    def start(self):
+        with self._lock:
+            self._exit = None
+            self._alive = True
+            self._draining = False
+            self.incarnations += 1
+            # journal replay — the at-least-once hazard the router's
+            # dedupe must absorb
+            for rid, doc in self._accepted.items():
+                if rid not in self._done:
+                    self._queue.append(dict(doc))
+            self._cv.notify_all()
+        self._worker = cc.Thread(target=self._run,
+                                 name=f"fake-{self.name}", daemon=True)
+        self._worker.start()
+
+    def _run(self):
+        while True:
+            with self._lock:
+                while not self._queue and self._alive and not self._draining:
+                    self._cv.wait(timeout=0.05)
+                if not self._alive:
+                    return
+                if not self._queue:
+                    # draining and empty: graceful exit 0
+                    self._alive = False
+                    self._exit = 0
+                    return
+                doc = self._queue.popleft()
+            cc.sleep(self.delay_s)
+            with self._lock:
+                if not self._alive:
+                    return  # killed mid-request: stays journal-pending
+            rid = str(doc.get("id"))
+            self.deliver(self.name, {
+                "id": rid, "outcome": "ok",
+                "tokens": [1] * int(doc.get("max_new_tokens") or 1),
+            })
+            with self._lock:
+                self._done.add(rid)
+
+    def alive(self):
+        with self._lock:
+            return self._alive
+
+    def poll_exit(self):
+        with self._lock:
+            return self._exit
+
+    def send(self, doc):
+        with self._lock:
+            if not self._alive or self._draining:
+                return False
+            rid = str(doc.get("id"))
+            self._accepted.setdefault(rid, dict(doc))  # journal accept
+            self._queue.append(dict(doc))
+            self._cv.notify_all()
+        return True
+
+    def health(self, now):
+        with self._lock:
+            return {"state": "serving", "queue_depth": len(self._queue),
+                    "occupancy": 0}
+
+    def pending_requests(self):
+        with self._lock:
+            return [dict(d) for rid, d in self._accepted.items()
+                    if rid not in self._done]
+
+    def begin_drain(self):
+        with self._lock:
+            self._draining = True
+            self._cv.notify_all()
+
+    def die(self, rc):
+        with self._lock:
+            if not self._alive:
+                return
+            self._alive = False
+            self._exit = rc
+            self._cv.notify_all()
+
+    def kill(self):
+        self.die(9)
+
+    def join(self, timeout):
+        w = self._worker
+        if w is not None:
+            w.join(timeout=timeout)
+            return not w.is_alive()
+        return True
+
+    # ------------------------------------------------------ spec hooks
+
+    def accepted_count(self):
+        with self._lock:
+            return len(self._accepted)
+
+
+def _fleet(ctx, n, **kw):
+    emitted = []
+    elock = cc.Lock()
+
+    def emit(doc):
+        with elock:
+            emitted.append(doc)
+
+    reps = [FakeReplica(f"replica-{i}") for i in range(n)]
+    kw.setdefault("poll_s", 0.01)
+    kw.setdefault("health_period_s", 0.0)
+    kw.setdefault("restart_base_delay", 0.02)
+    router = FleetRouter(reps, emit=emit, **kw)
+    for r in reps:
+        r.deliver = router.deliver
+    ctx.static_watch(router)
+    return router, reps, emitted
+
+
+def _check_exactly_once(router, emitted, submitted):
+    ids = [str(d.get("id")) for d in emitted]
+    assert len(ids) == len(set(ids)), f"double-emitted: {ids}"
+    assert set(ids) == set(submitted), (set(ids), set(submitted))
+    with router._lock:
+        order = list(router._order)
+    assert ids == order, ("emission violated submission order",
+                          ids, order)
+    for d in emitted:
+        assert d.get("outcome") in LEGAL, d
+
+
+def _run_router(router):
+    box = {}
+
+    def target():
+        box["rc"] = router.run()
+
+    t = cc.Thread(target=target, name="fleet-run", daemon=True)
+    t.start()
+    return t, box
+
+
+def run(ctx):
+    # replica deaths and budget exhaustion log warnings/errors per
+    # explored schedule — keep the analyzer report readable
+    logger = logging.getLogger("paddle_tpu")
+    prev = logger.level
+    logger.setLevel(logging.CRITICAL)
+    try:
+        _phase_route(ctx)
+        _phase_death_reoffer(ctx)
+        _phase_drain_race(ctx)
+        _phase_budget_exhausted(ctx)
+    finally:
+        logger.setLevel(prev)
+
+
+def _phase_route(ctx):
+    router, reps, emitted = _fleet(ctx, 2)
+    router.start()
+    t, box = _run_router(router)
+    submitted = []
+    slock = cc.Lock()
+
+    def client(tag, n):
+        for i in range(n):
+            rid = f"{tag}{i}"
+            assert router.submit({"id": rid, "prompt": [2, 3],
+                                  "max_new_tokens": 1})
+            with slock:
+                submitted.append(rid)
+
+    t_a = cc.Thread(target=client, args=("a", 2))
+    t_b = cc.Thread(target=client, args=("b", 2))
+    t_a.start()
+    t_b.start()
+    t_a.join()
+    t_b.join()
+    # duplicate id at the front door is refused, not double-answered
+    assert router.submit({"id": "a0", "prompt": [2]}) is False
+    router.note_eof()
+    t.join(timeout=120.0)
+    assert not t.is_alive(), "router run() did not terminate (route phase)"
+    assert box["rc"] == 0, box
+    _check_exactly_once(router, emitted, submitted)
+    router.shutdown(timeout=10.0)
+
+
+def _phase_death_reoffer(ctx):
+    router, reps, emitted = _fleet(ctx, 2, restart_budget=3)
+    router.start()
+    t, box = _run_router(router)
+    submitted = [f"r{i}" for i in range(4)]
+    for rid in submitted:
+        assert router.submit({"id": rid, "prompt": [5],
+                              "max_new_tokens": 1})
+    # wait until replica-0 has journaled at least one accept, then kill
+    # it with the budgeted exit class — the re-offer races its restart's
+    # journal replay
+    deadline = cc.monotonic() + 60.0
+    while reps[0].accepted_count() == 0 and cc.monotonic() < deadline:
+        cc.sleep(0.005)
+    reps[0].die(17)
+    router.note_eof()
+    t.join(timeout=120.0)
+    assert not t.is_alive(), "router run() did not terminate (death phase)"
+    assert box["rc"] == 0, box
+    _check_exactly_once(router, emitted, submitted)
+    # every answer in this phase is a completion — nothing was draining
+    for d in emitted:
+        assert d.get("outcome") == "ok", d
+    st = router.status()
+    assert st["deaths"] >= 1, st
+    router.shutdown(timeout=10.0)
+
+
+def _phase_drain_race(ctx):
+    router, reps, emitted = _fleet(ctx, 2)
+    router.start()
+    t, box = _run_router(router)
+    submitted = []
+    slock = cc.Lock()
+
+    def client():
+        for i in range(3):
+            rid = f"d{i}"
+            if router.submit({"id": rid, "prompt": [7],
+                              "max_new_tokens": 1}):
+                with slock:
+                    submitted.append(rid)
+
+    t_c = cc.Thread(target=client)
+    t_c.start()
+    router.request_drain()  # races the submits: in-flight complete,
+    # queued/new reject — either side of the flag is legal
+    t_c.join()
+    t.join(timeout=120.0)
+    assert not t.is_alive(), "router run() did not terminate (drain phase)"
+    assert box["rc"] == 0, box
+    _check_exactly_once(router, emitted, submitted)
+    # the drain's terminal fleet state: every child exited
+    st = router.status()
+    assert st["draining"] is True, st
+    assert all(not r["up"] for r in st["replicas"].values()), st
+    router.shutdown(timeout=10.0)
+
+
+def _phase_budget_exhausted(ctx):
+    router, reps, emitted = _fleet(ctx, 1, restart_budget=0)
+    router.start()
+    t, box = _run_router(router)
+    submitted = ["z0", "z1"]
+    for rid in submitted:
+        assert router.submit({"id": rid, "prompt": [9],
+                              "max_new_tokens": 1})
+    reps[0].die(20)  # EXIT_OOM: budgeted class, budget is zero
+    router.note_eof()
+    t.join(timeout=120.0)
+    assert not t.is_alive(), "router run() did not terminate (budget phase)"
+    _check_exactly_once(router, emitted, submitted)
+    # the fleet failed — but it failed HONESTLY: if any request got an
+    # error answer the exit code says so; racing answers may legally
+    # complete everything first (died-after-answering), which is rc 0
+    errs = [d for d in emitted if d.get("outcome") == "error"]
+    assert box["rc"] == (1 if errs else 0), (box, emitted)
+    router.shutdown(timeout=10.0)

@@ -1,0 +1,565 @@
+"""Serving fleet (doc/serving.md "Serving fleet"): the multi-replica
+router — health-scored least-loaded balancing, journal-replay failover
+under at-least-once dedupe-by-id, fleet-wide graceful drain — plus hot
+weight reload (checkpoint lands mid-stream, swap at an iteration
+boundary, zero dropped/duplicated/stranded requests), the aggregate
+`paddle serve-status <dir>` fleet view, the fleet window merge behind
+`bench.py serve --replicas=N`, and the fleet.* chaos sites."""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from paddle_tpu.observability import metrics as obs
+from paddle_tpu.observability.analyze import load_run
+from paddle_tpu.observability.compare import _serve_key
+from paddle_tpu.resilience import EXIT_PREEMPTED, faultinject
+from paddle_tpu.serving import Engine, FakeBackend
+from paddle_tpu.serving.fleet import (
+    FleetRouter,
+    merge_windows,
+    replica_score,
+)
+from paddle_tpu.serving.resilience import (
+    WeightReloader,
+    read_status,
+    status_main,
+)
+from paddle_tpu.utils import concurrency as cc
+
+pytestmark = pytest.mark.fleet
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# the race spec's FakeReplica is the reference in-process implementation
+# of the duck-typed replica handle protocol — reuse it rather than fork
+# a second one that could drift
+_spec = importlib.util.spec_from_file_location(
+    "spec_serve_fleet",
+    os.path.join(REPO, "tests", "race_specs", "spec_serve_fleet.py"))
+_mod = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_mod)
+FakeReplica = _mod.FakeReplica
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    obs.registry().reset()
+    yield
+    obs.configure("")
+    faultinject.configure("")
+
+
+class _Ctx:
+    """Stand-in for the race explorer context: static_watch is a no-op
+    outside `paddle race`."""
+
+    def static_watch(self, obj):
+        pass
+
+
+def _fleet(n, **kw):
+    emitted = []
+    elock = cc.Lock()
+
+    def emit(doc):
+        with elock:
+            emitted.append(doc)
+
+    reps = [FakeReplica(f"replica-{i}") for i in range(n)]
+    kw.setdefault("poll_s", 0.005)
+    kw.setdefault("health_period_s", 0.0)
+    kw.setdefault("restart_base_delay", 0.01)
+    router = FleetRouter(reps, emit=emit, **kw)
+    for r in reps:
+        r.deliver = router.deliver
+    return router, reps, emitted
+
+
+def _run_to_eof(router, timeout=60.0):
+    box = {}
+
+    def target():
+        box["rc"] = router.run()
+
+    t = cc.Thread(target=target, daemon=True)
+    t.start()
+    router.note_eof()
+    t.join(timeout=timeout)
+    assert not t.is_alive(), "router run() did not terminate"
+    return box["rc"]
+
+
+# ------------------------------------------------------------- scoring
+
+
+def test_replica_score_health_weighted():
+    assert replica_score(3, None) == 3.0
+    assert replica_score(0, {"queue_depth": 4, "occupancy": 2}) == 6.0
+    # a stale doc contributes nothing: outstanding is the only honest
+    # signal left
+    assert replica_score(2, {"stale": True, "queue_depth": 99}) == 2.0
+    assert replica_score(1, {"queue_depth": "bogus"}) == 1.0
+
+
+# ------------------------------------------------------------- routing
+
+
+def test_routes_all_answered_in_submission_order():
+    router, reps, emitted = _fleet(2)
+    router.start()
+    ids = [f"r{i}" for i in range(6)]
+    for rid in ids:
+        assert router.submit({"id": rid, "prompt": [2],
+                              "max_new_tokens": 1})
+    assert _run_to_eof(router) == 0
+    router.shutdown(timeout=10.0)
+    assert [d["id"] for d in emitted] == ids
+    assert all(d["outcome"] == "ok" for d in emitted), emitted
+    # least-loaded balancing actually spread the work: with equal-cost
+    # requests neither replica took everything
+    assert reps[0].accepted_count() > 0 and reps[1].accepted_count() > 0
+    assert router.status()["routed"] == len(ids)
+
+
+def test_duplicate_submit_refused_and_duplicate_answer_absorbed():
+    router, reps, emitted = _fleet(1)
+    router.start()
+    assert router.submit({"id": "a", "prompt": [2], "max_new_tokens": 1})
+    assert router.submit({"id": "a", "prompt": [2]}) is False
+    assert _run_to_eof(router) == 0
+    # a replayed answer for an already-answered id (at-least-once
+    # journal semantics) is counted, never re-emitted
+    router.deliver("replica-0", {"id": "a", "outcome": "ok", "tokens": [1]})
+    router.shutdown(timeout=10.0)
+    assert [d["id"] for d in emitted] == ["a"]
+    assert router.status()["duplicate_answers"] == 1
+
+
+def test_open_breaker_routes_around():
+    router, reps, emitted = _fleet(2)
+    # replica-0 reports an open breaker: every request must land on
+    # replica-1
+    orig = reps[0].health
+    reps[0].health = lambda now: dict(orig(now), breaker="open")
+    router.start()
+    for i in range(3):
+        assert router.submit({"id": f"b{i}", "prompt": [2],
+                              "max_new_tokens": 1})
+    assert _run_to_eof(router) == 0
+    router.shutdown(timeout=10.0)
+    assert [d["outcome"] for d in emitted] == ["ok"] * 3
+    assert reps[0].accepted_count() == 0
+    assert reps[1].accepted_count() == 3
+
+
+# ------------------------------------------------------------ failover
+
+
+def test_failover_reoffers_journal_exactly_once():
+    """THE failover drill: replica-0 dies (budgeted exit class) holding
+    journaled work; the router re-offers it to replica-1 while
+    replica-0's restart replays the same journal — every id answered
+    exactly once, the death and restart observable."""
+    router, reps, emitted = _fleet(2, restart_budget=3)
+    # slow replica-0 down so it dies with work still pending
+    reps[0].delay_s = 0.2
+    router.start()
+    box = {}
+
+    def target():
+        box["rc"] = router.run()
+
+    t = cc.Thread(target=target, daemon=True)
+    t.start()
+    ids = [f"f{i}" for i in range(6)]
+    for rid in ids:
+        assert router.submit({"id": rid, "prompt": [3],
+                              "max_new_tokens": 1})
+    deadline = cc.monotonic() + 30.0
+    while reps[0].accepted_count() == 0 and cc.monotonic() < deadline:
+        cc.sleep(0.002)
+    assert reps[0].accepted_count() > 0, "replica-0 never took work"
+    reps[0].die(17)  # EXIT_CRASH_LOOP: consumes the restart budget
+    router.note_eof()
+    t.join(timeout=120.0)
+    assert not t.is_alive(), "router run() did not terminate"
+    assert box["rc"] == 0
+    router.shutdown(timeout=10.0)
+    assert [d["id"] for d in emitted] == ids
+    assert all(d["outcome"] == "ok" for d in emitted), emitted
+    st = router.status()
+    assert st["deaths"] >= 1 and st["reoffers"] >= 1, st
+    assert st["replicas"]["replica-0"]["restarts"] >= 1, st
+    assert reps[0].incarnations >= 2  # it rejoined the rotation
+
+
+def test_preemption_restart_is_budget_free():
+    router, reps, emitted = _fleet(1, restart_budget=0)
+    reps[0].delay_s = 0.1
+    router.start()
+    box = {}
+
+    def target():
+        box["rc"] = router.run()
+
+    t = cc.Thread(target=target, daemon=True)
+    t.start()
+    assert router.submit({"id": "p0", "prompt": [2], "max_new_tokens": 1})
+    deadline = cc.monotonic() + 30.0
+    while reps[0].accepted_count() == 0 and cc.monotonic() < deadline:
+        cc.sleep(0.002)
+    reps[0].die(EXIT_PREEMPTED)
+    router.note_eof()
+    # budget is ZERO — only the free preemption class lets this fleet
+    # finish its batch
+    t.join(timeout=120.0)
+    assert not t.is_alive(), "router run() did not terminate"
+    assert box["rc"] == 0
+    router.shutdown(timeout=10.0)
+    assert [d["id"] for d in emitted] == ["p0"]
+    assert emitted[0]["outcome"] == "ok"
+    st = router.status()["replicas"]["replica-0"]
+    assert st["restarts"] == 0, st  # the free class consumed no budget
+
+
+def test_all_replicas_down_errors_out_instead_of_hanging():
+    router, reps, emitted = _fleet(1, restart_budget=0)
+    reps[0].delay_s = 60.0  # never answers within the test
+    router.start()
+    for i in range(2):
+        assert router.submit({"id": f"z{i}", "prompt": [2],
+                              "max_new_tokens": 1})
+    cc.sleep(0.05)
+    reps[0].die(20)  # EXIT_OOM, budget 0: permanently down
+    assert _run_to_eof(router, timeout=120.0) == 1
+    router.shutdown(timeout=10.0)
+    assert [d["id"] for d in emitted] == ["z0", "z1"]
+    assert all(d["outcome"] == "error" for d in emitted), emitted
+
+
+def test_stale_status_routes_around_then_kills_past_bound():
+    """An injected fleet.status_stale verdict: the replica is routed
+    around immediately; persisting past the staleness bound (no startup
+    grace here) it is killed and treated as a death — the fleet still
+    answers everything."""
+    router, reps, emitted = _fleet(
+        2, restart_budget=0, stale_after_s=0.05, startup_grace_s=0.0)
+    # replica-0's probe reads permanently stale (the wedged-child
+    # verdict the fleet.status_stale chaos site also produces)
+    reps[0].health = lambda now: {"stale": True, "detail": "wedged"}
+    router.start()
+    box = {}
+
+    def target():
+        box["rc"] = router.run()
+
+    t = cc.Thread(target=target, daemon=True)
+    t.start()
+    for i in range(3):
+        assert router.submit({"id": f"s{i}", "prompt": [2],
+                              "max_new_tokens": 1})
+    # keep the loop alive past the staleness bound: the wedged replica
+    # must be culled as a death even though the batch already answered
+    deadline = cc.monotonic() + 30.0
+    while router.status()["deaths"] == 0 and cc.monotonic() < deadline:
+        cc.sleep(0.005)
+    router.note_eof()
+    t.join(timeout=120.0)
+    assert not t.is_alive(), "router run() did not terminate"
+    assert box["rc"] == 0
+    router.shutdown(timeout=10.0)
+    assert [d["id"] for d in emitted] == ["s0", "s1", "s2"]
+    assert all(d["outcome"] == "ok" for d in emitted), emitted
+    # replica-1 carried the fleet; replica-0 was never routed to and
+    # was eventually culled as a death
+    assert reps[0].accepted_count() == 0
+    assert reps[1].accepted_count() == 3
+    assert router.status()["deaths"] >= 1
+
+
+# --------------------------------------------------------------- drain
+
+
+def test_drain_completes_inflight_rejects_queued():
+    router, reps, emitted = _fleet(1)
+    reps[0].delay_s = 0.05
+    router.start()
+    box = {}
+
+    def target():
+        box["rc"] = router.run()
+
+    t = cc.Thread(target=target, daemon=True)
+    t.start()
+    assert router.submit({"id": "in0", "prompt": [2], "max_new_tokens": 1})
+    # wait until in0 is actually routed (in-flight), then drain
+    deadline = cc.monotonic() + 30.0
+    while reps[0].accepted_count() == 0 and cc.monotonic() < deadline:
+        cc.sleep(0.002)
+    router.request_drain()
+    t.join(timeout=60.0)
+    assert not t.is_alive() and box["rc"] == 0
+    # a post-drain submit is rejected — and still ANSWERED (the late-
+    # arrival path emits inline once the loop has exited)
+    assert router.submit({"id": "late", "prompt": [2]})
+    router.shutdown(timeout=10.0)
+    by_id = {d["id"]: d["outcome"] for d in emitted}
+    assert by_id["in0"] in ("ok", "error"), by_id  # in-flight completed
+    assert by_id["late"] == "rejected", by_id
+    st = router.status()
+    assert st["draining"] is True
+    assert all(not r["up"] for r in st["replicas"].values()), st
+
+
+# ----------------------------------------------------------- hot reload
+
+
+def test_engine_reload_swaps_at_boundary_no_dropped_requests(tmp_path):
+    """The reload contract end-to-end on the real engine: a swap staged
+    mid-stream lands at an iteration boundary — requests admitted
+    before it finish, requests after it run on the new weights, the
+    swap is visible in status(), counters and the telemetry stream."""
+    obs.configure(str(tmp_path))
+    be = FakeBackend(slots=2, max_length=8, step_delay_s=0.01)
+    eng = Engine(be, request_timeout_s=30.0, idle_poll_s=0.01,
+                 replica="replica-0").start()
+    try:
+        old = be.token_fn
+        pre = [eng.submit([2, 3], max_new_tokens=3, rid=f"pre{i}")
+               for i in range(3)]
+        eng.request_reload(lambda slot, step: 7, tag="ckpt-00042")
+        post = [eng.submit([2, 3], max_new_tokens=3, rid=f"post{i}")
+                for i in range(3)]
+        results = [f.result(timeout=60.0) for f in pre + post]
+        assert all(r.outcome == "ok" for r in results), results
+        assert all(len(r.tokens) == 3 for r in results), results
+        st = eng.status()
+        assert st["reloads"] == 1 and st["reload_tag"] == "ckpt-00042", st
+        assert be.reloads == 1 and be.token_fn is not old
+        # post-swap work really ran on the new weights
+        tail = eng.submit([2], max_new_tokens=2, rid="tail").result(60.0)
+        assert tail.tokens == [7, 7], tail
+    finally:
+        assert eng.drain(timeout=60.0)
+    obs.flush()
+    recs = [r for rs in load_run(str(tmp_path)).values() for r in rs]
+    reloads = [r for r in recs if r.get("kind") == "reload"]
+    assert len(reloads) == 1, reloads
+    assert reloads[0]["path"] == "ckpt-00042"
+    assert reloads[0]["replica"] == "replica-0"
+    assert not obs.validate_record(reloads[0]), reloads[0]
+
+
+def test_weight_reloader_probe_swap_and_poison(tmp_path):
+    """The watcher half: only a CHANGED durable checkpoint triggers a
+    staged reload; a poison checkpoint is skipped permanently; the
+    fleet.reload_torn chaos site aborts the attempt and retries."""
+    be = FakeBackend(slots=1, max_length=4)
+    eng = Engine(be, request_timeout_s=30.0, idle_poll_s=0.01).start()
+    try:
+        probed = {"path": "ckpt-1"}
+        loads = []
+
+        def loader(path):
+            loads.append(path)
+            return lambda slot, step: 9
+
+        wr = WeightReloader(str(tmp_path), eng, loader,
+                            probe=lambda d: probed["path"])
+        # baseline: the checkpoint present at start never reloads
+        assert wr.check_once() is False and loads == []
+        probed["path"] = "ckpt-2"
+        assert wr.check_once() is True and loads == ["ckpt-2"]
+        assert wr.check_once() is False  # same path: no news
+        # torn-commit chaos: abort, keep old weights, RETRY next poll
+        probed["path"] = "ckpt-3"
+        faultinject.configure("fleet.reload_torn=raise@1")
+        assert wr.check_once() is False and loads == ["ckpt-2"]
+        assert wr.check_once() is True and loads[-1] == "ckpt-3"
+        # poison: the loader blows up — skipped permanently, serving on
+        probed["path"] = "ckpt-4"
+
+        def boom(path):
+            raise RuntimeError("corrupt")
+
+        wr._loader = boom
+        assert wr.check_once() is False
+        assert wr.check_once() is False  # not retried in a hot loop
+        assert wr.reloads == 2
+    finally:
+        faultinject.configure("")
+        assert eng.drain(timeout=60.0)
+
+
+# ------------------------------------------------------ fleet status view
+
+
+def test_serve_status_fleet_view_tolerates_torn(tmp_path, capsys):
+    good = {"started": True, "queue_depth": 2, "occupancy": 1,
+            "slots": 2, "breaker": "closed", "last_collect_age_s": 0.1,
+            "totals": {"ok": 5, "error": 1}}
+    (tmp_path / "replica-0.json").write_text(json.dumps(good))
+    (tmp_path / "replica-1.json").write_text(json.dumps(
+        dict(good, queue_depth=0, occupancy=2,
+             totals={"ok": 7, "error": 0})))
+    (tmp_path / "replica-2.json").write_text('{"started": tru')  # torn
+    assert status_main([str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "replica-0" in out and "replica-2" in out
+    assert "STALE" in out  # the torn doc is a row, not a crash
+    assert "2/3 up" in out
+    assert "12" in out  # fleet ok total
+    # --json: machine-readable, torn docs as {"stale": true}
+    assert status_main([str(tmp_path), "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["replica-2"] == {"stale": True}
+    assert doc["replica-0"]["totals"]["ok"] == 5
+
+
+def test_read_status_tolerant():
+    assert read_status("/nonexistent/path.json") is None
+
+
+# ------------------------------------------------------- sites + merge
+
+
+def test_fleet_sites_registered():
+    for site in ("fleet.replica_crash", "fleet.status_stale",
+                 "fleet.reload_torn"):
+        assert site in faultinject.SITE_DOCS, site
+
+
+def _win(completed, gen_tokens, p99, replica):
+    return {
+        "engine": "continuous", "replica": replica,
+        "arrived": completed, "admitted": completed,
+        "completed": completed, "rejected": 0, "timeouts": 0,
+        "cancelled": 0, "errors": 0, "shed": 0, "breaker_open": 0,
+        "launches": completed, "gen_tokens": gen_tokens, "exec_s": 0.5,
+        "latency": {"count": completed, "mean": 0.1, "p50": 0.1,
+                    "p99": p99, "max": p99},
+        "ttft": {"count": completed, "mean": 0.05, "p50": 0.05,
+                 "p99": 0.05, "max": 0.05},
+        "queue_wait": {"count": completed, "mean": 0.01, "p50": 0.01,
+                       "p99": 0.01, "max": 0.01},
+        "queue_depth": {"count": 4, "mean": 1.0, "p50": 1.0, "p99": 2.0,
+                        "max": 2.0},
+        "occupancy": {"count": 4, "mean": 1.5, "p50": 1.5, "p99": 2.0,
+                      "max": 2.0},
+        "queue_wait_share": 0.1,
+    }
+
+
+def test_merge_windows_sums_counts_keeps_worst_tail(tmp_path):
+    obs.configure(str(tmp_path))
+    rec = merge_windows(
+        [_win(4, 40, 0.2, "replica-0"), _win(8, 80, 0.5, "replica-1")],
+        rate_rps=2.0, rung=3, window_s=10.0, router_s=0.25)
+    assert rec["replicas"] == 2
+    assert rec["completed"] == 12 and rec["gen_tokens"] == 120
+    assert rec["goodput_tok_s"] == 12.0
+    assert rec["latency"]["p99"] == 0.5  # the WORST replica's tail
+    assert rec["latency"]["count"] == 12
+    assert rec["router_share"] == 0.025
+    assert "replica" not in rec  # the merged record is the fleet's
+    obs.flush()
+    recs = [r for rs in load_run(str(tmp_path)).values() for r in rs]
+    wins = [r for r in recs if r.get("kind") == "serve_window"]
+    assert len(wins) == 1 and wins[0]["replicas"] == 2
+    assert not obs.validate_record(wins[0]), wins[0]
+
+
+def test_compare_serve_key_joins_on_replicas():
+    seen = set()
+    assert _serve_key(2.0, 0, seen) == "serve.2rps."
+    assert _serve_key(2.0, 1, set(), replicas=2) == "serve.x2.2rps."
+    assert _serve_key(2.0, 2, set(), replicas=1) == "serve.2rps."
+    # an x2 rung never collides with the x4 one in the same artifact
+    seen2 = set()
+    k2 = _serve_key(2.0, 0, seen2, engine="continuous", replicas=2)
+    k4 = _serve_key(2.0, 1, seen2, engine="continuous", replicas=4)
+    assert k2 != k4
+
+
+# --------------------------------------------------------- chaos e2e
+
+
+SERVE_CONFIG = """
+import sys
+sys.path.insert(0, {demo!r})
+from paddle.trainer_config_helpers import *
+from seqToseq_net import gru_encoder_decoder
+
+settings(batch_size=2, learning_rate=1e-3, learning_method=AdamOptimizer())
+gru_encoder_decoder(source_dict_dim=50, target_dict_dim=50,
+                    is_generating=True, word_vector_dim=16,
+                    encoder_size=16, decoder_size=16, beam_size=1,
+                    max_length=6)
+"""
+
+SUBPROC_ENV = dict(
+    os.environ, JAX_PLATFORMS="cpu",
+    PYTHONPATH=f"{REPO}:{os.path.join(REPO, 'compat')}",
+)
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_chaos_fleet_kills_one_replica_every_request_answered(tmp_path):
+    """THE acceptance scenario: `paddle serve-fleet` with 2 replicas;
+    replica 0 takes an injected serve.crash (hard os._exit at its 2nd
+    collect boundary, via the per-child fault env). The router marks it
+    dead, re-offers its journaled unanswered requests to replica 1,
+    restarts it on budget — and every request id is answered EXACTLY
+    once on the router's stdout, in submission order, rc 0."""
+    cfg = tmp_path / "serve_conf.py"
+    cfg.write_text(SERVE_CONFIG.format(
+        demo=os.path.join(REPO, "demo", "seqToseq")))
+    status_dir = tmp_path / "fleet"
+    run_dir = tmp_path / "run"
+    ids = [f"c{i}" for i in range(8)]
+    reqs = "\n".join(json.dumps(
+        {"id": rid, "prompt": [4 + i, 7], "max_new_tokens": 2}
+    ) for i, rid in enumerate(ids))
+    env = dict(
+        SUBPROC_ENV,
+        PADDLE_TPU_FLEET_CHILD_FAULTS_0="serve.crash=exit:3@2",
+    )
+    out = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.cli", "serve-fleet",
+         f"--config={cfg}", "--use_tpu=0", "--fleet_replicas=2",
+         f"--fleet_status_dir={status_dir}",
+         "--serve_slots=2", "--serve_prompt_tokens=4",
+         "--serve_decode_block=1", "--restart_base_delay=0.01",
+         "--restart_budget=1",
+         f"--compile_cache_dir={tmp_path / 'ccache'}",
+         f"--metrics_path={run_dir}"],
+        input=reqs + "\n", capture_output=True, text=True, timeout=600,
+        env=env, cwd=str(tmp_path),
+    )
+    assert out.returncode == 0, (out.returncode, out.stderr[-4000:])
+    answers = []
+    for line in out.stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            doc = json.loads(line)
+            if "outcome" in doc:
+                answers.append(doc)
+    got = [d["id"] for d in answers]
+    assert got == ids, (got, out.stderr[-3000:])  # exactly once, in order
+    assert all(d["outcome"] == "ok" for d in answers), answers
+    # the drill actually fired: the router observed >= 1 death and
+    # routed every request — its run_end record carries the counters
+    recs = [r for rs in load_run(str(run_dir)).values() for r in rs]
+    end = [r for r in recs if r.get("kind") == "run_end"]
+    assert end and recs[-1]["kind"] == "run_end", recs[-1]  # stream's last
+    counters = end[0].get("counters") or {}
+    assert counters.get("fleet.deaths", 0) >= 1, counters
+    assert counters.get("fleet.routed", 0) >= len(ids), counters
+    # the per-replica journals recorded the failover's raw material
+    assert (status_dir / "replica-0.journal.jsonl").exists()
