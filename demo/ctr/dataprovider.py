@@ -1,0 +1,45 @@
+"""Seeded synthetic CTR impressions for demo/ctr.
+
+Each user belongs to an interest cluster and each ad to a topic; a
+click is likely when they match. Fully deterministic per (file seed,
+sample index) — the chaos drill replays the stream bit-exactly across
+a crash/relaunch, so recovered training is comparable to uninterrupted
+training. The id distribution is hot-set-skewed (a few heavy users
+dominate impressions, the CTR-shaped access pattern), which keeps the
+touched-row rate per batch well under the table size — the property
+the sparse gather/scatter path exists for.
+"""
+
+import random
+
+from paddle.trainer.PyDataProvider2 import *
+
+N_CLUSTERS = 4
+
+
+def initializer(settings, num_users, num_ads, **kwargs):
+    settings.num_users = num_users
+    settings.num_ads = num_ads
+    settings.input_types = [
+        integer_value(num_users),
+        integer_value(num_ads),
+        integer_value(2),
+    ]
+
+
+@provider(init_hook=initializer)
+def process(settings, file_name):
+    # file_name carries the seed ("impressions-seed-N"), mirroring the
+    # model_zoo/embedding corpus convention
+    seed = int(file_name.rsplit("-", 1)[-1])
+    rng = random.Random(seed)
+    for _ in range(1024):
+        if rng.random() < 0.8:
+            # hot set: 10% of users produce 80% of impressions
+            user = rng.randrange(max(settings.num_users // 10, 1))
+        else:
+            user = rng.randrange(settings.num_users)
+        ad = rng.randrange(settings.num_ads)
+        match = user % N_CLUSTERS == ad % N_CLUSTERS
+        click = 1 if rng.random() < (0.8 if match else 0.1) else 0
+        yield user, ad, click

@@ -1,0 +1,48 @@
+#edit-mode: -*- python -*-
+"""ctr: click-through-rate prediction over row-sharded sparse embeddings.
+
+The millions-of-users workload (doc/sparse.md, ROADMAP item 5): two id
+features — user and ad — each feed a ``sparse_embedding`` whose table
+trains on the row-sparse path (per-row gradients, per-row optimizer
+slots, ``row_range``-stamped durable shards). The user table is sized
+by ``num_users`` so a chaos drill can make it exceed a simulated
+per-host row budget (``--sparse_row_budget``) — the table then only
+fits SHARDED across hosts, which is exactly the elastic machinery the
+drill kills a host out from under.
+
+Train with::
+
+    paddle train --config=demo/ctr/trainer_config.py \
+        --save_dir=output --num_passes=3
+"""
+
+from paddle.trainer_config_helpers import *
+
+num_users = get_config_arg("num_users", int, 120)
+num_ads = get_config_arg("num_ads", int, 48)
+emb_dim = get_config_arg("emb_dim", int, 16)
+
+define_py_data_sources2(
+    train_list="train.list",
+    test_list=None,
+    module="dataprovider",
+    obj="process",
+    args={"num_users": num_users, "num_ads": num_ads},
+)
+
+settings(
+    batch_size=32,
+    learning_rate=0.1,
+    learning_method=AdaGradOptimizer(),
+)
+
+user = data_layer(name="user_id", size=num_users)
+ad = data_layer(name="ad_id", size=num_ads)
+user_emb = sparse_embedding(input=user, size=emb_dim, name="user",
+                            param_attr=ParamAttr(name="_user_emb"))
+ad_emb = sparse_embedding(input=ad, size=emb_dim, name="ad",
+                          param_attr=ParamAttr(name="_ad_emb"))
+hidden = fc_layer(input=[user_emb, ad_emb], size=32, act=ReluActivation())
+prediction = fc_layer(input=hidden, size=2, act=SoftmaxActivation())
+label = data_layer(name="click", size=2)
+outputs(classification_cost(input=prediction, label=label))

@@ -338,7 +338,18 @@ def _check_checkpoint(rest) -> int:
     for d in dirs:
         problems = ckpt.verify_checkpoint(d) + ckpt.verify_sharded_shards(d)
         manifest = read_manifest(d)
-        if problems:
+        # row-coverage holes in a committed dir are PARTIAL, not
+        # CORRUPT: the bytes that exist are sound, but a row-sharded
+        # table has a gap/overlap (a lost host's rows) — the messages
+        # name the missing interval and the responsible host(s)
+        row_probs = [p for p in problems if "row coverage:" in p
+                     or "rows [" in p]
+        if problems and len(row_probs) == len(problems):
+            bad += 1
+            print(f"PARTIAL  {d} (row-sharded coverage holes — not restorable)")
+            for p in problems:
+                print(f"         - {p}")
+        elif problems:
             bad += 1
             print(f"CORRUPT  {d}")
             for p in problems:
@@ -359,6 +370,12 @@ def _check_checkpoint(rest) -> int:
                 "— the save never reached its commit agreement; not "
                 "restorable)"
             )
+            # name the exact row intervals a torn ROW-SHARDED pass is
+            # missing (and which hosts did land their partial index)
+            from paddle_tpu.sparse import ckpt as sparse_ckpt
+
+            for hole in sparse_ckpt.partial_row_holes(tmp):
+                print(f"         - {hole}")
     return 1 if bad else 0
 
 

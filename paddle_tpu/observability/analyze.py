@@ -94,6 +94,9 @@ def analyze(streams: Dict[int, List[Dict[str, Any]]]) -> Dict[str, Any]:
     # (the `nf lyr` column and the compare surface)
     mem_by_pass: Dict[int, Dict[str, float]] = {}
     mem_last: Dict[int, Dict[str, Any]] = {}
+    # sparse-table plane: latest-wins per (host, pass) like pass_end,
+    # then hosts are summed per pass (each host touches its own rows)
+    sparse_by: Dict[tuple, Dict[str, Any]] = {}
     numerics_count = 0
     nf_layers_by_pass: Dict[int, set] = {}
     nf_layers_all: set = set()
@@ -179,6 +182,10 @@ def analyze(streams: Dict[int, List[Dict[str, Any]]]) -> Dict[str, Any]:
                      str(rec.get("replica") or ""),
                      int(rec.get("replicas") or 0), rec.get("rung"))
                 ] = rec
+            elif kind == "sparse":
+                p = rec.get("pass")
+                if isinstance(p, int):
+                    sparse_by[(host, p)] = rec
             elif kind == "pass_end":
                 p = int(rec.get("pass", -1))
                 per_host_pass.setdefault(host, {})[p] = rec
@@ -266,6 +273,21 @@ def analyze(streams: Dict[int, List[Dict[str, Any]]]) -> Dict[str, Any]:
     for p, layer_set in nf_layers_by_pass.items():
         if p in passes:
             passes[p]["nf_layers"] = len(layer_set)
+    # sparse plane: hosts summed per pass (rows_touched and rows/s are
+    # per-host quantities; reshard events take the max — every host
+    # reports the same restore-time count)
+    for (_h, p), srec in sorted(sparse_by.items()):
+        if p not in passes:
+            continue
+        row = passes[p]
+        for k in ("rows_touched", "unique_rows", "gather_bytes",
+                  "scatter_bytes", "sparse_rows_per_sec"):
+            if isinstance(srec.get(k), (int, float)):
+                row[k] = row.get(k, 0) + srec[k]
+        if isinstance(srec.get("reshard_events"), int):
+            row["reshard_events"] = max(
+                int(row.get("reshard_events", 0)), srec["reshard_events"]
+            )
 
     # straggler attribution: feed the gathered per-host step stats of the
     # LAST pass with full coverage through the BarrierStat formatter
@@ -469,6 +491,9 @@ def _fmt_table(doc: Dict[str, Any]) -> str:
     # and the count of layers with nonfinite gradients that pass
     with_hbm = any("hbm_peak_bytes" in r for r in doc["passes"])
     with_nf_layers = any("nf_layers" in r for r in doc["passes"])
+    # sparse rows/s column: only when some pass carried a kind=sparse
+    # record (runs without sparse tables keep the old table shape)
+    with_sparse = any("sparse_rows_per_sec" in r for r in doc["passes"])
     header = (
         f"{'pass':>5} {'samples':>9} {'AvgCost':>10} {'p50 ms':>8} "
         f"{'p99 ms':>8} {'data-wait':>9} {'nf':>4} {'retry':>5} {'fault':>5}"
@@ -483,6 +508,8 @@ def _fmt_table(doc: Dict[str, Any]) -> str:
         header += f" {'hbm pk':>8}"
     if with_nf_layers:
         header += f" {'nf lyr':>6}"
+    if with_sparse:
+        header += f" {'rows/s':>9}"
     lines = [header]
     for row in doc["passes"]:
         line = (
@@ -506,6 +533,9 @@ def _fmt_table(doc: Dict[str, Any]) -> str:
             line += f" {hbm / 1e9:>7.2f}G" if hbm is not None else f" {'-':>8}"
         if with_nf_layers:
             line += f" {int(row.get('nf_layers', 0)):>6}"
+        if with_sparse:
+            rps = row.get("sparse_rows_per_sec")
+            line += (f" {rps:>9.3g}" if rps is not None else f" {'-':>9}")
         lines.append(line)
     if doc["checkpoints"]:
         lines.append("")

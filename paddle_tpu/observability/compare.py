@@ -67,6 +67,10 @@ _HIGHER_BETTER = {
     "hbm_peak_bytes": False,
     "static_mem_bytes": False,
     "nonfinite_layers": False,
+    # sparse plane (doc/sparse.md): rows/s is throughput; gather share
+    # growing means the step is spending more of itself fetching rows
+    "sparse_rows_per_sec": True,
+    "sparse_gather_share": False,
 }
 
 

@@ -64,7 +64,7 @@ FILE_FMT = "metrics.host%d.jsonl"
 FLUSH_KINDS = frozenset(
     {"run_start", "run_end", "pass_end", "checkpoint",
      "barrier_skew", "restart", "compile", "roofline",
-     "request", "serve_window", "memory", "oom", "reload"}
+     "request", "serve_window", "memory", "oom", "reload", "sparse"}
 )
 
 # required keys of every record; kind-specific fields ride alongside
@@ -119,6 +119,10 @@ KIND_REQUIRED = {
     "lint_summary": ("findings", "counts"),
     "race_finding": ("detector", "spec"),
     "race_summary": ("findings", "counts"),
+    # sparse-table plane (paddle_tpu/sparse/, doc/sparse.md): one
+    # record per pass — touched/unique rows, gather/scatter bytes,
+    # reshard events; pass boundaries only, so it rides FLUSH_KINDS
+    "sparse": ("rows_touched",),
 }
 
 

@@ -91,3 +91,17 @@ def dedupe(ids: Array, rows: Array, nrows: int):
     uid_full = jax.ops.segment_max(ids_s, seg, num_segments=N)
     uid = jnp.where(valid, uid_full, nrows)
     return uid, g_rows, valid
+
+
+def touched_row_bytes(grad: RowSparseGrad) -> Tuple[int, int]:
+    """(gather_bytes, scatter_bytes) one step moved for this table:
+    gather pays per OCCURRENCE (the prefetch fetches per-id), scatter
+    per distinct row (the updater dedupes first). Host-side accounting
+    for the ``kind=sparse`` telemetry record — reads shapes/dtypes
+    only, never device data."""
+    import numpy as np
+
+    n = int(grad.ids.shape[0])
+    row_bytes = int(grad.rows.shape[-1]) * grad.rows.dtype.itemsize
+    uniq = int(np.unique(np.asarray(grad.ids)).size) if n else 0
+    return n * row_bytes, uniq * row_bytes

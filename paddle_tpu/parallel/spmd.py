@@ -225,6 +225,39 @@ def checkpoint_sharding_fn(mesh: Mesh, gm):
     return fn
 
 
+def owned_row_range(arr) -> "tuple[int, int]":
+    """The contiguous ``[lo, hi)`` row interval of a dim-0-sharded
+    array whose rows THIS process uniquely owns (replica_id == 0) —
+    the live-array twin of the ``row_range`` stamped into sparse shard
+    records (doc/sparse.md).  A replicated array owns every row on
+    process 0 and nothing elsewhere; a process owning non-contiguous
+    row blocks is a layout this framework never produces, and raises.
+    """
+    rows = []
+    for sh in arr.addressable_shards:
+        if sh.replica_id != 0:
+            continue
+        sl = sh.index[0] if sh.index else slice(0, int(arr.shape[0]))
+        lo = int(sl.start or 0)
+        hi = int(sl.stop) if sl.stop is not None else int(arr.shape[0])
+        rows.append((lo, hi))
+    if not rows:
+        return (0, 0)
+    rows.sort()
+    merged = [list(rows[0])]
+    for lo, hi in rows[1:]:
+        if lo <= merged[-1][1]:
+            merged[-1][1] = max(merged[-1][1], hi)
+        else:
+            merged.append([lo, hi])
+    if len(merged) != 1:
+        raise ValueError(
+            f"non-contiguous owned row blocks {merged} — not a "
+            "row-sharded table layout"
+        )
+    return (merged[0][0], merged[0][1])
+
+
 def _batch_tree_sharding(mesh: Mesh, batch) -> Any:
     bs = batch_sharding(mesh)
     return jax.tree_util.tree_map(lambda _: bs, batch)

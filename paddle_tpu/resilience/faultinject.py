@@ -118,6 +118,20 @@ SITE_DOCS = {
         "and the checkpoint load (raise = the checkpoint became "
         "durable mid-swap — abort the attempt, keep serving old "
         "weights, retry next poll)",
+    "sparse.gather_fault":
+        "before each launch that prefetches sparse-table rows "
+        "(raise = the touched-row gather fails — the batch aborts "
+        "loudly instead of training on stale rows)",
+    "sparse.row_corrupt":
+        "after a durable row-shard write, before the pass commits "
+        "(raise = flip a byte inside this host's row-shard file — "
+        "the CRC manifest verify must catch the poisoned row and "
+        "quarantine/fall back, never load it)",
+    "sparse.shard_lost":
+        "at the row-shard write boundary, before this host's shard "
+        "bytes or partial index land (raise = this host's row "
+        "shards vanish — check-checkpoint must name the exact "
+        "missing row interval, not zero-init it)",
 }
 
 KNOWN_SITES = tuple(SITE_DOCS)

@@ -89,6 +89,7 @@ import numpy as np
 
 from paddle_tpu.observability import metrics as obs
 from paddle_tpu.resilience import CheckpointError
+from paddle_tpu.sparse import runtime as sparse_rt
 from paddle_tpu.utils import concurrency as cc
 from paddle_tpu.utils.logging import logger
 
@@ -640,6 +641,13 @@ class ShardedAsyncCheckpointer(AsyncCheckpointer):
         trees, meta = ckpt.build_save_trees(
             pass_id, params, opt_state, extra_meta, multihost=True
         )
+        # sparse-table meta: which params are row-sharded tables and
+        # how many hosts wrote this pass — restore compares the host
+        # count against its own to detect (and count) a reshard
+        tables = sparse_rt.registered_tables()
+        if tables:
+            meta.setdefault("sparse_tables", tables)
+            meta.setdefault("sparse_hosts", self.count)
         return ckpt.snapshot_owned_trees(trees, self.pid), meta
 
     def _default_finalize(self, pass_id: int, job: _Job, rotate: bool) -> str:

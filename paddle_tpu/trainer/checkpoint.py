@@ -54,7 +54,8 @@ from paddle_tpu.observability import spans as obs_spans
 from paddle_tpu.optimizer.updater import UpdaterState
 from paddle_tpu.resilience import CheckpointCorruptError
 from paddle_tpu.resilience import manifest as ckpt_manifest
-from paddle_tpu.resilience.faultinject import fault_point
+from paddle_tpu.resilience.faultinject import FaultInjected, fault_point
+from paddle_tpu.sparse import runtime as sparse_rt
 from paddle_tpu.utils.flags import FLAGS
 from paddle_tpu.utils.logging import logger
 from paddle_tpu.utils.retry import RetryPolicy
@@ -244,16 +245,24 @@ def snapshot_owned_trees(
                     "dtype": str(arr.dtype),
                     "shards": [],
                 }
-            entry["shards"].append(
-                {
-                    "file": shard_file,
-                    "key": key,
-                    "start": [int(sl.start or 0) for sl in sh.index],
-                    # record extent up front so restore can skip
-                    # non-overlapping records without reading them
-                    "shape": list(data.shape),
-                }
-            )
+            rec = {
+                "file": shard_file,
+                "key": key,
+                "start": [int(sl.start or 0) for sl in sh.index],
+                # record extent up front so restore can skip
+                # non-overlapping records without reading them
+                "shape": list(data.shape),
+            }
+            # row-sharded sparse tables (and their per-row optimizer
+            # slots) carry an EXPLICIT row interval: check-checkpoint
+            # proves exact row coverage from these, and a relaunch
+            # reshard reads only overlapping records (doc/sparse.md)
+            nrows = sparse_rt.registered_tables().get(name.split("/", 1)[0])
+            if (nrows is not None and data.ndim >= 1
+                    and int(arr.shape[0]) == int(nrows)):
+                lo = rec["start"][0] if rec["start"] else 0
+                rec["row_range"] = [lo, lo + int(data.shape[0])]
+            entry["shards"].append(rec)
         out[base] = (pieces, partial)
     return out
 
@@ -298,6 +307,9 @@ def write_sharded_host_trees(
     agreement); the commit half is :func:`finalize_sharded_pass`."""
     tmp = os.path.join(save_dir, PASS_FMT % pass_id) + TMP_SUFFIX
     os.makedirs(tmp, exist_ok=True)
+    # chaos site: this host's row shards never land — the pass cannot
+    # commit, and check-checkpoint must name the missing row interval
+    fault_point("sparse.shard_lost", info=f"pass={pass_id} pid={pid}")
     own_files = [
         write_owned_shards(tmp, base, pid, pieces, partial)
         for base, (pieces, partial) in snapshot.items()
@@ -306,6 +318,25 @@ def write_sharded_host_trees(
         ckpt_manifest.write_partial_manifest, tmp, pid, own_files,
         label=f"MANIFEST.partial.{pid:05d}.json",
     )
+    # chaos site: poison a row AFTER the manifest digested the healthy
+    # bytes — the CRC verify must catch it and quarantine/fall back
+    try:
+        fault_point("sparse.row_corrupt", info=f"pass={pass_id} pid={pid}")
+    except FaultInjected:
+        for fn in own_files:
+            full = os.path.join(tmp, fn)
+            try:
+                size = os.path.getsize(full)
+                with open(full, "r+b") as f:
+                    f.seek(size // 2)
+                    b = f.read(1) or b"\x00"
+                    f.seek(size // 2)
+                    f.write(bytes([b[0] ^ 0xFF]))
+                    f.flush()
+                    os.fsync(f.fileno())
+            except OSError:
+                pass
+            break
 
 
 _SHARD_FILE_RE = re.compile(r"^(?P<base>.+)\.shard(?P<pid>\d{5})\.npz$")
@@ -722,6 +753,22 @@ def verify_sharded_shards(path: str) -> List[str]:
                     f"{base}/{name}: shard records cover {covered} of "
                     f"{total} elements (lost or duplicated host shards?)"
                 )
+            # row-sharded entries additionally prove EXACT row
+            # coverage: a missing or overlapping row_range is a named
+            # hole (check-checkpoint classifies these as PARTIAL),
+            # never a silent zero-init on restore
+            row_recs = [
+                (rec["row_range"][0], rec["row_range"][1],
+                 _shard_host(rec.get("file", "")))
+                for rec in entry.get("shards", [])
+                if rec.get("row_range")
+            ]
+            if row_recs and entry.get("shape"):
+                from paddle_tpu.sparse import rowshard
+
+                for msg in rowshard.coverage_problems(
+                        int(entry["shape"][0]), row_recs):
+                    problems.append(f"{base}/{name}: row coverage: {msg}")
     return problems
 
 

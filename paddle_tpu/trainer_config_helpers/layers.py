@@ -58,6 +58,7 @@ __all__ = [
     "mixed_layer",
     "data_layer",
     "embedding_layer",
+    "sparse_embedding",
     "fc_layer",
     "pooling_layer",
     "lstmemory",
@@ -588,6 +589,30 @@ def embedding_layer(
         act=IdentityActivation(),
         bias_attr=False,
         layer_attr=layer_attr,
+    )
+
+
+def sparse_embedding(
+    input: LayerOutput,
+    size: int,
+    name: Optional[str] = None,
+    param_attr: Optional[ParameterAttribute] = None,
+    layer_attr=None,
+) -> LayerOutput:
+    """An :func:`embedding_layer` whose table trains on the row-sparse
+    path (doc/sparse.md): ``sparse_update=True`` is forced onto the
+    table's :class:`ParameterAttribute`, so gradients stay per-row
+    (``RowSparseGrad``), optimizer slots update only touched rows, the
+    durable checkpoint stamps ``row_range`` into the table's shard
+    records, and multi-host relaunches reshard the rows. The config
+    helper the CTR demo (demo/ctr/) builds its id features with."""
+    if param_attr is None:
+        param_attr = ParameterAttribute(sparse_update=True)
+    else:
+        param_attr.sparse_update = True
+    return embedding_layer(
+        input, size, name=_name(name, "sparse_embedding"),
+        param_attr=param_attr, layer_attr=layer_attr,
     )
 
 

@@ -118,6 +118,19 @@ def _reshard_error(train_args: List[str], orig_n: int, cur_n: int) -> Optional[s
         rescale_mesh_spec(flag_value(train_args, "mesh_shape", ""), orig_n, cur_n)
     except ValueError as e:
         return str(e)
+    # row-sharded sparse tables add a second refusal: the new host set
+    # must hold the declared table within --sparse_row_budget rows per
+    # host (declared via --sparse_total_rows so this supervisor stays
+    # jax/config-free; doc/sparse.md "Refusal rule")
+    try:
+        budget = int(flag_value(train_args, "sparse_row_budget", "0") or 0)
+        rows = int(flag_value(train_args, "sparse_total_rows", "0") or 0)
+    except ValueError:
+        budget = rows = 0
+    if budget > 0 and rows > 0:
+        from paddle_tpu.sparse.rowshard import row_budget_error
+
+        return row_budget_error({"": rows}, cur_n, budget)
     return None
 
 

@@ -121,6 +121,16 @@ class _Flags:
     # inside the jitted step, so enabling it never recompiles) and emit
     # a kind=numerics record. 0 disables (no aux, no readback).
     numerics_log_period: int = 0
+    # row-sharded sparse-parameter training (paddle_tpu/sparse/,
+    # doc/sparse.md): sparse_row_budget caps how many embedding-table
+    # rows one host may hold (0 = unlimited) — the trainer refuses to
+    # start, and cluster_launch refuses a relaunch round, when the
+    # host set cannot hold every sparse_update table within the
+    # budget; sparse_total_rows declares the largest table's row count
+    # to the (jax-free) cluster_launch supervisor so it can apply the
+    # same refusal without importing the model config
+    sparse_row_budget: int = 0
+    sparse_total_rows: int = 0
     # hang defense (resilience/hangwatch.py): no step-loop progress for
     # this many seconds dumps all thread stacks + telemetry tail into
     # hang_report.json and exits EXIT_HANG=19 (0 disables). Set it

