@@ -30,4 +30,9 @@ echo "== paddle lint =="
 echo "== paddle race (schedules=$SCHEDULES) =="
 "$PY" -m paddle_tpu.cli race --schedules "$SCHEDULES"
 
+echo "== paddle trace --selftest =="
+# golden two-stream fixture through the full reconstruct/align/attribute
+# path — jax-free, <5 s (doc/observability.md "Distributed tracing")
+"$PY" -m paddle_tpu.cli trace --selftest
+
 echo "== analysis gate clean =="

@@ -28,7 +28,7 @@ def main(argv=None) -> int:
         print(__doc__)
         print("usage: paddle <train|supervise|test|gen|serve|serve-fleet|"
               "checkgrad|dump_config|merge_model|check-checkpoint|metrics|"
-              "memory|roofline|compare|serve-report|serve-status|lint|race|"
+              "memory|roofline|compare|trace|serve-report|serve-status|lint|race|"
               "faults|version> [--flags]")
         return 0
     cmd, rest = argv[0], argv[1:]
@@ -73,6 +73,12 @@ def main(argv=None) -> int:
         from paddle_tpu.observability.compare import main as compare_main
 
         return compare_main(rest)
+
+    if cmd == "trace":
+        # cross-process request timelines + tail attribution — jax-free
+        from paddle_tpu.observability.tracing import main as trace_main
+
+        return trace_main(rest)
     if cmd == "serve":
         # continuous-batching generation server (doc/serving.md):
         # stdin-JSONL requests through the slot-based decode engine,

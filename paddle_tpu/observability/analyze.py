@@ -49,12 +49,34 @@ _COUNTER_COLS = (
 )
 
 
-def load_run(run_dir: str) -> Dict[int, List[Dict[str, Any]]]:
-    """{host: [records in stream order]} for one run dir."""
-    streams: Dict[int, List[Dict[str, Any]]] = {}
-    for path in obs.metrics_files(run_dir):
-        for rec in obs.read_records(path):
-            streams.setdefault(int(rec.get("host", 0)), []).append(rec)
+def load_run(run_dir: str) -> Dict[Any, List[Dict[str, Any]]]:
+    """{stream key: [records in stream order]} for one run dir.
+
+    A FLEET run dir (router stream + ``replica-*/`` child streams,
+    discovered via :func:`metrics.fleet_stream_dirs`) merges every
+    stream: keys become ``"<stream>/<host>"`` strings so one replica's
+    ``run_start`` cannot supersede another replica's windows, and
+    replica-less serve records are stamped with their stream's replica
+    name for the merged per-rung tables. Single-stream dirs keep plain
+    int host keys (and their exact analysis shape)."""
+    dirs = obs.fleet_stream_dirs(run_dir)
+    streams: Dict[Any, List[Dict[str, Any]]] = {}
+    base = os.path.normpath(run_dir)
+    for d in dirs:
+        label = ("" if os.path.normpath(d) == base
+                 else os.path.basename(os.path.normpath(d)))
+        for path in obs.metrics_files(d):
+            for rec in obs.read_records(path):
+                host = int(rec.get("host", 0))
+                if len(dirs) == 1:
+                    key: Any = host
+                else:
+                    key = f"{label or 'router'}/{host}"
+                    if (label and not rec.get("replica")
+                            and rec.get("kind") in ("serve_window",
+                                                    "request", "span")):
+                        rec["replica"] = label
+                streams.setdefault(key, []).append(rec)
     return streams
 
 
@@ -442,6 +464,11 @@ def analyze(streams: Dict[int, List[Dict[str, Any]]]) -> Dict[str, Any]:
             "windows": len(serve_windows),
             "rungs": len({w.get("rung") for w in serve_windows}),
         }
+        # fleet runs only — single-stream serve JSON keeps its shape
+        replicas = sorted({str(w.get("replica")) for w in serve_windows
+                           if w.get("replica")})
+        if replicas:
+            serve["replicas"] = replicas
 
     # memory/numerics planes (doc/observability.md "Memory & numerics
     # telemetry") — None when the run predates them, so old-run JSON
@@ -639,6 +666,26 @@ def _fmt_table(doc: Dict[str, Any]) -> str:
             line += (" — `paddle serve-report <run_dir>` for the "
                      "latency/goodput table")
         lines.append(line)
+        wins = doc.get("serve_windows") or []
+        if any(w.get("replica") for w in wins):
+            # fleet run: merged per-rung view with a replica column
+            # (replica-stamped rows from the child streams, plus any
+            # replicas=N merged rollups labelled "merged")
+            lines.append(
+                f"{'rung':>4} {'replica':<12} {'rps':>7} {'completed':>9} "
+                f"{'p99 s':>8} {'goodput':>9}"
+            )
+            for w in wins:
+                lat = w.get("latency") or {}
+                name = str(w.get("replica") or
+                           ("merged" if w.get("replicas") else "-"))
+                lines.append(
+                    f"{w.get('rung') or 0:>4} {name:<12} "
+                    f"{float(w.get('offered_rps') or 0.0):>7.2f} "
+                    f"{int(w.get('completed') or 0):>9} "
+                    f"{float(lat.get('p99') or 0.0):>8.4f} "
+                    f"{float(w.get('goodput_tok_s') or 0.0):>9.1f}"
+                )
     if doc["straggler"] and doc["straggler"].get("line"):
         lines.append("")
         lines.append(doc["straggler"]["line"])
@@ -651,42 +698,53 @@ def _fmt_table(doc: Dict[str, Any]) -> str:
 
 def follow(run_dir: str, poll_s: float = 0.5,
            max_polls: Optional[int] = None,
-           poll_boundaries: bool = False) -> Iterator[Optional[Dict[str, Any]]]:
+           poll_boundaries: bool = False,
+           with_stream: bool = False) -> Iterator[Any]:
     """Live-tail every ``metrics*.jsonl`` stream of a run dir.
 
     Yields each newly appended record in file order, re-discovering
-    per-host stream files as they appear (a late host joining mid-run).
-    Torn-tail tolerant like :func:`metrics.read_records`: only complete
-    (newline-terminated) lines are consumed — a partially flushed tail
-    stays buffered in the file until its newline lands, so a record is
-    never yielded twice or half-parsed. ``max_polls`` bounds the scan
-    loop for tests; the CLI polls until interrupted or ``run_end``.
-    ``poll_boundaries=True`` additionally yields ``None`` after each
-    full scan over every stream — the only safe point to decide "all
-    observed hosts are done" (mid-scan, later hosts' files are still
-    unread)."""
+    per-host stream files as they appear (a late host joining mid-run,
+    or a fleet replica's ``replica-*/`` stream dir materializing after
+    the router's — :func:`metrics.fleet_stream_dirs` re-runs every
+    poll). Torn-tail tolerant like :func:`metrics.read_records`: only
+    complete (newline-terminated) lines are consumed — a partially
+    flushed tail stays buffered in the file until its newline lands, so
+    a record is never yielded twice or half-parsed. ``max_polls``
+    bounds the scan loop for tests; the CLI polls until interrupted or
+    ``run_end``. ``poll_boundaries=True`` additionally yields ``None``
+    after each full scan over every stream — the only safe point to
+    decide "all observed hosts are done" (mid-scan, later hosts' files
+    are still unread). ``with_stream=True`` yields ``(stream_label,
+    record)`` pairs instead — label ``""`` for the run dir's own
+    streams, the subdir name for discovered replica streams — so the
+    CLI can tell the router's ``run_end`` from a replica's."""
     offsets: Dict[str, int] = {}
     polls = 0
+    base = os.path.normpath(run_dir)
     while True:
-        for path in obs.metrics_files(run_dir):
-            pos = offsets.get(path, 0)
-            try:
-                if os.path.getsize(path) < pos:
-                    # file shrank: truncated/recreated (run dir reused)
-                    # — restart this stream from the top instead of
-                    # waiting forever past its EOF
-                    pos = offsets[path] = 0
-                with open(path) as f:
-                    f.seek(pos)
-                    data = f.read()
-            except OSError:
-                continue
-            end = data.rfind("\n")
-            if end < 0:
-                continue  # nothing complete yet (or only a torn tail)
-            offsets[path] = pos + end + 1
-            # same torn-line tolerance policy as every other reader
-            yield from obs.parse_record_lines(data[:end])
+        for d in obs.fleet_stream_dirs(run_dir):
+            label = ("" if os.path.normpath(d) == base
+                     else os.path.basename(os.path.normpath(d)))
+            for path in obs.metrics_files(d):
+                pos = offsets.get(path, 0)
+                try:
+                    if os.path.getsize(path) < pos:
+                        # file shrank: truncated/recreated (run dir
+                        # reused) — restart this stream from the top
+                        # instead of waiting forever past its EOF
+                        pos = offsets[path] = 0
+                    with open(path) as f:
+                        f.seek(pos)
+                        data = f.read()
+                except OSError:
+                    continue
+                end = data.rfind("\n")
+                if end < 0:
+                    continue  # nothing complete yet (or a torn tail)
+                offsets[path] = pos + end + 1
+                # same torn-line tolerance policy as every reader
+                for rec in obs.parse_record_lines(data[:end]):
+                    yield (label, rec) if with_stream else rec
         polls += 1
         if poll_boundaries:
             yield None
@@ -705,28 +763,44 @@ def _follow_cli(run_dir: str) -> int:
     ``status="preempted"`` run_end means the supervisor is about to
     relaunch into the same stream, and a later ``run_start`` from a
     host un-ends it. Hosts that crash without a run_end keep the tail
-    alive (^C to stop) — silence is not completion."""
-    seen: set = set()
+    alive (^C to stop) — silence is not completion.
+
+    Fleet run dirs (any ``replica-*/`` stream discovered) change the
+    stop rule: replicas come and go — a killed replica's stream never
+    completes and a restarted one re-opens — so only the ROUTER's own
+    ``run_end status="completed"`` (the run dir's root stream, which
+    the router writes last, after every child is reaped) ends the
+    tail."""
+    seen: set = set()      # (stream_label, host) pairs
     ended: set = set()
+    fleet = False
     try:
-        for rec in follow(run_dir, poll_boundaries=True):
-            if rec is None:
+        for item in follow(run_dir, poll_boundaries=True,
+                           with_stream=True):
+            if item is None:
                 # full scan over every stream done — the only safe
                 # point to conclude: mid-scan, later hosts' files are
                 # still unread and would look "never seen"
-                if seen and ended >= seen:
+                if fleet:
+                    if any(key[0] == "" for key in ended):
+                        print("# router run_end — fleet run complete",
+                              file=sys.stderr)
+                        return 0
+                elif seen and ended >= seen:
                     print("# run_end on every observed host — complete",
                           file=sys.stderr)
                     return 0
                 continue
+            label, rec = item
             print(json.dumps(rec, default=str), flush=True)
-            host = rec.get("host", 0)
+            key = (label, rec.get("host", 0))
             kind = rec.get("kind")
-            seen.add(host)
+            seen.add(key)
+            fleet = fleet or label.startswith("replica-")
             if kind == "run_end" and rec.get("status") == "completed":
-                ended.add(host)
+                ended.add(key)
             elif kind == "run_start":
-                ended.discard(host)
+                ended.discard(key)
     except KeyboardInterrupt:
         return 0
     return 0

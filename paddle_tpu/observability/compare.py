@@ -145,6 +145,13 @@ def _higher_is_better(name: str) -> bool:
     # throughput default below)
     if n.startswith(("lint", "race")):
         return False
+    # tail-attribution shares (doc/observability.md "Distributed
+    # tracing"): an overhead bucket growing its slice of the p99 cohort
+    # is a regression — EXCEPT decode, whose share growing means the
+    # tail spends its time on useful token work instead of waiting (a
+    # decode-dominated p99 is the healthy end state)
+    if ".p99_share." in n:
+        return n.endswith(".decode")
     # serving metrics (doc/observability.md "Serving telemetry"):
     # goodput and the saturation knee are throughput-like; latency/TTFT/
     # queue-wait fall through to the lower-is-better suffixes below
@@ -231,6 +238,20 @@ def _run_side(path: str) -> Dict[str, float]:
     windows = [w for w in (doc.get("serve_windows") or [])
                if not w.get("replica")]
     seen_pre: set = set()
+    # p99 tail-latency attribution (doc/observability.md "Distributed
+    # tracing"): per-rate bucket shares reconstructed from the run's
+    # span streams, ZERO-FILLED below so pre-tracing artifacts (no span
+    # records) still share the keys — a 0 -> N queue-wait share then
+    # gets a REGRESSION verdict instead of landing invisibly in only_b.
+    # Joined on the same ".6g" offered-load format as the rung keys.
+    from paddle_tpu.observability.tracing import (BUCKETS,
+                                                  p99_shares_by_rate)
+
+    # training-only dirs skip the trace pass (it would re-read every
+    # stream just to find zero rungs)
+    shares_by_rate = ({format(rate, ".6g"): s
+                       for rate, s in p99_shares_by_rate(path).items()}
+                      if windows else {})
     # deterministic key assignment: iterate (engine, rung)-sorted so a
     # both-engines stream always hands the SAME engine the unqualified
     # keys regardless of which sweep was recorded first — two such
@@ -274,6 +295,13 @@ def _run_side(path: str) -> Dict[str, float]:
                 float(w.get("shed", 0) or 0) / float(arrived), 6)
             out[pre + "error_rate"] = round(
                 float(w.get("errors", 0) or 0) / float(arrived), 6)
+        # engine-scoped like the other share metrics: a share of e2e is
+        # only comparable within one latency regime
+        shares = shares_by_rate.get(
+            format(float(w.get("offered_rps") or 0.0), ".6g")) or {}
+        for bucket in BUCKETS:
+            out[_engine_scoped(pre, engine, f"p99_share.{bucket}")] = round(
+                float(shares.get(bucket, 0.0)), 6)
     if windows:
         from paddle_tpu.observability.serving import saturation_knee
 

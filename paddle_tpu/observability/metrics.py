@@ -64,7 +64,8 @@ FILE_FMT = "metrics.host%d.jsonl"
 FLUSH_KINDS = frozenset(
     {"run_start", "run_end", "pass_end", "checkpoint",
      "barrier_skew", "restart", "compile", "roofline",
-     "request", "serve_window", "memory", "oom", "reload", "sparse"}
+     "request", "serve_window", "memory", "oom", "reload", "sparse",
+     "span"}
 )
 
 # required keys of every record; kind-specific fields ride alongside
@@ -123,6 +124,13 @@ KIND_REQUIRED = {
     # record per pass — touched/unique rows, gather/scatter bytes,
     # reshard events; pass boundaries only, so it rides FLUSH_KINDS
     "sparse": ("rows_touched",),
+    # distributed tracing (observability/tracing.py, doc/observability.md
+    # "Distributed tracing"): one record per hop — `name` is the hop
+    # (router.wait, engine.prefill, ...), `t0` the hop's start as a
+    # stream-timebase offset, `dur_s` its duration (0.0 = instant);
+    # `trace`/`traces` join hops to requests, `span_id`/`parent_id`
+    # order them across processes
+    "span": ("name", "t0", "dur_s"),
 }
 
 
@@ -463,6 +471,20 @@ def flush() -> None:
         _writer.flush()
 
 
+def rel_time(mono: float) -> float:
+    """Map an absolute ``time.monotonic()`` reading into the global
+    writer's ``t``-offset timebase (seconds since its ``run_start``).
+    Span emitters measure hop boundaries with their own monotonic reads
+    and convert here, so a span's ``t0`` shares the timebase every other
+    record's envelope ``t`` uses — the property the trace reconstructor's
+    run_start wall-clock alignment depends on. Returns the reading
+    unchanged when telemetry is off (the record it would anchor is a
+    no-op anyway)."""
+    if _writer is None:
+        return float(mono)
+    return round(float(mono) - _writer._t0_mono, 6)
+
+
 # ---------------------------------------------------------------- reading
 
 
@@ -479,6 +501,30 @@ def metrics_files(run_dir: str) -> List[str]:
         if f.startswith("metrics") and f.endswith(".jsonl")
     ]
     return sorted(out)
+
+
+def fleet_stream_dirs(run_dir: str) -> List[str]:
+    """Every telemetry stream dir of a FLEET run rooted at ``run_dir``:
+    the dir itself (the router's stream, when it has one) plus each
+    replica's per-child metrics dir — ``replica-<i>/`` children of the
+    run dir or of a nested ``fleet_status/`` (the layouts
+    ``serve-fleet``'s ``_child_argv`` produces for ``--fleet_status_dir``
+    inside or beside ``--metrics_path``). A plain single-process run dir
+    comes back as ``[run_dir]`` unchanged, so fleet-aware readers can
+    call this unconditionally."""
+    if not os.path.isdir(run_dir):
+        return [run_dir]
+    dirs = [run_dir]
+    roots = [run_dir, os.path.join(run_dir, "fleet_status")]
+    for root in roots:
+        if not os.path.isdir(root):
+            continue
+        for name in sorted(os.listdir(root)):
+            sub = os.path.join(root, name)
+            if (name.startswith("replica-") and os.path.isdir(sub)
+                    and metrics_files(sub)):
+                dirs.append(sub)
+    return dirs
 
 
 def parse_record_lines(text: str) -> Iterator[Dict[str, Any]]:

@@ -124,6 +124,10 @@ class Request:
     cohort_size: int = 0
     outcome: str = "pending"
     max_new: Optional[int] = None
+    # distributed-tracing join key (doc/observability.md "Distributed
+    # tracing"): opaque, echoed verbatim onto every emitted record as
+    # `trace_id`. "" = untraced (single-process runs stay unchanged)
+    trace: str = ""
 
     @property
     def queue_wait_s(self) -> Optional[float]:
@@ -201,6 +205,8 @@ class RequestLog:
             "t_enqueue": round(req.t_enqueue, 6),
             "prompt_tokens": int(req.prompt_tokens),
         }
+        if req.trace:
+            rec["trace_id"] = req.trace
         if self.beam_size is not None:
             rec["beam_size"] = int(self.beam_size)
         if req.cohort >= 0:

@@ -1,0 +1,601 @@
+"""``paddle trace`` — cross-process request-timeline reconstruction.
+
+The serving fleet scatters one request's story across N+1 JSONL
+telemetry streams: the router's (enqueue → route → reoffer → answer
+spans) and each replica's (journal append, engine queue wait, prefill
+cohort, decode iteration windows, readback, interference instants).
+This module merges those streams — jax-free, read-only, torn tails
+tolerated — into per-request timelines joined on the propagated
+``trace_id``, and renders the tail-latency attribution table: for the
+p99 cohort of each rung, the share of end-to-end latency spent in
+router wait / replica queue / prefill / decode / readback /
+failover-reoffer (doc/observability.md "Distributed tracing").
+
+Clock alignment: every stream's ``t`` offsets are process-local
+monotonic seconds; its ``run_start`` record carries the one wall-clock
+anchor (``wall_time``) that maps them to civil time. Wall clocks skew
+across processes, so after the anchor join each replica stream gets a
+single residual shift ``d`` chosen from hop causality — a replica
+cannot journal a request before the router routed it, nor finish it
+after the router heard the answer. The feasible interval for ``d`` is
+intersected over every hop; the shift nearest zero inside it is
+applied and reported as the stream's skew bound (an empty interval is
+reported as a violation, never hidden).
+
+Coverage honesty: spans are measured, not invented — the only
+synthesized segment is the stdin-pipe wait between the router's send
+and the replica's first sight of the request (a real queue: a cold or
+busy child buffers routed requests in its pipe), bucketed as replica
+queue time. Requests whose spans still fail to cover end-to-end
+within ``--tolerance`` (default 5%) are flagged with their gap and
+overlap, not silently averaged away.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+from paddle_tpu.observability import metrics as obs
+
+#: attribution buckets, highest precedence first — when spans overlap
+#: (a decode window brackets its readback; a reoffer brackets the lost
+#: route), each elementary segment counts ONCE, toward the most
+#: specific cause
+BUCKETS = ("reoffer", "readback", "prefill", "decode", "queue_wait",
+           "router_wait")
+
+_PRIORITY = {b: i for i, b in enumerate(BUCKETS)}
+
+#: span name → attribution bucket; instants (dur_s=0) ride along in
+#: timelines but contribute no covered time
+SPAN_BUCKET = {
+    "router.wait": "router_wait",
+    "router.reoffer": "reoffer",
+    "replica.pipe": "queue_wait",       # synthesized (module docstring)
+    "replica.journal": "queue_wait",
+    "engine.queue_wait": "queue_wait",
+    "engine.prefill": "prefill",
+    "engine.decode_window": "decode",
+    "engine.readback": "readback",
+}
+
+
+# ---------------------------------------------------------- loading
+
+def _read_jsonl(path: str) -> List[Dict[str, Any]]:
+    """Every parseable record of one JSONL file, in file order. A torn
+    tail (crash mid-append) or stray noise line is skipped, never
+    fatal — the analyzer reads streams the writer may not have closed."""
+    out: List[Dict[str, Any]] = []
+    try:
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict):
+                    out.append(rec)
+    except OSError:
+        pass
+    return out
+
+
+def load_stream(stream_dir: str) -> Dict[str, Any]:
+    """One telemetry stream dir → its trace-relevant records with
+    ABSOLUTE (wall-anchored) times. A restarted process appends a new
+    ``run_start`` to the same file with a fresh ``t`` base, so
+    anchoring is segment-wise: each ``run_start``'s ``wall_time``
+    re-anchors everything after it. Records before any anchor are
+    unplaceable and dropped (counted)."""
+    spans: List[Dict[str, Any]] = []
+    requests: List[Dict[str, Any]] = []
+    windows: List[Dict[str, Any]] = []
+    anchored = False
+    dropped = 0
+    segments = 0
+    router_end = None
+    for path in obs.metrics_files(stream_dir):
+        anchor: Optional[float] = None
+        for rec in _read_jsonl(path):
+            kind = rec.get("kind")
+            if kind == "run_start":
+                wall = rec.get("wall_time")
+                if isinstance(wall, (int, float)):
+                    anchor = float(wall) - float(rec.get("t") or 0.0)
+                    anchored = True
+                    segments += 1
+                continue
+            if anchor is None:
+                dropped += 1
+                continue
+            if kind == "span":
+                t0 = rec.get("t0")
+                dur = rec.get("dur_s")
+                if not isinstance(t0, (int, float)):
+                    continue
+                spans.append({
+                    "name": str(rec.get("name") or ""),
+                    "t0": anchor + float(t0),
+                    "dur_s": max(float(dur or 0.0), 0.0),
+                    "trace": rec.get("trace"),
+                    "traces": rec.get("traces"),
+                    "rid": rec.get("rid"),
+                    "replica": rec.get("replica"),
+                    "attempt": rec.get("attempt"),
+                })
+            elif kind == "request":
+                requests.append(rec)
+            elif kind == "serve_window":
+                windows.append(rec)
+            elif kind == "run_end":
+                router_end = rec
+    return {
+        "dir": stream_dir,
+        "name": os.path.basename(os.path.normpath(stream_dir)) or stream_dir,
+        "spans": spans,
+        "requests": requests,
+        "windows": windows,
+        "anchored": anchored,
+        "segments": segments,
+        "dropped": dropped,
+        "run_end": router_end,
+    }
+
+
+def _expand_dirs(run_dirs: List[str]) -> List[str]:
+    """The given dirs plus every discovered fleet replica stream dir,
+    deduplicated, order-preserved."""
+    seen: Dict[str, None] = {}
+    for d in run_dirs:
+        for sub in obs.fleet_stream_dirs(d):
+            seen.setdefault(os.path.normpath(sub))
+    return list(seen)
+
+
+# -------------------------------------------------------- alignment
+
+def _is_replica_stream(stream: Dict[str, Any]) -> bool:
+    return stream["name"].startswith("replica-")
+
+
+def _trace_events(stream: Dict[str, Any]) -> Dict[str, List[Dict]]:
+    """trace id → that stream's spans mentioning it (cohort spans fan
+    out to every trace they carry), time-sorted."""
+    by: Dict[str, List[Dict]] = {}
+    for sp in stream["spans"]:
+        traces = []
+        if sp.get("trace"):
+            traces.append(str(sp["trace"]))
+        for t in sp.get("traces") or ():
+            traces.append(str(t))
+        for t in traces:
+            by.setdefault(t, []).append(sp)
+    for evs in by.values():
+        evs.sort(key=lambda s: s["t0"])
+    return by
+
+
+def align_streams(streams: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Per-replica residual shift from hop causality (module
+    docstring). The router stream is the reference (shift 0). Each
+    replica stream's shift report: ``{"stream", "shift_s",
+    "bound_s", "feasible"}``. Shifts are APPLIED to the stream's span
+    times in place."""
+    router_spans = [sp for st in streams if not _is_replica_stream(st)
+                    for sp in st["spans"]]
+    route_end: Dict[Tuple[str, str], float] = {}   # (replica, trace)
+    answer_at: Dict[Tuple[str, str], float] = {}
+    for sp in router_spans:
+        t = str(sp.get("trace") or "")
+        rep = str(sp.get("replica") or "")
+        if not t or not rep:
+            continue
+        key = (rep, t)
+        if sp["name"] == "router.wait":
+            end = sp["t0"] + sp["dur_s"]
+            route_end[key] = min(route_end.get(key, end), end)
+        elif sp["name"] == "router.answer":
+            answer_at[key] = sp["t0"]
+    reports = []
+    for st in streams:
+        if not _is_replica_stream(st) or not st["spans"]:
+            continue
+        lo, hi = float("-inf"), float("inf")
+        by_trace = _trace_events(st)
+        for trace, evs in by_trace.items():
+            key = (st["name"], trace)
+            if key in route_end:
+                # the replica cannot see the request before the route
+                lo = max(lo, route_end[key] - evs[0]["t0"])
+            if key in answer_at:
+                # ...nor still be working it after the router heard
+                # the answer from THIS replica
+                last_end = max(e["t0"] + e["dur_s"] for e in evs)
+                hi = min(hi, answer_at[key] - last_end)
+        feasible = lo <= hi
+        if lo == float("-inf") and hi == float("inf"):
+            shift = 0.0
+        elif not feasible:
+            shift = (lo + hi) / 2.0
+        elif lo <= 0.0 <= hi:
+            shift = 0.0
+        else:
+            shift = lo if lo > 0.0 else hi
+        for sp in st["spans"]:
+            sp["t0"] += shift
+        reports.append({
+            "stream": st["name"],
+            "shift_s": round(shift, 6),
+            "bound_s": round(abs(shift), 6),
+            "feasible": feasible,
+        })
+    return reports
+
+
+# ---------------------------------------------------- reconstruction
+
+def _sweep(intervals: List[Tuple[float, float, str]], start: float,
+           end: float) -> Tuple[Dict[str, float], float]:
+    """Elementary-segment sweep over ``[start, end]``: each instant of
+    the request's life counts toward exactly one bucket (precedence on
+    overlap), uncovered instants toward ``uncovered``. Returns
+    (bucket seconds, covered union seconds)."""
+    clipped = [(max(a, start), min(b, end), bk)
+               for a, b, bk in intervals if min(b, end) > max(a, start)]
+    pts = sorted({start, end, *(a for a, _b, _k in clipped),
+                  *(b for _a, b, _k in clipped)})
+    buckets: Dict[str, float] = {}
+    union = 0.0
+    for a, b in zip(pts, pts[1:]):
+        if b <= a:
+            continue
+        mid = (a + b) / 2.0
+        best: Optional[str] = None
+        for s, e, bk in clipped:
+            if s <= mid < e and (best is None
+                                 or _PRIORITY[bk] < _PRIORITY[best]):
+                best = bk
+        if best is None:
+            buckets["uncovered"] = buckets.get("uncovered", 0.0) + (b - a)
+        else:
+            union += b - a
+            buckets[best] = buckets.get(best, 0.0) + (b - a)
+    return buckets, union
+
+
+def analyze_trace(run_dirs: List[str],
+                  tolerance: float = 0.05) -> Dict[str, Any]:
+    """The full reconstruction document for one fleet (or single-
+    stream) run: per-request timelines, coverage verdicts, per-stream
+    skew reports, and the per-rung p99 attribution table."""
+    dirs = _expand_dirs(list(run_dirs))
+    streams = [load_stream(d) for d in dirs]
+    streams = [st for st in streams if st["anchored"] or st["spans"]]
+    skew = align_streams(streams)
+    # rung lookup: request records carry the trace join key, windows
+    # carry the offered rate per rung
+    rung_of: Dict[str, int] = {}
+    for st in streams:
+        for rec in st["requests"]:
+            tid = rec.get("trace_id")
+            if tid:
+                rung_of[str(tid)] = int(rec.get("rung") or 0)
+    rate_of_rung: Dict[int, float] = {}
+    for st in streams:
+        for w in st["windows"]:
+            r = int(w.get("rung") or 0)
+            rate_of_rung.setdefault(r, float(w.get("offered_rps") or 0.0))
+
+    # pool every span per trace across the aligned streams
+    pooled: Dict[str, List[Tuple[Dict, str]]] = {}
+    for st in streams:
+        for trace, evs in _trace_events(st).items():
+            pooled.setdefault(trace, []).extend(
+                (sp, st["name"]) for sp in evs)
+    timelines: Dict[str, Dict[str, Any]] = {}
+    for trace, evs in sorted(pooled.items()):
+        evs.sort(key=lambda p: p[0]["t0"])
+        enq = next((sp for sp, _s in evs
+                    if sp["name"] == "router.enqueue"), None)
+        ans = next((sp for sp, _s in evs
+                    if sp["name"] == "router.answer"), None)
+        spans = [{
+            "name": sp["name"], "stream": stream_name,
+            "t0": round(sp["t0"], 6), "dur_s": round(sp["dur_s"], 6),
+            **({"attempt": sp["attempt"]}
+               if sp.get("attempt") is not None else {}),
+            **({"replica": sp["replica"]} if sp.get("replica") else {}),
+        } for sp, stream_name in evs]
+        tl: Dict[str, Any] = {
+            "trace": trace,
+            "rid": str((enq or {}).get("rid") or trace),
+            "rung": rung_of.get(trace, 0),
+            "answered": ans is not None,
+            "spans": spans,
+            "streams": sorted({s for _sp, s in evs}),
+            "reoffered": any(sp["name"] == "router.reoffer"
+                             for sp, _s in evs),
+        }
+        if enq is not None and ans is not None:
+            start, end = enq["t0"], ans["t0"]
+            e2e = max(end - start, 1e-9)
+            intervals: List[Tuple[float, float, str]] = []
+            raw_covered = 0.0
+            for sp, _s in evs:
+                bucket = SPAN_BUCKET.get(sp["name"])
+                if bucket is None or sp["dur_s"] <= 0.0:
+                    continue
+                a = max(sp["t0"], start)
+                b = min(sp["t0"] + sp["dur_s"], end)
+                if b > a:
+                    intervals.append((a, b, bucket))
+                    raw_covered += b - a
+            # synthesized stdin-pipe wait: route send → the replica's
+            # first sight of the request (module docstring)
+            first_by_stream: Dict[str, float] = {}
+            for sp, sname in evs:
+                if sname.startswith("replica-"):
+                    first_by_stream.setdefault(sname, sp["t0"])
+            for sp, _s in evs:
+                if sp["name"] == "router.wait" and sp.get("replica"):
+                    rep = str(sp["replica"])
+                    send = sp["t0"] + sp["dur_s"]
+                    first = first_by_stream.get(rep)
+                    if first is not None and first > send:
+                        a, b = max(send, start), min(first, end)
+                        if b > a:
+                            intervals.append((a, b, "queue_wait"))
+                            raw_covered += b - a
+            buckets, union = _sweep(intervals, start, end)
+            gap = max(e2e - union, 0.0)
+            tl.update({
+                "t_enqueue": round(start, 6),
+                "t_answer": round(end, 6),
+                "e2e_s": round(e2e, 6),
+                "coverage": round(union / e2e, 4),
+                "gap_s": round(gap, 6),
+                "overlap_s": round(max(raw_covered - union, 0.0), 6),
+                "covered_ok": gap <= tolerance * e2e,
+                "buckets": {k: round(v, 6)
+                            for k, v in sorted(buckets.items())},
+            })
+        timelines[trace] = tl
+
+    # per-rung p99 cohort attribution
+    by_rung: Dict[int, List[Dict[str, Any]]] = {}
+    for tl in timelines.values():
+        if "e2e_s" in tl:
+            by_rung.setdefault(tl["rung"], []).append(tl)
+    rungs = []
+    for rung in sorted(by_rung):
+        rows = sorted(by_rung[rung], key=lambda t: t["e2e_s"])
+        # p99 cohort = every request at or past the 99th-percentile
+        # e2e (at small n that is the worst request)
+        idx = max(0, -(-99 * len(rows) // 100) - 1)
+        cohort = rows[idx:]
+        e2e_sum = sum(t["e2e_s"] for t in cohort) or 1e-9
+        shares = {}
+        for bucket in (*BUCKETS, "uncovered"):
+            sec = sum(t["buckets"].get(bucket, 0.0) for t in cohort)
+            shares[bucket] = round(sec / e2e_sum, 4)
+        rungs.append({
+            "rung": rung,
+            "offered_rps": rate_of_rung.get(rung, 0.0),
+            "requests": len(rows),
+            "p99_cohort": [t["trace"] for t in cohort],
+            "p99_e2e_s": round(cohort[-1]["e2e_s"], 6),
+            "shares": shares,
+        })
+
+    answered = [t for t in timelines.values() if t["answered"]]
+    flagged = [t for t in timelines.values()
+               if "covered_ok" in t and not t["covered_ok"]]
+    return {
+        "streams": [{
+            "name": st["name"], "dir": st["dir"],
+            "spans": len(st["spans"]), "segments": st["segments"],
+            "dropped_unanchored": st["dropped"],
+        } for st in streams],
+        "skew": skew,
+        "tolerance": tolerance,
+        "requests": timelines,
+        "n_requests": len(timelines),
+        "n_answered": len(answered),
+        "n_reconstructed": sum(1 for t in answered if "e2e_s" in t),
+        "n_flagged": len(flagged),
+        "flagged": [t["trace"] for t in flagged],
+        "rungs": rungs,
+    }
+
+
+def p99_shares_by_rate(run_dir: str) -> Dict[float, Dict[str, float]]:
+    """``paddle compare``'s join surface: offered rate → p99-cohort
+    attribution shares, empty for pre-tracing artifacts (no span
+    records anywhere under ``run_dir``)."""
+    try:
+        doc = analyze_trace([run_dir])
+    except Exception:  # noqa: BLE001 — comparison survives odd artifacts
+        return {}
+    return {float(r["offered_rps"]): dict(r["shares"])
+            for r in doc["rungs"]}
+
+
+# --------------------------------------------------------- rendering
+
+def _render(doc: Dict[str, Any]) -> str:
+    lines = [
+        f"== paddle trace: {len(doc['streams'])} stream(s), "
+        f"{doc['n_requests']} request(s), {doc['n_answered']} answered, "
+        f"{doc['n_reconstructed']} reconstructed =="
+    ]
+    for sk in doc["skew"]:
+        note = "" if sk["feasible"] else "  CAUSALITY VIOLATION"
+        lines.append(f"  skew {sk['stream']}: shift {sk['shift_s']:+.4f}s "
+                     f"(bound {sk['bound_s']:.4f}s){note}")
+    if doc["n_flagged"]:
+        lines.append(f"  coverage below {1 - doc['tolerance']:.0%} on "
+                     f"{doc['n_flagged']} request(s):")
+        for trace in doc["flagged"]:
+            t = doc["requests"][trace]
+            lines.append(f"    {trace}: coverage {t['coverage']:.1%} "
+                         f"gap {t['gap_s']:.4f}s "
+                         f"overlap {t['overlap_s']:.4f}s")
+    if doc["rungs"]:
+        cols = (*BUCKETS, "uncovered")
+        lines.append("")
+        lines.append("p99 tail-latency attribution "
+                     "(share of cohort e2e):")
+        head = (f"{'rung':>4} {'rps':>7} {'n':>4} {'p99_e2e_s':>10}  "
+                + "  ".join(f"{c:>11}" for c in cols))
+        lines.append(head)
+        for r in doc["rungs"]:
+            row = (f"{r['rung']:>4} {r['offered_rps']:>7.2f} "
+                   f"{r['requests']:>4} {r['p99_e2e_s']:>10.4f}  "
+                   + "  ".join(f"{r['shares'].get(c, 0.0):>11.1%}"
+                               for c in cols))
+            lines.append(row)
+    for trace, t in sorted(doc["requests"].items()):
+        if "e2e_s" not in t:
+            continue
+        lines.append("")
+        mark = "" if t["covered_ok"] else "  [COVERAGE FLAG]"
+        lines.append(f"{trace} (rung {t['rung']}, e2e {t['e2e_s']:.4f}s, "
+                     f"coverage {t['coverage']:.1%}"
+                     f"{', reoffered' if t['reoffered'] else ''}){mark}")
+        base = t["t_enqueue"]
+        for sp in t["spans"]:
+            lines.append(f"  {sp['t0'] - base:>9.4f}s "
+                         f"+{sp['dur_s']:.4f}s  {sp['name']:<22} "
+                         f"{sp['stream']}"
+                         + (f" attempt={sp['attempt']}"
+                            if "attempt" in sp else ""))
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------- selftest
+
+def _selftest() -> int:
+    """Golden two-stream fixture (router + one replica with a
+    deliberate +0.25s wall-clock skew and a torn tail): the analyzer
+    must align within the reported bound, reconstruct the request, and
+    attribute the decode-dominated tail — jax-free and fast, run by
+    bin/check_analysis.sh on every gate."""
+    import tempfile
+
+    root = tempfile.mkdtemp(prefix="paddle_trace_selftest_")
+    router_d = os.path.join(root, "router")
+    replica_d = os.path.join(root, "replica-0")
+    os.makedirs(router_d)
+    os.makedirs(replica_d)
+
+    def w(d: str, recs: List[Dict[str, Any]], torn: bool = False) -> None:
+        with open(os.path.join(d, "metrics.jsonl"), "w",
+                  encoding="utf-8") as f:
+            for rec in recs:
+                f.write(json.dumps(rec) + "\n")
+            if torn:
+                f.write('{"v": 1, "kind": "span", "name": "eng')
+
+    def span(t: float, name: str, t0: float, dur: float,
+             **fields: Any) -> Dict[str, Any]:
+        return {"v": 1, "kind": "span", "host": "h", "t": t,
+                "name": name, "t0": t0, "dur_s": dur, **fields}
+
+    w(router_d, [
+        {"v": 1, "kind": "run_start", "host": "h", "t": 0.0,
+         "wall_time": 1000.0},
+        span(0.1, "router.enqueue", 0.10, 0.0, trace="r1", rid="r1"),
+        span(0.15, "router.wait", 0.10, 0.05, trace="r1", rid="r1",
+             replica="replica-0", attempt=1),
+        span(1.15, "router.answer", 1.15, 0.0, trace="r1",
+             replica="replica-0"),
+        {"v": 1, "kind": "run_end", "host": "h", "t": 1.2,
+         "status": "completed"},
+    ])
+    # replica wall clock runs 0.25s BEHIND the router's; its process
+    # started at router-time 0.15
+    w(replica_d, [
+        {"v": 1, "kind": "run_start", "host": "h", "t": 0.0,
+         "wall_time": 999.90},
+        span(0.02, "replica.journal", 0.01, 0.01, trace="r1"),
+        span(0.02, "replica.accept", 0.02, 0.0, trace="r1"),
+        span(0.15, "engine.queue_wait", 0.02, 0.13, trace="r1",
+             rid="r1"),
+        span(0.25, "engine.prefill", 0.15, 0.10, trace="r1", rid="r1"),
+        span(0.95, "engine.decode_window", 0.25, 0.70, traces=["r1"]),
+        span(0.99, "engine.readback", 0.95, 0.04, traces=["r1"]),
+    ], torn=True)
+
+    doc = analyze_trace([router_d, replica_d])
+    tl = doc["requests"].get("r1")
+    problems = []
+    if doc["n_reconstructed"] != 1 or tl is None or "e2e_s" not in tl:
+        problems.append("request r1 not reconstructed")
+    else:
+        if not tl["covered_ok"]:
+            problems.append(f"coverage {tl['coverage']} below tolerance")
+        sk = next((s for s in doc["skew"]
+                   if s["stream"] == "replica-0"), None)
+        if sk is None or not sk["feasible"]:
+            problems.append("replica-0 skew not aligned")
+        elif not (0.1 <= sk["shift_s"] <= 0.3):
+            problems.append(f"skew shift {sk['shift_s']} outside the "
+                            "planted 0.25s neighbourhood")
+        shares = doc["rungs"][0]["shares"] if doc["rungs"] else {}
+        if not shares.get("decode", 0.0) > 0.5:
+            problems.append(f"decode share {shares.get('decode')} — "
+                            "expected the dominant bucket")
+        if shares.get("uncovered", 1.0) > 0.05:
+            problems.append(f"uncovered share {shares.get('uncovered')}")
+    if problems:
+        print("paddle trace --selftest FAILED:", file=sys.stderr)
+        for p in problems:
+            print(f"  - {p}", file=sys.stderr)
+        return 1
+    print("paddle trace selftest: ok — 2 streams aligned "
+          f"(skew bound {doc['skew'][0]['bound_s']:.3f}s), 1 request "
+          f"reconstructed, coverage {tl['coverage']:.1%}")
+    return 0
+
+
+# -------------------------------------------------------------- CLI
+
+def main(rest: List[str]) -> int:
+    ap = argparse.ArgumentParser(
+        prog="paddle trace",
+        description="Reconstruct per-request cross-process timelines "
+                    "from fleet telemetry streams (jax-free).")
+    ap.add_argument("run_dir", nargs="*",
+                    help="run or fleet dir(s); replica-*/ and "
+                         "fleet_status/replica-*/ streams are "
+                         "discovered automatically")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full reconstruction document")
+    ap.add_argument("--tolerance", type=float, default=0.05,
+                    help="e2e coverage slack before a request is "
+                         "flagged (default 0.05)")
+    ap.add_argument("--selftest", action="store_true",
+                    help="golden two-stream fixture, no run dir needed")
+    args = ap.parse_args(rest)
+    if args.selftest:
+        return _selftest()
+    if not args.run_dir:
+        ap.error("a run dir is required (or --selftest)")
+    doc = analyze_trace(args.run_dir, tolerance=args.tolerance)
+    if not doc["streams"]:
+        print(f"error: no telemetry streams under {args.run_dir}",
+              file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(doc, indent=2, sort_keys=True))
+    else:
+        print(_render(doc))
+    return 0

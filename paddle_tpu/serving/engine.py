@@ -218,6 +218,11 @@ class EngineRequest(slog.Request):
     budget: int = 0
     tokens: List[int] = dataclasses.field(default_factory=list)
     error: Optional[str] = None
+    # absolute monotonic enqueue stamp for span emission: the inherited
+    # t_enqueue is WINDOW-relative (re-anchored at every window_roll),
+    # but a span's t0 must live in the stream timebase, which
+    # obs.rel_time derives from absolute monotonic readings
+    t_enqueue_abs: float = 0.0
 
 
 class Engine:
@@ -276,6 +281,11 @@ class Engine:
         self._started = False
         self._draining = False
         self._n_submitted = 0
+        # distributed tracing (doc/observability.md "Distributed
+        # tracing"): latched under the lock at the first traced submit;
+        # until then the engine emits zero kind=span records, so
+        # untraced single-process runs keep their telemetry unchanged
+        self._tracing = False
         self._pid = os.getpid()
         self.warmup_s: Optional[float] = None
         # --- resilience plane (doc/resilience.md "Serving resilience")
@@ -356,13 +366,17 @@ class Engine:
                max_new_tokens: Optional[int] = None,
                rid: Optional[str] = None,
                timeout_s: Optional[float] = None,
-               replay: bool = False) -> ResultFuture:
+               replay: bool = False,
+               trace: str = "") -> ResultFuture:
         """Enqueue one request; returns its future. Rejected immediately
         (``outcome=rejected``) when draining, stopped, or past
         ``queue_cap`` — a rejection is an answer, never an exception.
         ``replay=True`` re-offers a durably journaled backlog after a
         restart: arrival control (``queue_cap``, brownout arrival shed)
-        governs new arrivals, not the already-accepted queue."""
+        governs new arrivals, not the already-accepted queue.
+        ``trace`` is the opaque distributed-tracing join key: when set,
+        the request's record carries it as ``trace_id`` and the engine
+        emits ``kind=span`` hop records for it."""
         fut = ResultFuture()
         with self._lock:
             now = self._now()
@@ -374,7 +388,10 @@ class Engine:
                 rid=rid, t_enqueue=now, prompt=list(prompt),
                 prompt_tokens=len(prompt), max_new=max_new_tokens,
                 future=fut, deadline=now + float(limit),
+                trace=str(trace or ""), t_enqueue_abs=now + self._t0,
             )
+            if req.trace:
+                self._tracing = True
             if self._draining or not self._started or self._thread is None:
                 self._finish_locked(req, "rejected", now)
             elif max_new_tokens is not None and int(max_new_tokens) <= 0:
@@ -390,6 +407,10 @@ class Engine:
                 # reject-fast while the launch-failure breaker cools:
                 # queueing behind a faulting device only converts this
                 # request into a slower error/timeout
+                if req.trace:
+                    self._span_locked("engine.breaker_reject",
+                                      now + self._t0, 0.0,
+                                      trace=req.trace, rid=req.rid)
                 self._finish_locked(
                     req, "shed", now,
                     retry_after=self._breaker.retry_after_s(),
@@ -492,6 +513,26 @@ class Engine:
     def _now(self) -> float:
         return self._clock() - self._t0
 
+    def _span_locked(self, name: str, t0_abs: float, dur_s: float,
+                     **fields: Any) -> None:
+        """One ``kind=span`` hop record (doc/observability.md
+        "Distributed tracing"). ``t0_abs`` is an absolute monotonic
+        reading from ``self._clock`` — mapped into the stream timebase
+        here, because request stamps like ``t_enqueue`` are
+        window-relative and re-anchor at every roll. Caller holds
+        ``self._lock`` (telemetry under the engine lock follows the
+        ``_note_reload`` precedent); a no-op until the first traced
+        submit, so untraced runs emit nothing."""
+        if not self._tracing:
+            return
+        from paddle_tpu.observability import metrics as obs
+
+        obs.emit("span", name=name, t0=obs.rel_time(t0_abs),
+                 dur_s=round(max(float(dur_s), 0.0), 6),
+                 engine=ENGINE_NAME,
+                 **({"replica": self.replica} if self.replica else {}),
+                 **fields)
+
     def _finish_locked(self, req: EngineRequest, outcome: str,
                        now: float, error: Optional[str] = None,
                        retry_after: Optional[float] = None) -> None:
@@ -513,6 +554,12 @@ class Engine:
         elif outcome == "cancelled":
             self._log.cancel(req, now)
         elif outcome == "shed":
+            if req.trace:
+                # interference instant: the deadline/brownout/breaker
+                # shed that ended this trace early shows up in its
+                # timeline, not just in the aggregate counters
+                self._span_locked("engine.shed", now + self._t0, 0.0,
+                                  trace=req.trace, rid=req.rid)
             self._log.shed(req, now, arrived=req.queued,
                            retry_after_s=retry_after)
         else:
@@ -880,6 +927,9 @@ class Engine:
         obs.registry().counter("serve.reloads").inc()
         obs.emit("reload", path=tag, engine=ENGINE_NAME,
                  **({"replica": self.replica} if self.replica else {}))
+        # reload-boundary interference marker for traced timelines
+        self._span_locked("engine.reload_boundary", self._clock(), 0.0,
+                          tag=tag)
         logger.info("serve weights hot-reloaded at iteration boundary "
                     "(%s, reload #%d)", tag or "<untagged>", self._reloads)
 
@@ -918,6 +968,15 @@ class Engine:
                 req.t_admit = now
                 self._slots[b] = req
                 self._log.admit(req)
+                if req.trace:
+                    # request-perspective hops: time queued behind the
+                    # cohort wave, then the (shared) prefill launch
+                    self._span_locked(
+                        "engine.queue_wait", req.t_enqueue_abs,
+                        (now + self._t0) - req.t_enqueue_abs,
+                        trace=req.trace, rid=req.rid)
+                    self._span_locked("engine.prefill", t0, dt,
+                                      trace=req.trace, rid=req.rid)
             self._admitting = []
             self._log.note_exec(dt)
             self._prefill_ema = (1 - _EMA) * self._prefill_ema + _EMA * dt
@@ -1024,6 +1083,17 @@ class Engine:
                 self._step_ema = step_ema
                 self._last_collect = self._clock()
                 self._note_collect_locked()
+                traces = [r.trace for r in self._slots
+                          if r is not None and r.trace]
+                if traces:
+                    self._span_locked("engine.decode_window", t0, dt,
+                                      traces=traces, block=int(u))
+                    rb = float(getattr(backend, "last_readback_s", 0.0)
+                               or 0.0)
+                    if rb > 0.0:
+                        self._span_locked("engine.readback",
+                                          t0 + dt - rb, rb,
+                                          traces=traces)
                 self._apply_step_locked(out, dt, occupancy)
 
     # ----------------------------------------------- the pipelined loop
@@ -1132,6 +1202,21 @@ class Engine:
                     self._step_ema = step_ema
                     self._last_collect = self._clock()
                     self._note_collect_locked()
+                    traces = [r.trace for _b, r in cohort if r.trace]
+                    if traces:
+                        # decode-iteration window from the COHORT
+                        # snapshot: the requests whose tokens this
+                        # collect actually carries, even if their
+                        # slots were since reassigned
+                        self._span_locked("engine.decode_window",
+                                          t_disp, t_done - t_disp,
+                                          traces=traces, block=int(u))
+                        rb = float(getattr(backend, "last_readback_s",
+                                           0.0) or 0.0)
+                        if rb > 0.0:
+                            self._span_locked("engine.readback",
+                                              t_done - rb, rb,
+                                              traces=traces)
                     stale = disp_log is not self._log
                     if not stale:
                         self._log.note_overlap(max(t_wait - t_disp, 0.0))

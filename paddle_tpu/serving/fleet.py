@@ -322,6 +322,14 @@ class FleetRouter:
         self._emit_idx = 0
         self._unsent: collections.deque = collections.deque()
         self._owner: Dict[str, str] = {}       # rid -> replica name
+        # distributed tracing (doc/observability.md "Distributed
+        # tracing") — all under self._lock: per-rid wait-start / route
+        # instant / routing-attempt counter, driving the
+        # router.{enqueue,wait,reoffer,answer} spans and the per-hop
+        # ids stamped on forwarded request lines
+        self._t_wait0: Dict[str, float] = {}
+        self._t_route: Dict[str, float] = {}
+        self._attempt: Dict[str, int] = {}
         self._outstanding: Dict[str, set] = {r.name: set() for r in replicas}
         # replica supervision state
         self._rep: Dict[str, Dict[str, Any]] = {
@@ -368,6 +376,11 @@ class FleetRouter:
         with self._lock:
             if rid in self._docs:
                 return False
+            # the router is the trace origin: stamp (or echo verbatim)
+            # the opaque join key BEFORE the doc is stored, so routing,
+            # failover re-offers and the replicas' journals all carry
+            # the same trace_id (doc/observability.md)
+            doc["trace_id"] = str(doc.get("trace_id") or rid)
             self._docs[rid] = doc
             self._order.append(rid)
             if self._draining or self._done_running:
@@ -383,6 +396,10 @@ class FleetRouter:
                     # lock serializes order
                     self._emit_ready_locked()
             else:
+                now = self._clock()
+                self._t_wait0[rid] = now
+                self._span("router.enqueue", now, 0.0,
+                           trace=doc["trace_id"], rid=rid)
                 self._unsent.append(rid)
             self._wake.notify_all()
         return True
@@ -401,6 +418,11 @@ class FleetRouter:
                 self.duplicate_answers += 1
                 return
             self._results[rid] = doc
+            self._span("router.answer", self._clock(), 0.0,
+                       trace=str(self._docs[rid].get("trace_id") or rid),
+                       rid=rid, replica=name)
+            self._t_wait0.pop(rid, None)
+            self._t_route.pop(rid, None)
             self._wake.notify_all()
 
     def note_eof(self) -> None:
@@ -437,6 +459,20 @@ class FleetRouter:
                 "duplicate_answers": self.duplicate_answers,
                 "deaths": self.deaths,
             }
+
+    def _span(self, name: str, t0_mono: float, dur_s: float,
+              **fields: Any) -> None:
+        """One router-side ``kind=span`` hop record (doc/observability.
+        md "Distributed tracing"). ``t0_mono`` is a ``self._clock``
+        reading, mapped into the router stream's ``t``-offset timebase;
+        a no-op when telemetry is off, so library/race harness use
+        emits nothing."""
+        from paddle_tpu.observability import metrics as obsm
+
+        if not obsm.enabled():
+            return
+        obsm.emit("span", name=name, t0=obsm.rel_time(t0_mono),
+                  dur_s=round(max(float(dur_s), 0.0), 6), **fields)
 
     # ------------------------------------------------------ scheduling
 
@@ -571,6 +607,18 @@ class FleetRouter:
             for rid in reversed(orphans):
                 self._owner.pop(rid, None)
                 self._unsent.appendleft(rid)
+            for rid in orphans:
+                # routed-but-lost: [route → death detected] — failover's
+                # DISTINCT share in the tail-latency attribution table
+                t_route = self._t_route.pop(rid, now)
+                self._span(
+                    "router.reoffer", t_route, now - t_route,
+                    trace=str(self._docs[rid].get("trace_id") or rid),
+                    rid=rid, replica=name,
+                    attempt=self._attempt.get(rid, 1))
+                # the next router.wait measures from the re-offer, not
+                # the original front-door enqueue (no double-count)
+                self._t_wait0[rid] = now
             self.reoffers += len(orphans)
             # exit-code discipline (resilience/supervisor.py): 18 =
             # preemption, budget-free up to the storm limit; everything
@@ -656,6 +704,15 @@ class FleetRouter:
                 _score, name, handle = cands[0]
                 self._unsent.popleft()
                 doc = self._docs[rid]
+                attempt = self._attempt.get(rid, 0) + 1
+                self._attempt[rid] = attempt
+                trace = str(doc.get("trace_id") or rid)
+                # per-hop ids ride the forwarded line (opaque to the
+                # replica, echoed onto its journal): the parent is the
+                # front-door enqueue, one child hop per routing attempt
+                doc["trace_id"] = trace
+                doc["span_id"] = f"{trace}:send:{attempt}"
+                doc["parent_id"] = f"{trace}:enqueue"
             # the pipe write runs OUTSIDE the lock: a full pipe to a
             # busy child must not block submit/deliver
             if handle.send(doc):
@@ -663,6 +720,12 @@ class FleetRouter:
                     self._owner[rid] = name
                     self._outstanding[name].add(rid)
                     self.routed += 1
+                    t_route = self._clock()
+                    t0 = self._t_wait0.get(rid, t_route)
+                    self._t_route[rid] = t_route
+                    self._span("router.wait", t0, t_route - t0,
+                               trace=trace, rid=rid, replica=name,
+                               attempt=attempt)
             else:
                 # send failed: the child is dying — requeue and let the
                 # reaper classify the death (its journal never saw this
@@ -836,12 +899,23 @@ def merge_windows(per: List[Dict[str, Any]], *, rate_rps: float, rung: int,
     def _merged_snap(key: str) -> Dict[str, float]:
         snaps = [w.get(key) or {} for w in per]
         count = sum(int(s.get("count") or 0) for s in snaps)
+        if key in ("queue_depth", "occupancy"):
+            # gauges are TIME-sampled, not per-completion: weighting by
+            # completions silently drops a zero-completion replica from
+            # the mean even though it held slots/queue all window, so
+            # occupancy reads high under imbalance. Weight by each
+            # snap's sample count instead (1 when unknown) — an idle
+            # replica contributes its honest zero.
+            wts = [max(int(s.get("count") or 0), 1) for s in snaps]
+        else:
+            wts = weights
+        wts_sum = sum(wts) or 1
         return {
             "count": count,
             "mean": round(sum(float(s.get("mean") or 0.0) * wt
-                              for s, wt in zip(snaps, weights)) / wsum, 6),
+                              for s, wt in zip(snaps, wts)) / wts_sum, 6),
             "p50": round(sum(float(s.get("p50") or 0.0) * wt
-                             for s, wt in zip(snaps, weights)) / wsum, 6),
+                             for s, wt in zip(snaps, wts)) / wts_sum, 6),
             "p99": round(max((float(s.get("p99") or 0.0) for s in snaps),
                              default=0.0), 6),
             "max": round(max((float(s.get("max") or 0.0) for s in snaps),

@@ -95,6 +95,23 @@ def _parse_line(
     return doc, "", rid
 
 
+def _span(name: str, t0_mono: float, dur_s: float, trace: str) -> None:
+    """One replica-side ``kind=span`` hop record (doc/observability.md
+    "Distributed tracing"). ``trace`` is the opaque ``trace_id`` the
+    router stamped on the forwarded request — absent (direct stdin
+    clients) means no span, so single-process runs keep their streams
+    unchanged. ``t0_mono`` is a ``cc.monotonic`` reading, mapped into
+    the stream's ``t``-offset timebase by ``rel_time``."""
+    if not trace:
+        return
+    from paddle_tpu.observability import metrics as obsm
+
+    if not obsm.enabled():
+        return
+    obsm.emit("span", name=name, t0=obsm.rel_time(t0_mono),
+              dur_s=round(max(float(dur_s), 0.0), 6), trace=trace)
+
+
 def main(rest: List[str]) -> int:
     from paddle_tpu.utils.flags import FLAGS
 
@@ -223,7 +240,9 @@ def main(rest: List[str]) -> int:
     for sig in (signal.SIGTERM, signal.SIGINT):
         signal.signal(sig, lambda *_: drain.set())
 
-    pending: List[Tuple[str, Any]] = []   # (id, future), submission order
+    # (id, future, trace_id), submission order — the trace_id rides to
+    # the result line so the router can re-correlate the echo
+    pending: List[Tuple[str, Any, str]] = []
     plock = cc.Lock()
     eof = cc.Event()
     n_lines = [0]   # reader progress — the drain path waits for it to
@@ -247,12 +266,13 @@ def main(rest: List[str]) -> int:
             # capping the re-offer would reject-and-done-mark the
             # tail, permanently truncating the very queue the journal
             # exists to preserve
+            trace = str(doc.get("trace_id") or "")
             fut = engine.submit(
                 doc.get("prompt") or [],
                 max_new_tokens=doc.get("max_new_tokens"),
-                rid=str(doc["id"]), replay=True)
+                rid=str(doc["id"]), replay=True, trace=trace)
             with plock:
-                pending.append((str(doc["id"]), fut))
+                pending.append((str(doc["id"]), fut, trace))
 
     def _reader() -> None:
         n = 0
@@ -265,24 +285,36 @@ def main(rest: List[str]) -> int:
                     print(json.dumps({"id": rid,
                                       "outcome": "error", "tokens": [],
                                       "error": err}), flush=True)
-                elif journal is not None and not journal.accept(doc):
-                    # this id is already journaled: answered in a
-                    # previous incarnation, or re-offered above — a
-                    # replayed stdin after a supervised restart must
-                    # not double-submit (dedupe by request id)
-                    print(f"# paddle serve: duplicate request id "
-                          f"{doc['id']!r} skipped (journal)",
-                          file=sys.stderr)
                 else:
-                    # the journal accept above was flushed+fsynced
-                    # BEFORE this submit — crash-ordered ahead of any
-                    # accept effect
-                    fut = engine.submit(
-                        doc["prompt"],
-                        max_new_tokens=doc.get("max_new_tokens"),
-                        rid=str(doc["id"]))
-                    with plock:
-                        pending.append((str(doc["id"]), fut))
+                    trace = str(doc.get("trace_id") or "")
+                    accepted = True
+                    if journal is not None:
+                        jt0 = cc.monotonic()
+                        accepted = journal.accept(doc)
+                        # the durable append (flush + fsync) is a real
+                        # hop on the request's critical path
+                        _span("replica.journal", jt0,
+                              cc.monotonic() - jt0, trace)
+                    if not accepted:
+                        # this id is already journaled: answered in a
+                        # previous incarnation, or re-offered above — a
+                        # replayed stdin after a supervised restart must
+                        # not double-submit (dedupe by request id)
+                        print(f"# paddle serve: duplicate request id "
+                              f"{doc['id']!r} skipped (journal)",
+                              file=sys.stderr)
+                    else:
+                        # the journal accept above was flushed+fsynced
+                        # BEFORE this submit — crash-ordered ahead of
+                        # any accept effect
+                        _span("replica.accept", cc.monotonic(), 0.0,
+                              trace)
+                        fut = engine.submit(
+                            doc["prompt"],
+                            max_new_tokens=doc.get("max_new_tokens"),
+                            rid=str(doc["id"]), trace=trace)
+                        with plock:
+                            pending.append((str(doc["id"]), fut, trace))
             with plock:
                 n_lines[0] += 1
             if drain.is_set():
@@ -297,12 +329,15 @@ def main(rest: List[str]) -> int:
             with plock:
                 if not pending:
                     return
-                rid, fut = pending[0]
+                rid, fut, trace = pending[0]
                 if not block and not fut.done():
                     return
                 pending.pop(0)
             res = fut.result(timeout=600.0)
             out = {"id": rid, "outcome": res.outcome, "tokens": res.tokens}
+            if trace:
+                # echoed verbatim — the propagation contract
+                out["trace_id"] = trace
             if res.error:
                 out["error"] = res.error
             if res.retry_after_s is not None:

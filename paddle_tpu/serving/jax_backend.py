@@ -350,10 +350,16 @@ class JaxDecodeBackend:
                 "(dispatch/collect pairing broken)"
             )
         toks, lives, fin, u, t_disp = self._inflight.popleft()
+        t_rb0 = cc.perf_counter()
         toks_np = np.asarray(toks)
         lives_np = np.asarray(lives)
         fin_np = np.asarray(fin)
         t_done = cc.perf_counter()
+        # device→host readback cost of THIS collect (the np.asarray
+        # syncs above) — the engine turns it into an engine.readback
+        # span for traced requests; a duration, not a timestamp, so
+        # the perf_counter vs monotonic timebase mismatch cannot leak
+        self.last_readback_s = t_done - t_rb0
         if self._registry is not None and self.serving:
             # union of dispatch->done spans: launch N+1 was dispatched
             # while N ran, so anchoring at max(dispatch, previous done)

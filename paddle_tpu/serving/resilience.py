@@ -331,6 +331,11 @@ class RequestJournal:
             rec = {"op": "accept", "id": rid,
                    "prompt": doc.get("prompt"),
                    "max_new_tokens": doc.get("max_new_tokens")}
+            if doc.get("trace_id"):
+                # a replayed backlog keeps its distributed-tracing join
+                # key — the restarted incarnation's spans still stitch
+                # into the same cross-process timeline
+                rec["trace_id"] = str(doc["trace_id"])
             self.accepted[rid] = rec
             self._f.write(json.dumps(rec) + "\n")
             self._f.flush()

@@ -474,6 +474,35 @@ def test_merge_windows_sums_counts_keeps_worst_tail(tmp_path):
     assert not obs.validate_record(wins[0]), wins[0]
 
 
+def test_merge_windows_gauge_means_keep_zero_completion_replicas(tmp_path):
+    """The silent-drop bug (PR 18): completion-weighted means gave a
+    zero-completion replica weight 0 in the GAUGE snaps too, so an idle
+    (or just-restarted) replica vanished from the merged occupancy/
+    queue-depth view and the fleet looked busier than it was. Gauges
+    are sampled per snapshot, not per completion — they now weight by
+    the snap's own sample count."""
+    obs.configure(str(tmp_path))
+    wa = _win(0, 0, 0.0, "replica-0")     # answered nothing this window
+    wa["occupancy"] = {"count": 4, "mean": 0.0, "p50": 0.0, "p99": 0.0,
+                       "max": 0.0}
+    wa["queue_depth"] = {"count": 4, "mean": 0.0, "p50": 0.0, "p99": 0.0,
+                         "max": 0.0}
+    wb = _win(8, 80, 0.5, "replica-1")
+    wb["occupancy"] = {"count": 4, "mean": 2.0, "p50": 2.0, "p99": 2.0,
+                       "max": 2.0}
+    wb["queue_depth"] = {"count": 4, "mean": 2.0, "p50": 2.0, "p99": 2.0,
+                         "max": 2.0}
+    rec = merge_windows([wa, wb], rate_rps=2.0, rung=0, window_s=10.0,
+                        router_s=0.0)
+    # the idle replica is half the fleet: the merged gauge mean must be
+    # 1.0, not replica-1's 2.0 (the pre-fix silent drop)
+    assert rec["occupancy"]["mean"] == pytest.approx(1.0)
+    assert rec["queue_depth"]["mean"] == pytest.approx(1.0)
+    # completion-weighted stats are untouched: all latency mass is B's
+    assert rec["latency"]["p99"] == 0.5
+    assert rec["completed"] == 8
+
+
 def test_compare_serve_key_joins_on_replicas():
     seen = set()
     assert _serve_key(2.0, 0, seen) == "serve.2rps."
@@ -563,3 +592,84 @@ def test_chaos_fleet_kills_one_replica_every_request_answered(tmp_path):
     assert counters.get("fleet.routed", 0) >= len(ids), counters
     # the per-replica journals recorded the failover's raw material
     assert (status_dir / "replica-0.journal.jsonl").exists()
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+@pytest.mark.trace
+def test_chaos_fleet_trace_reconstructs_every_answered_request(tmp_path):
+    """PR 18 acceptance: kill 1 of 2 replicas mid-load, then `paddle
+    trace` over the fleet run dir must reconstruct a timeline for 100%
+    of answered requests EXACTLY once, span sets covering e2e within
+    tolerance (gap/overlap reported otherwise), re-offered requests
+    carrying a distinct `router.reoffer` span, and the p99 attribution
+    naming failover re-offer as its own share."""
+    from paddle_tpu.observability.tracing import analyze_trace
+
+    cfg = tmp_path / "serve_conf.py"
+    cfg.write_text(SERVE_CONFIG.format(
+        demo=os.path.join(REPO, "demo", "seqToseq")))
+    run_dir = tmp_path / "run"
+    # the status dir INSIDE the run dir: replica streams land at
+    # run/fleet_status/replica-*/ where fleet_stream_dirs discovers
+    # them next to the router's own stream
+    status_dir = run_dir / "fleet_status"
+    ids = [f"c{i}" for i in range(8)]
+    reqs = "\n".join(json.dumps(
+        {"id": rid, "prompt": [4 + i, 7], "max_new_tokens": 2}
+    ) for i, rid in enumerate(ids))
+    env = dict(
+        SUBPROC_ENV,
+        PADDLE_TPU_FLEET_CHILD_FAULTS_0="serve.crash=exit:3@2",
+    )
+    out = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.cli", "serve-fleet",
+         f"--config={cfg}", "--use_tpu=0", "--fleet_replicas=2",
+         f"--fleet_status_dir={status_dir}",
+         "--serve_slots=2", "--serve_prompt_tokens=4",
+         "--serve_decode_block=1", "--restart_base_delay=0.01",
+         "--restart_budget=1",
+         f"--compile_cache_dir={tmp_path / 'ccache'}",
+         f"--metrics_path={run_dir}"],
+        input=reqs + "\n", capture_output=True, text=True, timeout=600,
+        env=env, cwd=str(tmp_path),
+    )
+    assert out.returncode == 0, (out.returncode, out.stderr[-4000:])
+    answers = [json.loads(l) for l in out.stdout.splitlines()
+               if l.strip().startswith("{") and "outcome" in l]
+    answered = [d["id"] for d in answers if d["outcome"] == "ok"]
+    assert sorted(answered) == ids, (answered, out.stderr[-3000:])
+
+    doc = analyze_trace([str(run_dir)])
+    # the router's stream plus both replicas' were discovered
+    assert len(doc["streams"]) >= 3, doc["streams"]
+    # exactly-once reconstruction: one timeline per answered id, each
+    # with a full e2e interval (requests dict is keyed by trace, so
+    # double-counting would have to surface as a missing id)
+    recon = {t["rid"]: t for t in doc["requests"].values()
+             if t["answered"]}
+    assert sorted(recon) == ids, sorted(recon)
+    assert doc["n_reconstructed"] == len(ids), doc
+    for rid, tl in recon.items():
+        assert "e2e_s" in tl, (rid, tl)
+        # coverage within tolerance; the gap/overlap numbers ARE the
+        # report when this fails
+        assert tl["covered_ok"], (rid, tl["coverage"], tl["gap_s"],
+                                  tl["overlap_s"])
+        # each instant counted once: the bucket sweep partitions e2e
+        total = sum(tl["buckets"].values())
+        assert total == pytest.approx(tl["e2e_s"], rel=1e-3, abs=1e-4)
+    # the drill fired: at least one request was re-offered after the
+    # death, and its timeline shows the distinct reoffer span
+    reoffered = [t for t in recon.values() if t["reoffered"]]
+    assert reoffered, "no request was re-offered — the kill never bit"
+    for tl in reoffered:
+        names = [sp["name"] for sp in tl["spans"]]
+        assert "router.reoffer" in names, names
+        assert tl["buckets"].get("reoffer", 0.0) > 0.0, tl["buckets"]
+    # failover re-offer is a named share of the attribution table
+    assert doc["rungs"], doc
+    assert all("reoffer" in r["shares"] for r in doc["rungs"])
+    # the skew bound was computed and reported for every replica stream
+    assert {s["stream"] for s in doc["skew"]} >= {"replica-0",
+                                                  "replica-1"}

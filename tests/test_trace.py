@@ -1,0 +1,336 @@
+"""Distributed tracing (doc/observability.md "Distributed tracing"):
+the `kind=span` schema, `paddle trace` stream reconstruction — segment-
+wise wall-clock anchoring, causality-bounded skew alignment, torn-tail
+tolerance — the attribution sweep's one-instant-one-bucket precedence,
+fleet stream discovery, and the writer-timebase `rel_time` helper the
+emitters depend on. All jax-free."""
+
+import json
+import os
+
+import pytest
+
+from paddle_tpu.observability import metrics as obs
+from paddle_tpu.observability.tracing import (
+    BUCKETS,
+    _selftest,
+    _sweep,
+    align_streams,
+    analyze_trace,
+    load_stream,
+    main as trace_main,
+    p99_shares_by_rate,
+)
+from paddle_tpu.utils import concurrency as cc
+
+pytestmark = pytest.mark.trace
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    obs.registry().reset()
+    yield
+    obs.configure("")
+
+
+def _write(d, recs, torn=False):
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "metrics.jsonl"), "w", encoding="utf-8") as f:
+        for rec in recs:
+            f.write(json.dumps(rec) + "\n")
+        if torn:
+            # a crash mid-append: no newline, unparseable — every
+            # reader must skip it
+            f.write('{"v": 1, "kind": "span", "name": "eng')
+
+
+def _span(t, name, t0, dur, **fields):
+    return {"v": 1, "kind": "span", "host": 0, "t": t,
+            "name": name, "t0": t0, "dur_s": dur, **fields}
+
+
+def _start(wall, t=0.0):
+    return {"v": 1, "kind": "run_start", "host": 0, "t": t,
+            "wall_time": wall}
+
+
+# ------------------------------------------------------------ schema
+
+
+def test_span_record_is_schema_clean(tmp_path):
+    obs.configure(str(tmp_path))
+    obs.emit("span", name="engine.prefill", t0=0.5, dur_s=0.1,
+             trace="t1", rid="r1")
+    obs.flush()
+    recs = obs.read_records(obs.metrics_files(str(tmp_path))[0])
+    spans = [r for r in recs if r.get("kind") == "span"]
+    assert len(spans) == 1
+    assert not obs.validate_record(spans[0]), spans[0]
+    # the required triple is enforced: a nameless span is invalid
+    assert obs.validate_record({"v": 1, "kind": "span", "host": 0,
+                                "t": 0.0, "t0": 0.1, "dur_s": 0.0})
+
+
+def test_rel_time_maps_monotonic_onto_stream_timebase(tmp_path):
+    obs.configure(str(tmp_path))
+    r = obs.rel_time(cc.monotonic())
+    # "now" in the writer's timebase: a small non-negative offset from
+    # its run_start
+    assert 0.0 <= r < 60.0, r
+    # no writer: identity fallback keeps callers harmless
+    obs.configure("")
+    assert obs.rel_time(5.25) == 5.25
+
+
+def test_fleet_stream_dirs_discovery(tmp_path):
+    run = tmp_path / "run"
+    _write(str(run), [_start(10.0)])
+    _write(str(run / "replica-0"), [_start(10.0)])
+    _write(str(run / "fleet_status" / "replica-1"), [_start(10.0)])
+    (run / "replica-9").mkdir()  # no metrics file: not a stream
+    dirs = obs.fleet_stream_dirs(str(run))
+    names = [os.path.basename(os.path.normpath(d)) for d in dirs]
+    assert names[0] == "run"
+    assert "replica-0" in names and "replica-1" in names
+    assert "replica-9" not in names
+    # a plain single-stream dir stays itself
+    assert obs.fleet_stream_dirs(str(run / "replica-0")) == [
+        str(run / "replica-0")]
+
+
+# ------------------------------------------------- anchoring + skew
+
+
+def test_load_stream_segmentwise_anchoring_and_torn_tail(tmp_path):
+    """A killed-and-restarted replica APPENDS a fresh run_start (new t
+    base) to the same file; spans after it must anchor on the new
+    wall_time, and records before any anchor are dropped, counted."""
+    d = str(tmp_path / "replica-0")
+    _write(d, [
+        _span(0.0, "engine.prefill", 0.0, 0.1, trace="pre"),  # unanchored
+        _start(100.0),
+        _span(1.0, "engine.prefill", 1.0, 0.1, trace="a"),
+        # restart: same file, new incarnation 50s later, t rebased to 0
+        _start(150.0, t=0.0),
+        _span(2.0, "engine.prefill", 2.0, 0.1, trace="b"),
+    ], torn=True)
+    st = load_stream(d)
+    assert st["segments"] == 2 and st["dropped"] == 1
+    by = {s["trace"]: s for s in st["spans"]}
+    assert by["a"]["t0"] == pytest.approx(101.0)
+    assert by["b"]["t0"] == pytest.approx(152.0)  # the NEW anchor
+    assert "pre" not in by  # unplaceable, not guessed
+
+
+def test_align_streams_recovers_planted_wall_clock_skew(tmp_path):
+    """The replica's wall clock runs 0.30s behind the router's: hop
+    causality (route-send <= first replica event; last replica event <=
+    answer) must bound and correct the shift."""
+    router = str(tmp_path / "run")
+    replica = str(tmp_path / "run" / "replica-0")
+    _write(router, [
+        _start(1000.0),
+        _span(0.1, "router.enqueue", 0.10, 0.0, trace="x", rid="x"),
+        _span(0.2, "router.wait", 0.10, 0.10, trace="x",
+              replica="replica-0", attempt=1),
+        _span(2.0, "router.answer", 2.00, 0.0, trace="x",
+              replica="replica-0"),
+    ])
+    _write(replica, [
+        _start(999.70),  # 0.30s behind
+        _span(0.0, "engine.queue_wait", 0.00, 0.20, trace="x"),
+        _span(1.5, "engine.decode_window", 0.20, 1.30, traces=["x"]),
+    ])
+    streams = [load_stream(router), load_stream(replica)]
+    reports = align_streams(streams)
+    assert len(reports) == 1
+    rep = reports[0]
+    assert rep["stream"] == "replica-0" and rep["feasible"]
+    # route-send at router-abs 1000.20; replica's first raw-anchored
+    # event at 999.70 => shift >= 0.50... no: anchor 999.70 + 0.0 =
+    # 999.70, route end = 1000.0 + 0.20 = 1000.20 -> lo = 0.50? The
+    # planted skew is 0.30 plus the 0.20s pipe wait; causality can only
+    # give a BOUND, and it must cover the truth without violating it:
+    answer = 1000.0 + 2.00
+    last = max(s["t0"] + s["dur_s"] for s in streams[1]["spans"])
+    assert last <= answer + 1e-9  # hi-constraint honored post-shift
+    assert rep["shift_s"] >= 0.30 - 1e-9  # at least the planted skew
+
+
+def test_infeasible_alignment_is_flagged_not_hidden(tmp_path):
+    """A replica event AFTER the router's answer with no shift that can
+    fix both ends: reported feasible=False, never silently clamped."""
+    router = str(tmp_path / "r")
+    replica = str(tmp_path / "r" / "replica-0")
+    _write(router, [
+        _start(100.0),
+        _span(0.1, "router.enqueue", 0.1, 0.0, trace="y"),
+        _span(0.2, "router.wait", 0.1, 0.1, trace="y",
+              replica="replica-0"),
+        _span(0.3, "router.answer", 0.3, 0.0, trace="y",
+              replica="replica-0"),
+    ])
+    # the replica claims 5s of decode inside a 0.1s route->answer hole
+    _write(replica, [
+        _start(100.0),
+        _span(0.2, "engine.decode_window", 0.2, 5.0, traces=["y"]),
+    ])
+    streams = [load_stream(router), load_stream(replica)]
+    reports = align_streams(streams)
+    assert reports and reports[0]["feasible"] is False
+
+
+# ------------------------------------------------- attribution sweep
+
+
+def test_sweep_counts_each_instant_once_with_precedence():
+    # decode window [0, 10] brackets its readback [8, 10]; queue_wait
+    # [0, 2] overlaps decode too — precedence: readback > decode >
+    # queue_wait, each instant exactly once
+    buckets, union = _sweep([
+        (0.0, 2.0, "queue_wait"),
+        (0.0, 10.0, "decode"),
+        (8.0, 10.0, "readback"),
+    ], 0.0, 12.0)
+    assert union == pytest.approx(10.0)
+    assert buckets["decode"] == pytest.approx(8.0)  # 10 - readback's 2
+    assert buckets["readback"] == pytest.approx(2.0)
+    assert "queue_wait" not in buckets  # fully shadowed by decode
+    assert buckets["uncovered"] == pytest.approx(2.0)
+    assert sum(buckets.values()) == pytest.approx(12.0)  # e2e, exactly
+
+
+def test_sweep_clips_to_request_window():
+    buckets, union = _sweep([(-5.0, 50.0, "decode")], 0.0, 1.0)
+    assert union == pytest.approx(1.0)
+    assert buckets == {"decode": pytest.approx(1.0)}
+
+
+def test_reoffer_outranks_every_other_bucket():
+    assert BUCKETS[0] == "reoffer"
+    buckets, _ = _sweep([
+        (0.0, 4.0, "reoffer"), (0.0, 4.0, "decode"),
+        (0.0, 4.0, "queue_wait"),
+    ], 0.0, 4.0)
+    assert buckets == {"reoffer": pytest.approx(4.0)}
+
+
+# ------------------------------------------------------- end to end
+
+
+def test_analyze_trace_reconstructs_and_flags_gaps(tmp_path):
+    """Two requests, one fully covered and one with a deliberate 40%
+    hole: the covered one passes, the holey one is flagged with its
+    gap, and both count as reconstructed."""
+    router = str(tmp_path / "run")
+    replica = str(tmp_path / "run" / "replica-0")
+    _write(router, [
+        _start(0.0),
+        _span(0.0, "router.enqueue", 0.0, 0.0, trace="ok", rid="ok"),
+        _span(0.0, "router.wait", 0.0, 0.2, trace="ok",
+              replica="replica-0"),
+        _span(1.0, "router.answer", 1.0, 0.0, trace="ok",
+              replica="replica-0"),
+        _span(0.0, "router.enqueue", 0.0, 0.0, trace="gap", rid="gap"),
+        _span(0.0, "router.wait", 0.0, 0.2, trace="gap",
+              replica="replica-0"),
+        _span(1.0, "router.answer", 1.0, 0.0, trace="gap",
+              replica="replica-0"),
+    ])
+    _write(replica, [
+        _start(0.0),
+        _span(0.9, "engine.decode_window", 0.2, 0.8, traces=["ok"]),
+        # "gap" is only covered 0.2..0.6: a 0.4s hole before the answer
+        _span(0.6, "engine.decode_window", 0.2, 0.4, traces=["gap"]),
+    ])
+    doc = analyze_trace([router])
+    assert doc["n_requests"] == 2 == doc["n_reconstructed"]
+    assert doc["requests"]["ok"]["covered_ok"]
+    assert doc["requests"]["ok"]["coverage"] == pytest.approx(1.0)
+    assert doc["flagged"] == ["gap"]
+    g = doc["requests"]["gap"]
+    assert not g["covered_ok"]
+    assert g["gap_s"] == pytest.approx(0.4, abs=1e-6)
+    # rung table exists and its shares include the uncovered column
+    assert doc["rungs"] and "uncovered" in doc["rungs"][0]["shares"]
+
+
+def test_unanswered_request_rides_along_unflagged(tmp_path):
+    d = str(tmp_path / "run")
+    _write(d, [
+        _start(0.0),
+        _span(0.0, "router.enqueue", 0.0, 0.0, trace="lost"),
+        _span(0.0, "router.wait", 0.0, 0.1, trace="lost",
+              replica="replica-0"),
+    ])
+    doc = analyze_trace([d])
+    tl = doc["requests"]["lost"]
+    assert tl["answered"] is False and "e2e_s" not in tl
+    assert doc["n_answered"] == 0 and doc["n_flagged"] == 0
+
+
+def test_p99_shares_empty_for_pretracing_artifacts(tmp_path):
+    """The compare join surface must be {} (=> zero-filled keys) for a
+    run dir with no span records — and for garbage paths."""
+    d = str(tmp_path / "old")
+    _write(d, [_start(0.0),
+               {"v": 1, "kind": "serve_window", "host": 0, "t": 1.0,
+                "rung": 0, "offered_rps": 2.0, "engine": "continuous"}])
+    assert p99_shares_by_rate(d) == {}
+    assert p99_shares_by_rate(str(tmp_path / "nope")) == {}
+
+
+def test_selftest_golden_fixture():
+    assert _selftest() == 0
+    assert trace_main(["--selftest"]) == 0
+
+
+# --------------------------------------------- fleet-aware analyzers
+
+
+def test_load_run_merges_fleet_streams_without_cross_wipe(tmp_path):
+    """analyze.load_run on a fleet dir: every stream keyed separately
+    (one replica's run_start must never supersede another stream's
+    records), replica labels stamped onto its windows."""
+    from paddle_tpu.observability.analyze import analyze, load_run
+
+    run = tmp_path / "run"
+    win = {"v": 1, "kind": "serve_window", "host": 0, "t": 1.0,
+           "rung": 0, "offered_rps": 2.0, "engine": "continuous",
+           "window_s": 1.0, "arrived": 2, "admitted": 2, "completed": 2,
+           "rejected": 0, "timeouts": 0, "cancelled": 0, "errors": 0,
+           "launches": 2, "exec_s": 0.5, "gen_tokens": 20,
+           "goodput_tok_s": 20.0}
+    _write(str(run), [_start(100.0), dict(win, replicas=2)])
+    _write(str(run / "replica-0"), [_start(100.1), win])
+    _write(str(run / "replica-1"), [_start(100.2), win])
+    streams = load_run(str(run))
+    assert sorted(streams) == ["replica-0/0", "replica-1/0", "router/0"]
+    doc = analyze(streams)
+    serve = doc.get("serve") or {}
+    assert serve.get("replicas") == ["replica-0", "replica-1"], serve
+    # all three windows survived the merge (no run_start cross-wipe)
+    assert len(doc.get("serve_windows") or []) == 3
+    # single-stream dirs keep the exact legacy int-keyed shape
+    solo = load_run(str(run / "replica-0"))
+    assert list(solo) == [0]
+
+
+def test_follow_with_stream_labels_and_fleet_stop(tmp_path):
+    from paddle_tpu.observability.analyze import follow
+
+    run = tmp_path / "run"
+    _write(str(run), [_start(100.0),
+                      {"v": 1, "kind": "run_end", "host": 0, "t": 9.0,
+                       "status": "completed"}])
+    _write(str(run / "replica-0"), [_start(100.0)])
+    got = []
+    for item in follow(str(run), max_polls=1, poll_boundaries=True,
+                       with_stream=True):
+        if item is None:
+            break
+        got.append(item)
+    labels = {lab for lab, _rec in got}
+    assert labels == {"", "replica-0"}
+    kinds = {(lab, rec["kind"]) for lab, rec in got}
+    assert ("", "run_end") in kinds
