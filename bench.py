@@ -687,7 +687,8 @@ def _serve_sweep_continuous(gm, params, registry, *, rates, B, T,
                             max_length, n_requests, seed, timeout_s,
                             queue_cap, decode_block, prompt_fn, budget_fn,
                             pipeline=True, fused_step=False,
-                            shed_policy="off", replicas=(1,)):
+                            shed_policy="off", replicas=(1,),
+                            transport="pipe"):
     """The continuous-batching engine (paddle_tpu/serving/) on the SAME
     seeded workload, driven open-loop in wall-clock time. ``pipeline``
     selects the overlapped dispatch/collect loop vs the serial PR-12
@@ -696,8 +697,18 @@ def _serve_sweep_continuous(gm, params, registry, *, rates, B, T,
     REPLICAS): each size N > 1 runs the whole rate sweep through
     ``drive_fleet_rung`` — N engines behind the router's own
     least-loaded scoring — so the scaling curve (goodput vs replicas,
-    router overhead share) is measured, not assumed. Returns (sweep
-    doc, measured capacity req/s of ONE replica)."""
+    router overhead share) is measured, not assumed.
+
+    ``transport`` (PADDLE_TPU_BENCH_SERVE_TRANSPORT=pipe|tcp) selects
+    the submit path: ``pipe`` is the direct in-process call; ``tcp``
+    fronts every engine with an :class:`EngineSocketServer` on a
+    loopback ephemeral port and drives it through a framed
+    :class:`SocketEngineClient`, so JSON serialization + the socket
+    round trip land in the measured ``router_share`` — the
+    pipe-vs-tcp A/B `paddle compare` judges. tcp routes EVERY rung
+    (n == 1 included) through the fleet driver: the single-engine
+    drive_rung path has no client seam. Returns (sweep doc, measured
+    capacity req/s of ONE replica)."""
     import numpy as np
 
     from paddle_tpu.observability import serving
@@ -749,6 +760,22 @@ def _serve_sweep_continuous(gm, params, registry, *, rates, B, T,
                replica=(f"replica-{i}" if n_max > 1 else "")).start()
         for i, b in enumerate(backends)
     ]
+    servers, clients = [], []
+    if transport == "tcp":
+        # the real wire, loopback: every engine behind a framed socket
+        # server, driven by a framed client — serialization + syscall
+        # cost lands inside the router_s stopwatch
+        from paddle_tpu.serving.transport import (EngineSocketServer,
+                                                  SocketEngineClient)
+
+        for e in engines:
+            srv = EngineSocketServer(e, "127.0.0.1:0")
+            srv.start()
+            servers.append(srv)
+        for srv in servers:
+            c = SocketEngineClient(srv.address)
+            c.start()
+            clients.append(c)
     try:
         windows = []
         rung = 0
@@ -758,7 +785,7 @@ def _serve_sweep_continuous(gm, params, registry, *, rates, B, T,
                     float(rate), n_requests, seed + rung, rung=rung,
                     prompt_fn=prompt_fn, budget_fn=budget_fn,
                 )
-                if n_max <= 1:
+                if n_max <= 1 and transport != "tcp":
                     # no fleet anywhere in the ladder: the PR-13 single-
                     # engine path, byte-identical records
                     w = drive_rung(engines[0], reqs, rate_rps=float(rate),
@@ -768,11 +795,16 @@ def _serve_sweep_continuous(gm, params, registry, *, rates, B, T,
                     # the baseline carries replicas=1 (and pays the
                     # same routing overhead) — the scaling curve's x=1
                     # point must be measured under the same discipline
-                    w = drive_fleet_rung(engines[:n], reqs,
-                                         rate_rps=float(rate), rung=rung)
+                    w = drive_fleet_rung(
+                        engines[:n], reqs, rate_rps=float(rate), rung=rung,
+                        clients=clients[:n] if clients else None)
                 windows.append(w)
                 rung += 1
     finally:
+        for c in clients:
+            c.close()
+        for srv in servers:
+            srv.close()
         for e in engines:
             e.drain(timeout=600.0)
     # the knee belongs to ONE ladder: with a fleet-size sweep, report
@@ -933,6 +965,18 @@ def bench_serve(B=None, T=None, vocab=None, dim=None, beam_size=None,
             "PADDLE_TPU_BENCH_SERVE_REPLICAS needs "
             "PADDLE_TPU_BENCH_SERVE_ENGINE=continuous (the static "
             "driver has no fleet)")
+    # the submit path A/B (doc/serving.md "Cross-host fleet"): pipe is
+    # the in-process call, tcp fronts every engine with a loopback
+    # framed-socket server so the wire cost is measured
+    transport = env("PADDLE_TPU_BENCH_SERVE_TRANSPORT", "pipe")
+    if transport not in ("pipe", "tcp"):
+        raise ValueError(f"unknown serve transport {transport!r}: "
+                         "expected 'pipe' or 'tcp'")
+    if transport == "tcp" and engine != "continuous":
+        raise ValueError(
+            "PADDLE_TPU_BENCH_SERVE_TRANSPORT=tcp needs "
+            "PADDLE_TPU_BENCH_SERVE_ENGINE=continuous (the static "
+            "driver has no socket seam)")
 
     if engine == "continuous":
         doc, capacity_rps = _serve_sweep_continuous(
@@ -942,7 +986,7 @@ def bench_serve(B=None, T=None, vocab=None, dim=None, beam_size=None,
             decode_block=decode_block, prompt_fn=prompt_fn,
             budget_fn=budget_fn, pipeline=bool(pipeline),
             fused_step=bool(fused_step), shed_policy=shed_policy,
-            replicas=tuple(replicas),
+            replicas=tuple(replicas), transport=transport,
         )
         beam_size = 1  # the engine decodes greedily (doc/serving.md)
     else:
@@ -1005,6 +1049,10 @@ def bench_serve(B=None, T=None, vocab=None, dim=None, beam_size=None,
                if isinstance(w.get("replicas"), int) else {}),
             **({"router_share": w["router_share"]}
                if isinstance(w.get("router_share"), (int, float)) else {}),
+            # pipe|tcp — compare joins pipe-vs-tcp rungs on offered
+            # load and judges router_share across the wire
+            **({"transport": w["transport"]}
+               if isinstance(w.get("transport"), str) else {}),
         }
         for w in doc["rungs"]
     ]
@@ -1022,6 +1070,7 @@ def bench_serve(B=None, T=None, vocab=None, dim=None, beam_size=None,
         # BENCH_*.json says WHAT was measured (and compare joins on it)
         extras["pipeline"] = "on" if pipeline else "off"
         extras["decode_blocks"] = str(decode_block)
+        extras["transport"] = transport
         if max(replicas) > 1:
             extras["replicas"] = ",".join(str(n) for n in replicas)
         if fused_step:

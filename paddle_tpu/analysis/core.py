@@ -244,6 +244,14 @@ DEFAULT_CONFIG: Dict[str, Any] = {
         # request, so a device sync or unbounded wait here stalls the
         # whole fleet
         ("serving/fleet.py", "run"),
+        # the socket transport's I/O loops: every cross-host frame
+        # passes through these — a device sync or unbounded wait here
+        # stalls heartbeats and the router's health view with them
+        ("serving/transport.py", "_run"),
+        ("serving/transport.py", "_read_until_disconnect"),
+        ("serving/transport.py", "_accept"),
+        ("serving/transport.py", "_serve_conn"),
+        ("serving/transport.py", "_pump"),
     ),
     # PTL002: calls whose results live on device (taint sources)
     "device_source_res": (r"\.call$", r"_step$", r"^launch_fn$"),

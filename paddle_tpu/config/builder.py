@@ -209,6 +209,7 @@ def _apply_settings(opt: OptimizationConfig, s: Dict[str, Any]) -> None:
         "scan_unroll",
         "batches_per_launch",
         "pallas_rnn",
+        "pallas_flat",
         "conv_s2d",
         "conv_stats_mode",
         "pallas_decoder",

@@ -41,6 +41,7 @@ def compute_dtype_of(opt_config) -> Optional[Any]:
 class GradientMachine:
     def __init__(self, model: ModelConfig, dtype=jnp.float32, compute_dtype=None,
                  scan_unroll: int = 1, pallas_rnn: bool = False,
+                 pallas_flat: bool = False,
                  conv_s2d: bool = False, conv_stats_mode: str = "",
                  pallas_decoder: bool = False):
         self.model = model
@@ -55,6 +56,8 @@ class GradientMachine:
         self.scan_unroll = max(1, int(scan_unroll))
         # recurrent layers via the fused Pallas kernels (ops/pallas_lstm)
         self.pallas_rnn = bool(pallas_rnn)
+        # their transpose-free batch-major interface (A/B knob)
+        self.pallas_flat = bool(pallas_flat)
         # stem conv space-to-depth rewrite (layers/vision.py)
         self.conv_s2d = bool(conv_s2d)
         # fused attention-GRU decoder groups (ops/pallas_attention_gru)
@@ -117,6 +120,7 @@ class GradientMachine:
             dtype=self.dtype, mesh=self.mesh, table_overrides=table_overrides,
             compute_dtype=self.compute_dtype, no_cast_inputs=self.no_cast_inputs,
             scan_unroll=self.scan_unroll, pallas_rnn=self.pallas_rnn,
+            pallas_flat=self.pallas_flat,
             conv_s2d=self.conv_s2d, conv_stats_mode=self.conv_stats_mode,
             pallas_decoder=self.pallas_decoder, gen_capture=gen_capture,
         )

@@ -463,10 +463,21 @@ def _forward_scan(network, cfg: LayerConfig, sub: SubModelConfig, ctx: LayerCont
             frontier_ok = all(f == gname for f in dyn_frontier)
             links_ok = all(l.layer_name == gname for l in inside_out_links)
             if frontier_ok and links_ok:
-                fused_ys = run_fused_decoder(
-                    network, sub, ctx, statics, fplan, pro_feeds,
-                    init_carries[0], mask_bt,
-                )
+                try:
+                    fused_ys = run_fused_decoder(
+                        network, sub, ctx, statics, fplan, pro_feeds,
+                        init_carries[0], mask_bt,
+                    )
+                except Exception as exc:  # noqa: BLE001 — any compile
+                    # failure (VMEM overflow on an untested shape, a
+                    # Mosaic lowering bug) must not kill the step: the
+                    # unfused scan below computes the same function
+                    import logging
+
+                    logging.getLogger("paddle_tpu.graph").warning(
+                        "fused decoder kernel failed for %s — falling "
+                        "back to the unfused scan: %s", sub.name, exc)
+                    fused_ys = None
 
     def step(carries, inp):
         x_v, x_i, x_sl, m_t, t_idx, x_pro = inp

@@ -89,6 +89,9 @@ class LayerContext:
     # OptimizationConfig.pallas_rnn: lstmemory/gated_recurrent layers use
     # the fused Pallas sequence kernels when shapes/activations allow
     pallas_rnn: bool = False
+    # OptimizationConfig.pallas_flat: the kernels take the transpose-free
+    # batch-major interface (PADDLE_TPU_PALLAS_FLAT=1 still forces it)
+    pallas_flat: bool = False
     # OptimizationConfig.conv_s2d: few-channel 7x7/s2 stem convs rewrite
     # to a space-to-depth 4x4/s1 conv (layers/vision.py _stem_s2d_conv)
     conv_s2d: bool = False

@@ -137,11 +137,13 @@ def _pallas_rnn_path(ctx, cfg, a, x, mask, w, bias, usable_fn, fwd_fn):
             return None
     elif not usable_fn(cfg, x):
         return None
-    # PADDLE_TPU_PALLAS_FLAT=1: the transpose-free interface — the
-    # kernel reads the projection output's batch-major value through a
-    # free [B, T*width] reshape instead of a materialized time-major
-    # swap (A/B knob; flip the default only on a measured win)
-    flat = os.environ.get("PADDLE_TPU_PALLAS_FLAT") == "1"
+    # transpose-free interface — the kernel reads the projection
+    # output's batch-major value through a free [B, T*width] reshape
+    # instead of a materialized time-major swap (A/B knob; flip the
+    # default only on a measured win). settings(pallas_flat=True) is
+    # the config-level switch; the PADDLE_TPU_PALLAS_FLAT=1 env var
+    # still forces it for configs that can't be edited.
+    flat = ctx.pallas_flat or os.environ.get("PADDLE_TPU_PALLAS_FLAT") == "1"
     x_bt = a.value if flat else None
     # the env flag wins even on TPU so a compiled-kernel discrepancy can
     # be A/B'd in interpret mode on the device where it manifests (off

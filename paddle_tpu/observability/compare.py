@@ -77,7 +77,8 @@ _HIGHER_BETTER = {
 def _serve_key(offered_rps, qualifier, seen_pre: set,
                engine: Optional[str] = None,
                pipeline: Optional[str] = None,
-               replicas: Any = None) -> str:
+               replicas: Any = None,
+               transport: Optional[str] = None) -> str:
     """The ONE serve rung key format, shared by the run-dir and bench-
     artifact sides (a divergence would silently break their
     comparability): 6 significant digits of offered load — a slow
@@ -102,7 +103,15 @@ def _serve_key(offered_rps, qualifier, seen_pre: set,
     once per fleet size IN ONE artifact, and the scaling curve
     (goodput vs replicas, router overhead share) is read by joining
     same-x rungs across artifacts — an x2 rung must never diff against
-    an x4 one."""
+    an x4 one.
+
+    Transport (``pipe`` vs ``tcp``, the socket-fleet sweep) qualifies
+    only on collision, AFTER pipeline: a one-transport-per-artifact
+    A/B (pipe baseline vs tcp candidate, pinned rates) joins on
+    offered load alone — which is exactly the cross-transport
+    router_share comparison being asked for — while a both-transports
+    artifact repeats every (engine, pipeline, rate) once per wire and
+    must not diff a transport against itself."""
     rate = format(float(offered_rps or 0.0), ".6g")
     x = f"x{int(replicas)}." if replicas and int(replicas) > 1 else ""
     pre = f"serve.{x}{rate}rps."
@@ -110,6 +119,8 @@ def _serve_key(offered_rps, qualifier, seen_pre: set,
         pre = f"serve.{engine}.{x}{rate}rps."
     if pre in seen_pre and engine and pipeline:
         pre = f"serve.{engine}.pipe-{pipeline}.{x}{rate}rps."
+    if pre in seen_pre and engine and pipeline and transport:
+        pre = f"serve.{engine}.pipe-{pipeline}.net-{transport}.{x}{rate}rps."
     if pre in seen_pre:
         pre = f"{pre[:-1]}.r{qualifier}."
     seen_pre.add(pre)
@@ -260,13 +271,16 @@ def _run_side(path: str) -> Dict[str, float]:
                     key=lambda w: (str(w.get("engine") or ""),
                                    str(w.get("pipeline") or ""),
                                    int(w.get("replicas") or 0),
+                                   str(w.get("transport") or ""),
                                    w.get("rung") if isinstance(
                                        w.get("rung"), int) else 0)):
         engine = w.get("engine") if isinstance(w.get("engine"), str) else None
         pipe = w.get("pipeline") if isinstance(w.get("pipeline"), str) else None
+        tran = (w.get("transport")
+                if isinstance(w.get("transport"), str) else None)
         pre = _serve_key(w.get("offered_rps"), w.get("rung", 0), seen_pre,
                          engine=engine, pipeline=pipe,
-                         replicas=w.get("replicas"))
+                         replicas=w.get("replicas"), transport=tran)
         for snap_key, dst, scale in (
             ("latency", "p50_ms", 1e3), ("latency", "p99_ms", 1e3),
             ("ttft", "ttft_p50_ms", 1e3), ("ttft", "ttft_p99_ms", 1e3),
@@ -371,16 +385,20 @@ def _bench_side(path: str, raw: str) -> Dict[str, float]:
     seen_pre: set = set()
     rungs = [(i, r) for i, r in enumerate(line.get("rungs") or [])
              if isinstance(r, dict)]
-    # (engine, pipeline, replicas, index)-sorted for the same
+    # (engine, pipeline, replicas, transport, index)-sorted for the same
     # deterministic key assignment as the run-dir side (see _run_side)
     rungs.sort(key=lambda p: (str(p[1].get("engine") or ""),
                               str(p[1].get("pipeline") or ""),
-                              int(p[1].get("replicas") or 0), p[0]))
+                              int(p[1].get("replicas") or 0),
+                              str(p[1].get("transport") or ""), p[0]))
     for i, r in rungs:
         engine = r.get("engine") if isinstance(r.get("engine"), str) else None
         pipe = r.get("pipeline") if isinstance(r.get("pipeline"), str) else None
+        tran = (r.get("transport")
+                if isinstance(r.get("transport"), str) else None)
         pre = _serve_key(r.get("offered_rps"), i, seen_pre, engine=engine,
-                         pipeline=pipe, replicas=r.get("replicas"))
+                         pipeline=pipe, replicas=r.get("replicas"),
+                         transport=tran)
         for key in ("p50_ms", "p99_ms", "ttft_p50_ms", "ttft_p99_ms",
                     "goodput_tok_s"):
             v = r.get(key)

@@ -45,8 +45,8 @@ from paddle_tpu.observability import metrics as obs
 #: (a decode window brackets its readback; a reoffer brackets the lost
 #: route), each elementary segment counts ONCE, toward the most
 #: specific cause
-BUCKETS = ("reoffer", "readback", "prefill", "decode", "queue_wait",
-           "router_wait")
+BUCKETS = ("reoffer", "hedge", "readback", "prefill", "decode",
+           "queue_wait", "router_wait")
 
 _PRIORITY = {b: i for i, b in enumerate(BUCKETS)}
 
@@ -55,6 +55,9 @@ _PRIORITY = {b: i for i, b in enumerate(BUCKETS)}
 SPAN_BUCKET = {
     "router.wait": "router_wait",
     "router.reoffer": "reoffer",
+    "net.hedge": "hedge",               # [route → hedge fired]: the
+    # straggler tail a hedge cut; net.rpc/net.connect stay unbucketed
+    # (they overlap the replica's own spans — timeline-only)
     "replica.pipe": "queue_wait",       # synthesized (module docstring)
     "replica.journal": "queue_wait",
     "engine.queue_wait": "queue_wait",

@@ -91,7 +91,12 @@ def _vmem_fwd(bb: int, Te: int, D: int, E: int, itemsize: int,
         step_widths += D + 3 * D + Te + E          # h_prev, acts, alpha, ctx
     steps = 2 * bb * step_widths * itemsize
     scr = bb * D * 4
-    return enc_in + w_in + steps + scr
+    # the attention step materializes `combined` (tanh(ep + m)) as a
+    # live [Te,bB,D] f32 temporary every iteration — the largest single
+    # buffer in the step and previously unaccounted, so marginal shapes
+    # passed the estimate and OOM'd VMEM at compile time
+    tmp = Te * bb * D * 4
+    return enc_in + w_in + steps + scr + tmp
 
 
 def _vmem_bwd(bb: int, Te: int, D: int, E: int, itemsize: int) -> int:
@@ -104,7 +109,11 @@ def _vmem_bwd(bb: int, Te: int, D: int, E: int, itemsize: int) -> int:
     dep_acc = Te * bb * D * 4                      # d_enc_proj f32
     steps = 2 * bb * (D + 1 + D + 3 * D + Te + 3 * D + E) * itemsize
     scr = bb * D * 4
-    return enc_in + w_in + dw_acc + dep_acc + steps + scr
+    # the attention backward recomputes `combined` and holds `d_comb`
+    # and `dtanh` beside it — three live [Te,bB,D] f32 temporaries per
+    # step (see _bwd_step), previously unaccounted in the estimate
+    tmp = 3 * Te * bb * D * 4
+    return enc_in + w_in + dw_acc + dep_acc + steps + scr + tmp
 
 
 def supported(B: int, Te: int, D: int, E: int, itemsize: int = 2) -> bool:

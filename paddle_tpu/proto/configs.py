@@ -387,6 +387,14 @@ class OptimizationConfig(Message):
     # faster on the target chip; layers fall back to lax.scan for
     # unsupported shapes/activations either way.
     pallas_rnn: bool = False
+    # transpose-free interface for the fused Pallas sequence kernels:
+    # the kernel reads the projection output's batch-major value through
+    # a free [B, T*width] reshape instead of a materialized time-major
+    # swap (layers/recurrent.py _pallas_rnn_path). A/B knob beside
+    # pallas_rnn; the PADDLE_TPU_PALLAS_FLAT=1 env var still forces it
+    # on for configs that can't be edited. Flip the default only on a
+    # measured win.
+    pallas_flat: bool = False
     # space-to-depth rewrite of few-channel 7x7/s2 stem convs (ResNet
     # conv1) into an MXU-friendly 4x4/s1 conv over a 2x2-block view —
     # exact arithmetic, summation order aside (layers/vision.py

@@ -132,6 +132,22 @@ SITE_DOCS = {
         "bytes or partial index land (raise = this host's row "
         "shards vanish — check-checkpoint must name the exact "
         "missing row interval, not zero-init it)",
+    "net.drop":
+        "before each socket frame write (raise = connection reset "
+        "mid-stream — the transport must reconnect with backoff and "
+        "the hello handshake must re-offer undelivered requests)",
+    "net.stall":
+        "inside each socket read loop iteration (sleep = wedged read: "
+        "heartbeat pongs stop, the replica's health goes stale and "
+        "the router routes around it, then kills past the bound)",
+    "net.torn_frame":
+        "before each socket frame write (raise = a strict prefix of "
+        "the frame is sent, then the connection closes — the reader "
+        "must discard the partial frame, never crash the router)",
+    "net.dup":
+        "after each socket frame write (raise = the frame is sent "
+        "twice — duplicate delivery the id-dedupe on both ends must "
+        "absorb, like a hedge loser)",
 }
 
 KNOWN_SITES = tuple(SITE_DOCS)

@@ -303,6 +303,7 @@ class FleetRouter:
                  health_period_s: float = HEALTH_PERIOD_S,
                  restart_budget: int = 5,
                  restart_base_delay: float = 1.0,
+                 hedge_after: float = 0.0,
                  clock: Optional[Callable[[], float]] = None):
         self._emit = emit
         self.poll_s = float(poll_s)
@@ -311,6 +312,11 @@ class FleetRouter:
         self.health_period_s = float(health_period_s)
         self.restart_budget = max(0, int(restart_budget))
         self.restart_base_delay = float(restart_base_delay)
+        # hedged retries (doc/serving.md "Cross-host fleet"): a request
+        # outstanding past max(hedge_after, streaming p99 of answer
+        # latency) is re-sent to the next-healthiest replica; first
+        # answer wins, the loser is absorbed by the dedupe. 0 disables.
+        self.hedge_after = float(hedge_after)
         self._clock = clock or cc.monotonic
         self._lock = cc.Lock()
         self._wake = cc.Condition(self._lock)
@@ -353,11 +359,19 @@ class FleetRouter:
         self._drain_req = cc.Event()
         self._failed = False
         self._done_running = False  # run() exited: late submits self-emit
+        # hedging state — all under self._lock: rid -> hedge-target
+        # replica (at most one live hedge per request) and the
+        # streaming answer-latency quantile the adaptive delay tracks
+        self._hedged: Dict[str, str] = {}
+        self._lat_p99 = 0.0
+        self._lat_scale = 0.0
         # counters mirrored into telemetry + `paddle serve-status`
         self.routed = 0
         self.reoffers = 0
         self.duplicate_answers = 0
         self.deaths = 0
+        self.hedges = 0
+        self.hedge_wins = 0
 
     # ---------------------------------------------------- client side
 
@@ -417,6 +431,14 @@ class FleetRouter:
             if rid in self._results:
                 self.duplicate_answers += 1
                 return
+            t_route = self._t_route.get(rid)
+            if t_route is not None:
+                # feed the adaptive hedge delay with the winning
+                # answer's route->answer latency
+                self._note_latency_locked(self._clock() - t_route)
+            if self._hedged.get(rid) == name:
+                self.hedge_wins += 1
+            self._hedged.pop(rid, None)
             self._results[rid] = doc
             self._span("router.answer", self._clock(), 0.0,
                        trace=str(self._docs[rid].get("trace_id") or rid),
@@ -458,6 +480,8 @@ class FleetRouter:
                 "reoffers": self.reoffers,
                 "duplicate_answers": self.duplicate_answers,
                 "deaths": self.deaths,
+                "hedges": self.hedges,
+                "hedge_wins": self.hedge_wins,
             }
 
     def _span(self, name: str, t0_mono: float, dur_s: float,
@@ -504,6 +528,7 @@ class FleetRouter:
         self._refresh_health(now)
         self._due_restarts(now)
         self._route_pending(now)
+        self._hedge_pending(now)
         with self._lock:
             self._fail_if_abandoned_locked()
             self._emit_ready_locked()
@@ -606,6 +631,9 @@ class FleetRouter:
                 return
             for rid in reversed(orphans):
                 self._owner.pop(rid, None)
+                if self._hedged.get(rid) == name:
+                    # the hedge target died: the request may hedge again
+                    self._hedged.pop(rid, None)
                 self._unsent.appendleft(rid)
             for rid in orphans:
                 # routed-but-lost: [route → death detected] — failover's
@@ -734,6 +762,67 @@ class FleetRouter:
                     self._unsent.appendleft(rid)
                 return
 
+    def _note_latency_locked(self, lat: float) -> None:
+        """Streaming p99 of route->answer latency (stochastic quantile
+        estimate — no sample buffer): each observation nudges the
+        estimate up by 0.99 steps or down by 0.01 steps, with the step
+        scaled to the latency magnitude EMA. Caller holds the lock."""
+        lat = max(float(lat), 0.0)
+        self._lat_scale += (lat - self._lat_scale) * 0.05
+        step = max(self._lat_scale, 1e-4) * 0.05
+        if lat > self._lat_p99:
+            self._lat_p99 += step * 0.99
+        else:
+            self._lat_p99 = max(self._lat_p99 - step * 0.01, 0.0)
+
+    def _hedge_pending(self, now: float) -> None:
+        """Hedged retries: a request outstanding on its owner past the
+        adaptive hedge delay is re-sent (once) to the next-healthiest
+        replica. First answer wins in :meth:`deliver`; the loser lands
+        in ``duplicate_answers``. The hedge send rides the SAME doc, so
+        a wire-stamped ``deadline_unix`` carries the shrunken budget."""
+        if self.hedge_after <= 0:
+            return
+        sends = []
+        with self._lock:
+            if self._draining:
+                return
+            delay = max(self.hedge_after, self._lat_p99)
+            for rid, t_route in list(self._t_route.items()):
+                if now - t_route < delay:
+                    continue
+                if rid in self._results or rid in self._hedged:
+                    continue
+                owner = self._owner.get(rid)
+                cands = [c for c in self._candidates() if c[1] != owner]
+                if not cands:
+                    continue
+                _score, name, handle = cands[0]
+                doc = self._docs[rid]
+                attempt = self._attempt.get(rid, 1) + 1
+                self._attempt[rid] = attempt
+                trace = str(doc.get("trace_id") or rid)
+                doc["span_id"] = f"{trace}:send:{attempt}"
+                self._hedged[rid] = name
+                sends.append((rid, name, handle, doc, t_route, trace,
+                              attempt))
+        # sends run OUTSIDE the lock, like _route_pending's
+        for rid, name, handle, doc, t_route, trace, attempt in sends:
+            if handle.send(doc):
+                with self._lock:
+                    self._outstanding[name].add(rid)
+                    self.hedges += 1
+                    # [route -> hedge fired]: the tail the hedge is
+                    # trying to cut, attributed as its own bucket in
+                    # the trace tail table (doc/observability.md)
+                    self._span("net.hedge", t_route, now - t_route,
+                               trace=trace, rid=rid, replica=name,
+                               attempt=attempt)
+            else:
+                with self._lock:
+                    if self._hedged.get(rid) == name:
+                        del self._hedged[rid]
+
     def _fail_if_abandoned_locked(self) -> None:
         if self._draining or self._failed:
             return
@@ -817,7 +906,8 @@ class FleetRouter:
 # ------------------------------------------------- in-process fleet
 
 def drive_fleet_rung(engines, requests, *, rate_rps: float, rung: int = 0,
-                     result_timeout_s: float = 300.0) -> Dict[str, Any]:
+                     result_timeout_s: float = 300.0,
+                     clients=None) -> Dict[str, Any]:
     """Open-loop driver for one offered-load rung across N in-process
     engines (``bench.py serve --replicas=N``): each arrival routes to
     the least-loaded replica under the SAME :func:`replica_score`
@@ -827,7 +917,15 @@ def drive_fleet_rung(engines, requests, *, rate_rps: float, rung: int = 0,
     stamped ``replicas=N`` — the record `paddle compare` joins the
     scaling curve on. The merge is conservative: counts/goodput sum,
     p99s take the worst replica, p50s/means average weighted by
-    completions."""
+    completions.
+
+    ``clients`` (optional) is a parallel list of
+    :class:`~paddle_tpu.serving.transport.SocketEngineClient` — the
+    bench's ``transport=tcp`` mode: scoring still reads each engine's
+    in-process status, but the submit and the answer take the real
+    framed-socket path, so serialization + syscall cost lands in the
+    measured ``router_share`` and the merged window is stamped
+    ``transport=tcp`` instead of ``pipe``."""
     for e in engines:
         e.begin_window()
     t0 = cc.monotonic()
@@ -845,9 +943,17 @@ def drive_fleet_rung(engines, requests, *, rate_rps: float, rung: int = 0,
             # doc, and the outstanding count carries the decision
             scores.append((replica_score(outstanding[i], e.status()), i))
         i = min(scores)[1]
+        if clients is not None:
+            # the framed-socket hop is part of the routing cost being
+            # measured — keep it inside the router_s stopwatch
+            fut = clients[i].submit({"id": req.rid,
+                                     "prompt": req.prompt or [],
+                                     "max_new_tokens": req.max_new})
+        else:
+            fut = engines[i].submit(req.prompt or [],
+                                    max_new_tokens=req.max_new,
+                                    rid=req.rid)
         router_s += cc.perf_counter() - r0
-        fut = engines[i].submit(req.prompt or [], max_new_tokens=req.max_new,
-                                rid=req.rid)
         outstanding[i] += 1
 
         def _dec(i=i):
@@ -862,17 +968,21 @@ def drive_fleet_rung(engines, requests, *, rate_rps: float, rung: int = 0,
     per = [e.window_roll(offered_rps=rate_rps, rung=rung, window_s=window_s)
            for e in engines]
     return merge_windows(per, rate_rps=rate_rps, rung=rung,
-                         window_s=window_s, router_s=router_s)
+                         window_s=window_s, router_s=router_s,
+                         transport="tcp" if clients is not None else "pipe")
 
 
 def merge_windows(per: List[Dict[str, Any]], *, rate_rps: float, rung: int,
-                  window_s: float, router_s: float = 0.0) -> Dict[str, Any]:
+                  window_s: float, router_s: float = 0.0,
+                  transport: str = "") -> Dict[str, Any]:
     """Fold N per-replica ``serve_window`` records into one fleet
     window (``replicas=N``). Sums for counts and token totals; for the
     latency histograms the merged p99 is the WORST replica's (tail
     honesty) and the p50/mean are completion-weighted averages — a
     cross-replica histogram merge without the samples is necessarily
-    approximate, and this direction never understates the tail."""
+    approximate, and this direction never understates the tail.
+    ``transport`` (pipe|tcp) is stamped when known, so `paddle
+    compare` can qualify and judge the A/B."""
     from paddle_tpu.observability import metrics as obs
 
     n = len(per)
@@ -884,6 +994,10 @@ def merge_windows(per: List[Dict[str, Any]], *, rate_rps: float, rung: int,
     }
     if isinstance(per[0].get("pipeline"), str):
         rec["pipeline"] = per[0]["pipeline"]
+    if transport:
+        # pipe|tcp — `paddle compare` joins scaling curves on it so
+        # transport overhead is measured, not assumed
+        rec["transport"] = transport
     for key in ("arrived", "admitted", "completed", "rejected", "timeouts",
                 "cancelled", "errors", "shed", "breaker_open", "launches",
                 "gen_tokens"):
@@ -973,29 +1087,52 @@ def main(rest: List[str]) -> int:
     router process never imports jax; the replicas own the device."""
     from paddle_tpu.observability import metrics as obsm
     from paddle_tpu.serving.frontend import _parse_line
-    from paddle_tpu.utils.flags import FLAGS
+    from paddle_tpu.utils.flags import FLAGS, flag_values
 
+    # repeatable: --replica_addr h1:9000 --replica_addr h2:9000 (or one
+    # comma list) — collected BEFORE FLAGS.parse keeps only the last
+    addrs = flag_values(list(rest), "replica_addr")
     leftover = FLAGS.parse(list(rest))
     if leftover:
         print(f"warning: unrecognized flags {leftover}", file=sys.stderr)
-    if not FLAGS.config:
+    if not addrs and not FLAGS.config:
         print("error: --config is required", file=sys.stderr)
         return 2
-    n = max(1, FLAGS.fleet_replicas)
+    n = len(addrs) if addrs else max(1, FLAGS.fleet_replicas)
     status_dir = FLAGS.fleet_status_dir or os.path.join(
         FLAGS.save_dir or "output", "fleet_status")
     os.makedirs(status_dir, exist_ok=True)
     obsm.configure_from_flags(FLAGS)
     if FLAGS.fault_spec:
-        # the fleet.* chaos sites fire in THIS process; serve.* specs
-        # for the children ride the per-replica CHILD_FAULTS env
+        # the fleet.* (and, socket mode, net.*) chaos sites fire in
+        # THIS process; serve.* specs for pipe children ride the
+        # per-replica CHILD_FAULTS env
         faultinject.configure(FLAGS.fault_spec, FLAGS.fault_seed)
 
     def emit(doc: Dict[str, Any]) -> None:
         print(json.dumps(doc), flush=True)
 
-    router = FleetRouter(
-        [
+    if addrs:
+        # cross-host fleet (doc/serving.md "Cross-host fleet"): each
+        # address is a running `paddle serve --listen` replica; the
+        # shared --io_retry_* policy drives reconnect backoff, and a
+        # retry-budget-exhausted transport surfaces as a death (the
+        # restart budget then governs fresh connection attempts)
+        from paddle_tpu.serving.transport import SocketReplica
+        from paddle_tpu.utils.retry import RetryPolicy
+
+        replicas = [
+            SocketReplica(
+                f"replica-{i}", addr,
+                deliver=lambda name, doc: router.deliver(name, doc),
+                timeout_s=FLAGS.serve_request_timeout,
+                policy=RetryPolicy.from_flags(
+                    FLAGS, retry_on=(OSError,), name="net.connect"),
+            )
+            for i, addr in enumerate(addrs)
+        ]
+    else:
+        replicas = [
             ProcReplica(
                 f"replica-{i}", _child_argv(rest, status_dir, i),
                 status_path=os.path.join(status_dir, f"replica-{i}.json"),
@@ -1005,15 +1142,20 @@ def main(rest: List[str]) -> int:
                 env=_child_env(i),
             )
             for i in range(n)
-        ],
+        ]
+    router = FleetRouter(
+        replicas,
         emit=emit,
         restart_budget=FLAGS.restart_budget,
         restart_base_delay=FLAGS.restart_base_delay,
+        hedge_after=FLAGS.hedge_after,
     )
     for sig in (signal.SIGTERM, signal.SIGINT):
         signal.signal(sig, lambda *_: router.request_drain())
     router.start()
-    print(f"# paddle serve-fleet: {n} replica(s), status dir {status_dir} "
+    where = (f"replica addr(s) {', '.join(addrs)}" if addrs
+             else f"status dir {status_dir}")
+    print(f"# paddle serve-fleet: {n} replica(s), {where} "
           "— reading JSONL requests from stdin", file=sys.stderr)
 
     def _reader() -> None:
@@ -1044,6 +1186,10 @@ def main(rest: List[str]) -> int:
         reg.counter("fleet.reoffers").inc(st["reoffers"])
         reg.counter("fleet.duplicate_answers").inc(st["duplicate_answers"])
         reg.counter("fleet.deaths").inc(st["deaths"])
+        # net.reconnects is already live in the registry (the transport
+        # counts each re-established connection as it happens)
+        reg.counter("net.hedges").inc(st["hedges"])
+        reg.counter("net.hedge_wins").inc(st["hedge_wins"])
         # run_end is the router stream's LAST record, mirroring the
         # single-process serve contract; it carries the fleet counters
         # snapshot (the trainer's pass_end idiom)

@@ -112,6 +112,56 @@ def _span(name: str, t0_mono: float, dur_s: float, trace: str) -> None:
               dur_s=round(max(float(dur_s), 0.0), 6), trace=trace)
 
 
+def _serve_listen(engine, journal, status, reloader) -> int:
+    """``paddle serve --listen HOST:PORT`` (doc/serving.md "Cross-host
+    fleet"): the socket front door. Framed requests in, framed answers
+    out in submission order, the same journal/dedupe/drain contract as
+    the stdin path — a `paddle serve-fleet --replica_addr` router on
+    another host is the expected client. Runs until SIGTERM/SIGINT or
+    a ``drain`` control frame, then drains exactly like stdin EOF."""
+    from paddle_tpu.observability import metrics as obsm
+    from paddle_tpu.serving.transport import EngineSocketServer
+    from paddle_tpu.utils.flags import FLAGS
+
+    drain = cc.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: drain.set())
+    server = EngineSocketServer(engine, FLAGS.listen, journal=journal,
+                                on_drain=drain.set)
+    # a restarted replica re-offers its journal backlog FIRST; the
+    # answers queue for whichever router connects (at-least-once)
+    if journal is not None:
+        replay = journal.pending()
+        if replay:
+            print(f"# paddle serve: re-offering {len(replay)} journaled "
+                  "request(s) from a previous run", file=sys.stderr)
+        for doc in replay:
+            server.replay(doc)
+    server.start()
+    # the bound address line is the startup contract (--listen :0
+    # binds an ephemeral port; launchers parse this line)
+    print(f"# paddle serve: listening on {server.address}",
+          file=sys.stderr, flush=True)
+    while not drain.is_set():
+        drain.wait(timeout=0.5)
+    print("# paddle serve: drain requested", file=sys.stderr)
+    if reloader is not None:
+        reloader.stop()
+    engine.drain(timeout=600.0)
+    server.wait_idle(timeout=600.0)   # every accepted answer framed out
+    server.close()
+    if status is not None:
+        status.stop()
+    if journal is not None:
+        journal.close()
+    if obsm.enabled():
+        engine.window_roll()
+        obsm.emit("run_end", status="completed")
+        obsm.flush()
+    print("# paddle serve: drained", file=sys.stderr)
+    return 0
+
+
 def main(rest: List[str]) -> int:
     from paddle_tpu.utils.flags import FLAGS
 
@@ -230,6 +280,10 @@ def main(rest: List[str]) -> int:
                                   _load_ckpt).start()
         print(f"# paddle serve: watching {FLAGS.serve_reload_watch} for "
               "durable checkpoints (hot weight reload)", file=sys.stderr)
+    if FLAGS.listen:
+        # the socket front door replaces the stdin reader wholesale —
+        # same engine, journal, status, and reload planes
+        return _serve_listen(engine, journal, status, reloader)
     print(f"# paddle serve: {engine.slots} slot(s), max_length "
           f"{engine.max_length}, decode blocks {FLAGS.serve_decode_block}, "
           f"pipeline {'on' if FLAGS.serve_pipeline else 'off'}"

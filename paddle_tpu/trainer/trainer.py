@@ -126,6 +126,7 @@ class Trainer:
             config.model_config, dtype=dtype, compute_dtype=compute_dtype,
             scan_unroll=config.opt_config.scan_unroll,
             pallas_rnn=config.opt_config.pallas_rnn,
+            pallas_flat=config.opt_config.pallas_flat,
             conv_s2d=config.opt_config.conv_s2d,
             conv_stats_mode=config.opt_config.conv_stats_mode,
             pallas_decoder=config.opt_config.pallas_decoder,

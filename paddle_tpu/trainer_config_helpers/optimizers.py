@@ -214,6 +214,7 @@ def settings(
     num_batches_per_send_parameter: Optional[int] = None,
     batches_per_launch: Optional[int] = None,
     pallas_rnn: Optional[bool] = None,
+    pallas_flat: Optional[bool] = None,
     conv_s2d: Optional[bool] = None,
     conv_stats_mode: Optional[str] = None,
     pallas_decoder: Optional[bool] = None,
@@ -255,6 +256,10 @@ def settings(
         s["batches_per_launch"] = batches_per_launch
     if pallas_rnn is not None:
         s["pallas_rnn"] = pallas_rnn
+    if pallas_flat is not None:
+        # transpose-free pallas_rnn interface (batch-major [B, T*width]
+        # reads instead of a materialized time-major swap)
+        s["pallas_flat"] = pallas_flat
     if conv_s2d is not None:
         s["conv_s2d"] = conv_s2d
     if conv_stats_mode is not None:

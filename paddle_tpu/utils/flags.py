@@ -219,6 +219,21 @@ class _Flags:
     fleet_replicas: int = 2
     fleet_status_dir: str = ""
     serve_reload_watch: str = ""
+    # cross-host fleet (serving/transport.py, doc/serving.md "Cross-host
+    # fleet"): listen — `paddle serve --listen HOST:PORT` accepts
+    # length-prefixed JSON frames over TCP instead of stdin JSONL (same
+    # journal/dedupe/drain contract; port 0 = ephemeral, the bound
+    # address is printed on stderr); replica_addr — `paddle serve-fleet
+    # --replica_addr HOST:PORT` (repeatable, or one comma list) routes
+    # to remote listeners through SocketReplica instead of spawning
+    # pipe children (reconnect/backoff via the --io_retry_* policy);
+    # hedge_after — a request outstanding on one replica longer than
+    # max(hedge_after, adaptive p99 of observed answer latency) seconds
+    # is re-sent to the next-healthiest replica, first answer wins
+    # (0 disables hedging; works for pipe and socket fleets alike)
+    listen: str = ""
+    replica_addr: str = ""
+    hedge_after: float = 0.0
     # `paddle supervise` child job: train (default) or serve — a serve
     # child keeps its args on restart (no --init_model_path=auto
     # injection; the request journal is its resume state) and its
@@ -264,6 +279,25 @@ def flag_value(argv: List[str], name: str, default: str = "") -> str:
                 out = argv[i + 1]
         elif a.startswith(f"--{name}="):
             out = a[len(name) + 3:]
+    return out
+
+
+def flag_values(argv: List[str], name: str) -> List[str]:
+    """Every occurrence of ``--name=value`` / ``--name value`` in an argv
+    list, in order, with comma lists split. The repeatable-flag
+    companion to :func:`flag_value` — e.g. ``paddle serve-fleet
+    --replica_addr h1:9000 --replica_addr h2:9000`` (or the equivalent
+    ``--replica_addr h1:9000,h2:9000``) yields both addresses."""
+    out: List[str] = []
+    for i, a in enumerate(argv):
+        v = None
+        if a == f"--{name}":
+            if i + 1 < len(argv):
+                v = argv[i + 1]
+        elif a.startswith(f"--{name}="):
+            v = a[len(name) + 3:]
+        if v is not None:
+            out.extend(p for p in (s.strip() for s in v.split(",")) if p)
     return out
 
 

@@ -1,0 +1,323 @@
+"""Race spec: socket transport — reconnect-vs-send and
+hedge-vs-first-answer interleavings over the REAL
+:class:`SocketTransport` / :class:`FleetRouter`, with the wire replaced
+by an in-memory duplex pipe built from cc primitives (the transport
+takes ``connect_fn`` exactly for this seam).
+
+Phase 1 — reconnect vs send: a sender thread pushes frames while the
+server end drops the connection mid-stream. The transport's contract:
+``send()`` returning True means the frame reached the peer's buffer
+(the fake wire drains buffered bytes before reporting EOF, so every
+accepted frame decodes); a drop surfaces as send() == False plus a
+reconnect, never a crash, a torn decode or a duplicate. After the drop
+the state machine must come back UP and deliver a marker frame on the
+new wire.
+
+Phase 2 — hedge vs first answer: a two-replica fleet where one replica
+answers slowly; ``hedge_after`` is tiny, so the router's hedge loop
+races the owner's late answer. Whichever side wins, every submitted id
+is emitted exactly once in order, the loser is absorbed into
+``duplicate_answers``, and the hedge counters stay consistent
+(``hedge_wins <= hedges``).
+
+Invariants:
+- no frame is lost after being accepted, none decodes twice;
+- a dropped connection advances ``reconnects`` and ends UP, not CLOSED;
+- fleet exactly-once holds under hedging (no double emission, order
+  kept, ``run()`` terminates);
+- ``hedge_wins <= hedges`` and duplicates are counted, never emitted.
+"""
+
+import logging
+
+from paddle_tpu.serving import transport
+from paddle_tpu.serving.fleet import FleetRouter
+from paddle_tpu.utils import concurrency as cc
+from paddle_tpu.utils.retry import RetryPolicy
+
+NAME = "transport"
+
+
+# ------------------------------------------------- in-memory duplex wire
+
+
+class FakeWire:
+    """One end of an in-memory duplex pipe speaking the socket subset
+    the transport uses (sendall/recv/close/settimeout), built on cc
+    primitives so `paddle race` can interleave it. Closing either end
+    closes both; buffered bytes drain before EOF — like a real TCP
+    FIN, which delivers what was already in flight."""
+
+    def __init__(self):
+        self._lock = cc.Lock()
+        self._cv = cc.Condition(self._lock)
+        self._buf = bytearray()
+        self._closed = False
+        self.peer = None  # wired by _pipe()
+
+    def settimeout(self, t):
+        pass
+
+    def sendall(self, data):
+        p = self.peer
+        with p._lock:
+            if p._closed:
+                raise ConnectionResetError(104, "peer closed")
+            p._buf += data
+            p._cv.notify_all()
+
+    def recv(self, n):
+        with self._lock:
+            while not self._buf and not self._closed:
+                self._cv.wait(timeout=0.05)
+            if self._buf:
+                out = bytes(self._buf[:n])
+                del self._buf[:n]
+                return out
+            return b""
+
+    def close(self):
+        for w in (self, self.peer):
+            with w._lock:
+                w._closed = True
+                w._cv.notify_all()
+
+
+def _pipe():
+    a, b = FakeWire(), FakeWire()
+    a.peer, b.peer = b, a
+    return a, b
+
+
+def run(ctx):
+    # connection drops log warnings per explored schedule — keep the
+    # analyzer report readable
+    logger = logging.getLogger("paddle_tpu")
+    prev = logger.level
+    logger.setLevel(logging.CRITICAL)
+    try:
+        _phase_reconnect_vs_send(ctx)
+        _phase_hedge_vs_first_answer(ctx)
+    finally:
+        logger.setLevel(prev)
+
+
+# ------------------------------------------- phase 1: reconnect vs send
+
+
+def _phase_reconnect_vs_send(ctx):
+    decoded = []
+    dlock = cc.Lock()
+    conns = []
+    clock_ = cc.Lock()
+
+    def serve(wire):
+        reader = transport.FrameReader()
+        while True:
+            data = wire.recv(65536)
+            if not data:
+                return
+            for doc in reader.feed(data):
+                with dlock:
+                    decoded.append(doc)
+
+    def connect(addr):
+        a, b = _pipe()
+        with clock_:
+            conns.append(b)
+        cc.Thread(target=serve, args=(b,), name="fake-server",
+                  daemon=True).start()
+        return a
+
+    policy = RetryPolicy(max_attempts=1000, base_delay=0.001,
+                         max_delay=0.005, jitter=0.0, name="net.connect")
+    t = transport.SocketTransport("c0", "fake:0", on_frame=lambda d: None,
+                                  policy=policy, connect_fn=connect)
+    ctx.static_watch(t)
+    t.start()
+
+    sent = []
+    slock = cc.Lock()
+
+    def sender():
+        for i in range(4):
+            rid = f"s{i}"
+            while not t.send({"id": rid}):
+                if t.closed():
+                    return
+                cc.sleep(0.002)
+            with slock:
+                sent.append(rid)
+
+    st = cc.Thread(target=sender, name="sender")
+    st.start()
+    # drop the FIRST connection while the sends race it
+    deadline = cc.monotonic() + 60.0
+    first = None
+    while cc.monotonic() < deadline:
+        with clock_:
+            if conns:
+                first = conns[0]
+                break
+        cc.sleep(0.001)
+    assert first is not None, "transport never connected"
+    first.close()
+    st.join()
+    # the state machine must come back UP and deliver on the new wire
+    while not t.send({"id": "marker"}):
+        assert not t.closed(), "transport gave up instead of reconnecting"
+        cc.sleep(0.002)
+    with slock:
+        sent.append("marker")
+    deadline = cc.monotonic() + 60.0
+    while cc.monotonic() < deadline:
+        with dlock:
+            if any(d.get("id") == "marker" for d in decoded):
+                break
+        cc.sleep(0.002)
+    t.close()
+    assert t.join(timeout=30.0), "transport thread did not exit"
+    ids = [d.get("id") for d in decoded]
+    assert len(ids) == len(set(ids)), f"duplicate decode: {ids}"
+    assert set(ids) <= set(sent), (ids, sent)
+    assert "marker" in ids, "reconnected wire never delivered"
+    assert t.reconnects >= 1, "drop did not advance reconnects"
+
+
+# -------------------------------------- phase 2: hedge vs first answer
+
+
+class HedgeReplica:
+    """Minimal ProcReplica duck-type: a worker answers each routed doc
+    after ``delay_s`` — slow enough on one replica that the router's
+    hedge loop races the owner's own late answer."""
+
+    def __init__(self, name, delay_s):
+        self.name = name
+        self.delay_s = delay_s
+        self.deliver = None
+        self._lock = cc.Lock()
+        self._cv = cc.Condition(self._lock)
+        self._queue = []
+        self._alive = False
+        self._draining = False
+        self._exit = None
+        self._worker = None
+
+    def start(self):
+        with self._lock:
+            self._alive = True
+            self._exit = None
+        self._worker = cc.Thread(target=self._run,
+                                 name=f"hedge-{self.name}", daemon=True)
+        self._worker.start()
+
+    def _run(self):
+        while True:
+            with self._lock:
+                while not self._queue and self._alive and not self._draining:
+                    self._cv.wait(timeout=0.05)
+                if not self._alive:
+                    return
+                if not self._queue:
+                    self._alive = False
+                    self._exit = 0
+                    return
+                doc = self._queue.pop(0)
+            cc.sleep(self.delay_s)
+            with self._lock:
+                if not self._alive:
+                    return
+            self.deliver(self.name, {
+                "id": str(doc.get("id")), "outcome": "ok",
+                "tokens": [1] * int(doc.get("max_new_tokens") or 1),
+            })
+
+    def alive(self):
+        with self._lock:
+            return self._alive
+
+    def poll_exit(self):
+        with self._lock:
+            return self._exit
+
+    def send(self, doc):
+        with self._lock:
+            if not self._alive or self._draining:
+                return False
+            self._queue.append(dict(doc))
+            self._cv.notify_all()
+        return True
+
+    def health(self, now):
+        with self._lock:
+            return {"state": "serving", "queue_depth": len(self._queue),
+                    "occupancy": 0}
+
+    def pending_requests(self):
+        return []
+
+    def begin_drain(self):
+        with self._lock:
+            self._draining = True
+            self._cv.notify_all()
+
+    def kill(self):
+        with self._lock:
+            self._alive = False
+            self._exit = 9
+            self._cv.notify_all()
+
+    def join(self, timeout):
+        w = self._worker
+        if w is not None:
+            w.join(timeout=timeout)
+            return not w.is_alive()
+        return True
+
+
+def _phase_hedge_vs_first_answer(ctx):
+    emitted = []
+    elock = cc.Lock()
+
+    def emit(doc):
+        with elock:
+            emitted.append(doc)
+
+    reps = [HedgeReplica("replica-0", delay_s=0.2),
+            HedgeReplica("replica-1", delay_s=0.01)]
+    router = FleetRouter(reps, emit=emit, poll_s=0.005,
+                         health_period_s=0.0, restart_base_delay=0.02,
+                         hedge_after=0.005)
+    for r in reps:
+        r.deliver = router.deliver
+    ctx.static_watch(router)
+    router.start()
+    box = {}
+
+    def target():
+        box["rc"] = router.run()
+
+    t = cc.Thread(target=target, name="fleet-run", daemon=True)
+    t.start()
+    submitted = [f"h{i}" for i in range(4)]
+    for rid in submitted:
+        assert router.submit({"id": rid, "prompt": [2, 3],
+                              "max_new_tokens": 1})
+    router.note_eof()
+    t.join(timeout=120.0)
+    assert not t.is_alive(), "router run() did not terminate (hedge phase)"
+    assert box["rc"] == 0, box
+    ids = [str(d.get("id")) for d in emitted]
+    assert len(ids) == len(set(ids)), f"double-emitted: {ids}"
+    assert set(ids) == set(submitted), (set(ids), set(submitted))
+    with router._lock:
+        order = list(router._order)
+    assert ids == order, ("emission violated submission order", ids, order)
+    for d in emitted:
+        assert d.get("outcome") == "ok", d
+    st = router.status()
+    assert st["hedge_wins"] <= st["hedges"], st
+    # a hedge's loser answers late: it must be absorbed, never emitted
+    assert st["duplicate_answers"] <= st["hedges"], st
+    router.shutdown(timeout=10.0)
