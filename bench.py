@@ -688,7 +688,8 @@ def _serve_sweep_continuous(gm, params, registry, *, rates, B, T,
                             queue_cap, decode_block, prompt_fn, budget_fn,
                             pipeline=True, fused_step=False,
                             shed_policy="off", replicas=(1,),
-                            transport="pipe"):
+                            transport="pipe", spec_tokens="0",
+                            slot_dtype="f32"):
     """The continuous-batching engine (paddle_tpu/serving/) on the SAME
     seeded workload, driven open-loop in wall-clock time. ``pipeline``
     selects the overlapped dispatch/collect loop vs the serial PR-12
@@ -707,7 +708,18 @@ def _serve_sweep_continuous(gm, params, registry, *, rates, B, T,
     round trip land in the measured ``router_share`` — the
     pipe-vs-tcp A/B `paddle compare` judges. tcp routes EVERY rung
     (n == 1 included) through the fleet driver: the single-engine
-    drive_rung path has no client seam. Returns (sweep doc, measured
+    drive_rung path has no client seam.
+
+    ``spec_tokens`` (PADDLE_TPU_BENCH_SERVE_SPEC, "0" = off) is the
+    speculative draft-length ladder and ``slot_dtype``
+    (PADDLE_TPU_BENCH_SERVE_SLOT_DTYPE) the slot-state storage dtype —
+    doc/serving.md "Speculative decode" / "Reduced-precision slot
+    state". With speculation on, the calibration pass's emitted
+    sequences seed every engine's draft table before rung 0 (the
+    calibration launches are already excluded from rung telemetry via
+    ``backend.serving``), so the first measured rung isn't penalized
+    by draft-table cold start — the same discipline that keeps warmup
+    compiles out of the measurement. Returns (sweep doc, measured
     capacity req/s of ONE replica)."""
     import numpy as np
 
@@ -721,7 +733,8 @@ def _serve_sweep_continuous(gm, params, registry, *, rates, B, T,
     backend = JaxDecodeBackend(
         gm, params, slots=B, prompt_tokens=T, max_length=max_length,
         decode_block=decode_block, registry=registry, pipeline=pipeline,
-        fused_step=fused_step,
+        fused_step=fused_step, spec_tokens=spec_tokens,
+        slot_dtype=slot_dtype,
     )
     backend.warmup()  # compiles land now; Engine.start()'s call re-runs
     # two cheap no-slot launches (idempotent semantically)
@@ -736,8 +749,21 @@ def _serve_sweep_continuous(gm, params, registry, *, rates, B, T,
             for i in range(B)]
     t0 = time.perf_counter()
     backend.admit(list(range(B)), warm, [max_length] * B)
-    while not bool(backend.step().finished.all()):
-        pass
+    # calibration emits real greedy tokens — keep them: with
+    # speculation on they seed the draft tables below, so rung 0 sees
+    # a warm table (the launches themselves stay out of rung telemetry
+    # via backend.serving)
+    cal_seqs = [[] for _ in range(B)]
+    done = False
+    while not done:
+        out = backend.step()
+        toks = np.asarray(out.tokens)
+        lives = np.asarray(out.live)
+        for u in range(toks.shape[0]):
+            for b in range(B):
+                if lives[u, b]:
+                    cal_seqs[b].append(int(toks[u, b]))
+        done = bool(out.finished.all())
     capacity_rps = B / max(time.perf_counter() - t0, 1e-6)
     backend.serving = True
     if not rates:
@@ -750,7 +776,8 @@ def _serve_sweep_continuous(gm, params, registry, *, rates, B, T,
         JaxDecodeBackend(
             gm, params, slots=B, prompt_tokens=T, max_length=max_length,
             decode_block=decode_block, registry=None, pipeline=pipeline,
-            fused_step=fused_step,
+            fused_step=fused_step, spec_tokens=spec_tokens,
+            slot_dtype=slot_dtype,
         )
         for _ in range(1, n_max)
     ]
@@ -760,6 +787,10 @@ def _serve_sweep_continuous(gm, params, registry, *, rates, B, T,
                replica=(f"replica-{i}" if n_max > 1 else "")).start()
         for i, b in enumerate(backends)
     ]
+    draft_seeded = 0
+    if backend.spec_blocks:
+        for e in engines:
+            draft_seeded = e.seed_draft(cal_seqs)
     servers, clients = [], []
     if transport == "tcp":
         # the real wire, loopback: every engine behind a framed socket
@@ -813,7 +844,12 @@ def _serve_sweep_continuous(gm, params, registry, *, rates, B, T,
     knee_windows = [w for w in windows
                     if int(w.get("replicas") or 1) == n_max]
     return ({"rungs": windows,
-             "knee_rps": serving.saturation_knee(knee_windows)},
+             "knee_rps": serving.saturation_knee(knee_windows),
+             # per-slot device state bytes (weights excluded) — the
+             # honest bf16-vs-f32 footprint stamp `paddle compare`
+             # judges as slot_bytes
+             "slot_bytes": backend.slot_state_bytes(),
+             "draft_seeded": draft_seeded},
             capacity_rps)
 
 
@@ -977,6 +1013,24 @@ def bench_serve(B=None, T=None, vocab=None, dim=None, beam_size=None,
             "PADDLE_TPU_BENCH_SERVE_TRANSPORT=tcp needs "
             "PADDLE_TPU_BENCH_SERVE_ENGINE=continuous (the static "
             "driver has no socket seam)")
+    # speculative decode + slot-state precision (doc/serving.md): the
+    # spec-on-vs-off and bf16-vs-f32 A/Bs, continuous engine only
+    from paddle_tpu.serving.backend import (parse_slot_dtype,
+                                            parse_spec_tokens)
+
+    spec_tokens = env("PADDLE_TPU_BENCH_SERVE_SPEC", "0")
+    slot_dtype = parse_slot_dtype(
+        env("PADDLE_TPU_BENCH_SERVE_SLOT_DTYPE", "f32"))
+    if parse_spec_tokens(spec_tokens) and engine != "continuous":
+        raise ValueError(
+            "PADDLE_TPU_BENCH_SERVE_SPEC needs "
+            "PADDLE_TPU_BENCH_SERVE_ENGINE=continuous (the static "
+            "driver has no draft seam)")
+    if slot_dtype != "f32" and engine != "continuous":
+        raise ValueError(
+            "PADDLE_TPU_BENCH_SERVE_SLOT_DTYPE needs "
+            "PADDLE_TPU_BENCH_SERVE_ENGINE=continuous (slot state is "
+            "the continuous engine's)")
 
     if engine == "continuous":
         doc, capacity_rps = _serve_sweep_continuous(
@@ -987,6 +1041,7 @@ def bench_serve(B=None, T=None, vocab=None, dim=None, beam_size=None,
             budget_fn=budget_fn, pipeline=bool(pipeline),
             fused_step=bool(fused_step), shed_policy=shed_policy,
             replicas=tuple(replicas), transport=transport,
+            spec_tokens=spec_tokens, slot_dtype=slot_dtype,
         )
         beam_size = 1  # the engine decodes greedily (doc/serving.md)
     else:
@@ -1053,6 +1108,17 @@ def bench_serve(B=None, T=None, vocab=None, dim=None, beam_size=None,
             # load and judges router_share across the wire
             **({"transport": w["transport"]}
                if isinstance(w.get("transport"), str) else {}),
+            # speculation config + per-rung draft acceptance: spec
+            # ("4"/"2,4"/"off") and slot_dtype join the compare key;
+            # accept_rate rides so an archived artifact carries the
+            # spec A/B's explanatory variable (zero when no verify
+            # launch ran — compare zero-fills old artifacts the same)
+            **({"spec": w["spec"]}
+               if isinstance(w.get("spec"), str) else {}),
+            **({"slot_dtype": w["slot_dtype"]}
+               if isinstance(w.get("slot_dtype"), str) else {}),
+            **({"accept_rate": w["accept_rate"]}
+               if isinstance(w.get("accept_rate"), (int, float)) else {}),
         }
         for w in doc["rungs"]
     ]
@@ -1071,6 +1137,17 @@ def bench_serve(B=None, T=None, vocab=None, dim=None, beam_size=None,
         extras["pipeline"] = "on" if pipeline else "off"
         extras["decode_blocks"] = str(decode_block)
         extras["transport"] = transport
+        # speculation + slot-dtype headline stamps: spec=K|off and the
+        # storage dtype say WHAT was measured; slot_bytes is the
+        # memory_analysis-honest per-slot footprint compare judges
+        spec_ladder = parse_spec_tokens(spec_tokens)
+        extras["spec"] = (",".join(str(k) for k in spec_ladder)
+                          if spec_ladder else "off")
+        extras["slot_dtype"] = slot_dtype
+        if isinstance(doc.get("slot_bytes"), int):
+            extras["slot_bytes"] = doc["slot_bytes"]
+        if doc.get("draft_seeded"):
+            extras["draft_seeded"] = doc["draft_seeded"]
         if max(replicas) > 1:
             extras["replicas"] = ",".join(str(n) for n in replicas)
         if fused_step:

@@ -222,7 +222,8 @@ class GradientMachine:
     def asDecodeEngine(self, slots: int = 8, prompt_tokens: int = 32,
                        queue_cap: int = 0, request_timeout_s: float = 60.0,
                        decode_block=1, registry=None,
-                       pipeline: bool = True, fused_step: bool = False):
+                       pipeline: bool = True, fused_step: bool = False,
+                       spec_tokens="0", slot_dtype: str = "f32"):
         """The continuous-batching engine over this machine's generator
         graph (doc/serving.md) — the concurrent-use superset of
         :class:`SequenceGenerator`: submit() from any thread, slot-based
@@ -237,6 +238,7 @@ class GradientMachine:
             prompt_tokens=prompt_tokens, queue_cap=queue_cap,
             request_timeout_s=request_timeout_s, decode_block=decode_block,
             registry=registry, pipeline=pipeline, fused_step=fused_step,
+            spec_tokens=spec_tokens, slot_dtype=slot_dtype,
         )
 
 

@@ -154,13 +154,56 @@ def capture_prefill(machine, plan: GenPlan, params, in_args):
 _AGENT_TYPES = ("agent", "sequence_agent", "scatter_agent", "gather_agent")
 
 
-def plan_fused_step(machine, plan: GenPlan):
+# ------------------------------------------------ reduced-precision slot state
+#
+# ``--serve_slot_dtype=bf16`` halves per-slot HBM by STORING slot state
+# (GRU carries + captured statics) in bfloat16 while every step still
+# COMPUTES in f32: the backend upcasts statics once per launch and
+# carries before every micro-step, and downcasts only what it stores
+# back. This is a *storage* plan — it deliberately does NOT relax the
+# f32-compute refusal below: a model that computes in bf16 rounds
+# differently per layer and the greedy argmax could silently diverge
+# from the golden-parity contract, whereas store-rounding is a bounded,
+# tested perturbation of the carry between steps.
+SLOT_STORE_DTYPES: Dict[str, Optional[str]] = {"f32": None, "bf16": "bfloat16"}
+
+# Parity tolerance gate per slot dtype: the max fraction of emitted
+# token positions allowed to differ from the f32-stored reference on the
+# seeded parity workloads (tests/test_speculative.py). f32 storage is
+# bit-exact by construction; bf16 carry rounding may flip near-tie
+# argmax tokens, and past this rate the plan is considered broken.
+SLOT_PARITY_TOL: Dict[str, float] = {"f32": 0.0, "bf16": 0.05}
+
+
+def plan_slot_dtype(slot_dtype: str) -> Tuple[Optional[Dict[str, Any]], str]:
+    """(mixed-precision storage plan, "") for a ``--serve_slot_dtype``
+    spelling, else (None, reason). The plan names the storage dtype
+    (None = store in the model dtype, the PR-12 behavior) and the parity
+    tolerance the golden tests gate on."""
+    if slot_dtype not in SLOT_STORE_DTYPES:
+        return None, (
+            f"unknown slot dtype {slot_dtype!r} "
+            f"(supported: {tuple(SLOT_STORE_DTYPES)})"
+        )
+    return {
+        "store_dtype": SLOT_STORE_DTYPES[slot_dtype],
+        "parity_tol": SLOT_PARITY_TOL[slot_dtype],
+    }, ""
+
+
+def plan_fused_step(machine, plan: GenPlan, slot_dtype: str = "f32"):
     """(extraction dict, "") when the generation step graph is EXACTLY
     the attention-GRU decoder template (simple_attention + gru_step +
     softmax out — the seqToseq shape graph/fused_decoder.py matches on
     the training side), else (None, reason). The dict carries every
-    parameter name and static-link key the fused step needs; refusals
+    parameter name and static-link key the fused step needs, plus the
+    :func:`plan_slot_dtype` storage plan for ``slot_dtype`` (the
+    store-bf16/compute-f32 extension past the f32 refusal below — the
+    refusal itself is about COMPUTE dtype and is unchanged); refusals
     are loud because ``--serve_fused_step`` is an explicit request."""
+    slot_plan, why = plan_slot_dtype(slot_dtype)
+    if slot_plan is None:
+        return None, why
     sub = plan.sub
     lm = machine.network.layer_map
     # the fused step computes in f32; under a reduced compute dtype the
@@ -280,6 +323,7 @@ def plan_fused_step(machine, plan: GenPlan):
                                combine.bias_parameter_name) if p],
         xw_bias_params=[p for p in (din.bias_parameter_name,
                                     gru.bias_parameter_name) if p],
+        **slot_plan,
     ), ""
 
 

@@ -78,7 +78,9 @@ def _serve_key(offered_rps, qualifier, seen_pre: set,
                engine: Optional[str] = None,
                pipeline: Optional[str] = None,
                replicas: Any = None,
-               transport: Optional[str] = None) -> str:
+               transport: Optional[str] = None,
+               spec: Optional[str] = None,
+               slot_dtype: Optional[str] = None) -> str:
     """The ONE serve rung key format, shared by the run-dir and bench-
     artifact sides (a divergence would silently break their
     comparability): 6 significant digits of offered load — a slow
@@ -111,7 +113,15 @@ def _serve_key(offered_rps, qualifier, seen_pre: set,
     offered load alone — which is exactly the cross-transport
     router_share comparison being asked for — while a both-transports
     artifact repeats every (engine, pipeline, rate) once per wire and
-    must not diff a transport against itself."""
+    must not diff a transport against itself.
+
+    Speculation config (``spec``, the draft-length ladder spelling or
+    "off") and slot-state dtype qualify the same way, after pipeline:
+    the intended spec-on-vs-spec-off (or bf16-vs-f32) A/B is one
+    config per artifact with pinned rates — joining on offered load
+    alone — while a both-configs sweep in ONE artifact repeats every
+    (engine, pipeline, rate) once per config and must not diff a
+    config against itself."""
     rate = format(float(offered_rps or 0.0), ".6g")
     x = f"x{int(replicas)}." if replicas and int(replicas) > 1 else ""
     pre = f"serve.{x}{rate}rps."
@@ -119,6 +129,11 @@ def _serve_key(offered_rps, qualifier, seen_pre: set,
         pre = f"serve.{engine}.{x}{rate}rps."
     if pre in seen_pre and engine and pipeline:
         pre = f"serve.{engine}.pipe-{pipeline}.{x}{rate}rps."
+    if pre in seen_pre and engine and pipeline and spec:
+        pre = f"serve.{engine}.pipe-{pipeline}.spec-{spec}.{x}{rate}rps."
+    if pre in seen_pre and engine and pipeline and spec and slot_dtype:
+        pre = (f"serve.{engine}.pipe-{pipeline}.spec-{spec}"
+               f".dt-{slot_dtype}.{x}{rate}rps.")
     if pre in seen_pre and engine and pipeline and transport:
         pre = f"serve.{engine}.pipe-{pipeline}.net-{transport}.{x}{rate}rps."
     if pre in seen_pre:
@@ -151,6 +166,12 @@ def _higher_is_better(name: str) -> bool:
     # the throughput default would judge them backwards
     if n.endswith(("shed_rate", "error_rate")):
         return False
+    # speculative-decode draft acceptance (doc/serving.md "Speculative
+    # decode"): a higher share of draft tokens surviving verification
+    # is more free tokens per launch — explicit because the generic
+    # rules below would only cover it by the fall-through default
+    if n.endswith("accept_rate"):
+        return True
     # lint/race metrics are finding counts: fewer is always better (and
     # the bare rule/detector ids would otherwise fall through to the
     # throughput default below)
@@ -270,6 +291,8 @@ def _run_side(path: str) -> Dict[str, float]:
     for w in sorted(windows,
                     key=lambda w: (str(w.get("engine") or ""),
                                    str(w.get("pipeline") or ""),
+                                   str(w.get("spec") or ""),
+                                   str(w.get("slot_dtype") or ""),
                                    int(w.get("replicas") or 0),
                                    str(w.get("transport") or ""),
                                    w.get("rung") if isinstance(
@@ -280,7 +303,12 @@ def _run_side(path: str) -> Dict[str, float]:
                 if isinstance(w.get("transport"), str) else None)
         pre = _serve_key(w.get("offered_rps"), w.get("rung", 0), seen_pre,
                          engine=engine, pipeline=pipe,
-                         replicas=w.get("replicas"), transport=tran)
+                         replicas=w.get("replicas"), transport=tran,
+                         spec=(w.get("spec")
+                               if isinstance(w.get("spec"), str) else None),
+                         slot_dtype=(w.get("slot_dtype")
+                                     if isinstance(w.get("slot_dtype"), str)
+                                     else None))
         for snap_key, dst, scale in (
             ("latency", "p50_ms", 1e3), ("latency", "p99_ms", 1e3),
             ("ttft", "ttft_p50_ms", 1e3), ("ttft", "ttft_p99_ms", 1e3),
@@ -309,6 +337,14 @@ def _run_side(path: str) -> Dict[str, float]:
                 float(w.get("shed", 0) or 0) / float(arrived), 6)
             out[pre + "error_rate"] = round(
                 float(w.get("errors", 0) or 0) / float(arrived), 6)
+        # speculative-decode acceptance, ZERO-FILLED like shed_rate:
+        # pre-speculation artifacts (no accept_rate field) still share
+        # the key, and 0 -> N acceptance shows up as IMPROVED instead
+        # of landing invisibly in only_b. Only the continuous engine
+        # speculates — static windows stay 0 == 0 (SAME).
+        if engine == "continuous":
+            out[pre + "accept_rate"] = round(
+                float(w.get("accept_rate", 0.0) or 0.0), 6)
         # engine-scoped like the other share metrics: a share of e2e is
         # only comparable within one latency regime
         shares = shares_by_rate.get(
@@ -389,6 +425,8 @@ def _bench_side(path: str, raw: str) -> Dict[str, float]:
     # deterministic key assignment as the run-dir side (see _run_side)
     rungs.sort(key=lambda p: (str(p[1].get("engine") or ""),
                               str(p[1].get("pipeline") or ""),
+                              str(p[1].get("spec") or ""),
+                              str(p[1].get("slot_dtype") or ""),
                               int(p[1].get("replicas") or 0),
                               str(p[1].get("transport") or ""), p[0]))
     for i, r in rungs:
@@ -398,7 +436,12 @@ def _bench_side(path: str, raw: str) -> Dict[str, float]:
                 if isinstance(r.get("transport"), str) else None)
         pre = _serve_key(r.get("offered_rps"), i, seen_pre, engine=engine,
                          pipeline=pipe, replicas=r.get("replicas"),
-                         transport=tran)
+                         transport=tran,
+                         spec=(r.get("spec")
+                               if isinstance(r.get("spec"), str) else None),
+                         slot_dtype=(r.get("slot_dtype")
+                                     if isinstance(r.get("slot_dtype"), str)
+                                     else None))
         for key in ("p50_ms", "p99_ms", "ttft_p50_ms", "ttft_p99_ms",
                     "goodput_tok_s"):
             v = r.get(key)
@@ -420,8 +463,28 @@ def _bench_side(path: str, raw: str) -> Dict[str, float]:
                 if isinstance(v, (int, float)) and not isinstance(v, bool)
                 else 0.0
             )
+        # draft acceptance, zero-filled on continuous rungs like the
+        # run-dir side (0 -> N = IMPROVED, never only_b); per-slot
+        # state bytes (memory_analysis stamp, the bf16 proof surface)
+        # ride conditionally — zero-filling them would mint a phantom
+        # "bytes went to 0" IMPROVED verdict against pre-stamp artifacts
+        if engine == "continuous":
+            v = r.get("accept_rate")
+            out[pre + "accept_rate"] = (
+                float(v)
+                if isinstance(v, (int, float)) and not isinstance(v, bool)
+                else 0.0
+            )
+        v = r.get("slot_bytes")
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            out[pre + "slot_bytes"] = float(v)
     if isinstance(line.get("knee_rps"), (int, float)):
         out["serve_knee_rps"] = float(line["knee_rps"])
+    # headline per-slot state bytes (bf16 slot-state A/B): lower is
+    # better via the "_bytes" suffix rule
+    v = line.get("slot_bytes")
+    if isinstance(v, (int, float)) and not isinstance(v, bool):
+        out["slot_bytes"] = float(v)
     for leg, payload in (line.get("legs") or {}).items():
         if isinstance(payload, dict) and isinstance(
             payload.get("value"), (int, float)

@@ -66,10 +66,12 @@ from paddle_tpu.observability.analyze import (
 SERVE_GROUP = "serve_gen"
 
 # every serving launch group serve-report joins: the PR-8 static
-# engine's one-shot generation launch, and the continuous engine's
-# decode/prefill pair (paddle_tpu/serving/jax_backend.py) — all held to
-# the same recompiles=0-after-warmup contract
-SERVE_GROUPS = (SERVE_GROUP, "serve_decode", "serve_prefill")
+# engine's one-shot generation launch, the continuous engine's
+# decode/prefill pair, and the PR-20 speculative verify launch
+# (paddle_tpu/serving/jax_backend.py) — all held to the same
+# recompiles=0-after-warmup contract
+SERVE_GROUPS = (SERVE_GROUP, "serve_decode", "serve_prefill",
+                "serve_verify")
 
 # mean exec seconds per launch at or below which a rung is classified
 # dispatch-bound: the launch is latency-floor sized (per-launch dispatch
@@ -147,7 +149,9 @@ class RequestLog:
 
     def __init__(self, rung: int = 0, offered_rps: float = 0.0,
                  beam_size: Optional[int] = None, engine: str = "static",
-                 pipeline: Optional[str] = None, replica: str = ""):
+                 pipeline: Optional[str] = None, replica: str = "",
+                 spec: Optional[str] = None,
+                 slot_dtype: Optional[str] = None):
         self.rung = int(rung)
         self.offered_rps = float(offered_rps)
         self.beam_size = beam_size
@@ -168,6 +172,20 @@ class RequestLog:
         # stream; the MERGED fleet window instead carries `replicas=N`
         # (serving/fleet.py merge_windows)
         self.replica = str(replica)
+        # self-speculative decode config stamps (PR 20): `spec` is the
+        # draft-length ladder spelling ("4", "2,4") or "off" when the
+        # continuous engine's backend takes drafts but the ladder is
+        # empty; `slot_dtype` is the slot-state storage dtype
+        # ("f32"/"bf16"). Both None outside the continuous engine —
+        # the fields stay off static-driver records entirely. Part of
+        # the compare rung join, like `pipeline`.
+        self.spec = None if spec is None else str(spec)
+        self.slot_dtype = None if slot_dtype is None else str(slot_dtype)
+        # draft tokens proposed / accepted across the window's verify
+        # launches — accept_rate on the window record, plus the
+        # cumulative serve.spec_proposed / serve.spec_accepted counters
+        self.spec_proposed = 0
+        self.spec_accepted = 0
         # host seconds spent scheduling while a decode launch was in
         # flight (the pipelined loop's dispatch->collect-entry gaps)
         self.overlap_s = 0.0
@@ -334,6 +352,19 @@ class RequestLog:
         self.overlap_s += s
         obs.registry().counter("serve.overlap_s").inc(s)
 
+    def note_spec(self, proposed: int, accepted: int) -> None:
+        """One verify launch's draft outcome: ``proposed`` draft tokens
+        offered across all live slots, ``accepted`` the sum of common-
+        prefix matches the launch committed. Rides the window record
+        as ``accept_rate`` and the cumulative ``serve.spec_proposed`` /
+        ``serve.spec_accepted`` counters."""
+        p = max(int(proposed), 0)
+        a = max(int(accepted), 0)
+        self.spec_proposed += p
+        self.spec_accepted += a
+        obs.registry().counter("serve.spec_proposed").inc(p)
+        obs.registry().counter("serve.spec_accepted").inc(a)
+
     def note_dispatch(self, depth: int) -> None:
         """Launches dispatched but not yet collected (``serve.
         dispatch_depth`` gauge): 0 = the serial loop's steady state,
@@ -392,6 +423,15 @@ class RequestLog:
             rec["pipeline"] = self.pipeline
         if self.replica:
             rec["replica"] = self.replica
+        if self.spec is not None:
+            rec["spec"] = self.spec
+        if self.slot_dtype is not None:
+            rec["slot_dtype"] = self.slot_dtype
+        if self.spec_proposed > 0:
+            rec["spec_proposed"] = self.spec_proposed
+            rec["spec_accepted"] = self.spec_accepted
+            rec["accept_rate"] = round(
+                self.spec_accepted / self.spec_proposed, 4)
         if self.overlap_s > 0:
             rec["overlap_s"] = round(self.overlap_s, 6)
         if self._e2e_ok_s > 0:
@@ -731,7 +771,7 @@ def format_report(doc: Dict[str, Any]) -> str:
         f"{'rung':>4} {'offered r/s':>11} {'reqs':>5} {'ok':>5} {'rej':>4} "
         f"{'shed':>4} {'t/o':>4} {'err':>4} {'p50 ms':>8} {'p99 ms':>8} "
         f"{'ttft p50':>8} {'ttft p99':>8} {'q-wait':>6} {'occ':>5} "
-        f"{'goodput tok/s':>13} {'bound':>14}"
+        f"{'accept':>6} {'goodput tok/s':>13} {'bound':>14}"
     ]
     for r in doc["rungs"]:
         p50 = _q(r.get("latency"), "p50")
@@ -739,6 +779,8 @@ def format_report(doc: Dict[str, Any]) -> str:
         t50 = _q(r.get("ttft"), "p50")
         t99 = _q(r.get("ttft"), "p99")
         occ = _q(r.get("occupancy"), "mean")
+        acc = r.get("accept_rate")
+        acc_s = f"{float(acc) * 100:>5.1f}%" if acc is not None else f"{'-':>6}"
         lines.append(
             f"{r.get('rung', 0):>4} {r.get('offered_rps', 0.0):>11.2f} "
             f"{r.get('arrived', 0):>5} {r.get('completed', 0):>5} "
@@ -747,7 +789,8 @@ def format_report(doc: Dict[str, Any]) -> str:
             f"{(p50 or 0.0) * 1e3:>8.2f} {(p99 or 0.0) * 1e3:>8.2f} "
             f"{(t50 or 0.0) * 1e3:>8.2f} {(t99 or 0.0) * 1e3:>8.2f} "
             f"{(r.get('queue_wait_share') or 0.0) * 100:>5.1f}% "
-            f"{occ or 0.0:>5.2f} {r.get('goodput_tok_s', 0.0):>13.1f} "
+            f"{occ or 0.0:>5.2f} {acc_s} "
+            f"{r.get('goodput_tok_s', 0.0):>13.1f} "
             f"{r.get('bound', 'unknown'):>14}"
         )
     lines.append("")
@@ -774,6 +817,21 @@ def format_report(doc: Dict[str, Any]) -> str:
     pipelines = doc.get("pipelines") or []
     if pipelines:
         lines.append(f"pipelined decode: {', '.join(pipelines)}")
+    proposed = sum(int(r.get("spec_proposed", 0) or 0) for r in doc["rungs"])
+    if proposed:
+        accepted = sum(int(r.get("spec_accepted", 0) or 0)
+                       for r in doc["rungs"])
+        specs = sorted({str(r["spec"]) for r in doc["rungs"]
+                        if r.get("spec") not in (None, "off")})
+        lines.append(
+            f"speculative decode: ladder {', '.join(specs) or '?'} — "
+            f"{accepted}/{proposed} draft tokens accepted "
+            f"({accepted / proposed:.1%})"
+        )
+    dtypes = sorted({str(r["slot_dtype"]) for r in doc["rungs"]
+                     if isinstance(r.get("slot_dtype"), str)})
+    if dtypes and dtypes != ["f32"]:
+        lines.append(f"slot state dtype: {', '.join(dtypes)}")
     lines.append(
         f"{groups or SERVE_GROUP}: {doc['compiles']} compile(s), "
         f"recompiles after warmup: {doc['recompiles']}"

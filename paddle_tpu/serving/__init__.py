@@ -15,6 +15,10 @@ Layering (mirrors the analysis/resilience discipline):
   telemetry (PR-8 contract: ``kind=request``/``kind=serve_window``).
 - ``backend.py`` — the decode-seam protocol + a deterministic
   :class:`FakeBackend` (tests and ``tests/race_specs/``).
+- ``draft.py`` — the host-side n-gram draft table behind speculative
+  decode (doc/serving.md "Speculative decode"): jax-free, fed by
+  committed tokens at collect boundaries, proposals verified by one
+  fused ``serve_verify`` launch.
 - ``jax_backend.py`` — the real thing: donated slot state, jitted
   ``serve_prefill``/``serve_decode`` launch groups through the PR-7
   CompileRegistry (one signature each — zero recompiles after warmup).
@@ -35,7 +39,10 @@ from paddle_tpu.serving.backend import (
     FakeBackend,
     StepOut,
     parse_decode_blocks,
+    parse_slot_dtype,
+    parse_spec_tokens,
 )
+from paddle_tpu.serving.draft import DraftTable
 from paddle_tpu.serving.engine import (
     Engine,
     EngineRequest,
@@ -43,6 +50,7 @@ from paddle_tpu.serving.engine import (
     ServeResult,
     drive_rung,
     pick_block,
+    pick_spec_k,
 )
 from paddle_tpu.serving.fleet import (
     FleetRouter,
@@ -62,6 +70,7 @@ from paddle_tpu.serving.resilience import (
 __all__ = [
     "Engine", "EngineRequest", "ResultFuture", "ServeResult",
     "FakeBackend", "StepOut", "drive_rung", "pick_block",
+    "pick_spec_k", "DraftTable", "parse_spec_tokens", "parse_slot_dtype",
     "parse_decode_blocks", "CircuitBreaker", "RequestJournal",
     "ServeHangWatch", "StatusWriter", "SERVE_HANG_REPORT",
     "FleetRouter", "drive_fleet_rung", "replica_score",

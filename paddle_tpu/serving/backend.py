@@ -15,6 +15,14 @@ device-shaped hides behind this protocol:
   pipelined loop's first half); ``collect() -> StepOut`` — gather the
   OLDEST in-flight launch's results (blocking); ``inflight`` — how many
   launches are dispatched-but-uncollected.
+- ``spec_blocks`` — the pre-warmed speculation ladder (draft lengths K
+  the engine's ``pick_spec_k`` policy may choose from); empty = the
+  backend takes no drafts. When non-empty, ``dispatch``/``step`` accept
+  ``draft={slot: [tokens...]}``: ONE verify launch scores every drafted
+  position, commits the longest matching prefix per slot and, on the
+  first mismatch, the model's corrected token rides free. Slots without
+  a draft (or whose draft misses immediately) advance exactly one plain
+  greedy step — exact greedy output is preserved unconditionally.
 - ``step(block=None) -> StepOut`` — dispatch + collect in one call (the
   blocking loop and one-shot callers).
 - ``warmup()`` — pay compiles before serving (so compile telemetry
@@ -41,11 +49,17 @@ from __future__ import annotations
 
 import collections
 import dataclasses
-from typing import Any, Callable, List, Optional, Sequence, Tuple, Union
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
 from paddle_tpu.utils import concurrency as cc
+
+# A draft batch: slot index -> proposed next tokens (host ints). The
+# engine builds it under its lock from the DraftTable; the backend
+# snapshots it at dispatch (the pipelined loop carries it alongside the
+# slot->request cohort snapshot).
+DraftBatch = Dict[int, List[int]]
 
 
 def parse_decode_blocks(spec: Union[int, str, Sequence[int], None]) -> Tuple[int, ...]:
@@ -64,6 +78,39 @@ def parse_decode_blocks(spec: Union[int, str, Sequence[int], None]) -> Tuple[int
         blocks = [int(spec)]
     out = tuple(sorted({max(u, 1) for u in blocks}))
     return out or (1,)
+
+
+def parse_spec_tokens(spec: Union[int, str, Sequence[int], None]) -> Tuple[int, ...]:
+    """The speculation ladder (draft lengths K) from its flag/env
+    spelling. Same grammar as :func:`parse_decode_blocks` except that
+    ``None``/``0``/``"0"``/``""`` mean *speculation off* — an empty
+    ladder — and rungs < 1 are dropped rather than clamped."""
+    if spec is None:
+        return ()
+    if isinstance(spec, str):
+        parts = [p for p in spec.replace(" ", "").split(",") if p]
+        ks = [int(p) for p in parts]
+    elif isinstance(spec, (list, tuple)):
+        ks = [int(k) for k in spec]
+    else:
+        ks = [int(spec)]
+    return tuple(sorted({k for k in ks if k >= 1}))
+
+
+SLOT_DTYPES = ("f32", "bf16")
+
+
+def parse_slot_dtype(name: Union[str, None]) -> str:
+    """Validate a ``--serve_slot_dtype`` spelling. ``f32`` is the
+    PR-12 behavior unchanged; ``bf16`` stores slot state (GRU carries +
+    captured statics) in bfloat16 while every step still accumulates in
+    f32 — see graph/decode_step.plan_fused_step's mixed-precision
+    plan."""
+    dt = (name or "f32").strip().lower()
+    if dt not in SLOT_DTYPES:
+        raise ValueError(
+            f"serve_slot_dtype must be one of {SLOT_DTYPES}, got {name!r}")
+    return dt
 
 
 @dataclasses.dataclass
@@ -103,12 +150,14 @@ class FakeBackend:
                  chunk: Union[int, str, Sequence[int]] = 1,
                  step_delay_s: float = 0.0,
                  fail_at_launch: Union[int, Sequence[int], None] = None,
-                 fail_with: Optional[Callable[[int], Exception]] = None):
+                 fail_with: Optional[Callable[[int], Exception]] = None,
+                 spec_tokens: Union[int, str, Sequence[int], None] = None):
         self.slots = int(slots)
         self.max_length = int(max_length)
         self.eos = int(eos)
         self.decode_blocks = parse_decode_blocks(chunk)
         self.chunk = self.decode_blocks[-1]
+        self.spec_blocks = parse_spec_tokens(spec_tokens)
         self.step_delay_s = float(step_delay_s)
         if fail_at_launch is None:
             self.fail_at_launch = frozenset()
@@ -123,6 +172,8 @@ class FakeBackend:
         self.launches = 0
         self.reloads = 0                    # reload() calls, for tests
         self.admits: List[List[str]] = []   # admission waves, for tests
+        self.verify_launches = 0            # draft-carrying launches
+        self.spec_drafts: List[DraftBatch] = []  # verify inputs, for tests
         self._rows: List[Optional[dict]] = [None] * self.slots
         # dispatched-but-uncollected results (or faults): StepOut |
         # Exception, drained FIFO by collect()
@@ -162,10 +213,15 @@ class FakeBackend:
                 "done": int(budget) <= 0,
             }
 
-    def dispatch(self, block: Optional[int] = None) -> None:
+    def dispatch(self, block: Optional[int] = None,
+                 draft: Optional[DraftBatch] = None) -> None:
         """Advance the scripted rows now, surface the results (or the
         injected fault) only at collect — the jax async-dispatch
-        contract the pipelined engine is written against."""
+        contract the pipelined engine is written against. With
+        ``draft``, the launch is a verify: each slot advances through
+        its drafted tokens while the script agrees, plus the corrected
+        token on the first disagreement (slots without a draft take one
+        plain step)."""
         self.launches += 1
         if self.launches in self.fail_at_launch:
             if self.fail_with is not None:
@@ -176,6 +232,13 @@ class FakeBackend:
             return
         if self.step_delay_s:
             cc.sleep(self.step_delay_s)
+        if draft:
+            self.verify_launches += 1
+            snap = {int(b): [int(t) for t in toks]
+                    for b, toks in draft.items()}
+            self.spec_drafts.append(snap)
+            self._pending.append(self._verify(snap))
+            return
         u = max(int(block), 1) if block else self.chunk
         B = self.slots
         tokens = np.zeros((u, B), np.int64)
@@ -197,6 +260,34 @@ class FakeBackend:
         self._pending.append(StepOut(tokens=tokens, live=live,
                                      finished=finished))
 
+    def _verify(self, draft: DraftBatch) -> StepOut:
+        """The scripted verify launch: exact greedy semantics — every
+        emitted token is ``token_fn``'s own output; the draft only
+        decides how many steps a slot gets this launch."""
+        u = max(max((len(t) for t in draft.values()), default=0), 1)
+        B = self.slots
+        tokens = np.zeros((u, B), np.int64)
+        live = np.zeros((u, B), bool)
+        finished = np.zeros((B,), bool)
+        for b, row in enumerate(self._rows):
+            if row is None:
+                continue
+            d = draft.get(b, [])
+            i = 0
+            while not row["done"]:
+                tok = int(self.token_fn(row["rid"], row["emitted"]))
+                tokens[i, b] = tok
+                live[i, b] = True
+                row["emitted"] += 1
+                if tok == self.eos or row["emitted"] >= row["budget"]:
+                    row["done"] = True
+                matched = i < len(d) and tok == d[i]
+                i += 1
+                if not matched or i >= max(len(d), 1):
+                    break
+            finished[b] = row["done"]
+        return StepOut(tokens=tokens, live=live, finished=finished)
+
     def collect(self) -> StepOut:
         assert self._pending, "collect() with no launch in flight"
         out = self._pending.popleft()
@@ -204,6 +295,13 @@ class FakeBackend:
             raise out
         return out
 
-    def step(self, block: Optional[int] = None) -> StepOut:
-        self.dispatch(block=block)
+    def step(self, block: Optional[int] = None,
+             draft: Optional[DraftBatch] = None) -> StepOut:
+        # forward `draft` only when speculating: subclasses that
+        # override dispatch(block=...) without the draft seam (every
+        # pre-speculation backend shim) keep working un-speculated
+        if draft is None:
+            self.dispatch(block=block)
+        else:
+            self.dispatch(block=block, draft=draft)
         return self.collect()

@@ -113,6 +113,38 @@ BLOCK_OVERHEAD_SHARE = 0.5
 # EMA smoothing for the ladder's host/device time estimates
 _EMA = 0.3
 
+# --- self-speculative decode policy (doc/serving.md "Speculative
+# decode"). Acceptance-rate EMAs pick the draft length (the spec
+# ladder's rung) the way pick_block picks decode blocks; collapse falls
+# back to plain decode with ZERO recompiles (the verify launch's traced
+# K bound never changes signature).
+SPEC_MIN_SAMPLES = 4    # verify collects before the EMA is trusted
+SPEC_EMA_OFF = 0.2      # global EMA below this: plain decode (fallback)
+SPEC_EMA_FULL = 0.75    # global EMA at/above this: the top spec rung
+SPEC_REQ_OFF = 0.15     # per-request EMA below this: stop proposing
+                        # for that slot (it rides launches plain)
+SPEC_REPROBE = 64       # plain launches in fallback before the EMAs
+                        # reset and the bottom rung probes again — a
+                        # workload shift can re-earn its drafts
+
+
+def pick_spec_k(ladder: Sequence[int], ema: float, samples: int) -> int:
+    """The adaptive speculation policy: how many draft tokens to propose
+    per slot for the next verify launch — 0 means plain decode. Mirrors
+    :func:`pick_block`'s shape: unmeasured probes the bottom rung,
+    a collapsed acceptance EMA turns speculation off, and in between the
+    EMA interpolates across the pre-warmed ladder."""
+    if not ladder:
+        return 0
+    if samples < SPEC_MIN_SAMPLES:
+        return int(ladder[0])
+    if ema < SPEC_EMA_OFF:
+        return 0
+    if ema >= SPEC_EMA_FULL or len(ladder) == 1:
+        return int(ladder[-1])
+    frac = (ema - SPEC_EMA_OFF) / (SPEC_EMA_FULL - SPEC_EMA_OFF)
+    return int(ladder[min(int(frac * len(ladder)), len(ladder) - 1)])
+
 
 def pick_block(ladder: Sequence[int], cap: int, pressed: bool,
                host_s: float, step_s: float) -> int:
@@ -223,6 +255,13 @@ class EngineRequest(slog.Request):
     # but a span's t0 must live in the stream timebase, which
     # obs.rel_time derives from absolute monotonic readings
     t_enqueue_abs: float = 0.0
+    # --- self-speculation bookkeeping (collect-boundary only, under
+    # the engine lock): per-request acceptance EMA and the per-slot
+    # fallback latch — a request whose drafts keep missing stops
+    # proposing (it still rides verify launches as a plain step)
+    spec_ema: float = 0.0
+    spec_samples: int = 0
+    spec_off: bool = False
 
 
 class Engine:
@@ -275,6 +314,22 @@ class Engine:
             int(u) for u in (getattr(backend, "decode_blocks", None)
                              or (getattr(backend, "chunk", 1),))
         ))) or (1,)
+        # --- self-speculative decode (doc/serving.md "Speculative
+        # decode"): the backend advertises its pre-warmed draft-length
+        # ladder; empty/absent = speculation off and every spec field
+        # below stays inert. The draft table and its EMAs are touched
+        # ONLY under self._lock (the race spec's draft-table phases).
+        self._spec_ladder = tuple(
+            int(k) for k in (getattr(backend, "spec_blocks", ()) or ()))
+        self._draft = None
+        if self._spec_ladder:
+            from paddle_tpu.serving.draft import DraftTable
+
+            self._draft = DraftTable()
+        self._spec_ema = 0.0
+        self._spec_samples = 0
+        self._spec_cooloff = 0
+        self.slot_dtype = str(getattr(backend, "slot_dtype", "") or "")
         self._log = self._fresh_log()
         self._t0 = self._clock()
         self._thread = None
@@ -333,7 +388,33 @@ class Engine:
     def _fresh_log(self) -> slog.RequestLog:
         return slog.RequestLog(engine=ENGINE_NAME,
                                pipeline="on" if self.pipeline else "off",
-                               replica=self.replica)
+                               replica=self.replica,
+                               spec=(",".join(str(k) for k in
+                                              self._spec_ladder)
+                                     if self._spec_ladder else
+                                     ("off" if hasattr(self._backend,
+                                                       "spec_blocks")
+                                      else None)),
+                               slot_dtype=self.slot_dtype or None)
+
+    def seed_draft(self, seqs: Sequence[Sequence[int]]) -> int:
+        """Warm the speculation draft table from committed token
+        sequences — ``bench.py serve`` reuses the calibration's warmup
+        launches' outputs here, so spec-on first-rung goodput isn't
+        penalized by draft-table cold start (those launches already ran
+        with ``backend.serving`` off and stay out of the rung
+        telemetry). Returns how many sequences were folded in; a no-op
+        (0) when speculation is off."""
+        if self._draft is None:
+            return 0
+        n = 0
+        with self._lock:
+            for toks in seqs:
+                toks = list(toks or ())
+                if toks:
+                    self._draft.observe(toks)
+                    n += 1
+        return n
 
     def start(self) -> "Engine":
         """Warm the backend (all compiles land BEFORE serving — the
@@ -1015,6 +1096,50 @@ class Engine:
                 pressed = True  # a slot still owes its first token
         return (cap or self._ladder[-1]), pressed
 
+    # ------------------------------------------ self-speculation phases
+
+    def _spec_k_locked(self) -> int:
+        """The speculation rung for the NEXT launch (0 = plain decode),
+        from the lock-guarded acceptance EMA. In the fallback regime
+        this also runs the re-probe clock: after SPEC_REPROBE plain
+        launches the EMAs reset so the bottom rung probes again."""
+        if not self._spec_ladder:
+            return 0
+        k = pick_spec_k(self._spec_ladder, self._spec_ema,
+                        self._spec_samples)
+        if k <= 0:
+            # the _locked suffix contract: every caller holds self._lock
+            # (a non-reentrant cc.Lock — re-wrapping would deadlock)
+            self._spec_cooloff += 1  # lint: disable=PTL005 -- caller holds self._lock (_locked contract; non-reentrant Lock)
+            if self._spec_cooloff >= SPEC_REPROBE:
+                self._spec_cooloff = 0  # lint: disable=PTL005 -- caller holds self._lock (_locked contract)
+                self._spec_samples = 0  # lint: disable=PTL005 -- caller holds self._lock (_locked contract)
+                self._spec_ema = 0.0  # lint: disable=PTL005 -- caller holds self._lock (_locked contract)
+        return k
+
+    def _gather_spec_locked(self, cohort, k: int):
+        """The draft batch for one verify launch: up to ``k`` proposed
+        tokens per live slot from the n-gram table, capped by the slot's
+        remaining budget. Slots that opted out (per-request fallback),
+        finished, or for which the chains run dry simply get no entry —
+        they ride the launch as one plain greedy step. Returns None when
+        NO slot proposes (caller dispatches a plain decode block
+        instead; zero recompiles either way)."""
+        if self._draft is None or k <= 0:
+            return None
+        drafts: Dict[int, List[int]] = {}
+        for b, req in cohort:
+            if req is None or req.done or req.spec_off:
+                continue
+            room = req.budget - len(req.tokens)
+            kk = min(int(k), room)
+            if kk <= 0:
+                continue
+            d = self._draft.propose(req.tokens, kk)
+            if d:
+                drafts[b] = d
+        return drafts or None
+
     # ------------------------------------------------- the PR-12 loop
 
     def _loop_blocking(self) -> None:
@@ -1057,12 +1182,22 @@ class Engine:
                     t_host0 = self._clock()
                     continue
                 cap, pressed = self._block_inputs_locked()
-            u = pick_block(self._ladder, cap, pressed, host_ema, step_ema)
+                spec = self._gather_spec_locked(
+                    [(b, r) for b, r in enumerate(self._slots)
+                     if r is not None],
+                    self._spec_k_locked(),
+                )
+            if spec is not None:
+                u = max(len(d) for d in spec.values())
+            else:
+                u = pick_block(self._ladder, cap, pressed, host_ema,
+                               step_ema)
             t0 = self._clock()
             host_ema = (1 - _EMA) * host_ema + _EMA * (t0 - t_host0)
             try:
                 self._chaos_boundary()
-                out = backend.step(block=u)
+                out = (backend.step(draft=spec) if spec is not None
+                       else backend.step(block=u))
             except Exception as e:  # noqa: BLE001 — engine survives a bad launch
                 err = f"{type(e).__name__}: {e}"
                 logger.error("serve decode launch failed: %s", err)
@@ -1094,7 +1229,7 @@ class Engine:
                         self._span_locked("engine.readback",
                                           t0 + dt - rb, rb,
                                           traces=traces)
-                self._apply_step_locked(out, dt, occupancy)
+                self._apply_step_locked(out, dt, occupancy, spec=spec)
 
     # ----------------------------------------------- the pipelined loop
 
@@ -1127,26 +1262,52 @@ class Engine:
                 cohort = [(b, r) for b, r in enumerate(self._slots)
                           if r is not None]
                 cap, pressed = self._block_inputs_locked()
-                # speculate only when it can pay: if every live slot's
-                # remaining budget is already covered by in-flight
-                # micro-steps, launch N+1 would run all-frozen rows —
-                # pure waste (the short-budget regime) — so collect
-                # first and let the boundary see the finishes. EOS
-                # finishes stay unknowable ahead of time; budgets are
-                # the bound we do know.
-                pending_steps = sum(u for _c, u, _t, _lg in inflight)
+                # dispatch ahead only when it can pay: if every live
+                # slot's remaining budget is already covered by
+                # in-flight micro-steps, launch N+1 would run
+                # all-frozen rows — pure waste (the short-budget
+                # regime) — so collect first and let the boundary see
+                # the finishes. EOS finishes stay unknowable ahead of
+                # time; budgets are the bound we do know.
+                pending_steps = sum(u for _c, u, _t, _lg, _sp in inflight)
                 live_next = any(
                     r.budget - len(r.tokens) - pending_steps > 0
                     for _b, r in cohort
                 )
+                # self-speculation runs the launch pipeline at depth 1:
+                # drafts must be proposed from fully-committed context
+                # (a draft chained over an uncollected launch's unknown
+                # tokens would miss by construction), so while a launch
+                # is in flight the engine neither proposes nor
+                # interleaves a plain launch — it collects first. The
+                # in-flight launch still overlaps all host scheduling,
+                # and each launch commits up to K+1 tokens instead of
+                # the plain block's pipelined depth. With the EMA in
+                # the fallback regime (k=0) the plain depth-2 pipeline
+                # is back unchanged.
+                spec = None
+                spec_hold = False
+                spec_k = self._spec_k_locked()
+                if spec_k > 0:
+                    if inflight:
+                        spec_hold = True
+                    else:
+                        spec = self._gather_spec_locked(cohort, spec_k)
             dispatched = False
-            if occupancy and (live_next or not inflight):
+            if occupancy and not spec_hold and (live_next or not inflight):
                 dispatched = True
-                u = pick_block(self._ladder, cap, pressed, host_ema, step_ema)
+                if spec is not None:
+                    u = max(len(d) for d in spec.values())
+                else:
+                    u = pick_block(self._ladder, cap, pressed, host_ema,
+                                   step_ema)
                 t_disp = self._clock()
                 host_ema = (1 - _EMA) * host_ema + _EMA * (t_disp - t_host0)
                 try:
-                    backend.dispatch(block=u)
+                    if spec is not None:
+                        backend.dispatch(draft=spec)
+                    else:
+                        backend.dispatch(block=u)
                 except Exception as e:  # noqa: BLE001
                     err = f"{type(e).__name__}: {e}"
                     logger.error("serve decode dispatch failed: %s", err)
@@ -1163,12 +1324,13 @@ class Engine:
                     # its collect closes that window, and the stray
                     # launch must not leak its exec/overlap into the
                     # next one (begin_window's quiescence note)
-                    inflight.append((cohort, u, t_disp, self._log))
+                    inflight.append((cohort, u, t_disp, self._log, spec))
                     self._log.note_dispatch(len(inflight))
             # --- collect launch N while N+1 runs; collect immediately
-            # when nothing was dispatched ahead (tail / no-speculation)
+            # when nothing was dispatched ahead (tail / draft cadence /
+            # nothing worth dispatching)
             if inflight and (len(inflight) > 1 or not dispatched):
-                cohort, u, t_disp, disp_log = inflight[0]
+                cohort, u, t_disp, disp_log, spec_snap = inflight[0]
                 t_wait = self._clock()
                 try:
                     self._chaos_boundary()
@@ -1227,7 +1389,8 @@ class Engine:
                     # closed — its record is already emitted
                     self._apply_step_locked(out, service, len(cohort),
                                             cohort=cohort,
-                                            count_launch=not stale)
+                                            count_launch=not stale,
+                                            spec=spec_snap)
                 t_host0 = self._clock()
                 continue
             # --- idle / exit
@@ -1256,7 +1419,7 @@ class Engine:
         across overlapping snapshots and the slot sweep)."""
         with self._lock:
             now = self._now()
-            for cohort, _u, _t, _lg in inflight:
+            for cohort, _u, _t, _lg, _sp in inflight:
                 for _b, req in cohort:
                     self._finish_locked(req, "error", now, error=error)
             self._log.note_dispatch(0)
@@ -1264,13 +1427,18 @@ class Engine:
         return collections.deque()
 
     def _apply_step_locked(self, out, service_s: float, occupancy: int,
-                           cohort=None, count_launch: bool = True) -> None:
+                           cohort=None, count_launch: bool = True,
+                           spec=None) -> None:
         """Fold one launch's readback into the request lifecycles.
         ``cohort`` (pipelined) is the slot snapshot taken at dispatch:
         tokens belong to THOSE requests — a slot re-assigned between
         dispatch and collect must not leak a previous occupant's tokens
         to the new one (the snapshot discipline); evicted (done)
-        requests just skip."""
+        requests just skip. ``spec`` is the launch's draft snapshot
+        (slot -> proposed tokens, carried like the cohort snapshot):
+        acceptance is judged HERE, against the committed tokens, and the
+        draft table learns from them — the collect boundary is the only
+        place the table is ever written (under this lock)."""
         now = self._now()
         tokens, live, finished = out.tokens, out.live, out.finished
         u = tokens.shape[0]
@@ -1280,7 +1448,38 @@ class Engine:
             if req is None or req.done:
                 continue
             emitted = [int(tokens[i, b]) for i in range(u) if bool(live[i, b])]
+            d = spec.get(b) if spec else None
+            if d:
+                # accepted = the emitted prefix that matched the draft
+                # (the verify launch emits accepted + the one corrected
+                # token, so this is exact, not inferred from counts)
+                acc = 0
+                for t, want in zip(emitted, d):
+                    if t != want:
+                        break
+                    acc += 1
+                rate = acc / len(d)
+                self._log.note_spec(len(d), acc)
+                self._spec_samples += 1  # lint: disable=PTL005 -- caller holds self._lock (_locked contract; non-reentrant Lock)
+                self._spec_cooloff = 0  # lint: disable=PTL005 -- caller holds self._lock (_locked contract)
+                self._spec_ema = (rate if self._spec_samples == 1 else
+                                  (1 - _EMA) * self._spec_ema + _EMA * rate)  # lint: disable=PTL005 -- caller holds self._lock (_locked contract)
+                req.spec_samples += 1
+                req.spec_ema = (rate if req.spec_samples == 1 else
+                                (1 - _EMA) * req.spec_ema + _EMA * rate)
+                if (req.spec_samples >= SPEC_MIN_SAMPLES
+                        and req.spec_ema < SPEC_REQ_OFF):
+                    # per-slot fallback: this request's drafts keep
+                    # missing — stop proposing for it (zero recompiles:
+                    # it rides verify launches as a plain step)
+                    req.spec_off = True
             if emitted:
+                if self._draft is not None:
+                    # collect-boundary table update: context is the
+                    # previously committed tail, so chains span launch
+                    # boundaries without double-counting
+                    self._draft.observe(
+                        emitted, context=req.tokens[-self._draft.order:])
                 if req.t_first_token < 0:
                     # REAL wall-clock TTFT: this readback is the moment
                     # the first token left the device — mid-sequence,

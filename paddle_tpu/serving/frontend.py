@@ -35,7 +35,7 @@ def build_engine(machine, params, *, slots: int = 8,
                  pipeline: bool = True, fused_step: bool = False,
                  shed_policy: str = "off", breaker_threshold: int = 0,
                  breaker_cooldown_s: float = 30.0, hangwatch=None,
-                 on_oom=None):
+                 on_oom=None, spec_tokens="0", slot_dtype: str = "f32"):
     """Wire a :class:`JaxDecodeBackend` + :class:`Engine` for a core
     graph machine (the in-process serving API). Caller starts it.
     ``decode_block`` takes the ladder spelling ("1,2,4,8" or an int);
@@ -47,7 +47,10 @@ def build_engine(machine, params, *, slots: int = 8,
     disables), ``hangwatch`` a started-by-the-engine
     :class:`~paddle_tpu.serving.resilience.ServeHangWatch`, ``on_oom``
     the RESOURCE_EXHAUSTED handler (`paddle serve` installs the
-    pre-mortem + exit-20 one)."""
+    pre-mortem + exit-20 one). ``spec_tokens`` is the speculative
+    draft-length ladder ("0" = off) and ``slot_dtype`` the slot-state
+    storage dtype (f32|bf16) — doc/serving.md "Speculative decode" /
+    "Reduced-precision slot state"."""
     from paddle_tpu.serving.engine import Engine
     from paddle_tpu.serving.jax_backend import JaxDecodeBackend
     from paddle_tpu.serving.resilience import CircuitBreaker
@@ -56,6 +59,7 @@ def build_engine(machine, params, *, slots: int = 8,
         machine, params, slots=slots, prompt_tokens=prompt_tokens,
         max_length=max_length, decode_block=decode_block, registry=registry,
         pipeline=pipeline, fused_step=fused_step,
+        spec_tokens=spec_tokens, slot_dtype=slot_dtype,
     )
     breaker = (CircuitBreaker(breaker_threshold, breaker_cooldown_s)
                if breaker_threshold > 0 else None)
@@ -255,6 +259,8 @@ def main(rest: List[str]) -> int:
             breaker_cooldown_s=FLAGS.serve_breaker_cooldown,
             hangwatch=hangwatch,
             on_oom=_on_oom,
+            spec_tokens=FLAGS.serve_spec_tokens,
+            slot_dtype=FLAGS.serve_slot_dtype,
         )
     except (UnsupportedModelError, ValueError) as e:
         print(f"error: {e}", file=sys.stderr)
@@ -287,7 +293,9 @@ def main(rest: List[str]) -> int:
     print(f"# paddle serve: {engine.slots} slot(s), max_length "
           f"{engine.max_length}, decode blocks {FLAGS.serve_decode_block}, "
           f"pipeline {'on' if FLAGS.serve_pipeline else 'off'}"
-          f"{', fused step' if FLAGS.serve_fused_step else ''} — "
+          f"{', fused step' if FLAGS.serve_fused_step else ''}"
+          f"{', spec ' + FLAGS.serve_spec_tokens if FLAGS.serve_spec_tokens not in ('', '0') else ''}"
+          f"{', slot dtype ' + FLAGS.serve_slot_dtype if FLAGS.serve_slot_dtype != 'f32' else ''} — "
           "reading JSONL requests from stdin", file=sys.stderr)
 
     drain = cc.Event()

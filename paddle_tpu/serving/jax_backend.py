@@ -25,6 +25,26 @@ routed through the PR-7 :class:`CompileRegistry`:
   and recompiles stay 0 across the ladder by construction — stronger
   than one pre-warmed signature per rung, which would show up as
   ``recompiles>0`` group churn in the compile telemetry.
+- launch group ``serve_verify`` (PR 20, ``--serve_spec_tokens``) — the
+  speculative draft-and-verify launch: ONE ``[K, B]`` signature for the
+  whole speculation ladder (draft length ``k`` is a traced scalar, the
+  draft buffer is sized to the ladder's top rung, same discipline as
+  ``serve_decode``). Each step scores the next position with the real
+  model; a slot keeps advancing while its drafted token matches the
+  model's own greedy argmax, and the first mismatching step's token is
+  the model's CORRECTION — it commits too, riding free. Every emitted
+  token is therefore the model's own greedy output: exact parity with
+  plain decode, unconditionally. Slots whose draft misses at once (or
+  that proposed nothing) advance exactly one plain step.
+
+Reduced-precision slot state (PR 20, ``--serve_slot_dtype=bf16``):
+float slot buffers (captured statics + GRU carries) are STORED in
+bfloat16 — halving per-slot HBM so ``--serve_slots`` doubles at fixed
+footprint — while every step still COMPUTES in f32: statics upcast once
+per launch outside the fori_loop, carries upcast before and downcast
+after EVERY micro-step inside it. Rounding once per micro-step (not
+once per launch) keeps the token stream identical across decode-block
+rungs, so the cross-rung golden tests still hold under bf16.
 
 ``dispatch()`` enqueues the decode launch and immediately starts
 ``copy_to_host_async`` on its token/live/finished outputs — the PR-5
@@ -48,7 +68,13 @@ from typing import Any, List, Optional, Sequence, Union
 
 import numpy as np
 
-from paddle_tpu.serving.backend import StepOut, parse_decode_blocks
+from paddle_tpu.serving.backend import (
+    DraftBatch,
+    StepOut,
+    parse_decode_blocks,
+    parse_slot_dtype,
+    parse_spec_tokens,
+)
 from paddle_tpu.utils import concurrency as cc
 
 
@@ -60,17 +86,21 @@ class UnsupportedModelError(RuntimeError):
 class JaxDecodeBackend:
     GROUP_DECODE = "serve_decode"
     GROUP_PREFILL = "serve_prefill"
+    GROUP_VERIFY = "serve_verify"
 
     def __init__(self, machine, params, slots: int, prompt_tokens: int,
                  max_length: Optional[int] = None,
                  decode_block: Union[int, str, Sequence[int]] = 1,
                  registry=None, feed_name: Optional[str] = None,
-                 pipeline: bool = True, fused_step: bool = False):
+                 pipeline: bool = True, fused_step: bool = False,
+                 spec_tokens: Union[int, str, Sequence[int], None] = None,
+                 slot_dtype: str = "f32"):
         import jax
         import jax.numpy as jnp
 
         from paddle_tpu.graph.decode_step import (
             capture_prefill, make_greedy_step, plan_fused_step, plan_of,
+            plan_slot_dtype,
         )
 
         self._jax, self._jnp = jax, jnp
@@ -86,6 +116,15 @@ class JaxDecodeBackend:
                               plan.max_length)
         self.decode_blocks = parse_decode_blocks(decode_block)
         self.max_block = self.decode_blocks[-1]
+        self.spec_blocks = parse_spec_tokens(spec_tokens)
+        self.max_spec = self.spec_blocks[-1] if self.spec_blocks else 0
+        self.slot_dtype = parse_slot_dtype(slot_dtype)
+        slot_plan, why = plan_slot_dtype(self.slot_dtype)
+        if slot_plan is None:
+            raise UnsupportedModelError(why)
+        self._store_dtype = (jnp.dtype(slot_plan["store_dtype"])
+                             if slot_plan["store_dtype"] else None)
+        self.parity_tol = float(slot_plan["parity_tol"])
         self.pipeline = bool(pipeline)
         self._registry = registry
         # exec attribution gate: warmup flips it on; callers measuring
@@ -105,7 +144,8 @@ class JaxDecodeBackend:
         self._capture = capture_prefill
         fused_plan = None
         if fused_step:
-            fused_plan, why = plan_fused_step(machine, plan)
+            fused_plan, why = plan_fused_step(machine, plan,
+                                              slot_dtype=self.slot_dtype)
             if fused_plan is None:
                 raise UnsupportedModelError(
                     f"--serve_fused_step: {why} (the unfused per-step "
@@ -115,6 +155,8 @@ class JaxDecodeBackend:
         self._step = make_greedy_step(machine, plan, fused_plan=fused_plan)
         self._prefill_jit = jax.jit(self._prefill_write, donate_argnums=(1,))
         self._decode_jit = jax.jit(self._decode, donate_argnums=(1,))
+        self._verify_jit = (jax.jit(self._verify, donate_argnums=(1,))
+                            if self.max_spec else None)
         self._state = self._fresh_state()
         # dispatched-but-uncollected decode launches: (device arrays
         # with host copies in flight, block, dispatch wall time)
@@ -163,6 +205,35 @@ class JaxDecodeBackend:
                 budgets.astype(jnp.int32), mode="drop"),
         }
 
+    # --------------------------------------- reduced-precision slot state
+    # Under --serve_slot_dtype=bf16 the slot buffers are STORED in bf16
+    # but every step COMPUTES in f32: statics upcast once per launch
+    # (outside the fori_loop), carries upcast before / downcast after
+    # every micro-step inside it — the per-micro-step rounding point
+    # keeps token streams identical across decode-block rungs. Under
+    # f32 all three helpers are identity (same jaxpr as PR 12).
+
+    def _statics_compute(self, statics):
+        if self._store_dtype is None:
+            return statics
+        jax, jnp = self._jax, self._jnp
+        up = lambda x: (x.astype(jnp.float32)
+                        if x.dtype == self._store_dtype else x)
+        return jax.tree_util.tree_map(up, statics)
+
+    def _carries_compute(self, carries):
+        if self._store_dtype is None:
+            return carries
+        jnp = self._jnp
+        return tuple(c.astype(jnp.float32)
+                     if c.dtype == self._store_dtype else c for c in carries)
+
+    def _carries_store(self, like, carries):
+        if self._store_dtype is None:
+            return carries
+        return tuple(c.astype(o.dtype) if c.dtype != o.dtype else c
+                     for o, c in zip(like, carries))
+
     def _decode(self, params, state, u):
         """One iteration: ``u`` greedy micro-steps over all slots,
         EOS/budget termination on device. ``u`` is a TRACED scalar: the
@@ -171,12 +242,14 @@ class JaxDecodeBackend:
         jax, jnp = self._jax, self._jnp
         um, B = self.max_block, self.slots
         budget = state["budget"]
+        statics = self._statics_compute(state["statics"])
 
         def body(i, acc):
             carries, prev, fin, steps, toks, lives = acc
             live = ~fin
-            carries, tok, fin = self._step(params, state["statics"], carries,
-                                           prev, fin)
+            cf = self._carries_compute(carries)
+            cf, tok, fin = self._step(params, statics, cf, prev, fin)
+            carries = self._carries_store(carries, cf)
             steps = steps + live.astype(jnp.int32)
             fin = fin | (steps >= budget)
             return (carries, tok, fin, steps,
@@ -191,11 +264,63 @@ class JaxDecodeBackend:
                          finished=fin, steps=steps)
         return new_state, toks, lives, fin
 
+    def _verify(self, params, state, draft, dlen, k):
+        """The speculative verify launch: up to ``k`` greedy micro-steps
+        per slot, where a slot stays live only while its drafted token
+        keeps matching the model's own argmax (the first mismatching
+        step emits the model's corrected token, then the slot freezes
+        for the rest of the launch). ``k`` is a TRACED scalar bound like
+        ``_decode``'s ``u`` — the whole speculation ladder shares one
+        compiled executable (draft buffer sized to the top rung).
+
+        ``draft [K, B]`` int32, ``dlen [B]`` int32 (0 = no proposal: the
+        slot takes exactly one plain greedy step). Every emitted token
+        is the model's own greedy output — exact parity with plain
+        decode. ``prev_tok`` must end as the last token each slot truly
+        COMMITTED, so it is tracked separately from the step feed (a
+        frozen row's eos emission must not pollute it)."""
+        jax, jnp = self._jax, self._jnp
+        km, B = self.max_spec, self.slots
+        budget = state["budget"]
+        statics = self._statics_compute(state["statics"])
+
+        def body(i, acc):
+            (carries, prev, committed, fin, steps, accepting,
+             toks, lives) = acc
+            # a slot is dead for this launch once finished OR once its
+            # draft diverged (the correction already committed)
+            dead = fin | ~accepting
+            live = ~dead
+            cf = self._carries_compute(carries)
+            cf, tok, _sf = self._step(params, statics, cf, prev, dead)
+            carries = self._carries_store(carries, cf)
+            steps = steps + live.astype(jnp.int32)
+            # real termination comes only from live rows: eos emission
+            # or the budget bound (frozen rows emit eos score-free)
+            fin = fin | (live & (tok == self._plan.eos)) | (steps >= budget)
+            committed = jnp.where(live, tok, committed)
+            accepting = live & (i < dlen) & (tok == draft[i])
+            return (carries, tok, committed, fin, steps, accepting,
+                    toks.at[i].set(tok), lives.at[i].set(live))
+
+        init = (state["carries"], state["prev_tok"], state["prev_tok"],
+                state["finished"], state["steps"],
+                jnp.ones((B,), bool),
+                jnp.zeros((km, B), jnp.int32), jnp.zeros((km, B), bool))
+        (carries, _prev, committed, fin, steps, _acc, toks,
+         lives) = jax.lax.fori_loop(
+            0, jnp.minimum(jnp.maximum(k, 1), km), body, init)
+        new_state = dict(state, carries=carries, prev_tok=committed,
+                         finished=fin, steps=steps)
+        return new_state, toks, lives, fin
+
     # ------------------------------------------------------- fresh state
 
     def _fresh_state(self):
         """Zeroed slot buffers, every slot finished (frozen). Shapes come
-        from eval_shape of the capture — no compile, no launch."""
+        from eval_shape of the capture — no compile, no launch. Float
+        buffers land in the slot storage dtype (bf16 halves them; the
+        prefill scatter's ``astype(dst.dtype)`` downcasts admissions)."""
         jax, jnp = self._jax, self._jnp
         B, T = self.slots, self.prompt_tokens
         ids = jnp.zeros((B, T), jnp.int32)
@@ -205,7 +330,14 @@ class JaxDecodeBackend:
                                           self._feed(i, l)),
             self.params, ids, lens,
         )
-        zeros = lambda sd: jnp.zeros(sd.shape, sd.dtype)
+        store = self._store_dtype
+
+        def zeros(sd):
+            dt = sd.dtype
+            if store is not None and jnp.issubdtype(dt, jnp.floating):
+                dt = store
+            return jnp.zeros(sd.shape, dt)
+
         return {
             "statics": jax.tree_util.tree_map(zeros, statics_sd),
             "carries": tuple(zeros(sd) for sd in boots_sd),
@@ -240,12 +372,20 @@ class JaxDecodeBackend:
         )
         for u in self.decode_blocks:
             self.step(block=u)
+        # the speculation ladder warms through the SAME one serve_verify
+        # signature (traced k bound): every rung launches once over the
+        # all-finished state — zero slot effects, recompiles=0 after
+        for kk in self.spec_blocks:
+            self.step(draft={0: [0] * kk})
         if self._registry is not None:
             # warmup launches never reach note_exec (serving is off), so
             # the registry's pending compile-cost deduction would zero
             # the FIRST real launch's exec time instead — discard it
             self._registry.drop_pending(self.GROUP_PREFILL, self._sig_prefill())
             self._registry.drop_pending(self.GROUP_DECODE, self._sig_decode())
+            if self.spec_blocks:
+                self._registry.drop_pending(self.GROUP_VERIFY,
+                                            self._sig_verify())
         self._warmed = True
         self.serving = True
 
@@ -270,6 +410,19 @@ class JaxDecodeBackend:
 
     def _sig_decode(self):
         return (self.slots, self.prompt_tokens, self.max_block)
+
+    def _sig_verify(self):
+        return (self.slots, self.prompt_tokens, self.max_spec)
+
+    def slot_state_bytes(self) -> int:
+        """Stored decode-state bytes per slot (captured statics, GRU
+        carries, the scalar rows) — the weights-free numerator behind
+        the ``slot_bytes`` bench stamp. Cross-checked against
+        ``memory_analysis()`` argument bytes in tests: halving this is
+        what lets ``--serve_slots`` double at fixed footprint."""
+        leaves = self._jax.tree_util.tree_leaves(self._state)
+        total = sum(int(l.size) * int(l.dtype.itemsize) for l in leaves)
+        return total // self.slots
 
     def admit(self, slot_ids: Sequence[int], requests: Sequence[Any],
               budgets: Sequence[int]) -> None:
@@ -309,28 +462,51 @@ class JaxDecodeBackend:
             self._registry.note_exec(self.GROUP_PREFILL, key,
                                      cc.perf_counter() - t0)
 
-    def dispatch(self, block: Optional[int] = None) -> None:
+    def dispatch(self, block: Optional[int] = None,
+                 draft: Optional[DraftBatch] = None) -> None:
         """Enqueue one decode launch and start the device->host copies
         of its outputs — no waiting. Every output's copy is on the wire
         before anyone collects (the PR-5 all-dispatch-then-collect
-        snapshot discipline)."""
+        snapshot discipline). With ``draft`` (slot -> proposed tokens)
+        the launch is the ``serve_verify`` draft-and-verify step instead
+        of a plain decode block."""
         jnp = self._jnp
-        u = int(block) if block else self.max_block
         t0 = cc.perf_counter()
-        args = (self.params, self._state, jnp.asarray(u, jnp.int32))
-        if self._registry is not None:
-            out = self._registry.call(
-                self.GROUP_DECODE, self._sig_decode(), self._decode_jit,
-                *args)
+        if draft:
+            if not self.max_spec:
+                raise RuntimeError(
+                    "draft dispatch on a backend with no speculation "
+                    "ladder (spec_tokens unset)")
+            km, B = self.max_spec, self.slots
+            d = np.zeros((km, B), np.int32)
+            dl = np.zeros((B,), np.int32)
+            for b, toks in draft.items():
+                t = [int(x) for x in toks][:km]
+                if t:
+                    dl[int(b)] = len(t)
+                    d[:len(t), int(b)] = t
+            k = max(int(dl.max()), 1)
+            group, key, fn = self.GROUP_VERIFY, self._sig_verify(), \
+                self._verify_jit
+            args = (self.params, self._state, jnp.asarray(d),
+                    jnp.asarray(dl), jnp.asarray(k, jnp.int32))
+            u = k
         else:
-            out = self._decode_jit(*args)
+            u = int(block) if block else self.max_block
+            group, key, fn = self.GROUP_DECODE, self._sig_decode(), \
+                self._decode_jit
+            args = (self.params, self._state, jnp.asarray(u, jnp.int32))
+        if self._registry is not None:
+            out = self._registry.call(group, key, fn, *args)
+        else:
+            out = fn(*args)
         self._state, toks, lives, fin = out
         for arr in (toks, lives, fin):
             try:
                 arr.copy_to_host_async()
             except AttributeError:  # non-PJRT array stand-ins (tests)
                 break
-        self._inflight.append((toks, lives, fin, u, t0))
+        self._inflight.append((toks, lives, fin, u, t0, group, key))
 
     @property
     def inflight(self) -> int:
@@ -349,7 +525,7 @@ class JaxDecodeBackend:
                 "serve_decode collect() with no launch in flight "
                 "(dispatch/collect pairing broken)"
             )
-        toks, lives, fin, u, t_disp = self._inflight.popleft()
+        toks, lives, fin, u, t_disp, group, key = self._inflight.popleft()
         t_rb0 = cc.perf_counter()
         toks_np = np.asarray(toks)
         lives_np = np.asarray(lives)
@@ -365,11 +541,11 @@ class JaxDecodeBackend:
             # while N ran, so anchoring at max(dispatch, previous done)
             # keeps summed exec seconds <= wall seconds
             span = max(t_done - max(t_disp, self._exec_anchor), 0.0)
-            self._registry.note_exec(self.GROUP_DECODE, self._sig_decode(),
-                                     span, batches=u)
+            self._registry.note_exec(group, key, span, batches=u)
         self._exec_anchor = max(self._exec_anchor, t_done)
         return StepOut(tokens=toks_np, live=lives_np, finished=fin_np)
 
-    def step(self, block: Optional[int] = None) -> StepOut:
-        self.dispatch(block=block)
+    def step(self, block: Optional[int] = None,
+             draft: Optional[DraftBatch] = None) -> StepOut:
+        self.dispatch(block=block, draft=draft)
         return self.collect()

@@ -183,6 +183,19 @@ class _Flags:
     serve_decode_block: str = "1"
     serve_pipeline: bool = True
     serve_fused_step: bool = False
+    # speculative decode + slot-state precision (doc/serving.md
+    # "Speculative decode" / "Reduced-precision slot state"):
+    # serve_spec_tokens is the draft-length LADDER — max draft tokens
+    # per verify launch, a single int or comma list like "2,4" the
+    # engine's acceptance-EMA policy picks from ("0" disables; drafts
+    # come from a host-side n-gram table fed by committed tokens, ONE
+    # fused serve_verify signature covers the whole ladder, greedy
+    # output is bit-identical to plain decode); serve_slot_dtype
+    # stores GRU carries + captured statics in f32 or bf16 (compute
+    # stays f32 — bf16 roughly halves per-slot HBM so --serve_slots
+    # can double at fixed footprint, token parity within tolerance)
+    serve_spec_tokens: str = "0"
+    serve_slot_dtype: str = "f32"
     # serving resilience (doc/resilience.md "Serving resilience"):
     # serve_hang_timeout — no collect-boundary progress for this many
     # seconds dumps serve_hang_report.json (thread stacks + in-flight

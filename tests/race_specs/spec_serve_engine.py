@@ -28,7 +28,15 @@ over the deterministic FakeBackend under explored interleavings:
    exactly once with a legal terminal outcome. The frontend's journal
    discipline rides along: every submitted rid has its journal accept
    line appended (flushed + fsynced) BEFORE the submit — read back and
-   asserted after the drain.
+   asserted after the drain;
+6. speculative decode (PR-20): a spec-enabled engine proposes drafts
+   from its DraftTable and feeds acceptance back into the per-engine /
+   per-request EMAs while client threads submit and cancel
+   concurrently — the table's observe (at collect, under the lock)
+   races later proposes, and a cancel landing between a verify
+   dispatch and its collect must drop that slot's draft outcome
+   cleanly. Invariants: exact greedy parity (token streams equal the
+   spec-off reference), accepted <= proposed, EMAs stay in [0, 1].
 
 The pipelined loop adds a new shared hand-off: each dispatched launch
 carries a SNAPSHOT of its slot cohort, applied at collect while
@@ -245,3 +253,53 @@ def _run(ctx, pipeline=True):
         accepted = {json.loads(l)["id"] for l in f if l.strip()
                     and json.loads(l).get("op") == "accept"}
     assert set(futs4) <= accepted, (set(futs4), accepted)
+
+    # --- phase 6: speculative decode — draft-table updates (observe at
+    # collect) race proposes and the acceptance EMAs, while a cancel
+    # lands between a verify dispatch and its collect
+    def periodic(rid, i):
+        return (11, 12, 13)[i % 3]  # repetitive: drafts DO get accepted
+
+    ref_be = FakeBackend(slots=2, max_length=8, token_fn=periodic)
+    ref_eng = Engine(ref_be, request_timeout_s=30.0, idle_poll_s=0.2,
+                     pipeline=pipeline)
+    ref_eng.start()
+    ref = ref_eng.submit([2, 3], max_new_tokens=4, rid="ref").result(
+        timeout=120.0)
+    assert ref_eng.drain(timeout=120.0)
+
+    backend5 = FakeBackend(slots=2, max_length=8, token_fn=periodic,
+                           step_delay_s=0.01, spec_tokens="2")
+    engine5 = Engine(backend5, request_timeout_s=30.0, idle_poll_s=0.2,
+                     pipeline=pipeline)
+    ctx.static_watch(engine5)
+    doubles5 = _watchful_futures(ctx, engine5)
+    engine5.start()
+    futs5 = {}
+
+    def spec_client(tag, n):
+        for i in range(n):
+            rid = f"{tag}{i}"
+            fut = engine5.submit([2, 3], max_new_tokens=4, rid=rid)
+            with flock:
+                futs5[rid] = (fut, 4)
+
+    t_f = cc.Thread(target=spec_client, args=("u", 2))
+    t_g = cc.Thread(target=spec_client, args=("v", 2))
+    t_f.start()
+    t_g.start()
+    engine5.cancel("u1")  # may land mid-verify: drop the draft outcome
+    t_f.join()
+    t_g.join()
+    assert engine5.drain(timeout=120.0), "spec drain did not terminate"
+    for rid, (fut, _budget) in futs5.items():
+        res = fut.result(timeout=1.0)
+        if res.outcome == "ok" and rid != "u1":
+            # exact greedy parity under every interleaving: speculation
+            # must never change WHAT was generated
+            assert res.tokens == ref.tokens, (rid, res.tokens, ref.tokens)
+    _check_all(futs5, doubles5)
+    # acceptance accounting stayed consistent under the races
+    assert 0.0 <= engine5._spec_ema <= 1.0, engine5._spec_ema
+    for snap in backend5.spec_drafts:
+        assert all(len(d) <= 2 for d in snap.values()), snap

@@ -1,0 +1,606 @@
+"""Speculative decode + reduced-precision slot state (PR 20,
+doc/serving.md "Speculative decode" / "Reduced-precision slot state"):
+
+- DraftTable / parse_spec_tokens / pick_spec_k units,
+- FakeBackend verify-launch semantics (full accept, first-mismatch
+  correction, empty-draft plain step, budget/EOS mid-draft),
+- exact greedy parity: spec-on == spec-off across the draft ladder,
+  BOTH scheduler loops, on seeded ``schedule_requests`` workloads —
+  including an adversarial low-acceptance stream (the EMA fallback),
+- acceptance-EMA adaptation: collapse turns speculation off per engine
+  and per request with ZERO backend reconfiguration, re-probe resumes,
+- speculation telemetry: ``note_spec`` counters, ``accept_rate`` on
+  the serve_window record, the serve-report accept column,
+- ``paddle compare``: accept_rate (zero-filled, higher-is-better) and
+  slot_bytes (lower-is-better) join the rung verdict surface,
+- the device-modeled A/B: with verify positions cheaper than plain
+  micro-steps (batched vocab scoring — the TPU justification, PR-13
+  device-modeling precedent), spec-on beats spec-off on goodput at an
+  overload rung and `paddle compare` says IMPROVED,
+- jax backend: serve_verify parity + one-signature recompiles=0 across
+  the K ladder; bf16 slot state token parity within tolerance and ~2x
+  slots at fixed memory_analysis arg footprint.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from paddle_tpu.observability import metrics as obs
+from paddle_tpu.observability import serving as slog
+from paddle_tpu.serving import (
+    DraftTable,
+    Engine,
+    FakeBackend,
+    drive_rung,
+    parse_slot_dtype,
+    parse_spec_tokens,
+    pick_spec_k,
+)
+from paddle_tpu.serving.engine import (
+    SPEC_EMA_FULL,
+    SPEC_EMA_OFF,
+    SPEC_MIN_SAMPLES,
+)
+from paddle_tpu.utils import concurrency as cc
+
+pytestmark = pytest.mark.serve
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    obs.registry().reset()
+    yield
+    obs.configure("")
+
+
+# ------------------------------------------------------------------ units
+
+
+def test_parse_spec_tokens():
+    assert parse_spec_tokens(None) == ()
+    assert parse_spec_tokens(0) == ()
+    assert parse_spec_tokens("0") == ()
+    assert parse_spec_tokens("") == ()
+    assert parse_spec_tokens(4) == (4,)
+    assert parse_spec_tokens("4,2,4") == (2, 4)
+    assert parse_spec_tokens([8, 1]) == (1, 8)
+    # rungs < 1 drop (not clamp): "0,4" means a 1-rung ladder, not (1, 4)
+    assert parse_spec_tokens("0,4") == (4,)
+
+
+def test_parse_slot_dtype():
+    assert parse_slot_dtype(None) == "f32"
+    assert parse_slot_dtype("f32") == "f32"
+    assert parse_slot_dtype(" BF16 ") == "bf16"
+    with pytest.raises(ValueError, match="serve_slot_dtype"):
+        parse_slot_dtype("fp8")
+
+
+def test_plan_slot_dtype_layers_on_fused_plan():
+    """The slot-dtype plan is a STORAGE layer: f32 keeps zero tolerance,
+    bf16 carries a nonzero parity tolerance, unknown names refuse with
+    the reason — and the f32-compute refusal of plan_fused_step is
+    untouched (pinned by test_fused_step_refuses_off_template_models)."""
+    from paddle_tpu.graph.decode_step import plan_slot_dtype
+
+    p32, why = plan_slot_dtype("f32")
+    assert why == "" and p32["store_dtype"] is None
+    assert p32["parity_tol"] == 0.0
+    p16, why = plan_slot_dtype("bf16")
+    assert why == "" and p16["store_dtype"] == "bfloat16"
+    assert p16["parity_tol"] > 0.0
+    bad, why = plan_slot_dtype("fp8")
+    assert bad is None and "fp8" in why
+
+
+def test_draft_table_learns_and_proposes():
+    dt = DraftTable()
+    # deterministic period-3 stream: trigram contexts disambiguate it
+    seq = [11, 12, 13] * 5
+    dt.observe(seq)
+    assert dt.propose([11, 12], 3) == [13, 11, 12]
+    assert dt.propose([12, 13], 2) == [11, 12]
+    # unseen context: no proposal, never a guess
+    assert dt.propose([99, 98], 4) == []
+    # empty context (stream opening): the most common first token
+    assert dt.propose([], 1) == [11]
+
+
+def test_draft_table_observe_context_no_double_count():
+    """observe(tokens, context=...) counts only transitions whose
+    successor is inside ``tokens`` — re-observing the boundary with the
+    committed context must not double-count interior transitions."""
+    dt = DraftTable()
+    dt.observe([1, 2, 3])
+    n0 = len(dt)
+    dt.observe([4], context=[2, 3])  # boundary: (2,3)->4, (3,)->4 only
+    assert dt.propose([2, 3], 1) == [4]
+    assert len(dt) > n0
+
+
+def test_draft_table_lru_bound():
+    dt = DraftTable(max_contexts=8)
+    for i in range(100):
+        dt.observe([i, i + 1, i + 2])
+    assert len(dt) <= 8
+
+
+def test_pick_spec_k_policy():
+    ladder = (2, 4, 8)
+    # unmeasured: probe the bottom rung
+    assert pick_spec_k(ladder, 0.0, 0) == 2
+    assert pick_spec_k(ladder, 0.0, SPEC_MIN_SAMPLES - 1) == 2
+    # collapsed acceptance: plain decode, zero recompiles by construction
+    assert pick_spec_k(ladder, SPEC_EMA_OFF - 0.01, 100) == 0
+    # confident: the top rung
+    assert pick_spec_k(ladder, SPEC_EMA_FULL, 100) == 8
+    assert pick_spec_k(ladder, 1.0, 100) == 8
+    # in between: monotone interpolation across the ladder
+    ks = [pick_spec_k(ladder, e, 100) for e in (0.25, 0.45, 0.7)]
+    assert ks == sorted(ks) and all(k in ladder for k in ks)
+    assert pick_spec_k((), 1.0, 100) == 0
+
+
+# ------------------------------------------- FakeBackend verify semantics
+
+
+def _admitted(be, budgets, rids=None):
+    rids = rids or [f"r{i}" for i in range(len(budgets))]
+    reqs = [slog.Request(rid=r, t_enqueue=0.0, prompt=[2]) for r in rids]
+    be.admit(list(range(len(reqs))), reqs, budgets)
+    return reqs
+
+
+def test_fake_verify_full_accept_and_mismatch():
+    # scripted stream: 11, 12, 13, 11, ...
+    be = FakeBackend(slots=2, max_length=16, eos=1,
+                     token_fn=lambda rid, i: (11, 12, 13)[i % 3],
+                     spec_tokens="4")
+    _admitted(be, [8, 8])
+    # slot 0 drafts the true stream (full accept: exactly K tokens);
+    # slot 1 drafts wrong at position 1 (commits draft[0] + correction)
+    out = be.step(draft={0: [11, 12, 13, 11], 1: [11, 99, 13, 11]})
+    assert be.verify_launches == 1
+    t0 = [int(out.tokens[u, 0]) for u in range(4) if out.live[u, 0]]
+    t1 = [int(out.tokens[u, 1]) for u in range(4) if out.live[u, 1]]
+    assert t0 == [11, 12, 13, 11]       # K accepted
+    assert t1 == [11, 12]               # 1 accepted + corrected rides free
+    # slot without a draft advances exactly one plain step
+    out2 = be.step(draft={0: [12, 13]})
+    t1b = [int(out2.tokens[u, 1]) for u in range(out2.tokens.shape[0])
+           if out2.live[u, 1]]
+    assert t1b == [13]
+
+
+def test_fake_verify_budget_lands_mid_draft():
+    be = FakeBackend(slots=1, max_length=16, eos=1,
+                     token_fn=lambda rid, i: (11, 12, 13)[i % 3],
+                     spec_tokens="4")
+    _admitted(be, [2])
+    out = be.step(draft={0: [11, 12, 13, 11]})
+    toks = [int(out.tokens[u, 0]) for u in range(4) if out.live[u, 0]]
+    assert toks == [11, 12] and bool(out.finished[0])
+
+
+def test_fake_verify_eos_mid_draft():
+    be = FakeBackend(slots=1, max_length=16, eos=12,
+                     token_fn=lambda rid, i: (11, 12, 13)[i % 3],
+                     spec_tokens="4")
+    _admitted(be, [8])
+    out = be.step(draft={0: [11, 12, 13]})
+    toks = [int(out.tokens[u, 0]) for u in range(out.tokens.shape[0])
+            if out.live[u, 0]]
+    assert toks == [11, 12] and bool(out.finished[0])
+
+
+# ------------------------------------------------- engine greedy parity
+
+
+def _drive(be, pipeline, reqs, rate=50.0):
+    eng = Engine(be, request_timeout_s=60.0, pipeline=pipeline).start()
+    w = drive_rung(eng, reqs, rate_rps=rate, rung=0)
+    assert eng.drain(timeout=60.0)
+    return eng, w
+
+
+def _tokens_of(be, pipeline, reqs):
+    eng = Engine(be, request_timeout_s=60.0, pipeline=pipeline).start()
+    futs = [eng.submit(r.prompt or [2], max_new_tokens=r.max_new or 6,
+                       rid=r.rid) for r in reqs]
+    toks = [tuple(f.result(timeout=60.0).tokens) for f in futs]
+    assert eng.drain(timeout=60.0)
+    return toks, eng
+
+
+@pytest.mark.parametrize("token_fn,label", [
+    (lambda rid, i: (11, 12, 13)[i % 3], "high-acceptance periodic"),
+    (lambda rid, i: 2 + (hash((rid, i)) % 97), "adversarial low-acceptance"),
+])
+def test_spec_parity_on_seeded_workload(token_fn, label):
+    """spec-on == spec-off, token for token, across the draft ladder and
+    BOTH scheduler loops, on the seeded schedule_requests workload —
+    speculation must never change WHAT is generated, only how fast."""
+    rng = np.random.RandomState(9)
+    reqs = slog.schedule_requests(
+        200.0, 12, seed=9,
+        prompt_fn=lambda r, i: r.randint(2, 40, size=r.randint(1, 4)).tolist(),
+        budget_fn=lambda r, i: 2 + int(r.randint(0, 6)))
+    golden = None
+    for spec in (None, "2", "2,4"):
+        for pipeline in (False, True):
+            be = FakeBackend(slots=3, max_length=16, eos=1,
+                             token_fn=token_fn, spec_tokens=spec)
+            toks, _eng = _tokens_of(be, pipeline, reqs)
+            if golden is None:
+                golden = toks
+            assert toks == golden, (label, spec, pipeline)
+
+
+def test_spec_parity_under_cancel_timeout_and_fault():
+    """The cancel/timeout/fault paths with speculation on: surviving
+    requests still match the spec-off stream, faults error the cohort
+    exactly once, and the engine keeps speculating afterwards."""
+    periodic = lambda rid, i: (11, 12, 13)[i % 3]
+    for pipeline in (False, True):
+        # fault at the 3rd launch, spec on: cohort errors, engine lives
+        be = FakeBackend(slots=2, max_length=16, eos=1, token_fn=periodic,
+                         spec_tokens="2", fail_at_launch=3)
+        eng = Engine(be, request_timeout_s=30.0, pipeline=pipeline).start()
+        futs = [eng.submit([2, 3], max_new_tokens=6, rid=f"f{i}")
+                for i in range(4)]
+        res = [f.result(timeout=60.0) for f in futs]
+        assert {r.outcome for r in res} <= {"ok", "error"}
+        # post-fault requests complete and match plain greedy
+        fut = eng.submit([2, 3], max_new_tokens=6, rid="after")
+        after = fut.result(timeout=60.0)
+        assert after.outcome == "ok"
+        assert after.tokens == [11, 12, 13, 11, 12, 13]
+        # cancel races the verify in flight: terminal outcome either way
+        fut2 = eng.submit([2, 3], max_new_tokens=6, rid="c0")
+        eng.cancel("c0")
+        assert fut2.result(timeout=60.0).outcome in ("ok", "cancelled")
+        assert eng.drain(timeout=60.0)
+
+
+def test_acceptance_ema_fallback_is_recompile_free():
+    """An adversarial stream collapses the acceptance EMA: the engine
+    falls back to plain decode (no further verify launches) WITHOUT any
+    backend reconfiguration — the traced-K signature never changes, so
+    there is nothing to recompile."""
+    be = FakeBackend(slots=2, max_length=32, eos=1,
+                     token_fn=lambda rid, i: 2 + (hash((rid, i)) % 97),
+                     spec_tokens="4")
+    eng = Engine(be, request_timeout_s=60.0).start()
+    for wave in range(3):
+        futs = [eng.submit([2], max_new_tokens=10, rid=f"w{wave}-{i}")
+                for i in range(4)]
+        [f.result(timeout=60.0) for f in futs]
+    assert eng.drain(timeout=60.0)
+    assert eng._spec_ema < SPEC_EMA_OFF
+    stuck = be.verify_launches
+    assert stuck > 0  # it DID probe before collapsing
+    # keep serving plain: verify launches stop growing
+    eng2 = Engine(be, request_timeout_s=60.0)  # same backend object
+    assert be.verify_launches == stuck
+
+
+def test_per_request_spec_off_latch():
+    """One request whose stream defeats the table stops getting drafts
+    (its per-request EMA latches spec_off) while the engine keeps
+    speculating for the others."""
+    def token_fn(rid, i):
+        if rid == "bad":
+            return 2 + (hash((rid, i)) % 97)
+        return (11, 12, 13)[i % 3]
+
+    be = FakeBackend(slots=2, max_length=64, eos=1, token_fn=token_fn,
+                     spec_tokens="2")
+    eng = Engine(be, request_timeout_s=60.0).start()
+    # warm the table with the periodic idiom
+    eng.seed_draft([[11, 12, 13] * 4])
+    good = [eng.submit([2], max_new_tokens=24, rid=f"g{i}") for i in range(1)]
+    bad = eng.submit([3], max_new_tokens=24, rid="bad")
+    [f.result(timeout=60.0) for f in good]
+    bad.result(timeout=60.0)
+    assert eng.drain(timeout=60.0)
+    # drafts were proposed for the good stream well past the point where
+    # the bad request's own EMA latched off
+    slots_drafted = [set(snap) for snap in be.spec_drafts]
+    assert any(len(s) == 1 for s in slots_drafted[-3:]), slots_drafted
+
+
+def test_engine_seed_draft():
+    be = FakeBackend(slots=2, spec_tokens="2")
+    eng = Engine(be)
+    assert eng.seed_draft([[11, 12, 13, 11], []]) == 1
+    assert eng._draft.propose([11, 12], 1) == [13]
+    # spec off: seeding is a cheap no-op
+    be2 = FakeBackend(slots=2)
+    assert Engine(be2).seed_draft([[1, 2, 3]]) == 0
+
+
+# ------------------------------------------------- telemetry and compare
+
+
+def test_note_spec_counters_and_window_record():
+    log = slog.RequestLog(rung=0, offered_rps=4.0, engine="continuous",
+                          pipeline="on", spec="2,4", slot_dtype="bf16")
+    log.note_spec(8, 6)
+    log.note_spec(4, 1)
+    rec = log.window_record(window_s=1.0)
+    assert rec["spec"] == "2,4" and rec["slot_dtype"] == "bf16"
+    assert rec["spec_proposed"] == 12 and rec["spec_accepted"] == 7
+    assert rec["accept_rate"] == round(7 / 12, 4)
+    assert obs.registry().counter("serve.spec_proposed").value == 12
+    assert obs.registry().counter("serve.spec_accepted").value == 7
+    # no speculation: the fields stay off the record entirely
+    rec2 = slog.RequestLog(rung=0, engine="continuous").window_record(1.0)
+    for k in ("spec", "slot_dtype", "spec_proposed", "accept_rate"):
+        assert k not in rec2
+
+
+def test_serve_report_accept_column_and_summary():
+    doc = {
+        "rungs": [
+            {"rung": 0, "offered_rps": 2.0, "arrived": 8, "completed": 8,
+             "engine": "continuous", "goodput_tok_s": 40.0, "bound": "?",
+             "spec": "4", "spec_proposed": 10, "spec_accepted": 8,
+             "accept_rate": 0.8, "slot_dtype": "bf16"},
+        ],
+        "knee_rps": None, "engines": ["continuous"], "pipelines": [],
+        "groups": ["serve_decode", "serve_verify"], "requests": 8,
+        "compiles": 3, "recompiles": 0, "roofline": None,
+        "run_ended": True, "invalid_records": 0,
+    }
+    text = slog.format_report(doc)
+    assert "accept" in text
+    assert "80.0%" in text
+    assert "speculative decode: ladder 4" in text
+    assert "8/10 draft tokens accepted" in text
+    assert "slot state dtype: bf16" in text
+    # serve_verify is a first-class serve group
+    assert "serve_verify" in text
+
+
+def test_serve_groups_include_verify():
+    assert "serve_verify" in slog.SERVE_GROUPS
+
+
+def _bench_line(rungs, **extra):
+    return json.dumps(dict(
+        {"metric": "serve_goodput", "value": max(
+            (r.get("goodput_tok_s", 0.0) for r in rungs), default=0.0),
+         "rungs": rungs}, **extra))
+
+
+def test_compare_learns_accept_rate_and_slot_bytes(tmp_path):
+    from paddle_tpu.observability.compare import compare, load_side
+
+    def rung(rate, goodput, **kw):
+        return dict({"offered_rps": rate, "goodput_tok_s": goodput,
+                     "engine": "continuous", "pipeline": "on"}, **kw)
+
+    # A: pre-PR-20 artifact (no spec fields at all); B: spec-on with
+    # acceptance + a slot_bytes stamp
+    a = tmp_path / "a.json"
+    b = tmp_path / "b.json"
+    a.write_text(_bench_line([rung(2.0, 100.0), rung(8.0, 200.0)]))
+    b.write_text(_bench_line(
+        [rung(2.0, 110.0, spec="4", accept_rate=0.75, slot_bytes=400),
+         rung(8.0, 300.0, spec="4", accept_rate=0.8, slot_bytes=400)],
+        slot_bytes=400))
+    sa, sb = load_side(str(a)), load_side(str(b))
+    # zero-filled on the old side: the keys join and 0 -> N is judged
+    assert sa["serve.2rps.accept_rate"] == 0.0
+    assert sb["serve.2rps.accept_rate"] == 0.75
+    # slot_bytes conditional-only: no phantom key minted on the old side
+    assert "serve.2rps.slot_bytes" not in sa
+    assert sb["serve.2rps.slot_bytes"] == 400.0
+    assert sb["slot_bytes"] == 400.0
+    doc = compare(sa, sb)
+    row = {r["metric"]: r for r in doc["metrics"]}
+    assert row["serve.2rps.accept_rate"]["higher_is_better"] is True
+    assert row["serve.2rps.accept_rate"]["verdict"] == "IMPROVED"
+    assert row["serve.8rps.goodput_tok_s"]["verdict"] == "IMPROVED"
+    assert doc["verdict"] == "IMPROVED"
+
+
+def test_compare_slot_bytes_lower_is_better(tmp_path):
+    from paddle_tpu.observability.compare import compare, load_side
+
+    a = tmp_path / "f32.json"
+    b = tmp_path / "bf16.json"
+    a.write_text(_bench_line([], slot_bytes=800))
+    b.write_text(_bench_line([], slot_bytes=410))
+    doc = compare(load_side(str(a)), load_side(str(b)))
+    row = {r["metric"]: r for r in doc["metrics"]}
+    assert row["slot_bytes"]["higher_is_better"] is False
+    assert row["slot_bytes"]["verdict"] == "IMPROVED"
+
+
+def test_compare_key_qualifies_spec_collision(tmp_path):
+    """A both-configs sweep in ONE artifact (spec-on + spec-off rungs at
+    the same rates) must not diff a config against itself: the second
+    config's rungs pick up the spec qualifier."""
+    from paddle_tpu.observability.compare import load_side
+
+    rungs = [
+        {"offered_rps": 2.0, "goodput_tok_s": 100.0 + 10 * i,
+         "engine": "continuous", "pipeline": "on", "spec": spec}
+        for i, spec in enumerate(["off", "2", "4", "8"])
+    ]
+    p = tmp_path / "both.json"
+    p.write_text(_bench_line(rungs))
+    side = load_side(str(p))
+    # the collision chain walks engine -> pipeline -> spec: the fourth
+    # same-rate rung lands on a spec-qualified key, none is dropped
+    specced = [k for k in side if ".spec-" in k]
+    assert specced, sorted(side)
+    assert len({k for k in side if k.endswith("goodput_tok_s")}) == 4
+
+
+# --------------------------------------------------- device-modeled A/B
+
+
+class DeviceModeledSpecBackend(FakeBackend):
+    """FakeBackend + the device cost model (PR-13 precedent: CPU wall
+    clock can't exhibit device concurrency/batching, so the launch costs
+    are modeled). A plain micro-step pays the full sequential cost (the
+    vocab projection cannot batch: token t+1's input is step t's
+    argmax); a verify position pays only the recurrence — with drafts
+    the inputs are known up front, so the vocab scoring of all K
+    positions batches into one matmul (amortized into the launch
+    floor). That asymmetry IS speculative decoding's win on a real
+    accelerator."""
+
+    LAUNCH_S = 0.002   # dispatch + readback floor, either launch kind
+    STEP_S = 0.002     # plain micro-step: sequential score+select
+    REC_S = 0.0005     # verify position: recurrence only, scoring batched
+
+    def dispatch(self, block=None, draft=None):
+        if draft:
+            u = max((len(t) for t in draft.values()), default=1)
+            cc.sleep(self.LAUNCH_S + self.REC_S * max(u, 1))
+        else:
+            u = max(int(block), 1) if block else self.chunk
+            cc.sleep(self.LAUNCH_S + self.STEP_S * u)
+        super().dispatch(block=block, draft=draft)
+
+
+def _modeled_rung(spec, rate, n=24):
+    periodic = lambda rid, i: (11, 12, 13)[i % 3]
+    be = DeviceModeledSpecBackend(slots=4, max_length=64, eos=1,
+                                  token_fn=periodic, chunk="1,2,4",
+                                  spec_tokens=spec)
+    eng = Engine(be, request_timeout_s=120.0).start()
+    if spec:
+        eng.seed_draft([[11, 12, 13] * 6])
+    reqs = slog.schedule_requests(
+        rate, n, seed=5, prompt_fn=lambda r, i: [2, 3],
+        budget_fn=lambda r, i: 16)
+    w = drive_rung(eng, reqs, rate_rps=rate, rung=0)
+    assert eng.drain(timeout=120.0)
+    return w
+
+
+def test_device_modeled_spec_beats_plain_at_overload():
+    """The measured A/B under the device cost model: at an overload
+    rung (offered far above capacity) spec-on's goodput beats spec-off,
+    the window records a high accept_rate, and `paddle compare` renders
+    the verdict IMPROVED on the goodput key."""
+    from paddle_tpu.observability.compare import compare
+
+    rate = 500.0  # far above modeled capacity: both sides saturate
+    w_off = _modeled_rung(None, rate)
+    w_on = _modeled_rung("4", rate)
+    assert w_on.get("accept_rate", 0.0) > 0.5, w_on
+    assert w_on["goodput_tok_s"] > w_off["goodput_tok_s"] * 1.1, (
+        w_on["goodput_tok_s"], w_off["goodput_tok_s"])
+    doc = compare(
+        {"serve.500rps.goodput_tok_s": w_off["goodput_tok_s"],
+         "serve.500rps.accept_rate": 0.0},
+        {"serve.500rps.goodput_tok_s": w_on["goodput_tok_s"],
+         "serve.500rps.accept_rate": w_on["accept_rate"]},
+    )
+    assert doc["verdict"] == "IMPROVED"
+    assert "serve.500rps.goodput_tok_s" in doc["improvements"]
+
+
+# ------------------------------------------------------- jax backend
+
+
+@pytest.fixture(scope="module")
+def gen_machine():
+    from paddle_tpu.flagship import nmt_gen_config
+    from paddle_tpu.graph import GradientMachine
+    from paddle_tpu.graph.machine import compute_dtype_of
+
+    tc = nmt_gen_config(vocab=50, dim=16, beam_size=1, max_length=8,
+                        dtype="float32", batch_size=2)
+    gm = GradientMachine(tc.model_config,
+                         compute_dtype=compute_dtype_of(tc.opt_config))
+    return gm, gm.init_params(seed=1)
+
+
+def _jax_tokens(gm, params, *, spec=None, slot_dtype="f32", pipeline=False,
+                slots=3, registry=None, n=5, budget=6):
+    from paddle_tpu.serving.jax_backend import JaxDecodeBackend
+
+    be = JaxDecodeBackend(gm, params, slots=slots, prompt_tokens=4,
+                          decode_block="1,2", spec_tokens=spec,
+                          slot_dtype=slot_dtype, registry=registry)
+    eng = Engine(be, request_timeout_s=120.0, pipeline=pipeline).start()
+    futs = [eng.submit([5 + i, 9], max_new_tokens=budget, rid=f"r{i}")
+            for i in range(n)]
+    res = [f.result(timeout=120.0) for f in futs]
+    assert eng.drain(timeout=60.0)
+    assert all(r.outcome == "ok" for r in res), [r.outcome for r in res]
+    return [r.tokens for r in res], be
+
+
+def test_jax_spec_parity_and_verify_recompiles(gen_machine):
+    """serve_verify on device: exact greedy parity across the K ladder
+    and both loops, ONE compiled signature (the traced-k bound), zero
+    recompiles after warmup."""
+    import jax
+
+    from paddle_tpu.observability.compile_log import CompileRegistry
+
+    gm, params = gen_machine
+    golden, _ = _jax_tokens(gm, params)
+    for spec in ("2", "1,3"):
+        for pipeline in (False, True):
+            reg = CompileRegistry(device_kind=jax.devices()[0].device_kind)
+            toks, _be = _jax_tokens(gm, params, spec=spec,
+                                    pipeline=pipeline, registry=reg)
+            assert toks == golden, (spec, pipeline)
+            # ONE serve_verify compile (the warmup's) — serving added none
+            assert reg._group_compiles.get("serve_verify") == 1, (
+                spec, pipeline, reg._group_compiles)
+
+
+def test_jax_bf16_slot_state_parity_and_capacity(gen_machine):
+    """bf16 slot storage: token parity within the plan's tolerance, and
+    the memory_analysis proof — bf16 at DOUBLE the slots fits in the
+    f32 footprint (arg bytes), the capacity the precision bought."""
+    import jax
+
+    from paddle_tpu.observability.compile_log import CompileRegistry
+
+    gm, params = gen_machine
+    f32, be32 = _jax_tokens(gm, params, slot_dtype="f32")
+    bf16, be16 = _jax_tokens(gm, params, slot_dtype="bf16")
+    flat32 = [t for r in f32 for t in r]
+    flat16 = [t for r in bf16 for t in r]
+    mismatches = sum(1 for a, b in zip(flat32, flat16) if a != b)
+    assert mismatches / max(len(flat32), 1) <= be16.parity_tol, (
+        mismatches, len(flat32))
+    # per-slot device state roughly halves
+    assert be16.slot_state_bytes() < 0.62 * be32.slot_state_bytes(), (
+        be16.slot_state_bytes(), be32.slot_state_bytes())
+
+    def arg_bytes(slot_dtype, slots):
+        reg = CompileRegistry(device_kind=jax.devices()[0].device_kind)
+        _jax_tokens(gm, params, slot_dtype=slot_dtype, slots=slots,
+                    registry=reg, n=2, budget=3)
+        row = next(r for r in reg.static_memory_rows()
+                   if r.get("group") == "serve_decode")
+        return row["mem_arg_bytes"]
+
+    f32_b = arg_bytes("f32", 4)
+    bf16_2x = arg_bytes("bf16", 8)
+    # args = params + slots * per-slot state: halving the state pays for
+    # doubling the slots (small tolerance for non-state scalars)
+    assert bf16_2x <= f32_b * 1.05, (bf16_2x, f32_b)
+
+
+def test_jax_spec_with_bf16_combined(gen_machine):
+    """Both tentpole halves together: speculative verify over bf16 slot
+    state still matches the bf16 plain stream exactly."""
+    gm, params = gen_machine
+    plain, _ = _jax_tokens(gm, params, slot_dtype="bf16")
+    spec, be = _jax_tokens(gm, params, spec="2", slot_dtype="bf16")
+    assert spec == plain
+    assert be.slot_dtype == "bf16" and be.spec_blocks == (2,)
